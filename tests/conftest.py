@@ -1,0 +1,25 @@
+"""Test environment: force an 8-device virtual CPU mesh before jax loads.
+
+Mirrors the reference's testing strategy (SURVEY §4): the whole distributed
+surface is exercised in-process — unistore fakes a TiKV cluster in one Go
+process; we fake an 8-chip TPU pod slice with XLA host devices.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_ENABLE_X64"] = "1"
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    import jax
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,146 @@
+"""Chunk format + codec + device marshalling tests.
+
+Oracle pattern mirrors util/chunk/chunk_test.go and codec_test.go.
+"""
+
+import datetime
+from decimal import Decimal
+
+import numpy as np
+import pytest
+
+from tidb_tpu import types as T
+from tidb_tpu.chunk import Chunk, Column, iter_chunks
+from tidb_tpu.chunk.codec import decode_chunk, encode_chunk
+
+
+def make_mixed_chunk():
+    fts = [T.bigint(), T.double(), T.decimal(10, 2), T.varchar(20), T.date()]
+    data = [
+        [1, 2, None, 4, 5],
+        [1.5, None, 2.5, -3.0, 0.0],
+        [Decimal("12.34"), Decimal("-0.01"), None, Decimal("99.99"), Decimal("0")],
+        ["alpha", "beta", None, "", "delta"],
+        ["2024-01-01", None, "1999-12-31", "1970-01-01", "2024-06-30"],
+    ]
+    return Chunk.from_columns_data(fts, data)
+
+
+def test_basic_shape_and_access():
+    ch = make_mixed_chunk()
+    assert ch.num_rows == 5 and ch.num_cols == 5
+    assert ch.row(0) == (1, 1.5, Decimal("12.34"), "alpha",
+                         datetime.date(2024, 1, 1))
+    assert ch.row(1)[1] is None and ch.row(2)[0] is None
+    assert ch.columns[0].null_count == 1
+    assert ch.columns[3].get(2) is None
+
+
+def test_decimal_encoding_is_scaled_int64():
+    col = Column.from_list(T.decimal(10, 2), [Decimal("12.34"), None, 1])
+    assert col.values.dtype == np.int64
+    assert col.values[0] == 1234 and col.values[2] == 100
+    assert col.get(0) == Decimal("12.34") and col.get(1) is None
+
+
+def test_filter_take_concat_slice():
+    ch = make_mixed_chunk()
+    f = ch.filter(np.array([True, False, True, False, True]))
+    assert f.num_rows == 3 and f.row(1)[3] is None
+    t = ch.take(np.array([4, 0]))
+    assert t.row(0)[0] == 5 and t.row(1)[0] == 1
+    c = Chunk.concat([ch, ch])
+    assert c.num_rows == 10 and c.row(7) == ch.row(2)
+    s = ch.slice(1, 3)
+    assert s.num_rows == 2 and s.row(0) == ch.row(1)
+    parts = list(iter_chunks(c, 4))
+    assert [p.num_rows for p in parts] == [4, 4, 2]
+
+
+def test_codec_roundtrip():
+    ch = make_mixed_chunk()
+    buf = encode_chunk(ch)
+    back = decode_chunk(buf, ch.field_types)
+    assert back.rows() == ch.rows()
+
+
+def test_codec_roundtrip_empty_and_allnull():
+    fts = [T.bigint(), T.varchar()]
+    empty = Chunk.from_columns_data(fts, [[], []])
+    assert decode_chunk(encode_chunk(empty), fts).num_rows == 0
+    allnull = Chunk([Column.all_null(fts[0], 3), Column.all_null(fts[1], 3)])
+    back = decode_chunk(encode_chunk(allnull), fts)
+    assert back.rows() == [(None, None)] * 3
+
+
+def test_device_roundtrip():
+    from tidb_tpu.chunk.device import from_device, to_device
+
+    ch = make_mixed_chunk()
+    d = to_device(ch)
+    assert d.capacity == 1024 and int(d.n_rows) == 5
+    mask = np.asarray(d.row_mask())
+    assert mask.sum() == 5 and mask[:5].all()
+    back = from_device(d)
+    assert back.rows() == ch.rows()
+
+
+def test_device_bucketing():
+    from tidb_tpu.chunk.device import bucket_capacity
+
+    assert bucket_capacity(1) == 1024
+    assert bucket_capacity(1024) == 1024
+    assert bucket_capacity(1025) == 2048
+    assert bucket_capacity(100_000) == 131072
+
+
+def test_temporal_types():
+    col = Column.from_list(T.datetime(), ["2024-01-02T03:04:05", None])
+    assert col.get(0) == datetime.datetime(2024, 1, 2, 3, 4, 5)
+    dur = Column.from_list(T.FieldType(T.TypeKind.TIME),
+                           [datetime.timedelta(hours=1)])
+    assert dur.get(0) == datetime.timedelta(hours=1)
+
+
+def test_device_chunk_flows_through_jit():
+    """Dictionaries must not poison the jit cache (pytree aux regression)."""
+    from tidb_tpu.chunk.device import from_device, to_device
+    from tidb_tpu.ops.jax_env import jax, jnp
+
+    @jax.jit
+    def first_col_values(d):
+        return d.columns[0].values + 0
+
+    ch1 = Chunk.from_columns_data([T.bigint(), T.varchar()],
+                                  [[1, 2], ["a", "b"]])
+    ch2 = Chunk.from_columns_data([T.bigint(), T.varchar()],
+                                  [[3, 4], ["x", "y"]])
+    v1 = first_col_values(to_device(ch1))
+    v2 = first_col_values(to_device(ch2))  # second call: cached trace
+    assert int(v1[0]) == 1 and int(v2[0]) == 3
+
+    @jax.jit
+    def identity(d):
+        return d
+
+    out = identity(to_device(ch2))
+    # dictionary is dropped through jit; reattach host-side
+    out.columns[1] = out.columns[1].with_dictionary(
+        np.array(["x", "y"], dtype=object))
+    assert from_device(out).rows() == ch2.rows()
+
+
+def test_fixed_dictionary_miss_decodes_to_null():
+    from tidb_tpu.chunk.device import DeviceChunk, from_device, to_device_column
+    from tidb_tpu.ops.jax_env import jnp
+
+    col = Column.from_list(T.varchar(), ["a", "zzz"])
+    dc = to_device_column(col, 1024, dictionary=np.array(["a", "b"], dtype=object))
+    d = DeviceChunk([dc], jnp.asarray(2, dtype=jnp.int32))
+    assert from_device(d).rows() == [("a",), (None,)]
+
+
+def test_datetime_microsecond_precision_far_future():
+    ft = T.datetime()
+    v = datetime.datetime(9999, 12, 31, 23, 59, 59, 999999)
+    assert ft.decode_value(ft.encode_value(v)) == v

@@ -1,0 +1,35 @@
+"""tidb_tpu — a TPU-native analytical SQL execution framework.
+
+A ground-up, TPU-first re-design of the capabilities of TiDB (the reference
+at /root/reference): an Arrow-like columnar Chunk batch format, a vectorized
+volcano executor (hash aggregation, hash join, sort/TopN, vectorized scalar and
+aggregate expression evaluation), a cost-based planner routing plan subtrees to
+pluggable execution backends, and a distributed execution layer expressed as
+pjit/shard_map partitioning over a TPU mesh instead of MPP gRPC exchanges.
+
+Layer map (mirrors SURVEY.md §1, re-imagined for TPU):
+
+    session/     statement lifecycle (ref: session/session.go)
+    parser/      SQL → AST           (ref: parser/)
+    planner/     logical+physical optimization (ref: planner/)
+    executor/    volcano operators over Chunks (ref: executor/)
+    expression/  scalar + aggregate vectorized eval (ref: expression/)
+    chunk/       columnar batch format (ref: util/chunk/)
+    types/       MySQL-flavoured type system (ref: types/)
+    ops/         the TPU kernel library (jax/XLA/pallas) — the "coprocessor"
+    parallel/    mesh + shard_map exchanges (ref: MPP / store/copr)
+    storage/     in-memory column store w/ region sharding (ref: unistore)
+    catalog/     schema metadata (ref: infoschema/, meta/)
+    utils/       memory tracking, runtime stats (ref: util/memory, execdetails)
+"""
+
+__version__ = "0.1.0"
+
+
+def __getattr__(name):
+    # Lazy: importing tidb_tpu.chunk/types must not pull the whole session
+    # stack (and jax) in.
+    if name == "Session":
+        from tidb_tpu.session import Session
+        return Session
+    raise AttributeError(name)

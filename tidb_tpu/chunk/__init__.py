@@ -1,0 +1,202 @@
+"""Columnar Chunk batch format (ref: util/chunk/chunk.go:36-51, column.go:63-69).
+
+A Chunk is an ordered list of Columns sharing one row count. Each Column is
+a flat numpy array of physical values plus an optional validity bitmap
+(True = not NULL) — the Arrow layout the reference's chunk codec already uses
+(util/chunk/codec.go:43-77: [len][nullCount][nullBitmap][offsets][data]).
+
+Differences from the reference, deliberate and TPU-first:
+  * no varlen offsets buffer — strings live as numpy object arrays host-side
+    and as int32 dictionary codes on device (TPUs cannot chase offsets);
+  * the `sel []int` selection vector (chunk.go:44) is host-side only; on
+    device a selection is a boolean mask fused into downstream kernels;
+  * `requiredRows` pull-hinting is replaced by fixed padded batch buckets so
+    XLA sees a small set of static shapes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from tidb_tpu.types import FieldType, TypeKind
+
+# Default logical batch size (ref: variable.DefMaxChunkSize = 1024). We run much
+# larger batches: TPU kernels amortize launch + transfer over big chunks.
+DEFAULT_CHUNK_SIZE = 65536
+
+
+class Column:
+    """One column: physical values + validity. Immutable by convention."""
+
+    __slots__ = ("ftype", "values", "validity")
+
+    def __init__(self, ftype: FieldType, values: np.ndarray,
+                 validity: Optional[np.ndarray] = None):
+        self.ftype = ftype
+        self.values = values
+        if validity is not None and validity.all():
+            validity = None  # normalize: all-valid → None
+        self.validity = validity
+
+    # ---- constructors ----------------------------------------------------
+    @staticmethod
+    def from_list(ftype: FieldType, data: Sequence) -> "Column":
+        n = len(data)
+        encoded = [ftype.encode_value(v) for v in data]
+        validity = np.array([v is not None for v in encoded], dtype=bool)
+        if ftype.is_varlen:
+            values = np.array([v if v is not None else "" for v in encoded],
+                              dtype=object)
+        else:
+            zero = 0 if ftype.np_dtype.kind in "iu" else 0.0
+            values = np.array([v if v is not None else zero for v in encoded],
+                              dtype=ftype.np_dtype)
+        return Column(ftype, values, None if validity.all() else validity)
+
+    @staticmethod
+    def all_null(ftype: FieldType, n: int) -> "Column":
+        if ftype.is_varlen:
+            values = np.full(n, "", dtype=object)
+        else:
+            values = np.zeros(n, dtype=ftype.np_dtype)
+        return Column(ftype, values, np.zeros(n, dtype=bool))
+
+    # ---- accessors -------------------------------------------------------
+    def __len__(self) -> int:
+        return len(self.values)
+
+    @property
+    def null_count(self) -> int:
+        return 0 if self.validity is None else int((~self.validity).sum())
+
+    def is_null(self, i: int) -> bool:
+        return self.validity is not None and not self.validity[i]
+
+    def get(self, i: int):
+        """Decoded Python value at row i (None for NULL)."""
+        if self.is_null(i):
+            return None
+        return self.ftype.decode_value(self.values[i])
+
+    def valid_mask(self) -> np.ndarray:
+        if self.validity is None:
+            return np.ones(len(self.values), dtype=bool)
+        return self.validity
+
+    # ---- transforms ------------------------------------------------------
+    def take(self, indices: np.ndarray) -> "Column":
+        v = self.values[indices]
+        m = None if self.validity is None else self.validity[indices]
+        return Column(self.ftype, v, m)
+
+    def filter(self, mask: np.ndarray) -> "Column":
+        v = self.values[mask]
+        m = None if self.validity is None else self.validity[mask]
+        return Column(self.ftype, v, m)
+
+    def slice(self, start: int, stop: int) -> "Column":
+        m = None if self.validity is None else self.validity[start:stop]
+        return Column(self.ftype, self.values[start:stop], m)
+
+    @staticmethod
+    def concat(cols: Sequence["Column"]) -> "Column":
+        assert cols
+        ftype = cols[0].ftype
+        values = np.concatenate([c.values for c in cols])
+        if all(c.validity is None for c in cols):
+            validity = None
+        else:
+            validity = np.concatenate([c.valid_mask() for c in cols])
+        return Column(ftype, values, validity)
+
+    def to_pylist(self) -> list:
+        return [self.get(i) for i in range(len(self))]
+
+
+@dataclass
+class Chunk:
+    """A batch of rows in columnar layout (ref: util/chunk/chunk.go:36)."""
+
+    columns: List[Column]
+
+    def __post_init__(self):
+        if self.columns:
+            n = len(self.columns[0])
+            assert all(len(c) == n for c in self.columns), "ragged chunk"
+
+    # ---- shape -----------------------------------------------------------
+    @property
+    def num_rows(self) -> int:
+        return len(self.columns[0]) if self.columns else 0
+
+    @property
+    def num_cols(self) -> int:
+        return len(self.columns)
+
+    def __len__(self) -> int:
+        return self.num_rows
+
+    @property
+    def field_types(self) -> List[FieldType]:
+        return [c.ftype for c in self.columns]
+
+    # ---- row access (result delivery; not a hot path) --------------------
+    def row(self, i: int) -> tuple:
+        return tuple(c.get(i) for c in self.columns)
+
+    def rows(self) -> List[tuple]:
+        return [self.row(i) for i in range(self.num_rows)]
+
+    # ---- transforms ------------------------------------------------------
+    def take(self, indices: np.ndarray) -> "Chunk":
+        return Chunk([c.take(indices) for c in self.columns])
+
+    def filter(self, mask: np.ndarray) -> "Chunk":
+        return Chunk([c.filter(mask) for c in self.columns])
+
+    def slice(self, start: int, stop: int) -> "Chunk":
+        return Chunk([c.slice(start, stop) for c in self.columns])
+
+    def project(self, col_idx: Sequence[int]) -> "Chunk":
+        return Chunk([self.columns[i] for i in col_idx])
+
+    @staticmethod
+    def concat(chunks: Sequence["Chunk"]) -> "Chunk":
+        assert chunks
+        ncol = chunks[0].num_cols
+        return Chunk([Column.concat([ch.columns[j] for ch in chunks])
+                      for j in range(ncol)])
+
+    @staticmethod
+    def from_columns_data(ftypes: Sequence[FieldType],
+                          data: Sequence[Sequence]) -> "Chunk":
+        return Chunk([Column.from_list(ft, col) for ft, col in zip(ftypes, data)])
+
+    @staticmethod
+    def from_rows(ftypes: Sequence[FieldType], rows: Iterable[Sequence]) -> "Chunk":
+        rows = list(rows)
+        return Chunk([Column.from_list(ft, [r[j] for r in rows])
+                      for j, ft in enumerate(ftypes)])
+
+    def memory_usage(self) -> int:
+        total = 0
+        for c in self.columns:
+            if c.ftype.is_varlen:
+                total += sum(len(str(s)) for s in c.values) + 8 * len(c)
+            else:
+                total += c.values.nbytes
+            if c.validity is not None:
+                total += c.validity.nbytes
+        return total
+
+    def __repr__(self) -> str:
+        return f"Chunk({self.num_rows} rows × {self.num_cols} cols)"
+
+
+def iter_chunks(chunk: Chunk, max_rows: int = DEFAULT_CHUNK_SIZE):
+    """Split a big chunk into batches (ref: util/chunk/iterator.go)."""
+    for start in range(0, chunk.num_rows, max_rows):
+        yield chunk.slice(start, min(start + max_rows, chunk.num_rows))

@@ -1,0 +1,90 @@
+"""Chunk wire codec (ref: util/chunk/codec.go:43-77).
+
+Per column, little-endian, concatenated:
+
+    [length u32][nullCount u32][nullBitmap ceil(len/8) bytes if nullCount>0]
+    [offsets (len+1) x i64 if varlen][data]
+
+Same layout as the reference (it is already Arrow-shaped: validity bitmap +
+offsets + values), so chunks serialized here are byte-compatible in structure
+with tipb EncodeType_TypeChunk payloads. Used for host<->host exchange between
+distributed workers and for spill files.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Sequence
+
+import numpy as np
+
+from tidb_tpu.chunk import Chunk, Column
+from tidb_tpu.types import FieldType
+
+
+def _pack_bitmap(valid: np.ndarray) -> bytes:
+    return np.packbits(valid, bitorder="little").tobytes()
+
+
+def _unpack_bitmap(buf: bytes, n: int) -> np.ndarray:
+    bits = np.unpackbits(np.frombuffer(buf, dtype=np.uint8), bitorder="little")
+    return bits[:n].astype(bool)
+
+
+def encode_column(col: Column) -> bytes:
+    n = len(col)
+    null_count = col.null_count
+    parts = [struct.pack("<II", n, null_count)]
+    if null_count > 0:
+        parts.append(_pack_bitmap(col.valid_mask()))
+    if col.ftype.is_varlen:
+        encoded = [b"" if col.is_null(i) else str(col.values[i]).encode("utf-8")
+                   for i in range(n)]
+        lens = np.fromiter((len(e) for e in encoded), dtype=np.int64, count=n)
+        offsets = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(lens, out=offsets[1:])
+        parts.append(offsets.tobytes())
+        parts.append(b"".join(encoded))
+    else:
+        parts.append(np.ascontiguousarray(col.values).tobytes())
+    return b"".join(parts)
+
+
+def decode_column(buf: bytes, pos: int, ftype: FieldType):
+    n, null_count = struct.unpack_from("<II", buf, pos)
+    pos += 8
+    validity = None
+    if null_count > 0:
+        nbytes = (n + 7) // 8
+        validity = _unpack_bitmap(buf[pos:pos + nbytes], n)
+        pos += nbytes
+    if ftype.is_varlen:
+        offsets = np.frombuffer(buf, dtype=np.int64, count=n + 1, offset=pos)
+        pos += (n + 1) * 8
+        total = int(offsets[-1]) if n else 0
+        blob = buf[pos:pos + total]
+        pos += total
+        values = np.array(
+            [blob[offsets[i]:offsets[i + 1]].decode("utf-8") for i in range(n)],
+            dtype=object)
+    else:
+        dt = ftype.np_dtype
+        values = np.frombuffer(buf, dtype=dt, count=n, offset=pos).copy()
+        pos += n * dt.itemsize
+    return Column(ftype, values, validity), pos
+
+
+def encode_chunk(chunk: Chunk) -> bytes:
+    header = struct.pack("<I", chunk.num_cols)
+    return header + b"".join(encode_column(c) for c in chunk.columns)
+
+
+def decode_chunk(buf: bytes, ftypes: Sequence[FieldType]) -> Chunk:
+    (ncol,) = struct.unpack_from("<I", buf, 0)
+    assert ncol == len(ftypes), f"schema mismatch: {ncol} vs {len(ftypes)}"
+    pos = 4
+    cols: List[Column] = []
+    for ft in ftypes:
+        col, pos = decode_column(buf, pos, ft)
+        cols.append(col)
+    return Chunk(cols)

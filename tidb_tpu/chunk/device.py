@@ -1,0 +1,159 @@
+"""Host Chunk ↔ device marshalling (the Arrow→HBM bridge of SURVEY §7.3).
+
+A DeviceChunk is the on-device mirror of a Chunk: one jnp array per column
+plus a shared validity story. Three TPU-first rules (SURVEY §7 "hard parts"):
+
+  * static shapes — rows are padded up to a bucket capacity (powers of two),
+    and the logical row count rides along as a device scalar so varying row
+    counts inside one bucket do NOT retrigger XLA compilation;
+  * the selection vector becomes a mask — `sel []int` (util/chunk/chunk.go:44)
+    has no efficient TPU equivalent; filters produce boolean row masks that
+    downstream kernels fuse;
+  * strings become int32 dictionary codes; the dictionary stays on host.
+
+DeviceChunk is registered as a pytree so it can flow through jit directly.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tidb_tpu.chunk import Chunk, Column
+from tidb_tpu.ops.jax_env import jax, jnp, device_float_dtype
+from tidb_tpu.types import FieldType, TypeKind
+
+MIN_BUCKET = 1024
+
+
+def bucket_capacity(n: int) -> int:
+    """Round row count up to the shape bucket XLA compiles for."""
+    cap = MIN_BUCKET
+    while cap < n:
+        cap <<= 1
+    return cap
+
+
+def _device_dtype(ftype: FieldType):
+    dt = ftype.np_dtype
+    if dt == np.dtype(np.float64):
+        return device_float_dtype()
+    if ftype.is_varlen:
+        return jnp.int32  # dictionary codes
+    return jnp.dtype(dt)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class DeviceColumn:
+    values: "jnp.ndarray"              # (capacity,) padded
+    validity: "jnp.ndarray"            # (capacity,) bool; False in padding
+    ftype: FieldType = field(default=None)
+    dictionary: Optional[np.ndarray] = None  # host-side string dictionary
+
+    def tree_flatten(self):
+        # The dictionary deliberately does NOT ride the pytree: aux data keys
+        # the jit cache (arrays there are unhashable, and a cached trace would
+        # resurrect call-1 dictionaries onto call-2 outputs). Kernels operate
+        # on codes; the host executor re-attaches dictionaries afterwards.
+        return (self.values, self.validity), (self.ftype,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        values, validity = children
+        (ftype,) = aux
+        return cls(values, validity, ftype, None)
+
+    def with_dictionary(self, dictionary: Optional[np.ndarray]) -> "DeviceColumn":
+        return DeviceColumn(self.values, self.validity, self.ftype, dictionary)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class DeviceChunk:
+    columns: List[DeviceColumn]
+    n_rows: "jnp.ndarray"              # () int32 device scalar — logical rows
+
+    def tree_flatten(self):
+        return (self.columns, self.n_rows), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        columns, n_rows = children
+        return cls(list(columns), n_rows)
+
+    @property
+    def capacity(self) -> int:
+        return self.columns[0].values.shape[0] if self.columns else 0
+
+    def row_mask(self) -> "jnp.ndarray":
+        """True for logical rows, False for padding."""
+        return jnp.arange(self.capacity, dtype=jnp.int32) < self.n_rows
+
+
+def encode_strings(col: Column) -> Tuple[np.ndarray, np.ndarray]:
+    """Dictionary-encode a string column → (codes int32, dictionary).
+
+    Codes are dense [0, len(dict)); NULL rows get code 0 (masked by validity).
+    """
+    str_vals = np.array([str(v) for v in col.values], dtype=object)
+    dictionary, codes = np.unique(str_vals, return_inverse=True)
+    return codes.astype(np.int32), dictionary
+
+
+def to_device_column(col: Column, capacity: int,
+                     dictionary: Optional[np.ndarray] = None) -> DeviceColumn:
+    n = len(col)
+    dt = _device_dtype(col.ftype)
+    if col.ftype.is_varlen:
+        if dictionary is not None:
+            # encode against a fixed dictionary (e.g. join-key alignment)
+            lookup = {s: i for i, s in enumerate(dictionary)}
+            codes = np.fromiter((lookup.get(str(v), -1) for v in col.values),
+                                dtype=np.int32, count=n)
+        else:
+            codes, dictionary = encode_strings(col)
+        host = codes
+    else:
+        host = np.asarray(col.values)
+    padded = np.zeros(capacity, dtype=np.dtype(dt))
+    padded[:n] = host.astype(np.dtype(dt), copy=False)
+    valid = np.zeros(capacity, dtype=bool)
+    valid[:n] = col.valid_mask()
+    return DeviceColumn(jnp.asarray(padded), jnp.asarray(valid),
+                        col.ftype, dictionary)
+
+
+def to_device(chunk: Chunk, capacity: Optional[int] = None) -> DeviceChunk:
+    cap = capacity or bucket_capacity(chunk.num_rows)
+    assert cap >= chunk.num_rows
+    cols = [to_device_column(c, cap) for c in chunk.columns]
+    return DeviceChunk(cols, jnp.asarray(chunk.num_rows, dtype=jnp.int32))
+
+
+def from_device(dchunk: DeviceChunk, n_rows: Optional[int] = None) -> Chunk:
+    """Device → host Chunk (trims padding, decodes dictionaries)."""
+    n = int(dchunk.n_rows) if n_rows is None else n_rows
+    out: List[Column] = []
+    for dc in dchunk.columns:
+        vals = np.asarray(dc.values)[:n]
+        valid = np.asarray(dc.validity)[:n]
+        ft = dc.ftype
+        if ft.is_varlen and dc.dictionary is not None:
+            # negative codes are the fixed-dictionary miss sentinel → NULL,
+            # never silently the first dictionary entry
+            neg = vals < 0
+            if neg.any():
+                valid = valid & ~neg
+            if len(dc.dictionary):
+                decoded = dc.dictionary[np.clip(vals, 0, len(dc.dictionary) - 1)]
+                decoded = np.asarray(decoded, dtype=object)
+            else:
+                decoded = np.full(n, "", dtype=object)
+            vals = decoded
+        elif ft.np_dtype != vals.dtype and not ft.is_varlen:
+            vals = vals.astype(ft.np_dtype)
+        out.append(Column(ft, vals, None if valid.all() else valid.copy()))
+    return Chunk(out)

@@ -1,0 +1,59 @@
+"""Error hierarchy (ref: parser/terror, errno/ — simplified).
+
+The reference carries MySQL error codes end-to-end (errno/errcode.go); we keep
+a small typed hierarchy with MySQL-compatible codes on the classes users see.
+"""
+
+
+class TiDBTPUError(Exception):
+    """Base error."""
+
+    code = 1105  # ER_UNKNOWN_ERROR
+
+
+class ParseError(TiDBTPUError):
+    code = 1064  # ER_PARSE_ERROR
+
+
+class PlanError(TiDBTPUError):
+    code = 1105
+
+
+class ExecutionError(TiDBTPUError):
+    code = 1105
+
+
+class UnknownTableError(TiDBTPUError):
+    code = 1146  # ER_NO_SUCH_TABLE
+
+
+class UnknownColumnError(TiDBTPUError):
+    code = 1054  # ER_BAD_FIELD_ERROR
+
+
+class TableExistsError(TiDBTPUError):
+    code = 1050  # ER_TABLE_EXISTS_ERROR
+
+
+class TypeError_(TiDBTPUError):
+    code = 1366  # ER_TRUNCATED_WRONG_VALUE_FOR_FIELD
+
+
+class OverflowError_(TiDBTPUError):
+    code = 1690  # ER_DATA_OUT_OF_RANGE
+
+
+class MemoryQuotaExceeded(TiDBTPUError):
+    code = 1038  # ER_OUT_OF_SORTMEMORY (closest MySQL analog)
+
+
+class QueryKilledError(TiDBTPUError):
+    code = 1317  # ER_QUERY_INTERRUPTED
+
+
+class DivisionByZero(TiDBTPUError):
+    code = 1365  # ER_DIVISION_BY_ZERO
+
+
+class TxnError(TiDBTPUError):
+    code = 1205

@@ -1,0 +1,17 @@
+"""The TPU kernel library — the framework's "coprocessor".
+
+This package is the analog of what the reference pushes to TiKV/TiFlash
+coprocessors (store/mockstore/unistore/cophandler): whole query fragments
+(scan → filter → aggregate/join/topn) compiled as single XLA programs, not
+operator-at-a-time dispatch — the granularity precedent is unistore's
+closure executor (cophandler/closure_exec.go:459) which fuses a linear DAG
+into one callback.
+
+Modules:
+    jax_env    — central jax import + config (x64, default device policy)
+    hashing    — vectorized 64-bit column hashing (ref: util/codec/codec.go:1200)
+    segment    — sort-based group-by + segment reduction (HashAgg internals)
+    join       — device join kernels (sorted probe; ref: executor/hash_table.go)
+    sort       — sort / top-k kernels (ref: executor/sort.go)
+    filter     — predicate mask evaluation (ref: expression.VectorizedFilter)
+"""

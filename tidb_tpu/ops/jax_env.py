@@ -1,0 +1,40 @@
+"""Central JAX import point.
+
+Everything in tidb_tpu that touches jax must import it from here so that
+configuration (x64 for exact int64 decimal arithmetic) is applied before the
+first trace. int64 is the physical type of DECIMAL columns (types/__init__.py),
+so x64 is a correctness requirement, not a preference; on TPU int64 lanes are
+emulated as 2×int32 which is fine for the bandwidth-bound relational kernels.
+"""
+
+from __future__ import annotations
+
+import os
+
+# Harmless if already set; tests additionally force a CPU mesh via conftest.
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+
+def backend() -> str:
+    return jax.default_backend()
+
+
+def on_tpu() -> bool:
+    # "axon" is a real TPU chip behind an experimental tunnel platform.
+    return backend() in ("tpu", "axon")
+
+
+# Device float dtype policy: TPU has no native f64. DOUBLE columns compute in
+# f32 on TPU (sums use compensated accumulation in ops/segment.py); exact
+# aggregates ride DECIMAL/int64 which is unaffected.
+def device_float_dtype():
+    return jnp.float32 if on_tpu() else jnp.float64
+
+
+__all__ = ["jax", "jnp", "lax", "backend", "on_tpu", "device_float_dtype"]

@@ -1,0 +1,256 @@
+"""MySQL-flavoured type system, TPU-first physical mapping.
+
+Ref: /root/reference/types/ (Datum tagged union, types/datum.go:63-71;
+MyDecimal fixed-point, types/mydecimal.go:236). Instead of porting the
+9-digit-word MyDecimal, decimals are scaled int64 (exact add/sum/cmp, the
+operations analytics needs) — int64 lanes are what the TPU vector unit can
+actually chew on. Strings are dictionary-encoded on device (int32 codes).
+
+Physical mapping (host numpy dtype → device jnp dtype):
+
+    TINYINT..BIGINT    int64        int64 (or int32 when range-proven)
+    FLOAT/DOUBLE       float64      float32 on TPU matmul path, float64 ok on CPU
+    DECIMAL(p,s)       int64 (value * 10^s)
+    DATE               int32 (days since 1970-01-01)
+    DATETIME/TIMESTAMP int64 (microseconds since epoch)
+    TIME (duration)    int64 (microseconds)
+    CHAR/VARCHAR       numpy object host-side; dictionary codes int32 on device
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import enum
+from dataclasses import dataclass, field, replace
+
+import numpy as np
+
+
+class TypeKind(enum.Enum):
+    TINYINT = "tinyint"
+    SMALLINT = "smallint"
+    INT = "int"
+    BIGINT = "bigint"
+    FLOAT = "float"
+    DOUBLE = "double"
+    DECIMAL = "decimal"
+    CHAR = "char"
+    VARCHAR = "varchar"
+    DATE = "date"
+    DATETIME = "datetime"
+    TIMESTAMP = "timestamp"
+    TIME = "time"  # MySQL duration
+    NULLTYPE = "null"
+
+    @property
+    def is_integer(self) -> bool:
+        return self in _INT_KINDS
+
+    @property
+    def is_float(self) -> bool:
+        return self in (TypeKind.FLOAT, TypeKind.DOUBLE)
+
+    @property
+    def is_numeric(self) -> bool:
+        return self.is_integer or self.is_float or self is TypeKind.DECIMAL
+
+    @property
+    def is_string(self) -> bool:
+        return self in (TypeKind.CHAR, TypeKind.VARCHAR)
+
+    @property
+    def is_temporal(self) -> bool:
+        return self in (TypeKind.DATE, TypeKind.DATETIME, TypeKind.TIMESTAMP,
+                        TypeKind.TIME)
+
+
+_INT_KINDS = (TypeKind.TINYINT, TypeKind.SMALLINT, TypeKind.INT, TypeKind.BIGINT)
+
+_EPOCH = _dt.date(1970, 1, 1)
+
+
+@dataclass(frozen=True)
+class FieldType:
+    """Ref: parser/types/field_type.go — kind + (precision, scale) + nullability."""
+
+    kind: TypeKind
+    nullable: bool = True
+    precision: int = 0   # DECIMAL precision / display width
+    scale: int = 0       # DECIMAL scale / fractional-second precision
+    unsigned: bool = False
+
+    # ---- physical layout -------------------------------------------------
+    @property
+    def np_dtype(self) -> np.dtype:
+        k = self.kind
+        if k.is_integer or k is TypeKind.DECIMAL or k in (
+                TypeKind.DATETIME, TypeKind.TIMESTAMP, TypeKind.TIME):
+            return np.dtype(np.int64)
+        if k is TypeKind.DATE:
+            return np.dtype(np.int32)
+        if k.is_float:
+            return np.dtype(np.float64)
+        if k.is_string:
+            return np.dtype(object)
+        if k is TypeKind.NULLTYPE:
+            return np.dtype(np.int64)
+        raise AssertionError(f"no physical dtype for {k}")
+
+    @property
+    def is_varlen(self) -> bool:
+        return self.kind.is_string
+
+    @property
+    def decimal_multiplier(self) -> int:
+        return 10 ** self.scale
+
+    def with_nullable(self, nullable: bool) -> "FieldType":
+        return replace(self, nullable=nullable)
+
+    # ---- value conversion (host-side Datum layer) ------------------------
+    def encode_value(self, v):
+        """Python value → physical representation (int/float), None stays None."""
+        if v is None:
+            return None
+        k = self.kind
+        if k is TypeKind.DECIMAL:
+            # exact decimal quantization, half-away-from-zero like MySQL
+            # (binary-float intermediate would misround e.g. "1.005")
+            import decimal as _decimal
+            if isinstance(v, _decimal.Decimal):
+                d = v
+            elif isinstance(v, float):
+                d = _decimal.Decimal(repr(v))
+            else:
+                d = _decimal.Decimal(str(v))
+            return int(d.scaleb(self.scale).to_integral_value(
+                rounding=_decimal.ROUND_HALF_UP))
+        if k.is_integer:
+            return int(v)
+        if k.is_float:
+            return float(v)
+        if k is TypeKind.DATE:
+            if isinstance(v, str):
+                v = _dt.date.fromisoformat(v)
+            if isinstance(v, _dt.datetime):
+                v = v.date()
+            if isinstance(v, _dt.date):
+                return (v - _EPOCH).days
+            return int(v)
+        if k in (TypeKind.DATETIME, TypeKind.TIMESTAMP):
+            if isinstance(v, str):
+                v = _dt.datetime.fromisoformat(v)
+            if isinstance(v, _dt.date) and not isinstance(v, _dt.datetime):
+                v = _dt.datetime(v.year, v.month, v.day)
+            if isinstance(v, _dt.datetime):
+                if v.tzinfo is not None:
+                    v = v.astimezone(_dt.timezone.utc).replace(tzinfo=None)
+                # exact integer microseconds — float seconds loses precision
+                # past ~year 2255 (2^53 µs), MySQL DATETIME goes to 9999
+                return (v - _dt.datetime(1970, 1, 1)) // _dt.timedelta(
+                    microseconds=1)
+            return int(v)
+        if k is TypeKind.TIME:
+            if isinstance(v, _dt.timedelta):
+                return int(v.total_seconds() * 1_000_000)
+            return int(v)
+        if k.is_string:
+            return str(v)
+        return v
+
+    def decode_value(self, raw):
+        """Physical representation → Python value for result rows."""
+        if raw is None:
+            return None
+        k = self.kind
+        if k is TypeKind.DECIMAL:
+            q = int(raw)
+            if self.scale == 0:
+                return q
+            from decimal import Decimal
+            return Decimal(q).scaleb(-self.scale)
+        if k.is_integer:
+            return int(raw)
+        if k.is_float:
+            return float(raw)
+        if k is TypeKind.DATE:
+            return _EPOCH + _dt.timedelta(days=int(raw))
+        if k in (TypeKind.DATETIME, TypeKind.TIMESTAMP):
+            return _dt.datetime(1970, 1, 1) + _dt.timedelta(microseconds=int(raw))
+        if k is TypeKind.TIME:
+            return _dt.timedelta(microseconds=int(raw))
+        return raw
+
+    def __str__(self) -> str:
+        if self.kind is TypeKind.DECIMAL:
+            s = f"decimal({self.precision},{self.scale})"
+        elif self.kind.is_string and self.precision:
+            s = f"{self.kind.value}({self.precision})"
+        else:
+            s = self.kind.value
+        if not self.nullable:
+            s += " not null"
+        return s
+
+
+# Convenience constructors --------------------------------------------------
+
+def bigint(nullable: bool = True) -> FieldType:
+    return FieldType(TypeKind.BIGINT, nullable)
+
+
+def int_(nullable: bool = True) -> FieldType:
+    return FieldType(TypeKind.INT, nullable)
+
+
+def double(nullable: bool = True) -> FieldType:
+    return FieldType(TypeKind.DOUBLE, nullable)
+
+
+def decimal(precision: int, scale: int, nullable: bool = True) -> FieldType:
+    return FieldType(TypeKind.DECIMAL, nullable, precision, scale)
+
+
+def varchar(n: int = 255, nullable: bool = True) -> FieldType:
+    return FieldType(TypeKind.VARCHAR, nullable, n)
+
+
+def char(n: int = 1, nullable: bool = True) -> FieldType:
+    return FieldType(TypeKind.CHAR, nullable, n)
+
+
+def date(nullable: bool = True) -> FieldType:
+    return FieldType(TypeKind.DATE, nullable)
+
+
+def datetime(nullable: bool = True) -> FieldType:
+    return FieldType(TypeKind.DATETIME, nullable)
+
+
+def null_type() -> FieldType:
+    return FieldType(TypeKind.NULLTYPE, True)
+
+
+# ---- type inference / coercion (ref: expression/expression.go InferType) ---
+
+_NUMERIC_ORDER = {
+    TypeKind.TINYINT: 0, TypeKind.SMALLINT: 1, TypeKind.INT: 2,
+    TypeKind.BIGINT: 3, TypeKind.DECIMAL: 4, TypeKind.FLOAT: 5,
+    TypeKind.DOUBLE: 6,
+}
+
+
+def merge_numeric(a: FieldType, b: FieldType) -> FieldType:
+    """Result type of a binary arithmetic op — MySQL-ish promotion."""
+    if a.kind is TypeKind.NULLTYPE:
+        return b.with_nullable(True)
+    if b.kind is TypeKind.NULLTYPE:
+        return a.with_nullable(True)
+    if a.kind.is_float or b.kind.is_float or a.kind.is_string or b.kind.is_string:
+        return FieldType(TypeKind.DOUBLE, a.nullable or b.nullable)
+    if a.kind is TypeKind.DECIMAL or b.kind is TypeKind.DECIMAL:
+        scale = max(a.scale, b.scale)
+        prec = max(a.precision - a.scale, b.precision - b.scale) + scale + 1
+        return FieldType(TypeKind.DECIMAL, a.nullable or b.nullable,
+                         min(prec, 65), scale)
+    return FieldType(TypeKind.BIGINT, a.nullable or b.nullable)
