@@ -30,6 +30,9 @@ def __getattr__(name):
     # Lazy: importing tidb_tpu.chunk/types must not pull the whole session
     # stack (and jax) in.
     if name == "Session":
-        from tidb_tpu.session import Session
+        try:
+            from tidb_tpu.session import Session
+        except ImportError as e:
+            raise AttributeError(f"Session unavailable: {e}") from e
         return Session
     raise AttributeError(name)

@@ -125,7 +125,11 @@ class Chunk:
     def __post_init__(self):
         if self.columns:
             n = len(self.columns[0])
-            assert all(len(c) == n for c in self.columns), "ragged chunk"
+            if not all(len(c) == n for c in self.columns):
+                from tidb_tpu.errors import ExecutionError
+                raise ExecutionError(
+                    f"ragged chunk: column lengths "
+                    f"{[len(c) for c in self.columns]}")
 
     # ---- shape -----------------------------------------------------------
     @property

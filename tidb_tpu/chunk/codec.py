@@ -81,7 +81,9 @@ def encode_chunk(chunk: Chunk) -> bytes:
 
 def decode_chunk(buf: bytes, ftypes: Sequence[FieldType]) -> Chunk:
     (ncol,) = struct.unpack_from("<I", buf, 0)
-    assert ncol == len(ftypes), f"schema mismatch: {ncol} vs {len(ftypes)}"
+    if ncol != len(ftypes):
+        from tidb_tpu.errors import ExecutionError
+        raise ExecutionError(f"schema mismatch: {ncol} vs {len(ftypes)}")
     pos = 4
     cols: List[Column] = []
     for ft in ftypes:

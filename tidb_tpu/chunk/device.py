@@ -141,7 +141,13 @@ def from_device(dchunk: DeviceChunk, n_rows: Optional[int] = None) -> Chunk:
         vals = np.asarray(dc.values)[:n]
         valid = np.asarray(dc.validity)[:n]
         ft = dc.ftype
-        if ft.is_varlen and dc.dictionary is not None:
+        if ft.is_varlen and dc.dictionary is None:
+            from tidb_tpu.errors import ExecutionError
+            raise ExecutionError(
+                "varchar DeviceColumn has no dictionary (dictionaries do not "
+                "survive jit; reattach with with_dictionary() before "
+                "from_device)")
+        if ft.is_varlen:
             # negative codes are the fixed-dictionary miss sentinel → NULL,
             # never silently the first dictionary entry
             neg = vals < 0

@@ -1,0 +1,956 @@
+"""Scalar expression engine — vectorized, NULL-aware, device-traceable.
+
+Ref: /root/reference/expression/ (Expression/VecExpr, expression.go:63-78;
+vectorized builtins, builtin_*_vec.go). Instead of 562 per-signature structs
+with scalar+vec twins, one expression tree evaluates under any array
+namespace: numpy on host (the CPU oracle/baseline) and jax.numpy inside jit
+(the TPU path). A column of values is always the pair (values, validity);
+every kernel implements MySQL's three-valued logic explicitly.
+
+String strategy (TPU-first): device strings are int32 dictionary codes whose
+dictionary is SORTED (np.unique), so order comparisons against constants
+become integer rank comparisons, and arbitrary per-row string functions
+become a host-side evaluation over the (small) dictionary plus a device
+gather by code — the "dictionary pushdown" pattern. Host-side preparation is
+collected by `collect_preparations` and fed to jitted fragments as traced
+inputs so dictionaries never bake into the XLA program.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import math
+import re
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tidb_tpu import types as T
+from tidb_tpu.errors import TypeError_, UnknownColumnError
+from tidb_tpu.types import FieldType, TypeKind
+
+# ---------------------------------------------------------------------------
+# Evaluation context
+# ---------------------------------------------------------------------------
+
+
+class EvalContext:
+    """Bridges an expression tree to a batch of input columns.
+
+    `columns[i]` → (values, validity) arrays under namespace `xp`.
+    On device, string columns hold dictionary codes and `dictionaries[i]`
+    holds the (host-side) sorted dictionary; `prepared` maps expression node
+    ids to host-precomputed traced inputs (constant ranks, dictionary-mapped
+    lookup tables).
+    """
+
+    def __init__(self, xp, columns: Sequence[Tuple], *,
+                 dictionaries: Optional[Sequence[Optional[np.ndarray]]] = None,
+                 prepared: Optional[Dict[int, object]] = None,
+                 on_device: bool = False):
+        self.xp = xp
+        self._columns = list(columns)
+        self.dictionaries = list(dictionaries) if dictionaries else [
+            None] * len(self._columns)
+        self.prepared = prepared or {}
+        self.on_device = on_device
+
+    def column(self, i: int):
+        return self._columns[i]
+
+    @property
+    def num_rows(self):
+        return self._columns[0][0].shape[0] if self._columns else 0
+
+
+# ---------------------------------------------------------------------------
+# Expression nodes
+# ---------------------------------------------------------------------------
+
+
+class Expression:
+    ftype: FieldType
+
+    def children(self) -> List["Expression"]:
+        return []
+
+    def eval(self, ctx: EvalContext):
+        """→ (values, validity) arrays, full batch length."""
+        raise NotImplementedError
+
+    # host-side per-batch preparation (dictionary-dependent constants)
+    def prepare(self, dictionaries) -> Optional[object]:
+        return None
+
+    def walk(self):
+        yield self
+        for c in self.children():
+            yield from c.walk()
+
+    def references(self) -> List[int]:
+        return sorted({e.index for e in self.walk() if isinstance(e, ColumnRef)})
+
+    def is_constant(self) -> bool:
+        return all(not isinstance(e, ColumnRef) for e in self.walk())
+
+
+@dataclass(eq=False)
+class ColumnRef(Expression):
+    """Positional input column reference (ref: expression/column.go)."""
+
+    index: int
+    ftype: FieldType
+    name: str = ""
+
+    def eval(self, ctx: EvalContext):
+        return ctx.column(self.index)
+
+    def __repr__(self):
+        return f"col#{self.index}" + (f"({self.name})" if self.name else "")
+
+
+@dataclass(eq=False)
+class Constant(Expression):
+    """Literal (ref: expression/constant.go). Value is the *python* value."""
+
+    value: object
+    ftype: FieldType
+
+    def eval(self, ctx: EvalContext):
+        xp = ctx.xp
+        n = ctx.num_rows
+        if self.value is None:
+            return (xp.zeros(n, dtype=xp.int64 if not ctx.on_device else xp.int64),
+                    xp.zeros(n, dtype=bool))
+        raw = self.ftype.encode_value(self.value)
+        if self.ftype.kind.is_string:
+            if ctx.on_device:
+                raise AssertionError(
+                    "bare string constant on device; must be consumed by a "
+                    "prepared comparison/gather node")
+            vals = np.full(n, raw, dtype=object)
+            return vals, np.ones(n, dtype=bool)
+        dt = _xp_dtype(xp, self.ftype, ctx.on_device)
+        return xp.full(n, raw, dtype=dt), xp.ones(n, dtype=bool)
+
+    def __repr__(self):
+        return f"lit({self.value!r})"
+
+
+def _xp_dtype(xp, ftype: FieldType, on_device: bool):
+    npdt = ftype.np_dtype
+    if npdt == np.dtype(object):
+        return None
+    if on_device and npdt == np.dtype(np.float64):
+        from tidb_tpu.ops.jax_env import device_float_dtype
+        return device_float_dtype()
+    return npdt
+
+
+# ---------------------------------------------------------------------------
+# Scalar function framework
+# ---------------------------------------------------------------------------
+
+_KERNELS: Dict[str, Callable] = {}
+
+
+def kernel(name):
+    def deco(fn):
+        _KERNELS[name] = fn
+        return fn
+    return deco
+
+
+@dataclass(eq=False)
+class ScalarFunc(Expression):
+    """One scalar builtin call (ref: expression/scalar_function.go)."""
+
+    op: str
+    args: List[Expression]
+    ftype: FieldType
+
+    def children(self):
+        return self.args
+
+    def eval(self, ctx: EvalContext):
+        fn = _KERNELS.get(self.op)
+        if fn is None:
+            raise TypeError_(f"unsupported scalar function: {self.op}")
+        return fn(self, ctx)
+
+    def prepare(self, dictionaries):
+        prep = _PREPARE.get(self.op)
+        return prep(self, dictionaries) if prep else None
+
+    def __repr__(self):
+        return f"{self.op}({', '.join(map(repr, self.args))})"
+
+
+_PREPARE: Dict[str, Callable] = {}
+
+
+def preparer(name):
+    def deco(fn):
+        _PREPARE[name] = fn
+        return fn
+    return deco
+
+
+def collect_preparations(exprs: Sequence[Expression], dictionaries):
+    """Host-side pass: compute dictionary-dependent traced inputs.
+
+    Returns {node_id: value}; values become extra jit arguments so changing
+    dictionaries never re-triggers XLA compilation.
+    """
+    prepared: Dict[int, object] = {}
+    for e in exprs:
+        for node in e.walk():
+            v = node.prepare(dictionaries)
+            if v is not None:
+                prepared[id(node)] = v
+    return prepared
+
+
+# ---------------------------------------------------------------------------
+# Helpers shared by kernels
+# ---------------------------------------------------------------------------
+
+
+def _rescale(xp, vals, from_scale: int, to_scale: int):
+    if to_scale > from_scale:
+        return vals * (10 ** (to_scale - from_scale))
+    return vals
+
+
+def _numeric_common(func: ScalarFunc, ctx: EvalContext):
+    """Evaluate both args, promote to the result's physical domain."""
+    a, b = func.args
+    av, am = a.eval(ctx)
+    bv, bm = b.eval(ctx)
+    xp = ctx.xp
+    rt = func.ftype
+    if rt.kind.is_float or a.ftype.kind.is_float or b.ftype.kind.is_float:
+        fdt = _xp_dtype(xp, T.double(), ctx.on_device) or np.float64
+        av = _to_float(xp, av, a.ftype, fdt)
+        bv = _to_float(xp, bv, b.ftype, fdt)
+        return av, am, bv, bm, None
+    if a.ftype.kind is TypeKind.DECIMAL or b.ftype.kind is TypeKind.DECIMAL:
+        # integers participate as scale-0 decimals
+        scale = max(a.ftype.scale, b.ftype.scale)
+        av = _rescale(xp, av, a.ftype.scale, scale)
+        bv = _rescale(xp, bv, b.ftype.scale, scale)
+        return av, am, bv, bm, scale
+    return av, am, bv, bm, None
+
+
+def _to_float(xp, vals, ftype: FieldType, fdt):
+    vals = vals.astype(fdt)
+    if ftype.kind is TypeKind.DECIMAL and ftype.scale:
+        vals = vals / (10 ** ftype.scale)
+    return vals
+
+
+# ---------------------------------------------------------------------------
+# Arithmetic (ref: expression/builtin_arithmetic_vec.go)
+# ---------------------------------------------------------------------------
+
+
+def _arith(op):
+    def fn(func: ScalarFunc, ctx: EvalContext):
+        xp = ctx.xp
+        if op == "mul" and func.ftype.kind is TypeKind.DECIMAL:
+            # decimal × decimal/int: scales ADD, no equalization needed
+            a, b = func.args
+            av, am = a.eval(ctx)
+            bv, bm = b.eval(ctx)
+            prod_scale = a.ftype.scale + b.ftype.scale
+            out = av * bv
+            if prod_scale > func.ftype.scale:
+                out = out // (10 ** (prod_scale - func.ftype.scale))
+            else:
+                out = _rescale(xp, out, prod_scale, func.ftype.scale)
+            return out, am & bm
+        av, am, bv, bm, scale = _numeric_common(func, ctx)
+        valid = am & bm
+        if op == "plus":
+            out = av + bv
+        elif op == "minus":
+            out = av - bv
+        elif op == "mul":
+            out = av * bv
+        elif op == "div":
+            # SQL '/' → DOUBLE (planner types decimal div as double for device)
+            fdt = _xp_dtype(xp, T.double(), ctx.on_device) or np.float64
+            if scale is not None:
+                # decimal path: scaled ints at common scale — descale once
+                av = av.astype(fdt) / (10 ** scale)
+                bv = bv.astype(fdt) / (10 ** scale)
+            else:
+                # float path already converted by _numeric_common; int path
+                # is raw int64 — astype is correct for both
+                av = av.astype(fdt)
+                bv = bv.astype(fdt)
+            zero = bv == 0
+            valid = valid & ~zero
+            out = av / xp.where(zero, xp.ones_like(bv), bv)
+        elif op == "intdiv":
+            zero = bv == 0
+            valid = valid & ~zero
+            out = _floor_div_trunc(xp, av, xp.where(zero, xp.ones_like(bv), bv))
+        elif op == "mod":
+            zero = bv == 0
+            valid = valid & ~zero
+            safe_b = xp.where(zero, xp.ones_like(bv), bv)
+            if func.ftype.kind.is_float:
+                out = xp.where(valid, av - _trunc(xp, av / safe_b) * safe_b, 0.0)
+            else:
+                out = av - _floor_div_trunc(xp, av, safe_b) * safe_b
+        else:
+            raise AssertionError(op)
+        return out, valid
+    return fn
+
+
+def _trunc(xp, x):
+    return xp.trunc(x)
+
+
+def _floor_div_trunc(xp, a, b):
+    """MySQL DIV truncates toward zero (Go integer division semantics)."""
+    q = xp.abs(a) // xp.abs(b)
+    return xp.where((a < 0) != (b < 0), -q, q).astype(a.dtype)
+
+
+for _op in ("plus", "minus", "mul", "div", "intdiv", "mod"):
+    kernel(_op)(_arith(_op))
+
+
+@kernel("unary_minus")
+def _unary_minus(func, ctx):
+    v, m = func.args[0].eval(ctx)
+    return -v, m
+
+
+# ---------------------------------------------------------------------------
+# Comparison (ref: expression/builtin_compare_vec.go)
+# ---------------------------------------------------------------------------
+
+_CMP_NUMPY = {
+    "eq": lambda a, b: a == b, "ne": lambda a, b: a != b,
+    "lt": lambda a, b: a < b, "le": lambda a, b: a <= b,
+    "gt": lambda a, b: a > b, "ge": lambda a, b: a >= b,
+}
+
+
+def _is_string_cmp(func: ScalarFunc) -> bool:
+    return any(a.ftype.kind.is_string for a in func.args)
+
+
+def _cmp(op):
+    def fn(func: ScalarFunc, ctx: EvalContext):
+        xp = ctx.xp
+        a, b = func.args
+        if ctx.on_device and _is_string_cmp(func):
+            return _cmp_string_device(op, func, ctx)
+        if a.ftype.kind.is_string and not ctx.on_device:
+            av, am = a.eval(ctx)
+            bv, bm = b.eval(ctx)
+            res = np.asarray(_CMP_NUMPY[op](av, bv), dtype=bool)
+            return res, am & bm
+        av, am, bv, bm, _ = _numeric_common(func, ctx)
+        res = _CMP_NUMPY[op](av, bv)
+        return res.astype(bool), am & bm
+    return fn
+
+
+def _cmp_string_device(op, func: ScalarFunc, ctx: EvalContext):
+    """String vs constant on device: integer rank comparison on codes."""
+    xp = ctx.xp
+    prep = ctx.prepared.get(id(func))
+    assert prep is not None, "string comparison missing host preparation"
+    col = next(a for a in func.args if isinstance(a, ColumnRef))
+    flipped = not isinstance(func.args[0], ColumnRef)
+    codes, valid = col.eval(ctx)
+    left_rank, right_rank, present = prep
+    o = op
+    if flipped:  # const OP col  ≡  col flip(OP) const
+        o = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}.get(op, op)
+    if o == "eq":
+        res = (codes == left_rank) & present
+    elif o == "ne":
+        res = ~((codes == left_rank) & present)
+    elif o == "lt":
+        res = codes < left_rank
+    elif o == "le":
+        res = codes < right_rank
+    elif o == "gt":
+        res = codes >= right_rank
+    else:  # ge
+        res = codes >= left_rank
+    return res, valid
+
+
+def _prepare_string_cmp(func: ScalarFunc, dictionaries):
+    col = next((a for a in func.args if isinstance(a, ColumnRef)), None)
+    const = next((a for a in func.args if isinstance(a, Constant)), None)
+    if col is None or const is None or const.value is None:
+        return None
+    d = dictionaries[col.index]
+    if d is None:
+        return None
+    s = str(const.value)
+    left = int(np.searchsorted(d, s, side="left"))
+    right = int(np.searchsorted(d, s, side="right"))
+    present = left < right
+    return (np.int32(left), np.int32(right), np.bool_(present))
+
+
+for _op in _CMP_NUMPY:
+    kernel(_op)(_cmp(_op))
+    preparer(_op)(_prepare_string_cmp)
+
+
+@kernel("nulleq")  # <=> NULL-safe equal
+def _nulleq(func, ctx):
+    xp = ctx.xp
+    av, am, bv, bm, _ = _numeric_common(func, ctx)
+    eq = (av == bv) & am & bm
+    both_null = ~am & ~bm
+    return (eq | both_null), xp.ones_like(am)
+
+
+# ---------------------------------------------------------------------------
+# Logic — Kleene three-valued (ref: builtin_op_vec.go)
+# ---------------------------------------------------------------------------
+
+
+@kernel("and")
+def _and(func, ctx):
+    av, am = _as_bool(func.args[0], ctx)
+    bv, bm = _as_bool(func.args[1], ctx)
+    val = av & bv
+    # false dominates NULL
+    valid = (am & bm) | (am & ~av) | (bm & ~bv)
+    return val & valid, valid
+
+
+@kernel("or")
+def _or(func, ctx):
+    av, am = _as_bool(func.args[0], ctx)
+    bv, bm = _as_bool(func.args[1], ctx)
+    val = (av & am) | (bv & bm)
+    valid = (am & bm) | (am & av) | (bm & bv)
+    return val, valid
+
+
+@kernel("xor")
+def _xor(func, ctx):
+    av, am = _as_bool(func.args[0], ctx)
+    bv, bm = _as_bool(func.args[1], ctx)
+    return av ^ bv, am & bm
+
+
+@kernel("not")
+def _not(func, ctx):
+    av, am = _as_bool(func.args[0], ctx)
+    return (~av) & am, am
+
+
+def _as_bool(expr: Expression, ctx: EvalContext):
+    v, m = expr.eval(ctx)
+    if v.dtype == bool:
+        return v, m
+    return (v != 0), m
+
+
+@kernel("isnull")
+def _isnull(func, ctx):
+    xp = ctx.xp
+    _, m = func.args[0].eval(ctx)
+    return ~m, xp.ones_like(m)
+
+
+# ---------------------------------------------------------------------------
+# Control (ref: builtin_control_vec.go)
+# ---------------------------------------------------------------------------
+
+
+@kernel("if")
+def _if(func, ctx):
+    xp = ctx.xp
+    cv, cm = _as_bool(func.args[0], ctx)
+    tv, tm = _coerced(func.args[1], func.ftype, ctx)
+    ev, em = _coerced(func.args[2], func.ftype, ctx)
+    cond = cv & cm  # NULL condition → else branch (MySQL IF)
+    return xp.where(cond, tv, ev), xp.where(cond, tm, em)
+
+
+@kernel("ifnull")
+def _ifnull(func, ctx):
+    xp = ctx.xp
+    av, am = _coerced(func.args[0], func.ftype, ctx)
+    bv, bm = _coerced(func.args[1], func.ftype, ctx)
+    return xp.where(am, av, bv), am | bm
+
+
+@kernel("coalesce")
+def _coalesce(func, ctx):
+    xp = ctx.xp
+    out_v, out_m = _coerced(func.args[0], func.ftype, ctx)
+    for a in func.args[1:]:
+        av, am = _coerced(a, func.ftype, ctx)
+        take = ~out_m & am
+        out_v = xp.where(take, av, out_v)
+        out_m = out_m | am
+    return out_v, out_m
+
+
+@kernel("case")
+def _case(func, ctx):
+    """case(when1, then1, when2, then2, ..., [else]) — pre-desugared."""
+    xp = ctx.xp
+    n = len(func.args)
+    has_else = n % 2 == 1
+    pairs = (n - 1) // 2 if has_else else n // 2
+    if has_else:
+        out_v, out_m = _coerced(func.args[-1], func.ftype, ctx)
+    else:
+        zv, _ = _coerced(func.args[1], func.ftype, ctx)
+        out_v, out_m = xp.zeros_like(zv), xp.zeros(zv.shape[0], dtype=bool)
+    decided = xp.zeros(ctx.num_rows, dtype=bool)
+    for i in range(pairs):
+        wv, wm = _as_bool(func.args[2 * i], ctx)
+        tv, tm = _coerced(func.args[2 * i + 1], func.ftype, ctx)
+        hit = wv & wm & ~decided
+        out_v = xp.where(hit, tv, out_v)
+        out_m = xp.where(hit, tm, out_m)
+        decided = decided | (wv & wm)
+    return out_v, out_m
+
+
+def _coerced(expr: Expression, target: FieldType, ctx: EvalContext):
+    """Evaluate expr and cast its physical values into target's domain."""
+    v, m = expr.eval(ctx)
+    ft = expr.ftype
+    xp = ctx.xp
+    if ft.kind == target.kind and ft.scale == target.scale:
+        return v, m
+    if target.kind is TypeKind.DECIMAL:
+        if ft.kind is TypeKind.DECIMAL:
+            return _rescale(xp, v, ft.scale, target.scale), m
+        if ft.kind.is_integer:
+            return v * (10 ** target.scale), m
+        if ft.kind.is_float:
+            return _round_half_away(xp, v * (10 ** target.scale)), m
+    if target.kind.is_float:
+        fdt = _xp_dtype(xp, T.double(), ctx.on_device) or np.float64
+        return _to_float(xp, v, ft, fdt), m
+    if target.kind.is_integer and ft.kind.is_integer:
+        return v, m
+    if target.kind.is_integer:
+        return _round_half_away(xp, _to_float(
+            xp, v, ft, np.float64 if not ctx.on_device else v.dtype)), m
+    if target.kind.is_string or ft.kind.is_string:
+        return v, m  # same dictionary domain or host objects
+    return v, m
+
+
+def _round_half_away(xp, x):
+    return xp.where(x >= 0, xp.floor(x + 0.5), xp.ceil(x - 0.5)).astype(xp.int64)
+
+
+@kernel("cast")
+def _cast(func, ctx):
+    return _coerced(func.args[0], func.ftype, ctx)
+
+
+# ---------------------------------------------------------------------------
+# Math (ref: builtin_math_vec.go)
+# ---------------------------------------------------------------------------
+
+
+@kernel("abs")
+def _abs(func, ctx):
+    v, m = func.args[0].eval(ctx)
+    return ctx.xp.abs(v), m
+
+
+@kernel("ceil")
+def _ceil(func, ctx):
+    xp = ctx.xp
+    v, m = func.args[0].eval(ctx)
+    ft = func.args[0].ftype
+    if ft.kind is TypeKind.DECIMAL:
+        mul = 10 ** ft.scale
+        return _floor_div_neg(xp, v + mul - 1, mul), m
+    if ft.kind.is_integer:
+        return v, m
+    return xp.ceil(v).astype(xp.int64), m
+
+
+@kernel("floor")
+def _floor(func, ctx):
+    xp = ctx.xp
+    v, m = func.args[0].eval(ctx)
+    ft = func.args[0].ftype
+    if ft.kind is TypeKind.DECIMAL:
+        return _floor_div_neg(xp, v, 10 ** ft.scale), m
+    if ft.kind.is_integer:
+        return v, m
+    return xp.floor(v).astype(xp.int64), m
+
+
+def _floor_div_neg(xp, a, b):
+    return a // b  # python/numpy floor-div already floors toward -inf
+
+
+@kernel("round")
+def _round(func, ctx):
+    xp = ctx.xp
+    v, m = func.args[0].eval(ctx)
+    ft = func.args[0].ftype
+    if ft.kind is TypeKind.DECIMAL:
+        mul = 10 ** ft.scale
+        half = mul // 2
+        q = xp.where(v >= 0, (v + half) // mul, -((-v + half) // mul))
+        return q, m
+    if ft.kind.is_integer:
+        return v, m
+    return _round_half_away(xp, v), m
+
+
+@kernel("sqrt")
+def _sqrt(func, ctx):
+    xp = ctx.xp
+    v, m = func.args[0].eval(ctx)
+    fdt = _xp_dtype(xp, T.double(), ctx.on_device) or np.float64
+    fv = _to_float(xp, v, func.args[0].ftype, fdt)
+    neg = fv < 0
+    return xp.sqrt(xp.where(neg, 0.0, fv)), m & ~neg
+
+
+@kernel("pow")
+def _pow(func, ctx):
+    xp = ctx.xp
+    av, am, bv, bm, _ = _numeric_common(func, ctx)
+    fdt = _xp_dtype(xp, T.double(), ctx.on_device) or np.float64
+    return xp.power(av.astype(fdt), bv.astype(fdt)), am & bm
+
+
+# ---------------------------------------------------------------------------
+# String functions — dictionary pushdown (host evaluates over the dictionary,
+# device gathers by code). Ref: builtin_string_vec.go, builtin_like.go.
+# ---------------------------------------------------------------------------
+
+
+def _host_string_fn(name):
+    return _HOST_STRING_FNS[name]
+
+
+_HOST_STRING_FNS = {
+    "length": lambda s: len(s.encode("utf-8")),
+    "char_length": len,
+    "upper": str.upper,
+    "lower": str.lower,
+    "reverse": lambda s: s[::-1],
+    "ltrim": str.lstrip,
+    "rtrim": str.rstrip,
+    "trim": str.strip,
+    "ascii": lambda s: ord(s[0]) if s else 0,
+    "hex": lambda s: s.encode("utf-8").hex().upper(),
+}
+
+_STRING_INT_RESULT = {"length", "char_length", "ascii"}
+
+
+def _make_string_fn_kernel(name):
+    host = _HOST_STRING_FNS[name]
+
+    def fn(func: ScalarFunc, ctx: EvalContext):
+        xp = ctx.xp
+        v, m = func.args[0].eval(ctx)
+        if not ctx.on_device:
+            out = np.array([host(str(x)) for x in v],
+                           dtype=np.int64 if name in _STRING_INT_RESULT
+                           else object)
+            return out, m
+        table = ctx.prepared.get(id(func))
+        assert table is not None, f"{name}: missing dictionary preparation"
+        return xp.take(table, v.astype(xp.int32), mode="clip"), m
+
+    def prep(func: ScalarFunc, dictionaries):
+        col = func.args[0]
+        if not isinstance(col, ColumnRef):
+            return None
+        d = dictionaries[col.index]
+        if d is None:
+            return None
+        if name in _STRING_INT_RESULT:
+            return np.array([host(str(s)) for s in d], dtype=np.int64)
+        # string→string over dictionary: result values are NEW codes into a
+        # derived dictionary; executor retrieves it via derived_dictionary()
+        out = np.array([host(str(s)) for s in d], dtype=object)
+        newdict, codes = np.unique(out, return_inverse=True)
+        func._derived_dict = newdict  # noqa: SLF001 — consumed by executor
+        return codes.astype(np.int32)
+
+    kernel(name)(fn)
+    preparer(name)(prep)
+
+
+for _n in _HOST_STRING_FNS:
+    _make_string_fn_kernel(_n)
+
+
+def _like_to_regex(pattern: str) -> str:
+    out = []
+    i = 0
+    while i < len(pattern):
+        c = pattern[i]
+        if c == "\\" and i + 1 < len(pattern):
+            out.append(re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if c == "%":
+            out.append(".*")
+        elif c == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(c))
+        i += 1
+    return "^" + "".join(out) + "$"
+
+
+@kernel("like")
+def _like(func, ctx):
+    xp = ctx.xp
+    v, m = func.args[0].eval(ctx)
+    pat = func.args[1]
+    assert isinstance(pat, Constant), "LIKE pattern must be a constant"
+    if not ctx.on_device:
+        rx = re.compile(_like_to_regex(str(pat.value)), re.DOTALL)
+        out = np.fromiter((rx.match(str(x)) is not None for x in v),
+                          dtype=bool, count=len(v))
+        return out, m
+    table = ctx.prepared.get(id(func))
+    assert table is not None, "LIKE: missing dictionary preparation"
+    return xp.take(table, v.astype(xp.int32), mode="clip"), m
+
+
+@preparer("like")
+def _prepare_like(func: ScalarFunc, dictionaries):
+    col = func.args[0]
+    if not isinstance(col, ColumnRef):
+        return None
+    d = dictionaries[col.index]
+    if d is None:
+        return None
+    rx = re.compile(_like_to_regex(str(func.args[1].value)), re.DOTALL)
+    return np.fromiter((rx.match(str(s)) is not None for s in d),
+                       dtype=bool, count=len(d))
+
+
+@kernel("in")
+def _in(func, ctx):
+    """col IN (c1, c2, ...) — constants only on device (planner guarantees)."""
+    xp = ctx.xp
+    arg = func.args[0]
+    v, m = arg.eval(ctx)
+    if ctx.on_device and arg.ftype.kind.is_string:
+        codeset = ctx.prepared.get(id(func))
+        assert codeset is not None
+        hit = xp.zeros(v.shape[0], dtype=bool)
+        for c in codeset:
+            hit = hit | (v == c)
+        return hit, m
+    hit = None
+    for cexpr in func.args[1:]:
+        cv, cm = cexpr.eval(ctx)
+        h = (v == cv) & cm
+        hit = h if hit is None else (hit | h)
+    return np.asarray(hit, dtype=bool) if not ctx.on_device else hit, m
+
+
+@preparer("in")
+def _prepare_in(func: ScalarFunc, dictionaries):
+    col = func.args[0]
+    if not isinstance(col, ColumnRef) or not col.ftype.kind.is_string:
+        return None
+    d = dictionaries[col.index]
+    if d is None:
+        return None
+    codes = []
+    for cexpr in func.args[1:]:
+        s = str(cexpr.value)
+        left = int(np.searchsorted(d, s, side="left"))
+        if left < len(d) and d[left] == s:
+            codes.append(np.int32(left))
+    return codes if codes else [np.int32(-1)]
+
+
+# ---------------------------------------------------------------------------
+# Temporal (ref: builtin_time_vec.go) — physical encodings are plain ints
+# ---------------------------------------------------------------------------
+
+
+@kernel("year")
+def _year(func, ctx):
+    return _date_part(func, ctx, part="year")
+
+
+@kernel("month")
+def _month(func, ctx):
+    return _date_part(func, ctx, part="month")
+
+
+@kernel("dayofmonth")
+def _dayofmonth(func, ctx):
+    return _date_part(func, ctx, part="day")
+
+
+def _date_part(func, ctx, part):
+    """Civil-date decomposition from days-since-epoch (Howard Hinnant algo —
+    pure integer ops, traces cleanly under jit)."""
+    xp = ctx.xp
+    v, m = func.args[0].eval(ctx)
+    ft = func.args[0].ftype
+    if ft.kind in (TypeKind.DATETIME, TypeKind.TIMESTAMP):
+        days = _floor_div_neg(xp, v, 86_400_000_000)
+    else:
+        days = v
+    days = days.astype(xp.int64)
+    z = days + 719468
+    era = _floor_div_neg(xp, z, 146097)
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    mth = xp.where(mp < 10, mp + 3, mp - 9)
+    y = xp.where(mp >= 10, y + 1, y)
+    out = {"year": y, "month": mth, "day": d}[part]
+    return out.astype(xp.int64), m
+
+
+@kernel("date")
+def _date_fn(func, ctx):
+    xp = ctx.xp
+    v, m = func.args[0].eval(ctx)
+    ft = func.args[0].ftype
+    if ft.kind in (TypeKind.DATETIME, TypeKind.TIMESTAMP):
+        return _floor_div_neg(xp, v, 86_400_000_000).astype(xp.int32), m
+    return v, m
+
+
+# ---------------------------------------------------------------------------
+# Type inference / construction helpers (used by the planner)
+# ---------------------------------------------------------------------------
+
+_BOOL_OPS = {"eq", "ne", "lt", "le", "gt", "ge", "nulleq", "and", "or", "xor",
+             "not", "isnull", "like", "in"}
+_CMP_OPS = {"eq", "ne", "lt", "le", "gt", "ge"}
+
+
+def infer_type(op: str, args: Sequence[Expression]) -> FieldType:
+    nullable = any(a.ftype.nullable for a in args)
+    if op in _BOOL_OPS:
+        nn = False if op in ("isnull", "nulleq") else nullable
+        return FieldType(TypeKind.BIGINT, nn)  # MySQL booleans are ints
+    if op in ("plus", "minus"):
+        return T.merge_numeric(args[0].ftype, args[1].ftype)
+    if op == "mul":
+        a, b = args[0].ftype, args[1].ftype
+        if a.kind is TypeKind.DECIMAL and b.kind is TypeKind.DECIMAL:
+            scale = min(a.scale + b.scale, 30)
+            prec = min(a.precision + b.precision, 65)
+            return FieldType(TypeKind.DECIMAL, nullable, prec, scale)
+        return T.merge_numeric(a, b)
+    if op == "div":
+        return T.double(True)
+    if op in ("intdiv",):
+        return T.bigint(nullable or True)
+    if op == "mod":
+        return T.merge_numeric(args[0].ftype, args[1].ftype).with_nullable(True)
+    if op == "unary_minus":
+        return args[0].ftype
+    if op in ("if",):
+        return _merge_branch(args[1].ftype, args[2].ftype)
+    if op in ("ifnull", "coalesce"):
+        out = args[0].ftype
+        for a in args[1:]:
+            out = _merge_branch(out, a.ftype)
+        return out.with_nullable(all(a.ftype.nullable for a in args))
+    if op == "case":
+        n = len(args)
+        has_else = n % 2 == 1
+        branches = [args[2 * i + 1] for i in range((n - 1) // 2 if has_else
+                                                   else n // 2)]
+        if has_else:
+            branches.append(args[-1])
+        out = branches[0].ftype
+        for b in branches[1:]:
+            out = _merge_branch(out, b.ftype)
+        return out.with_nullable(True)
+    if op in ("abs",):
+        return args[0].ftype
+    if op in ("ceil", "floor", "round"):
+        if args[0].ftype.kind is TypeKind.DECIMAL:
+            return T.decimal(args[0].ftype.precision, 0, nullable)
+        return T.bigint(nullable)
+    if op in ("sqrt", "pow"):
+        return T.double(True)
+    if op in _STRING_INT_RESULT or op in ("year", "month", "dayofmonth"):
+        return T.bigint(nullable)
+    if op in _HOST_STRING_FNS:
+        return T.varchar(nullable=nullable)
+    if op == "date":
+        return T.date(nullable)
+    if op == "cast":
+        raise AssertionError("cast requires explicit target type")
+    raise TypeError_(f"cannot infer type for {op}")
+
+
+def _merge_branch(a: FieldType, b: FieldType) -> FieldType:
+    if a.kind is TypeKind.NULLTYPE:
+        return b.with_nullable(True)
+    if b.kind is TypeKind.NULLTYPE:
+        return a.with_nullable(True)
+    if a.kind.is_string and b.kind.is_string:
+        return T.varchar(nullable=a.nullable or b.nullable)
+    if a.kind == b.kind and a.scale == b.scale:
+        return a.with_nullable(a.nullable or b.nullable)
+    return T.merge_numeric(a, b)
+
+
+def func(op: str, *args: Expression, ftype: Optional[FieldType] = None
+         ) -> ScalarFunc:
+    return ScalarFunc(op, list(args), ftype or infer_type(op, args))
+
+
+def cast(arg: Expression, target: FieldType) -> ScalarFunc:
+    return ScalarFunc("cast", [arg], target)
+
+
+def lit(value, ftype: Optional[FieldType] = None) -> Constant:
+    if ftype is None:
+        if value is None:
+            ftype = T.null_type()
+        elif isinstance(value, bool):
+            ftype = T.bigint(False)
+        elif isinstance(value, int):
+            ftype = T.bigint(False)
+        elif isinstance(value, float):
+            ftype = T.double(False)
+        elif isinstance(value, str):
+            ftype = T.varchar(nullable=False)
+        else:
+            from decimal import Decimal
+            if isinstance(value, Decimal):
+                exp = -value.as_tuple().exponent
+                ftype = T.decimal(max(len(value.as_tuple().digits), exp + 1),
+                                  max(exp, 0), False)
+            else:
+                raise TypeError_(f"cannot infer literal type: {value!r}")
+    return Constant(value, ftype)

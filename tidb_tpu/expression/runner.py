@@ -1,0 +1,91 @@
+"""Evaluate expression lists over host Chunks / DeviceChunks.
+
+The host path is the CPU oracle and fallback engine; the device path is what
+executor fragments trace under jit. Ref pattern: expression/chunk_executor.go
+(VectorizedExecute / VectorizedFilter).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from tidb_tpu.chunk import Chunk, Column
+from tidb_tpu.chunk.device import DeviceChunk, DeviceColumn
+from tidb_tpu.expression import (Constant, EvalContext, Expression,
+                                 collect_preparations)
+from tidb_tpu.types import TypeKind
+
+
+def host_context(chunk: Chunk) -> EvalContext:
+    cols = [(c.values, c.valid_mask()) for c in chunk.columns]
+    return EvalContext(np, cols, on_device=False)
+
+
+def eval_on_chunk(exprs: Sequence[Expression], chunk: Chunk) -> Chunk:
+    """Host (numpy) vectorized evaluation → new Chunk (the CPU engine)."""
+    ctx = host_context(chunk)
+    out: List[Column] = []
+    for e in exprs:
+        v, m = e.eval(ctx)
+        ft = e.ftype
+        if ft.kind.is_string:
+            vals = np.asarray(v, dtype=object)
+        else:
+            vals = np.asarray(v).astype(ft.np_dtype, copy=False)
+        valid = np.asarray(m, dtype=bool)
+        out.append(Column(ft, vals, None if valid.all() else valid.copy()))
+    return Chunk(out)
+
+
+def filter_mask(pred: Expression, chunk: Chunk) -> np.ndarray:
+    """Host VectorizedFilter: NULL → excluded (SQL WHERE semantics)."""
+    ctx = host_context(chunk)
+    v, m = pred.eval(ctx)
+    return np.asarray((v != 0) & m, dtype=bool)
+
+
+def device_context(dchunk: DeviceChunk, xp,
+                   prepared: Optional[dict] = None) -> EvalContext:
+    cols = [(dc.values, dc.validity) for dc in dchunk.columns]
+    dicts = [dc.dictionary for dc in dchunk.columns]
+    return EvalContext(xp, cols, dictionaries=dicts,
+                       prepared=prepared or {}, on_device=True)
+
+
+def eval_on_device(exprs: Sequence[Expression], dchunk: DeviceChunk,
+                   jit: bool = True) -> DeviceChunk:
+    """Device evaluation: one traced program over all expressions.
+
+    Host-side dictionary preparations become extra traced arguments so the
+    compiled program is reusable across chunks with different dictionaries.
+    """
+    from tidb_tpu.ops.jax_env import jax, jnp
+
+    dicts = [dc.dictionary for dc in dchunk.columns]
+    prepared = collect_preparations(exprs, dicts)
+    keys = list(prepared.keys())
+
+    def run(dch, prep_vals):
+        ctx = device_context(dch, jnp, dict(zip(keys, prep_vals)))
+        out_cols = []
+        for e in exprs:
+            v, m = e.eval(ctx)
+            out_cols.append(DeviceColumn(v, m, e.ftype, None))
+        return DeviceChunk(out_cols, dch.n_rows)
+
+    prep_vals = [prepared[k] for k in keys]
+    fn = jax.jit(run) if jit else run
+    out = fn(dchunk, prep_vals)
+    # reattach derived dictionaries for string→string functions
+    for e, dc in zip(exprs, out.columns):
+        if e.ftype.kind.is_string:
+            d = getattr(e, "_derived_dict", None)
+            if d is None and e.references():
+                src = e.references()[0]
+                d = dicts[src] if src < len(dicts) else None
+            if d is None and isinstance(e, Constant):
+                d = np.array([str(e.value)], dtype=object)
+            out.columns[out.columns.index(dc)] = dc.with_dictionary(d)
+    return out

@@ -152,7 +152,7 @@ class FieldType:
             return int(v)
         if k is TypeKind.TIME:
             if isinstance(v, _dt.timedelta):
-                return int(v.total_seconds() * 1_000_000)
+                return v // _dt.timedelta(microseconds=1)
             return int(v)
         if k.is_string:
             return str(v)
