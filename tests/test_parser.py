@@ -1,0 +1,309 @@
+"""Parser tests — TPC-H query shapes + DDL/DML (ref: parser/parser_test.go)."""
+
+from decimal import Decimal
+
+import pytest
+
+from tidb_tpu.errors import ParseError
+from tidb_tpu.parser import ast, parse, parse_one
+from tidb_tpu.types import TypeKind
+
+TPCH_Q1 = """
+select
+    l_returnflag, l_linestatus,
+    sum(l_quantity) as sum_qty,
+    sum(l_extendedprice) as sum_base_price,
+    sum(l_extendedprice * (1 - l_discount)) as sum_disc_price,
+    sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge,
+    avg(l_quantity) as avg_qty,
+    avg(l_extendedprice) as avg_price,
+    avg(l_discount) as avg_disc,
+    count(*) as count_order
+from lineitem
+where l_shipdate <= date '1998-12-01' - interval 90 day
+group by l_returnflag, l_linestatus
+order by l_returnflag, l_linestatus
+"""
+
+TPCH_Q3 = """
+select
+    l_orderkey,
+    sum(l_extendedprice * (1 - l_discount)) as revenue,
+    o_orderdate, o_shippriority
+from customer, orders, lineitem
+where c_mktsegment = 'BUILDING'
+  and c_custkey = o_custkey
+  and l_orderkey = o_orderkey
+  and o_orderdate < date '1995-03-15'
+  and l_shipdate > date '1995-03-15'
+group by l_orderkey, o_orderdate, o_shippriority
+order by revenue desc, o_orderdate
+limit 10
+"""
+
+TPCH_Q5 = """
+select n_name, sum(l_extendedprice * (1 - l_discount)) as revenue
+from customer
+  join orders on c_custkey = o_custkey
+  join lineitem on l_orderkey = o_orderkey
+  join supplier on l_suppkey = s_suppkey and c_nationkey = s_nationkey
+  join nation on s_nationkey = n_nationkey
+  join region on n_regionkey = r_regionkey
+where r_name = 'ASIA'
+  and o_orderdate >= date '1994-01-01'
+  and o_orderdate < date '1995-01-01'
+group by n_name
+order by revenue desc
+"""
+
+TPCH_Q6 = """
+select sum(l_extendedprice * l_discount) as revenue
+from lineitem
+where l_shipdate >= date '1994-01-01'
+  and l_shipdate < date '1995-01-01'
+  and l_discount between 0.05 and 0.07
+  and l_quantity < 24
+"""
+
+
+def test_q1_shape():
+    s = parse_one(TPCH_Q1)
+    assert isinstance(s, ast.SelectStmt)
+    assert len(s.items) == 10
+    assert s.items[2].alias == "sum_qty"
+    agg = s.items[4].expr
+    assert isinstance(agg, ast.FuncCall) and agg.name == "sum"
+    assert isinstance(agg.args[0], ast.BinaryOp) and agg.args[0].op == "mul"
+    assert len(s.group_by) == 2 and len(s.order_by) == 2
+    assert isinstance(s.where, ast.BinaryOp) and s.where.op == "le"
+    # right side: date literal minus interval
+    assert isinstance(s.where.right, ast.BinaryOp)
+    assert isinstance(s.where.right.right, ast.IntervalExpr)
+    cnt = s.items[9].expr
+    assert cnt.name == "count" and isinstance(cnt.args[0], ast.Star)
+
+
+def test_q3_comma_joins_and_limit():
+    s = parse_one(TPCH_Q3)
+    assert isinstance(s.from_, ast.JoinExpr) and s.from_.kind == "cross"
+    assert s.limit == (0, 10)
+    assert s.order_by[0][1] is True and s.order_by[1][1] is False
+
+
+def test_q5_explicit_join_chain():
+    s = parse_one(TPCH_Q5)
+    j = s.from_
+    depth = 0
+    while isinstance(j, ast.JoinExpr):
+        assert j.kind == "inner" and j.on is not None
+        j = j.left
+        depth += 1
+    assert depth == 5 and isinstance(j, ast.TableName)
+    assert j.name == "customer"
+
+
+def test_q6_between():
+    s = parse_one(TPCH_Q6)
+    w = s.where
+    assert isinstance(w, ast.BinaryOp) and w.op == "and"
+    found_between = any(isinstance(n, ast.Between)
+                        for n in _walk_expr(s.where))
+    assert found_between
+
+
+def _walk_expr(e):
+    yield e
+    for attr in ("left", "right", "operand", "expr", "low", "high", "pattern"):
+        child = getattr(e, attr, None)
+        if isinstance(child, ast.ExprNode):
+            yield from _walk_expr(child)
+    for child in getattr(e, "args", []) or []:
+        if isinstance(child, ast.ExprNode):
+            yield from _walk_expr(child)
+
+
+def test_create_table():
+    s = parse_one("""
+        CREATE TABLE lineitem (
+            l_orderkey BIGINT NOT NULL,
+            l_quantity DECIMAL(15,2),
+            l_returnflag CHAR(1),
+            l_shipdate DATE,
+            l_comment VARCHAR(44) DEFAULT 'x',
+            PRIMARY KEY (l_orderkey),
+            KEY idx_ship (l_shipdate)
+        ) ENGINE=InnoDB CHARSET=utf8mb4
+    """)
+    assert isinstance(s, ast.CreateTable)
+    assert s.name == "lineitem" and len(s.columns) == 5
+    assert s.primary_key == ["l_orderkey"]
+    assert s.columns[0].ftype.nullable is False
+    assert s.columns[1].ftype.kind is TypeKind.DECIMAL
+    assert s.columns[1].ftype.precision == 15 and s.columns[1].ftype.scale == 2
+    assert s.indexes == [ast.IndexDef("idx_ship", ["l_shipdate"], False)]
+    assert isinstance(s.columns[4].default, ast.Literal)
+
+
+def test_create_table_inline_pk_and_if_not_exists():
+    s = parse_one("create table if not exists t (id int primary key, v text)")
+    assert s.if_not_exists and s.primary_key == ["id"]
+    assert s.columns[0].ftype.nullable is False
+
+
+def test_insert_forms():
+    s = parse_one("insert into t (a, b) values (1, 'x'), (2, NULL)")
+    assert s.table == "t" and s.columns == ["a", "b"] and len(s.rows) == 2
+    assert s.rows[1][1].value is None
+    s2 = parse_one("insert into t2 select a, b from t where a > 1")
+    assert s2.select is not None
+
+
+def test_update_delete():
+    s = parse_one("update t set a = a + 1, b = 'y' where id = 3")
+    assert isinstance(s, ast.Update) and len(s.assignments) == 2
+    d = parse_one("delete from t where a in (1, 2, 3)")
+    assert isinstance(d, ast.Delete)
+    assert isinstance(d.where, ast.InExpr)
+
+
+def test_subqueries():
+    s = parse_one("""
+        select a from t where a > (select avg(a) from t)
+        and exists (select 1 from u where u.id = t.id)
+    """)
+    subs = [n for n in _walk_expr(s.where)
+            if isinstance(n, (ast.Subquery, ast.ExistsExpr))]
+    assert len(subs) >= 2
+    s2 = parse_one("select * from (select a, b from t) d where d.a > 1")
+    assert isinstance(s2.from_, ast.SubqueryTable) and s2.from_.alias == "d"
+
+
+def test_union_order_limit():
+    s = parse_one("select a from t union all select b from u "
+                  "order by 1 desc limit 5")
+    assert isinstance(s, ast.SetOpStmt) and s.op == "union" and s.all
+    assert s.limit == (0, 5) and s.order_by[0][1] is True
+
+
+def test_case_both_forms():
+    s = parse_one("select case when a > 1 then 'big' else 'small' end, "
+                  "case b when 1 then 'one' when 2 then 'two' end from t")
+    c1 = s.items[0].expr
+    c2 = s.items[1].expr
+    assert c1.operand is None and c1.else_ is not None
+    assert c2.operand is not None and len(c2.whens) == 2 and c2.else_ is None
+
+
+def test_operator_precedence():
+    s = parse_one("select 1 + 2 * 3 - 4 / 2")
+    e = s.items[0].expr            # ((1 + (2*3)) - (4/2))
+    assert e.op == "minus"
+    assert e.left.op == "plus" and e.left.right.op == "mul"
+    assert e.right.op == "div"
+    s2 = parse_one("select a or b and c = d")
+    e2 = s2.items[0].expr
+    assert e2.op == "or" and e2.right.op == "and"
+    assert e2.right.right.op == "eq"
+
+
+def test_not_precedence_and_negated_predicates():
+    s = parse_one("select * from t where not a = 1 and b not in (2) "
+                  "and c not like 'x%' and d is not null "
+                  "and e not between 1 and 2")
+    names = [type(n).__name__ for n in _walk_expr(s.where)]
+    assert "InExpr" in names and "LikeExpr" in names and "Between" in names
+    neg = [n for n in _walk_expr(s.where)
+           if getattr(n, "negated", False)]
+    assert len(neg) == 4
+
+
+def test_explain_set_show():
+    e = parse_one("explain analyze select * from t")
+    assert isinstance(e, ast.Explain) and e.analyze
+    st = parse_one("set @@tidb_mem_quota_query = 1024, max_rows = 10")
+    assert isinstance(st, ast.SetStmt) and len(st.assignments) == 2
+    sh = parse_one("show tables")
+    assert sh.kind == "tables"
+    sh2 = parse_one("show columns from t")
+    assert sh2.kind == "columns" and sh2.target == "t"
+
+
+def test_multi_statement_script():
+    stmts = parse("create table t (a int); insert into t values (1); "
+                  "select * from t;")
+    assert len(stmts) == 3
+
+
+def test_string_escapes_and_quotes():
+    s = parse_one("select 'it''s', 'a\\'b', \"dq\"")
+    vals = [i.expr.value for i in s.items]
+    assert vals == ["it's", "a'b", "dq"]
+
+
+def test_backquoted_identifiers():
+    s = parse_one("select `select`, `weird col` from `my table`")
+    assert s.items[0].expr.parts == ("select",)
+    assert s.from_.name == "my table"
+
+
+def test_comments_stripped():
+    s = parse_one("select a -- trailing\n, b /* inline */ from t # hash\n")
+    assert len(s.items) == 2
+
+
+def test_qualified_star_and_names():
+    s = parse_one("select t.*, u.a, db_x.t2.c from t")
+    assert isinstance(s.items[0].expr, ast.Star) and s.items[0].expr.table == "t"
+    assert s.items[1].expr.parts == ("u", "a")
+    assert s.items[2].expr.parts == ("db_x", "t2", "c")
+
+
+def test_decimal_vs_float_literals():
+    s = parse_one("select 1.5, 1.5e3, 42")
+    assert s.items[0].expr.kind == "decimal"
+    assert s.items[0].expr.value == Decimal("1.5")
+    assert s.items[1].expr.kind == "float" and s.items[1].expr.value == 1500.0
+    assert s.items[2].expr.kind == "int"
+
+
+def test_parse_errors():
+    for bad in ["select from where", "create table t", "select * from t "
+                "group a", "insert t values 1", "select 'unterminated"]:
+        with pytest.raises(ParseError):
+            parse_one(bad)
+
+
+def test_txn_statements():
+    assert isinstance(parse_one("begin"), ast.BeginStmt)
+    assert isinstance(parse_one("start transaction"), ast.BeginStmt)
+    assert isinstance(parse_one("commit"), ast.CommitStmt)
+    assert isinstance(parse_one("rollback"), ast.RollbackStmt)
+
+
+def test_review_regressions():
+    # REPLACE / INSERT IGNORE keep their semantics
+    r = parse_one("replace into t values (1)")
+    assert r.replace and not r.ignore
+    ig = parse_one("insert ignore into t values (1)")
+    assert ig.ignore and not ig.replace
+    # scope-qualified sysvars and user variables
+    s = parse_one("set @@session.sql_mode = 'x', @@global.max_rows = 1, @u = 2")
+    assert [a[0] for a in s.assignments] == ["sql_mode", "max_rows", "@u"]
+    v = parse_one("select @@session.autocommit, @x")
+    assert v.items[0].expr.system and not v.items[1].expr.system
+    # SHOW VARIABLES LIKE requires a string
+    with pytest.raises(ParseError):
+        parse_one("show variables like")
+    with pytest.raises(ParseError):
+        parse_one("show variables like 123")
+    # malformed exponent stays in the ParseError domain, not ValueError
+    try:
+        parse_one("select 1e+ from t")
+    except ParseError:
+        pass
+    # parenthesized select with trailing order/limit
+    p = parse_one("(select 1 as a) order by 1 limit 3")
+    assert p.limit == (0, 3) and p.order_by
+    # unique index is structured
+    ct = parse_one("create table t (a int, unique key uk (a))")
+    assert ct.indexes[0].unique and ct.indexes[0].name == "uk"

@@ -1,0 +1,11 @@
+"""SQL parser (ref: /root/reference/parser/ — a goyacc grammar of ~8k lines).
+
+We use a hand-written lexer + recursive-descent/precedence-climbing parser
+over the analytical subset the engine executes: SELECT (joins, group/order/
+having/limit, subqueries, set ops), CREATE/DROP/TRUNCATE TABLE, INSERT/
+UPDATE/DELETE, EXPLAIN [ANALYZE], SET, SHOW. The AST mirrors parser/ast/
+in spirit: plain dataclasses the planner walks.
+"""
+
+from tidb_tpu.parser.parser import parse, parse_one  # noqa: F401
+from tidb_tpu.parser import ast  # noqa: F401

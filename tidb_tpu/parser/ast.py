@@ -1,0 +1,310 @@
+"""AST node definitions (ref: parser/ast/{expressions,dml,ddl}.go)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from tidb_tpu.types import FieldType
+
+# ---------------------------------------------------------------------------
+# Expressions
+# ---------------------------------------------------------------------------
+
+
+class Node:
+    pass
+
+
+class ExprNode(Node):
+    pass
+
+
+@dataclass
+class Literal(ExprNode):
+    value: object          # python value; None for NULL
+    kind: str              # int | decimal | float | str | null | bool
+
+    def __repr__(self):
+        return f"Lit({self.value!r})"
+
+
+@dataclass
+class Name(ExprNode):
+    """Possibly-qualified identifier: a | t.a | db.t.a."""
+
+    parts: Tuple[str, ...]
+
+    @property
+    def column(self) -> str:
+        return self.parts[-1]
+
+    @property
+    def qualifier(self) -> Optional[str]:
+        return self.parts[-2] if len(self.parts) > 1 else None
+
+    def __repr__(self):
+        return ".".join(self.parts)
+
+
+@dataclass
+class Star(ExprNode):
+    table: Optional[str] = None
+
+
+@dataclass
+class UnaryOp(ExprNode):
+    op: str                # minus | not
+    operand: ExprNode
+
+
+@dataclass
+class BinaryOp(ExprNode):
+    op: str                # plus minus mul div intdiv mod eq ne lt le gt ge
+    left: ExprNode         # nulleq and or xor
+    right: ExprNode
+
+
+@dataclass
+class FuncCall(ExprNode):
+    name: str
+    args: List[ExprNode]
+    distinct: bool = False
+
+
+@dataclass
+class CaseExpr(ExprNode):
+    operand: Optional[ExprNode]
+    whens: List[Tuple[ExprNode, ExprNode]]
+    else_: Optional[ExprNode]
+
+
+@dataclass
+class IsNull(ExprNode):
+    expr: ExprNode
+    negated: bool = False
+
+
+@dataclass
+class InExpr(ExprNode):
+    expr: ExprNode
+    items: Optional[List[ExprNode]]      # value list form
+    subquery: Optional["Subquery"] = None
+    negated: bool = False
+
+
+@dataclass
+class Between(ExprNode):
+    expr: ExprNode
+    low: ExprNode
+    high: ExprNode
+    negated: bool = False
+
+
+@dataclass
+class LikeExpr(ExprNode):
+    expr: ExprNode
+    pattern: ExprNode
+    negated: bool = False
+
+
+@dataclass
+class ExistsExpr(ExprNode):
+    subquery: "Subquery"
+    negated: bool = False
+
+
+@dataclass
+class Subquery(ExprNode):
+    select: "SelectStmt"
+
+
+@dataclass
+class CastExpr(ExprNode):
+    expr: ExprNode
+    target: FieldType
+
+
+@dataclass
+class IntervalExpr(ExprNode):
+    value: ExprNode
+    unit: str              # day | month | year | hour | minute | second
+
+
+@dataclass
+class VariableRef(ExprNode):
+    name: str
+    system: bool = False   # @@name vs @name
+
+
+# ---------------------------------------------------------------------------
+# Table references
+# ---------------------------------------------------------------------------
+
+
+class TableRef(Node):
+    pass
+
+
+@dataclass
+class TableName(TableRef):
+    name: str
+    alias: Optional[str] = None
+
+    @property
+    def ref_name(self) -> str:
+        return self.alias or self.name
+
+
+@dataclass
+class JoinExpr(TableRef):
+    kind: str              # inner | left | right | cross
+    left: TableRef
+    right: TableRef
+    on: Optional[ExprNode] = None
+    using: Optional[List[str]] = None
+
+
+@dataclass
+class SubqueryTable(TableRef):
+    select: "SelectStmt"
+    alias: str
+
+
+# ---------------------------------------------------------------------------
+# Statements
+# ---------------------------------------------------------------------------
+
+
+class StmtNode(Node):
+    pass
+
+
+@dataclass
+class SelectItem(Node):
+    expr: ExprNode
+    alias: Optional[str] = None
+
+
+@dataclass
+class SelectStmt(StmtNode):
+    items: List[SelectItem]
+    from_: Optional[TableRef] = None
+    where: Optional[ExprNode] = None
+    group_by: List[ExprNode] = field(default_factory=list)
+    having: Optional[ExprNode] = None
+    order_by: List[Tuple[ExprNode, bool]] = field(default_factory=list)  # (e, desc)
+    limit: Optional[Tuple[int, int]] = None   # (offset, count)
+    distinct: bool = False
+
+
+@dataclass
+class SetOpStmt(StmtNode):
+    op: str                # union | except | intersect
+    all: bool
+    left: StmtNode
+    right: StmtNode
+    order_by: List[Tuple[ExprNode, bool]] = field(default_factory=list)
+    limit: Optional[Tuple[int, int]] = None
+
+
+@dataclass
+class ColumnDef(Node):
+    name: str
+    ftype: FieldType
+    primary_key: bool = False
+    default: Optional[ExprNode] = None
+
+
+@dataclass
+class IndexDef(Node):
+    name: str
+    columns: List[str]
+    unique: bool = False
+
+
+@dataclass
+class CreateTable(StmtNode):
+    name: str
+    columns: List[ColumnDef]
+    primary_key: List[str] = field(default_factory=list)
+    indexes: List[IndexDef] = field(default_factory=list)
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropTable(StmtNode):
+    names: List[str]
+    if_exists: bool = False
+
+
+@dataclass
+class TruncateTable(StmtNode):
+    name: str
+
+
+@dataclass
+class Insert(StmtNode):
+    table: str
+    columns: Optional[List[str]]
+    rows: Optional[List[List[ExprNode]]] = None
+    select: Optional[SelectStmt] = None
+    replace: bool = False      # REPLACE INTO: delete-then-insert on dup key
+    ignore: bool = False       # INSERT IGNORE: skip dup-key rows
+
+
+@dataclass
+class Update(StmtNode):
+    table: TableName
+    assignments: List[Tuple[str, ExprNode]]
+    where: Optional[ExprNode] = None
+
+
+@dataclass
+class Delete(StmtNode):
+    table: TableName
+    where: Optional[ExprNode] = None
+
+
+@dataclass
+class Explain(StmtNode):
+    stmt: StmtNode
+    analyze: bool = False
+
+
+@dataclass
+class SetStmt(StmtNode):
+    assignments: List[Tuple[str, ExprNode]]   # (var_name, value)
+    global_scope: bool = False
+
+
+@dataclass
+class ShowStmt(StmtNode):
+    kind: str              # tables | columns | variables | create_table
+    target: Optional[str] = None
+    like: Optional[str] = None
+
+
+@dataclass
+class AnalyzeTable(StmtNode):
+    names: List[str]
+
+
+@dataclass
+class UseStmt(StmtNode):
+    db: str
+
+
+@dataclass
+class BeginStmt(StmtNode):
+    pass
+
+
+@dataclass
+class CommitStmt(StmtNode):
+    pass
+
+
+@dataclass
+class RollbackStmt(StmtNode):
+    pass

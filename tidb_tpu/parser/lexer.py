@@ -1,0 +1,168 @@
+"""SQL lexer (ref: parser/lexer.go, parser/misc.go keyword table)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+from tidb_tpu.errors import ParseError
+
+KEYWORDS = {
+    "select", "from", "where", "group", "by", "having", "order", "limit",
+    "offset", "as", "and", "or", "not", "xor", "in", "is", "null", "like",
+    "between", "exists", "case", "when", "then", "else", "end", "distinct",
+    "nulls", "first", "last",
+    "join", "inner", "left", "right", "outer", "cross", "on", "using",
+    "union", "all", "except", "intersect", "asc", "desc", "insert", "into",
+    "values", "update", "set", "delete", "create", "table", "drop",
+    "truncate", "if", "primary", "key", "index", "unique", "default",
+    "explain", "analyze", "show", "tables", "columns", "variables", "use",
+    "begin", "commit", "rollback", "interval", "cast", "div", "mod",
+    "true", "false", "global", "session", "database", "databases",
+    "int", "integer", "bigint", "smallint", "tinyint", "float", "double",
+    "decimal", "numeric", "char", "varchar", "text", "date", "datetime",
+    "timestamp", "time", "unsigned", "signed", "auto_increment", "engine",
+    "charset", "collate", "comment", "replace", "ignore", "start",
+    "transaction",
+}
+
+
+@dataclass
+class Token:
+    kind: str       # kw | ident | int | decimal | float | str | op | eof
+    value: object
+    pos: int
+
+    def is_kw(self, *kws: str) -> bool:
+        return self.kind == "kw" and self.value in kws
+
+
+_TWO_CHAR_OPS = {"<=", ">=", "<>", "!=", "&&", "||", ":="}
+_THREE_CHAR_OPS = {"<=>"}
+_ONE_CHAR_OPS = set("+-*/%(),.;=<>!@")
+
+
+def tokenize(sql: str) -> List[Token]:
+    toks: List[Token] = []
+    i, n = 0, len(sql)
+    while i < n:
+        c = sql[i]
+        if c.isspace():
+            i += 1
+            continue
+        # comments
+        if sql.startswith("--", i) or c == "#":
+            j = sql.find("\n", i)
+            i = n if j < 0 else j + 1
+            continue
+        if sql.startswith("/*", i):
+            j = sql.find("*/", i + 2)
+            if j < 0:
+                raise ParseError(f"unterminated comment at {i}")
+            i = j + 2
+            continue
+        # strings
+        if c in ("'", '"'):
+            val, i = _read_string(sql, i)
+            toks.append(Token("str", val, i))
+            continue
+        # backquoted identifier
+        if c == "`":
+            j = sql.find("`", i + 1)
+            if j < 0:
+                raise ParseError(f"unterminated identifier at {i}")
+            toks.append(Token("ident", sql[i + 1:j], i))
+            i = j + 1
+            continue
+        # numbers
+        if c.isdigit() or (c == "." and i + 1 < n and sql[i + 1].isdigit()):
+            tok, i = _read_number(sql, i)
+            toks.append(tok)
+            continue
+        # identifiers / keywords
+        if c.isalpha() or c == "_":
+            j = i
+            while j < n and (sql[j].isalnum() or sql[j] == "_"):
+                j += 1
+            word = sql[i:j]
+            lw = word.lower()
+            if lw in KEYWORDS:
+                toks.append(Token("kw", lw, i))
+            else:
+                toks.append(Token("ident", word, i))
+            i = j
+            continue
+        # operators
+        if sql[i:i + 3] in _THREE_CHAR_OPS:
+            toks.append(Token("op", sql[i:i + 3], i))
+            i += 3
+            continue
+        if sql[i:i + 2] in _TWO_CHAR_OPS:
+            toks.append(Token("op", sql[i:i + 2], i))
+            i += 2
+            continue
+        if sql.startswith("@@", i):
+            toks.append(Token("op", "@@", i))
+            i += 2
+            continue
+        if c in _ONE_CHAR_OPS:
+            toks.append(Token("op", c, i))
+            i += 1
+            continue
+        raise ParseError(f"unexpected character {c!r} at position {i}")
+    toks.append(Token("eof", None, n))
+    return toks
+
+
+def _read_string(sql: str, i: int):
+    quote = sql[i]
+    out = []
+    j = i + 1
+    n = len(sql)
+    while j < n:
+        c = sql[j]
+        if c == "\\" and j + 1 < n:
+            esc = sql[j + 1]
+            out.append({"n": "\n", "t": "\t", "r": "\r", "0": "\0",
+                        "\\": "\\", "'": "'", '"': '"', "%": "\\%",
+                        "_": "\\_"}.get(esc, esc))
+            j += 2
+            continue
+        if c == quote:
+            if j + 1 < n and sql[j + 1] == quote:  # '' escape
+                out.append(quote)
+                j += 2
+                continue
+            return "".join(out), j + 1
+        out.append(c)
+        j += 1
+    raise ParseError(f"unterminated string at {i}")
+
+
+def _read_number(sql: str, i: int):
+    j = i
+    n = len(sql)
+    seen_dot = seen_exp = False
+    while j < n:
+        c = sql[j]
+        if c.isdigit():
+            j += 1
+        elif c == "." and not seen_dot and not seen_exp:
+            seen_dot = True
+            j += 1
+        elif c in "eE" and not seen_exp and j > i and j + 1 < n and (
+                sql[j + 1].isdigit() or (sql[j + 1] in "+-" and j + 2 < n
+                                         and sql[j + 2].isdigit())):
+            seen_exp = True
+            j += 1
+            if sql[j] in "+-":
+                j += 1
+        else:
+            break
+    text = sql[i:j]
+    if seen_exp:
+        return Token("float", float(text), i), j
+    if seen_dot:
+        from decimal import Decimal
+        return Token("decimal", Decimal(text), i), j
+    return Token("int", int(text), i), j

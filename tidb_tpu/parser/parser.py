@@ -1,0 +1,771 @@
+"""Recursive-descent SQL parser (ref: parser/parser.y grammar, hand-rolled).
+
+Expression precedence ladder (subset of parser/misc.go):
+    OR < XOR < AND < NOT < predicate(cmp, IS, LIKE, IN, BETWEEN)
+       < add/sub < mul/div/mod < unary < primary
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from tidb_tpu import types as T
+from tidb_tpu.errors import ParseError
+from tidb_tpu.parser import ast
+from tidb_tpu.parser.lexer import Token, tokenize
+from tidb_tpu.types import FieldType, TypeKind
+
+
+def parse(sql: str) -> List[ast.StmtNode]:
+    """Parse a semicolon-separated script → statement list."""
+    p = Parser(tokenize(sql))
+    stmts = []
+    while not p.at("eof"):
+        if p.try_op(";"):
+            continue
+        stmts.append(p.statement())
+        if not p.at("eof"):
+            p.expect_op(";")
+    return stmts
+
+
+def parse_one(sql: str) -> ast.StmtNode:
+    stmts = parse(sql)
+    if len(stmts) != 1:
+        raise ParseError(f"expected one statement, got {len(stmts)}")
+    return stmts[0]
+
+
+class Parser:
+    def __init__(self, tokens: List[Token]):
+        self.toks = tokens
+        self.i = 0
+
+    # ---- token plumbing --------------------------------------------------
+    @property
+    def cur(self) -> Token:
+        return self.toks[self.i]
+
+    def at(self, kind: str) -> bool:
+        return self.cur.kind == kind
+
+    def at_kw(self, *kws: str) -> bool:
+        return self.cur.is_kw(*kws)
+
+    def at_op(self, *ops: str) -> bool:
+        return self.cur.kind == "op" and self.cur.value in ops
+
+    def advance(self) -> Token:
+        t = self.cur
+        self.i += 1
+        return t
+
+    def try_kw(self, *kws: str) -> Optional[Token]:
+        if self.at_kw(*kws):
+            return self.advance()
+        return None
+
+    def try_op(self, *ops: str) -> Optional[Token]:
+        if self.at_op(*ops):
+            return self.advance()
+        return None
+
+    def expect_kw(self, *kws: str) -> Token:
+        if not self.at_kw(*kws):
+            raise ParseError(
+                f"expected {'/'.join(kws).upper()} near {self._near()}")
+        return self.advance()
+
+    def expect_op(self, op: str) -> Token:
+        if not self.at_op(op):
+            raise ParseError(f"expected {op!r} near {self._near()}")
+        return self.advance()
+
+    def ident(self) -> str:
+        if self.at("ident"):
+            return self.advance().value
+        # non-reserved keywords usable as identifiers
+        if self.cur.kind == "kw" and self.cur.value in (
+                "date", "time", "timestamp", "key", "tables", "columns",
+                "comment", "engine", "charset", "begin", "analyze", "offset",
+                "set", "values", "variables", "if"):
+            return self.advance().value
+        raise ParseError(f"expected identifier near {self._near()}")
+
+    def _near(self) -> str:
+        t = self.cur
+        return f"{t.kind}:{t.value!r} (token {self.i})"
+
+    # ---- statements ------------------------------------------------------
+    def statement(self) -> ast.StmtNode:
+        if self.at_kw("select") or self.at_op("("):
+            return self.select_with_setops()
+        if self.at_kw("create"):
+            return self.create_table()
+        if self.at_kw("drop"):
+            return self.drop_table()
+        if self.at_kw("truncate"):
+            self.advance()
+            self.try_kw("table")
+            return ast.TruncateTable(self.ident())
+        if self.at_kw("insert", "replace"):
+            return self.insert()
+        if self.at_kw("update"):
+            return self.update()
+        if self.at_kw("delete"):
+            return self.delete()
+        if self.at_kw("explain"):
+            self.advance()
+            analyze = bool(self.try_kw("analyze"))
+            return ast.Explain(self.statement(), analyze)
+        if self.at_kw("set"):
+            return self.set_stmt()
+        if self.at_kw("show"):
+            return self.show_stmt()
+        if self.at_kw("analyze"):
+            self.advance()
+            self.expect_kw("table")
+            names = [self.ident()]
+            while self.try_op(","):
+                names.append(self.ident())
+            return ast.AnalyzeTable(names)
+        if self.at_kw("use"):
+            self.advance()
+            return ast.UseStmt(self.ident())
+        if self.at_kw("begin"):
+            self.advance()
+            return ast.BeginStmt()
+        if self.at_kw("start"):
+            self.advance()
+            self.expect_kw("transaction")
+            return ast.BeginStmt()
+        if self.at_kw("commit"):
+            self.advance()
+            return ast.CommitStmt()
+        if self.at_kw("rollback"):
+            self.advance()
+            return ast.RollbackStmt()
+        raise ParseError(f"unsupported statement near {self._near()}")
+
+    # ---- SELECT ----------------------------------------------------------
+    def select_with_setops(self) -> ast.StmtNode:
+        left = self.select_core()
+        while self.at_kw("union", "except", "intersect"):
+            op = self.advance().value
+            all_ = bool(self.try_kw("all"))
+            self.try_kw("distinct")
+            # trailing ORDER BY/LIMIT belongs to the set-op, not the operand
+            right = self.select_core(allow_tail=False)
+            left = ast.SetOpStmt(op, all_, left, right)
+        # trailing ORDER BY / LIMIT bind to the set-op result; also handles
+        # "(select ...) order by ..." where the parens consumed no tail
+        if isinstance(left, (ast.SetOpStmt, ast.SelectStmt)):
+            ob = self.order_by_clause()
+            lim = self.limit_clause()
+            if ob:
+                left.order_by = ob
+            if lim is not None:
+                left.limit = lim
+        return left
+
+    def select_core(self, allow_tail: bool = True) -> ast.StmtNode:
+        if self.try_op("("):
+            s = self.select_with_setops()
+            self.expect_op(")")
+            return s
+        self.expect_kw("select")
+        distinct = bool(self.try_kw("distinct"))
+        self.try_kw("all")
+        items = [self.select_item()]
+        while self.try_op(","):
+            items.append(self.select_item())
+        from_ = None
+        if self.try_kw("from"):
+            from_ = self.table_refs()
+        where = self.expr() if self.try_kw("where") else None
+        group_by: List[ast.ExprNode] = []
+        if self.try_kw("group"):
+            self.expect_kw("by")
+            group_by.append(self.expr())
+            while self.try_op(","):
+                group_by.append(self.expr())
+        having = self.expr() if self.try_kw("having") else None
+        order_by = self.order_by_clause() if allow_tail else []
+        limit = self.limit_clause() if allow_tail else None
+        return ast.SelectStmt(items, from_, where, group_by, having,
+                               order_by, limit, distinct)
+
+    def select_item(self) -> ast.SelectItem:
+        if self.at_op("*"):
+            self.advance()
+            return ast.SelectItem(ast.Star())
+        # t.* form
+        if self.at("ident") and self.toks[self.i + 1].kind == "op" \
+                and self.toks[self.i + 1].value == "." \
+                and self.toks[self.i + 2].kind == "op" \
+                and self.toks[self.i + 2].value == "*":
+            t = self.advance().value
+            self.advance()
+            self.advance()
+            return ast.SelectItem(ast.Star(table=t))
+        e = self.expr()
+        alias = None
+        if self.try_kw("as"):
+            alias = self.ident_or_string()
+        elif self.at("ident"):
+            alias = self.advance().value
+        elif self.at("str"):
+            alias = self.advance().value
+        return ast.SelectItem(e, alias)
+
+    def ident_or_string(self) -> str:
+        if self.at("str"):
+            return self.advance().value
+        return self.ident()
+
+    def order_by_clause(self):
+        out = []
+        if self.try_kw("order"):
+            self.expect_kw("by")
+            while True:
+                e = self.expr()
+                desc = False
+                if self.try_kw("desc"):
+                    desc = True
+                else:
+                    self.try_kw("asc")
+                out.append((e, desc))
+                if not self.try_op(","):
+                    break
+        return out
+
+    def limit_clause(self):
+        if not self.try_kw("limit"):
+            return None
+        first = self._int_value()
+        if self.try_op(","):
+            return (first, self._int_value())
+        if self.try_kw("offset"):
+            return (self._int_value(), first)
+        return (0, first)
+
+    def _int_value(self) -> int:
+        if not self.at("int"):
+            raise ParseError(f"expected integer near {self._near()}")
+        return self.advance().value
+
+    # ---- table references ------------------------------------------------
+    def table_refs(self) -> ast.TableRef:
+        left = self.join_chain()
+        while self.try_op(","):
+            right = self.join_chain()
+            left = ast.JoinExpr("cross", left, right)
+        return left
+
+    def join_chain(self) -> ast.TableRef:
+        left = self.table_factor()
+        while True:
+            kind = None
+            if self.try_kw("inner"):
+                self.expect_kw("join")
+                kind = "inner"
+            elif self.try_kw("cross"):
+                self.expect_kw("join")
+                kind = "cross"
+            elif self.at_kw("left", "right"):
+                side = self.advance().value
+                self.try_kw("outer")
+                self.expect_kw("join")
+                kind = side
+            elif self.try_kw("join"):
+                kind = "inner"
+            else:
+                break
+            right = self.table_factor()
+            on = None
+            using = None
+            if self.try_kw("on"):
+                on = self.expr()
+            elif self.try_kw("using"):
+                self.expect_op("(")
+                using = [self.ident()]
+                while self.try_op(","):
+                    using.append(self.ident())
+                self.expect_op(")")
+            left = ast.JoinExpr(kind, left, right, on, using)
+        return left
+
+    def table_factor(self) -> ast.TableRef:
+        if self.try_op("("):
+            if self.at_kw("select"):
+                s = self.select_with_setops()
+                self.expect_op(")")
+                self.try_kw("as")
+                alias = self.ident()
+                return ast.SubqueryTable(s, alias)
+            refs = self.table_refs()
+            self.expect_op(")")
+            return refs
+        name = self.ident()
+        alias = None
+        if self.try_kw("as"):
+            alias = self.ident()
+        elif self.at("ident"):
+            alias = self.advance().value
+        return ast.TableName(name, alias)
+
+    # ---- DDL -------------------------------------------------------------
+    def create_table(self) -> ast.CreateTable:
+        self.expect_kw("create")
+        self.expect_kw("table")
+        if_not_exists = False
+        if self.try_kw("if"):
+            self.expect_kw("not")
+            # "exists" arrives as kw
+            self.expect_kw("exists")
+            if_not_exists = True
+        name = self.ident()
+        self.expect_op("(")
+        columns: List[ast.ColumnDef] = []
+        pk: List[str] = []
+        indexes: List[Tuple[str, List[str]]] = []
+        while True:
+            if self.try_kw("primary"):
+                self.expect_kw("key")
+                self.expect_op("(")
+                pk = [self.ident()]
+                while self.try_op(","):
+                    pk.append(self.ident())
+                self.expect_op(")")
+            elif self.at_kw("key", "index", "unique"):
+                unique = bool(self.try_kw("unique"))
+                self.try_kw("key") or self.try_kw("index")
+                iname = self.ident() if self.at("ident") else f"idx_{len(indexes)}"
+                self.expect_op("(")
+                cols = [self.ident()]
+                while self.try_op(","):
+                    cols.append(self.ident())
+                self.expect_op(")")
+                indexes.append(ast.IndexDef(iname, cols, unique))
+            else:
+                columns.append(self.column_def())
+            if not self.try_op(","):
+                break
+        self.expect_op(")")
+        # swallow table options: ENGINE=x CHARSET=y COMMENT 'z' ...
+        while not self.at("eof") and not self.at_op(";"):
+            self.advance()
+        for c in columns:
+            if c.primary_key:
+                pk = [c.name]
+        if pk:
+            for c in columns:
+                if c.name in pk:
+                    c.ftype = c.ftype.with_nullable(False)
+        return ast.CreateTable(name, columns, pk, indexes, if_not_exists)
+
+    def column_def(self) -> ast.ColumnDef:
+        name = self.ident()
+        ftype = self.field_type()
+        primary = False
+        default = None
+        nullable = True
+        while True:
+            if self.try_kw("not"):
+                self.expect_kw("null")
+                nullable = False
+            elif self.try_kw("null"):
+                nullable = True
+            elif self.try_kw("primary"):
+                self.expect_kw("key")
+                primary = True
+                nullable = False
+            elif self.try_kw("default"):
+                default = self.expr()
+            elif self.try_kw("auto_increment", "unique", "key"):
+                pass
+            elif self.try_kw("comment"):
+                self.advance()  # the comment string
+            elif self.at_kw("charset", "collate"):
+                self.advance()
+                self.try_op("=")
+                self.advance()
+            else:
+                break
+        ftype = ftype.with_nullable(nullable)
+        return ast.ColumnDef(name, ftype, primary, default)
+
+    def field_type(self) -> FieldType:
+        t = self.advance()
+        if t.kind != "kw":
+            raise ParseError(f"expected type near {self._near()}")
+        kw = t.value
+        args: List[int] = []
+        if self.try_op("("):
+            args.append(self._int_value())
+            while self.try_op(","):
+                args.append(self._int_value())
+            self.expect_op(")")
+        unsigned = bool(self.try_kw("unsigned"))
+        self.try_kw("signed")
+        kind_map = {
+            "int": TypeKind.INT, "integer": TypeKind.INT,
+            "bigint": TypeKind.BIGINT, "smallint": TypeKind.SMALLINT,
+            "tinyint": TypeKind.TINYINT, "float": TypeKind.FLOAT,
+            "double": TypeKind.DOUBLE, "decimal": TypeKind.DECIMAL,
+            "numeric": TypeKind.DECIMAL, "char": TypeKind.CHAR,
+            "varchar": TypeKind.VARCHAR, "text": TypeKind.VARCHAR,
+            "date": TypeKind.DATE, "datetime": TypeKind.DATETIME,
+            "timestamp": TypeKind.TIMESTAMP, "time": TypeKind.TIME,
+        }
+        kind = kind_map.get(kw)
+        if kind is None:
+            raise ParseError(f"unsupported type {kw!r}")
+        precision = args[0] if args else (10 if kind is TypeKind.DECIMAL else 0)
+        scale = args[1] if len(args) > 1 else 0
+        return FieldType(kind, True, precision, scale, unsigned)
+
+    def drop_table(self) -> ast.DropTable:
+        self.expect_kw("drop")
+        self.expect_kw("table")
+        if_exists = False
+        if self.try_kw("if"):
+            self.expect_kw("exists")
+            if_exists = True
+        names = [self.ident()]
+        while self.try_op(","):
+            names.append(self.ident())
+        return ast.DropTable(names, if_exists)
+
+    # ---- DML -------------------------------------------------------------
+    def insert(self) -> ast.Insert:
+        replace = self.advance().value == "replace"
+        ignore = bool(self.try_kw("ignore"))
+        self.expect_kw("into")
+        table = self.ident()
+        columns = None
+        if self.try_op("("):
+            columns = [self.ident()]
+            while self.try_op(","):
+                columns.append(self.ident())
+            self.expect_op(")")
+        if self.at_kw("select"):
+            return ast.Insert(table, columns,
+                              select=self.select_with_setops(),
+                              replace=replace, ignore=ignore)
+        self.expect_kw("values")
+        rows = []
+        while True:
+            self.expect_op("(")
+            row = [self.expr()]
+            while self.try_op(","):
+                row.append(self.expr())
+            self.expect_op(")")
+            rows.append(row)
+            if not self.try_op(","):
+                break
+        return ast.Insert(table, columns, rows, replace=replace, ignore=ignore)
+
+    def update(self) -> ast.Update:
+        self.expect_kw("update")
+        tname = self.ident()
+        alias = None
+        if self.try_kw("as"):
+            alias = self.ident()
+        elif self.at("ident"):
+            alias = self.advance().value
+        self.expect_kw("set")
+        assigns = []
+        while True:
+            col = self.ident()
+            # allow qualified t.col
+            if self.try_op("."):
+                col = self.ident()
+            self.expect_op("=")
+            assigns.append((col, self.expr()))
+            if not self.try_op(","):
+                break
+        where = self.expr() if self.try_kw("where") else None
+        return ast.Update(ast.TableName(tname, alias), assigns, where)
+
+    def delete(self) -> ast.Delete:
+        self.expect_kw("delete")
+        self.expect_kw("from")
+        tname = self.ident()
+        alias = None
+        if self.at("ident"):
+            alias = self.advance().value
+        where = self.expr() if self.try_kw("where") else None
+        return ast.Delete(ast.TableName(tname, alias), where)
+
+    # ---- misc statements -------------------------------------------------
+    def set_stmt(self) -> ast.SetStmt:
+        self.expect_kw("set")
+        global_scope = bool(self.try_kw("global"))
+        self.try_kw("session")
+        assigns = []
+        while True:
+            if self.try_op("@@"):
+                name = self._sysvar_name()
+            elif self.try_op("@"):
+                name = "@" + self.ident()
+            else:
+                name = self.ident()
+            if not self.try_op("="):
+                self.expect_op(":=")
+            assigns.append((name, self.expr()))
+            if not self.try_op(","):
+                break
+        return ast.SetStmt(assigns, global_scope)
+
+    def _sysvar_name(self) -> str:
+        # @@x | @@session.x | @@global.x
+        if self.try_kw("session", "global"):
+            self.expect_op(".")
+            return self.ident()
+        name = self.ident()
+        if self.try_op("."):
+            name = self.ident()
+        return name
+
+    def show_stmt(self) -> ast.ShowStmt:
+        self.expect_kw("show")
+        if self.try_kw("tables"):
+            return ast.ShowStmt("tables")
+        if self.try_kw("databases"):
+            return ast.ShowStmt("databases")
+        if self.try_kw("variables"):
+            like = None
+            if self.try_kw("like"):
+                if not self.at("str"):
+                    raise ParseError(
+                        f"expected string pattern near {self._near()}")
+                like = self.advance().value
+            return ast.ShowStmt("variables", like=like)
+        if self.try_kw("columns"):
+            self.expect_kw("from")
+            return ast.ShowStmt("columns", target=self.ident())
+        if self.try_kw("create"):
+            self.expect_kw("table")
+            return ast.ShowStmt("create_table", target=self.ident())
+        raise ParseError(f"unsupported SHOW near {self._near()}")
+
+    # ---- expressions -----------------------------------------------------
+    def expr(self) -> ast.ExprNode:
+        return self.or_expr()
+
+    def or_expr(self) -> ast.ExprNode:
+        left = self.xor_expr()
+        while self.at_kw("or") or self.at_op("||"):
+            self.advance()
+            left = ast.BinaryOp("or", left, self.xor_expr())
+        return left
+
+    def xor_expr(self) -> ast.ExprNode:
+        left = self.and_expr()
+        while self.try_kw("xor"):
+            left = ast.BinaryOp("xor", left, self.and_expr())
+        return left
+
+    def and_expr(self) -> ast.ExprNode:
+        left = self.not_expr()
+        while self.at_kw("and") or self.at_op("&&"):
+            self.advance()
+            left = ast.BinaryOp("and", left, self.not_expr())
+        return left
+
+    def not_expr(self) -> ast.ExprNode:
+        if self.try_kw("not") or self.try_op("!"):
+            return ast.UnaryOp("not", self.not_expr())
+        return self.predicate()
+
+    _CMP = {"=": "eq", "<>": "ne", "!=": "ne", "<": "lt", "<=": "le",
+            ">": "gt", ">=": "ge", "<=>": "nulleq"}
+
+    def predicate(self) -> ast.ExprNode:
+        left = self.add_expr()
+        while True:
+            if self.cur.kind == "op" and self.cur.value in self._CMP:
+                op = self._CMP[self.advance().value]
+                # comparison with subquery: = (SELECT ...)
+                right = self.add_expr()
+                left = ast.BinaryOp(op, left, right)
+                continue
+            negated = False
+            save = self.i
+            if self.try_kw("not"):
+                negated = True
+            if self.try_kw("is"):
+                neg2 = bool(self.try_kw("not"))
+                self.expect_kw("null")
+                left = ast.IsNull(left, negated ^ neg2)
+                continue
+            if self.try_kw("in"):
+                self.expect_op("(")
+                if self.at_kw("select"):
+                    sub = ast.Subquery(self.select_with_setops())
+                    self.expect_op(")")
+                    left = ast.InExpr(left, None, sub, negated)
+                else:
+                    items = [self.expr()]
+                    while self.try_op(","):
+                        items.append(self.expr())
+                    self.expect_op(")")
+                    left = ast.InExpr(left, items, None, negated)
+                continue
+            if self.try_kw("between"):
+                low = self.add_expr()
+                self.expect_kw("and")
+                high = self.add_expr()
+                left = ast.Between(left, low, high, negated)
+                continue
+            if self.try_kw("like"):
+                left = ast.LikeExpr(left, self.add_expr(), negated)
+                continue
+            if negated:
+                self.i = save
+            break
+        return left
+
+    def add_expr(self) -> ast.ExprNode:
+        left = self.mul_expr()
+        while self.at_op("+", "-"):
+            op = "plus" if self.advance().value == "+" else "minus"
+            left = ast.BinaryOp(op, left, self.mul_expr())
+        return left
+
+    def mul_expr(self) -> ast.ExprNode:
+        left = self.unary_expr()
+        while True:
+            if self.at_op("*", "/", "%"):
+                sym = self.advance().value
+                op = {"*": "mul", "/": "div", "%": "mod"}[sym]
+            elif self.at_kw("div"):
+                self.advance()
+                op = "intdiv"
+            elif self.at_kw("mod"):
+                self.advance()
+                op = "mod"
+            else:
+                break
+            left = ast.BinaryOp(op, left, self.unary_expr())
+        return left
+
+    def unary_expr(self) -> ast.ExprNode:
+        if self.try_op("-"):
+            return ast.UnaryOp("minus", self.unary_expr())
+        if self.try_op("+"):
+            return self.unary_expr()
+        return self.primary()
+
+    def primary(self) -> ast.ExprNode:
+        t = self.cur
+        if t.kind in ("int", "decimal", "float", "str"):
+            self.advance()
+            return ast.Literal(t.value, t.kind)
+        if t.is_kw("null"):
+            self.advance()
+            return ast.Literal(None, "null")
+        if t.is_kw("true"):
+            self.advance()
+            return ast.Literal(1, "int")
+        if t.is_kw("false"):
+            self.advance()
+            return ast.Literal(0, "int")
+        if self.try_op("@@"):
+            return ast.VariableRef(self._sysvar_name(), system=True)
+        if self.try_op("@"):
+            return ast.VariableRef(self.ident(), system=False)
+        if self.try_op("("):
+            if self.at_kw("select"):
+                s = self.select_with_setops()
+                self.expect_op(")")
+                return ast.Subquery(s)
+            e = self.expr()
+            self.expect_op(")")
+            return e
+        if t.is_kw("exists"):
+            self.advance()
+            self.expect_op("(")
+            s = self.select_with_setops()
+            self.expect_op(")")
+            return ast.ExistsExpr(ast.Subquery(s))
+        if t.is_kw("case"):
+            return self.case_expr()
+        if t.is_kw("cast"):
+            self.advance()
+            self.expect_op("(")
+            e = self.expr()
+            self.expect_kw("as")
+            ftype = self.field_type()
+            self.expect_op(")")
+            return ast.CastExpr(e, ftype)
+        if t.is_kw("interval"):
+            self.advance()
+            v = self.add_expr()
+            unit = self.ident().lower()
+            return ast.IntervalExpr(v, unit)
+        if t.is_kw("if"):  # IF(c, a, b) function form
+            self.advance()
+            self.expect_op("(")
+            args = [self.expr()]
+            while self.try_op(","):
+                args.append(self.expr())
+            self.expect_op(")")
+            return ast.FuncCall("if", args)
+        if t.is_kw("date", "time", "timestamp") and \
+                self.toks[self.i + 1].kind == "str":
+            # temporal literal: DATE '1994-01-01'
+            kw = self.advance().value
+            s = self.advance().value
+            return ast.FuncCall(f"{kw}_literal", [ast.Literal(s, "str")])
+        if t.is_kw("replace", "left", "right", "database"):
+            # keywords that double as function names
+            if self.toks[self.i + 1].kind == "op" and \
+                    self.toks[self.i + 1].value == "(":
+                name = self.advance().value
+                return self._call(name)
+        if t.kind == "ident" or (t.kind == "kw" and t.value in (
+                "date", "time", "timestamp", "values", "if")):
+            name = self.advance().value
+            if self.at_op("("):
+                return self._call(name.lower())
+            parts = [name]
+            while self.try_op("."):
+                if self.at_op("*"):
+                    self.advance()
+                    return ast.Star(table=parts[-1])
+                parts.append(self.ident())
+            return ast.Name(tuple(parts))
+        raise ParseError(f"unexpected token near {self._near()}")
+
+    def _call(self, name: str) -> ast.ExprNode:
+        self.expect_op("(")
+        if self.try_op("*"):
+            self.expect_op(")")
+            return ast.FuncCall(name, [ast.Star()])
+        if self.try_op(")"):
+            return ast.FuncCall(name, [])
+        distinct = bool(self.try_kw("distinct"))
+        args = [self.expr()]
+        while self.try_op(","):
+            args.append(self.expr())
+        self.expect_op(")")
+        return ast.FuncCall(name, args, distinct)
+
+    def case_expr(self) -> ast.CaseExpr:
+        self.expect_kw("case")
+        operand = None
+        if not self.at_kw("when"):
+            operand = self.expr()
+        whens = []
+        while self.try_kw("when"):
+            c = self.expr()
+            self.expect_kw("then")
+            r = self.expr()
+            whens.append((c, r))
+        else_ = None
+        if self.try_kw("else"):
+            else_ = self.expr()
+        self.expect_kw("end")
+        return ast.CaseExpr(operand, whens, else_)
