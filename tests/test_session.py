@@ -1,0 +1,438 @@
+"""End-to-end SQL tests over the full spine (TestKit pattern, SURVEY §4
+tier 2: testkit/testkit.go MustExec/MustQuery over an embedded store)."""
+
+import datetime
+from decimal import Decimal
+
+import pytest
+
+from tidb_tpu.errors import (PlanError, TableExistsError, TiDBTPUError,
+                             TxnError, UnknownColumnError, UnknownTableError)
+from tidb_tpu.session import Engine, Session
+
+
+class TK:
+    """testkit.TestKit analog."""
+
+    def __init__(self, session: Session):
+        self.s = session
+
+    def must_exec(self, sql):
+        return self.s.query(sql)
+
+    def must_query(self, sql, expect=None):
+        rs = self.s.query(sql)
+        if expect is not None:
+            assert rs.rows == expect, f"{sql}\n got: {rs.rows}\nwant: {expect}"
+        return rs
+
+
+@pytest.fixture()
+def tk():
+    return TK(Session())
+
+
+@pytest.fixture()
+def people(tk):
+    tk.must_exec("create table t (id bigint primary key, name varchar(20), "
+                 "age bigint, city varchar(20), salary decimal(10,2))")
+    tk.must_exec(
+        "insert into t values "
+        "(1,'alice',30,'nyc',100.50),"
+        "(2,'bob',25,'sf',90.00),"
+        "(3,'carol',35,'nyc',120.25),"
+        "(4,'dave',null,'la',80.75),"
+        "(5,'erin',28,null,null)")
+    return tk
+
+
+# ---------------------------------------------------------------------------
+# basics
+# ---------------------------------------------------------------------------
+
+
+def test_select_no_from(tk):
+    tk.must_query("select 1", [(1,)])
+    tk.must_query("select 1+2*3, 'hi'", [(7, "hi")])
+    tk.must_query("select null", [(None,)])
+
+
+def test_create_insert_select(people):
+    people.must_query("select id, name from t order by id",
+                      [(1, "alice"), (2, "bob"), (3, "carol"),
+                       (4, "dave"), (5, "erin")])
+
+
+def test_select_star_where(people):
+    rs = people.must_query("select * from t where city = 'nyc' order by id")
+    assert [r[0] for r in rs.rows] == [1, 3]
+    assert rs.names == ["id", "name", "age", "city", "salary"]
+
+
+def test_where_null_semantics(people):
+    # NULL city rows are excluded by any city comparison
+    people.must_query("select id from t where city <> 'nyc' order by id",
+                      [(2,), (4,)])
+    people.must_query("select id from t where city is null", [(5,)])
+    people.must_query("select id from t where age is not null and age > 26 "
+                      "order by id", [(1,), (3,), (5,)])
+
+
+def test_expressions(people):
+    people.must_query("select id, salary * 2 from t where id = 1",
+                      [(1, Decimal("201.00"))])
+    people.must_query("select upper(name) from t where id = 2", [("BOB",)])
+    people.must_query("select id from t where name like 'a%'", [(1,)])
+    people.must_query(
+        "select case when age >= 30 then 'old' else 'young' end "
+        "from t where id in (1, 2) order by id", [("old",), ("young",)])
+
+
+def test_order_by_limit(people):
+    people.must_query("select id from t order by age desc, id limit 2",
+                      [(3,), (1,)])
+    # NULLs first ASC
+    people.must_query("select id from t order by age limit 1", [(4,)])
+    people.must_query("select id from t order by id limit 2 offset 2",
+                      [(3,), (4,)])
+
+
+def test_alias_and_ordinal(people):
+    people.must_query("select age + 1 as a from t where id <= 2 "
+                      "order by a desc", [(31,), (26,)])
+    people.must_query("select id, name from t order by 2 desc limit 1",
+                      [(5, "erin")])
+
+
+# ---------------------------------------------------------------------------
+# aggregation
+# ---------------------------------------------------------------------------
+
+
+def test_scalar_agg(people):
+    people.must_query("select count(*), count(age), sum(age), min(age), "
+                      "max(age) from t", [(5, 4, 118, 25, 35)])
+    rs = people.must_query("select avg(age) from t")
+    assert rs.rows[0][0] == Decimal("29.5000")
+
+
+def test_scalar_agg_empty(tk):
+    tk.must_exec("create table e (a bigint)")
+    tk.must_query("select count(*), sum(a), min(a) from e",
+                  [(0, None, None)])
+    tk.must_query("select count(*) from e where a > 5", [(0,)])
+
+
+def test_group_by(people):
+    people.must_query(
+        "select city, count(*), sum(salary) from t group by city "
+        "order by city",
+        [(None, 1, None), ("la", 1, Decimal("80.75")),
+         ("nyc", 2, Decimal("220.75")), ("sf", 1, Decimal("90.00"))])
+
+
+def test_group_by_having(people):
+    people.must_query(
+        "select city, count(*) as c from t group by city having c > 1",
+        [("nyc", 2)])
+
+
+def test_group_by_expr(people):
+    people.must_query(
+        "select age > 27, count(*) from t where age is not null "
+        "group by age > 27 order by 1", [(0, 1), (1, 3)])
+
+
+def test_distinct(people):
+    people.must_query("select distinct city from t order by city",
+                      [(None,), ("la",), ("nyc",), ("sf",)])
+    people.must_query("select count(distinct city) from t", [(3,)])
+
+
+def test_first_row_loose_group(people):
+    # MySQL loose GROUP BY: non-grouped column gets first_row
+    rs = people.must_query("select city, age from t where id = 1 "
+                           "group by city")
+    assert rs.rows == [("nyc", 30)]
+
+
+def test_agg_distinct_and_variance(tk):
+    tk.must_exec("create table v (g varchar(5), x double)")
+    tk.must_exec("insert into v values ('a',1.0),('a',1.0),('a',3.0),"
+                 "('b',5.0),('b',null)")
+    tk.must_query("select g, sum(distinct x) from v group by g order by g",
+                  [("a", 4.0), ("b", 5.0)])
+    rs = tk.must_query("select g, var_pop(x) from v group by g order by g")
+    assert rs.rows[0][1] == pytest.approx(8 / 9)
+    assert rs.rows[1][1] == pytest.approx(0.0)
+
+
+def test_group_concat(people):
+    rs = people.must_query("select city, group_concat(name) from t "
+                           "where city = 'nyc' group by city")
+    assert rs.rows == [("nyc", "alice,carol")]
+
+
+# ---------------------------------------------------------------------------
+# joins
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def orders(people):
+    people.must_exec("create table o (oid bigint, uid bigint, amount bigint)")
+    people.must_exec("insert into o values (10,1,5),(11,1,7),(12,2,3),"
+                     "(13,9,1),(14,null,2)")
+    return people
+
+
+def test_inner_join(orders):
+    orders.must_query(
+        "select t.id, o.oid from t join o on t.id = o.uid order by o.oid",
+        [(1, 10), (1, 11), (2, 12)])
+
+
+def test_left_join(orders):
+    orders.must_query(
+        "select t.id, o.oid from t left join o on t.id = o.uid "
+        "order by t.id, o.oid",
+        [(1, 10), (1, 11), (2, 12), (3, None), (4, None), (5, None)])
+
+
+def test_right_join(orders):
+    orders.must_query(
+        "select t.id, o.oid from o right join t on t.id = o.uid "
+        "order by t.id, o.oid",
+        [(1, 10), (1, 11), (2, 12), (3, None), (4, None), (5, None)])
+
+
+def test_join_null_keys_never_match(orders):
+    # o.uid NULL row must not match anything
+    orders.must_query("select count(*) from t join o on t.id = o.uid",
+                      [(3,)])
+
+
+def test_join_with_condition(orders):
+    orders.must_query(
+        "select t.id, o.oid from t join o on t.id = o.uid and o.amount > 4 "
+        "order by o.oid", [(1, 10), (1, 11)])
+    orders.must_query(
+        "select t.id, o.oid from t left join o on t.id = o.uid "
+        "and o.amount > 5 where t.id <= 2 order by t.id",
+        [(1, 11), (2, None)])
+
+
+def test_join_agg(orders):
+    orders.must_query(
+        "select t.city, sum(o.amount) from t join o on t.id = o.uid "
+        "group by t.city order by t.city", [("nyc", 12), ("sf", 3)])
+
+
+def test_cross_join(orders):
+    orders.must_query("select count(*) from t, o", [(25,)])
+    orders.must_query(
+        "select count(*) from t, o where t.id = o.uid", [(3,)])
+
+
+def test_self_join(people):
+    people.must_query(
+        "select a.id, b.id from t a join t b on a.age < b.age "
+        "and a.city = b.city", [(1, 3)])
+
+
+# ---------------------------------------------------------------------------
+# subqueries, set ops, derived tables
+# ---------------------------------------------------------------------------
+
+
+def test_scalar_subquery(people):
+    people.must_query("select id from t where age > (select avg(age) from t) "
+                      "order by id", [(1,), (3,)])
+
+
+def test_in_subquery(orders):
+    orders.must_query("select id from t where id in (select uid from o) "
+                      "order by id", [(1,), (2,)])
+    orders.must_query("select id from t where id not in "
+                      "(select uid from o where uid is not null) "
+                      "order by id", [(3,), (4,), (5,)])
+
+
+def test_exists(orders):
+    orders.must_query(
+        "select count(*) from t where exists (select 1 from o where amount > 100)",
+        [(0,)])
+
+
+def test_union(people):
+    people.must_query(
+        "select id from t where id <= 2 union all select id from t "
+        "where id = 1 order by id", [(1,), (1,), (2,)])
+    people.must_query(
+        "select city from t where id=1 union select city from t where id=3",
+        [("nyc",)])
+
+
+def test_derived_table(people):
+    people.must_query(
+        "select x.c from (select city, count(*) as c from t group by city) x "
+        "where x.city = 'nyc'", [(2,)])
+
+
+# ---------------------------------------------------------------------------
+# DML + transactions
+# ---------------------------------------------------------------------------
+
+
+def test_update_delete(people):
+    people.must_exec("update t set salary = salary + 10 where city = 'nyc'")
+    people.must_query("select sum(salary) from t where city = 'nyc'",
+                      [(Decimal("240.75"),)])
+    rs = people.must_exec("delete from t where age is null")
+    assert rs.affected_rows == 1
+    people.must_query("select count(*) from t", [(4,)])
+
+
+def test_update_all_rows(people):
+    people.must_exec("update t set age = 1")
+    people.must_query("select sum(age) from t", [(5,)])
+
+
+def test_txn_commit_rollback():
+    eng = Engine()
+    s1, s2 = eng.new_session(), eng.new_session()
+    s1.query("create table a (x bigint)")
+    s1.query("begin")
+    s1.query("insert into a values (1)")
+    # staged write visible to s1, not s2
+    assert s1.query("select count(*) from a").rows == [(1,)]
+    assert s2.query("select count(*) from a").rows == [(0,)]
+    s1.query("commit")
+    assert s2.query("select count(*) from a").rows == [(1,)]
+    s1.query("begin")
+    s1.query("delete from a")
+    s1.query("rollback")
+    assert s1.query("select count(*) from a").rows == [(1,)]
+
+
+def test_txn_write_conflict():
+    eng = Engine()
+    s1, s2 = eng.new_session(), eng.new_session()
+    s1.query("create table c (x bigint); insert into c values (1)")
+    s1.query("begin")
+    s2.query("begin")
+    s1.query("delete from c where x = 1")
+    s2.query("delete from c where x = 1")
+    s1.query("commit")
+    with pytest.raises(TxnError):
+        s2.query("commit")
+
+
+def test_insert_select_and_defaults(tk):
+    tk.must_exec("create table src (a bigint, b varchar(10))")
+    tk.must_exec("insert into src values (1,'x'),(2,'y')")
+    tk.must_exec("create table dst (a bigint, b varchar(10), "
+                 "c bigint default 7)")
+    tk.must_exec("insert into dst (a, b) select a, b from src")
+    tk.must_query("select a, b, c from dst order by a",
+                  [(1, "x", 7), (2, "y", 7)])
+
+
+# ---------------------------------------------------------------------------
+# DDL / SHOW / EXPLAIN / errors
+# ---------------------------------------------------------------------------
+
+
+def test_show_and_explain(people):
+    rs = people.must_query("show tables")
+    assert ("t",) in rs.rows
+    rs = people.must_query("explain select city, count(*) from t group by city")
+    ops = "".join(r[0] for r in rs.rows)
+    assert "HashAgg" in ops and "TableScan" in ops
+    rs = people.must_query(
+        "explain analyze select count(*) from t where age > 1")
+    assert any("rows:" in str(r[2]) for r in rs.rows)
+
+
+def test_errors(tk):
+    tk.must_exec("create table err (a bigint)")
+    with pytest.raises(TableExistsError):
+        tk.must_exec("create table err (a bigint)")
+    with pytest.raises(UnknownTableError):
+        tk.must_exec("select * from nope")
+    with pytest.raises(UnknownColumnError):
+        tk.must_exec("select nope from err")
+    with pytest.raises(TiDBTPUError):
+        tk.must_exec("insert into err values (1, 2)")
+
+
+def test_types_roundtrip(tk):
+    tk.must_exec("create table ty (d date, dt datetime, dec decimal(12,3), "
+                 "f double, s varchar(10))")
+    tk.must_exec("insert into ty values ('2024-03-15', "
+                 "'2024-03-15 10:30:00', 1.125, 2.5, 'abc')")
+    rs = tk.must_query("select * from ty")
+    d, dt, dec, f, s = rs.rows[0]
+    assert d == datetime.date(2024, 3, 15)
+    assert dt == datetime.datetime(2024, 3, 15, 10, 30)
+    assert dec == Decimal("1.125")
+    assert f == 2.5 and s == "abc"
+    tk.must_query("select year(d), month(d), dayofmonth(d) from ty",
+                  [(2024, 3, 15)])
+
+
+def test_truncate(people):
+    people.must_exec("truncate table t")
+    people.must_query("select count(*) from t", [(0,)])
+
+
+# ---------------------------------------------------------------------------
+# regression tests for review findings (commit atomicity, validation, ...)
+# ---------------------------------------------------------------------------
+
+
+def test_commit_atomicity_multi_table():
+    # a conflict on one table must leave the other table untouched
+    eng = Engine()
+    s1, s2 = eng.new_session(), eng.new_session()
+    s1.query("create table t1 (x bigint); insert into t1 values (1),(2)")
+    s1.query("create table t2 (x bigint); insert into t2 values (1)")
+    s1.query("begin")
+    s1.query("delete from t1 where x = 1")
+    s1.query("delete from t2 where x = 1")
+    s2.query("delete from t2 where x = 1")  # autocommit conflict source
+    with pytest.raises(TxnError):
+        s1.query("commit")
+    assert s2.query("select count(*) from t1").rows == [(2,)]
+
+
+def test_concurrent_append_then_staged_delete():
+    # region top-off must not break a concurrent txn's staged delete mask
+    eng = Engine()
+    s1, s2 = eng.new_session(), eng.new_session()
+    s1.query("create table g (x bigint); insert into g values (1),(2),(3)")
+    s1.query("begin")
+    s1.query("delete from g where x = 2")
+    s2.query("insert into g values (4),(5)")  # merges into the same region
+    s1.query("commit")
+    assert s2.query("select x from g order by x").rows == \
+        [(1,), (3,), (4,), (5,)]
+
+
+def test_count_distinct_multi_arg(tk):
+    tk.must_exec("create table cd (a bigint, b bigint)")
+    tk.must_exec("insert into cd values (1,1),(1,2),(2,1),(1,1),(1,null)")
+    tk.must_query("select count(distinct a, b) from cd", [(3,)])
+
+
+def test_insert_unknown_column(tk):
+    tk.must_exec("create table iu (a bigint, b bigint)")
+    with pytest.raises(UnknownColumnError):
+        tk.must_exec("insert into iu (a, zzz) values (1, 99)")
+
+
+def test_update_not_null(tk):
+    tk.must_exec("create table un (a bigint not null)")
+    tk.must_exec("insert into un values (1)")
+    with pytest.raises(TiDBTPUError):
+        tk.must_exec("update un set a = null")

@@ -1,0 +1,184 @@
+"""Schema metadata & versioned catalog.
+
+Ref: /root/reference/infoschema/ (versioned InfoSchema snapshots,
+infoschema/infoschema.go), parser/model/ (TableInfo/ColumnInfo), meta/
+(catalog persistence). The reference persists catalog state in KV and syncs
+schema versions across nodes via etcd; here the catalog is an in-process
+versioned map — every DDL bumps `version` and replaces the snapshot, so
+readers hold an immutable InfoSchema exactly like domain.Domain's infoCache
+(domain/domain.go:69-99).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tidb_tpu.errors import (TableExistsError, UnknownColumnError,
+                             UnknownTableError)
+from tidb_tpu.types import FieldType
+
+
+@dataclass(frozen=True)
+class ColumnInfo:
+    """Ref: parser/model/model.go ColumnInfo."""
+
+    name: str
+    ftype: FieldType
+    offset: int = 0
+    primary_key: bool = False
+    default: object = None
+    has_default: bool = False
+
+
+@dataclass(frozen=True)
+class IndexInfo:
+    """Ref: parser/model/model.go IndexInfo."""
+
+    name: str
+    columns: Tuple[str, ...]
+    unique: bool = False
+
+
+@dataclass(frozen=True)
+class TableInfo:
+    """Ref: parser/model/model.go TableInfo."""
+
+    id: int
+    name: str
+    columns: Tuple[ColumnInfo, ...]
+    primary_key: Tuple[str, ...] = ()
+    indexes: Tuple[IndexInfo, ...] = ()
+
+    def column(self, name: str) -> ColumnInfo:
+        lname = name.lower()
+        for c in self.columns:
+            if c.name.lower() == lname:
+                return c
+        raise UnknownColumnError(f"Unknown column '{name}' in '{self.name}'")
+
+    def has_column(self, name: str) -> bool:
+        lname = name.lower()
+        return any(c.name.lower() == lname for c in self.columns)
+
+    @property
+    def field_types(self) -> List[FieldType]:
+        return [c.ftype for c in self.columns]
+
+    @property
+    def column_names(self) -> List[str]:
+        return [c.name for c in self.columns]
+
+
+class InfoSchema:
+    """One immutable schema snapshot (ref: infoschema/infoschema.go:60)."""
+
+    def __init__(self, version: int, tables: Dict[str, TableInfo]):
+        self.version = version
+        self._tables = tables  # lower-name → TableInfo
+
+    def table(self, name: str) -> TableInfo:
+        t = self._tables.get(name.lower())
+        if t is None:
+            raise UnknownTableError(f"Table '{name}' doesn't exist")
+        return t
+
+    def table_by_id(self, tid: int) -> Optional[TableInfo]:
+        for t in self._tables.values():
+            if t.id == tid:
+                return t
+        return None
+
+    def has_table(self, name: str) -> bool:
+        return name.lower() in self._tables
+
+    def list_tables(self) -> List[TableInfo]:
+        return sorted(self._tables.values(), key=lambda t: t.name.lower())
+
+
+class Catalog:
+    """Mutable catalog owner; DDL entry point (ref: domain.Domain + ddl/).
+
+    The reference runs DDL as an async owner-elected job queue with F1 state
+    transitions (ddl/ddl_worker.go:82) because schema changes must propagate
+    across stateless nodes; in-process we apply synchronously under a lock but
+    keep the same observable contract: monotonically increasing schema
+    versions and immutable snapshots.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._snapshot = InfoSchema(0, {})
+        self._history: List[str] = []  # DDL job log (ref: meta DDL job queue)
+
+    @property
+    def info_schema(self) -> InfoSchema:
+        return self._snapshot
+
+    def _bump(self, tables: Dict[str, TableInfo], job: str) -> None:
+        self._snapshot = InfoSchema(self._snapshot.version + 1, tables)
+        self._history.append(job)
+
+    def ddl_history(self) -> List[str]:
+        return list(self._history)
+
+    def create_table(self, name: str, columns: Sequence[ColumnInfo],
+                     primary_key: Sequence[str] = (),
+                     indexes: Sequence[IndexInfo] = (),
+                     if_not_exists: bool = False) -> Optional[TableInfo]:
+        with self._lock:
+            key = name.lower()
+            if key in self._snapshot._tables:
+                if if_not_exists:
+                    return None
+                raise TableExistsError(f"Table '{name}' already exists")
+            cols = tuple(replace(c, offset=i) for i, c in enumerate(columns))
+            info = TableInfo(next(self._ids), name, cols,
+                             tuple(primary_key), tuple(indexes))
+            tables = dict(self._snapshot._tables)
+            tables[key] = info
+            self._bump(tables, f"create table {name}")
+            return info
+
+    def drop_table(self, name: str, if_exists: bool = False) -> Optional[TableInfo]:
+        with self._lock:
+            key = name.lower()
+            info = self._snapshot._tables.get(key)
+            if info is None:
+                if if_exists:
+                    return None
+                raise UnknownTableError(f"Unknown table '{name}'")
+            tables = dict(self._snapshot._tables)
+            del tables[key]
+            self._bump(tables, f"drop table {name}")
+            return info
+
+    def rename_table(self, old: str, new: str) -> TableInfo:
+        with self._lock:
+            info = self._snapshot.table(old)
+            if new.lower() in self._snapshot._tables:
+                raise TableExistsError(f"Table '{new}' already exists")
+            renamed = replace(info, name=new)
+            tables = dict(self._snapshot._tables)
+            del tables[old.lower()]
+            tables[new.lower()] = renamed
+            self._bump(tables, f"rename table {old} to {new}")
+            return renamed
+
+    def add_column(self, table: str, col: ColumnInfo) -> TableInfo:
+        """Online ADD COLUMN (ref: ddl/column.go). Storage backfills lazily:
+        existing regions surface the column's default via schema offset."""
+        with self._lock:
+            info = self._snapshot.table(table)
+            if info.has_column(col.name):
+                raise TableExistsError(
+                    f"Duplicate column name '{col.name}'")
+            cols = info.columns + (replace(col, offset=len(info.columns)),)
+            updated = replace(info, columns=cols)
+            tables = dict(self._snapshot._tables)
+            tables[table.lower()] = updated
+            self._bump(tables, f"alter table {table} add column {col.name}")
+            return updated

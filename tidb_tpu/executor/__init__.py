@@ -1,0 +1,305 @@
+"""Volcano executors over Chunks (ref: /root/reference/executor/).
+
+`Executor` mirrors the reference's three-method iterator interface
+(executor/executor.go:259-265: Open / Next(*chunk.Chunk) / Close); `build`
+mirrors executorBuilder.build (executor/builder.go:144), the single seam
+where engines plug in: a PhysTpuFragment node builds a fragment executor
+that runs the whole subtree as one jitted device program instead of a
+CPU operator pipeline.
+
+All CPU operators are vectorized numpy over Chunk columns — they are both
+the correctness oracle for the device kernels (the reference's vec-vs-scalar
+twin-test pattern, SURVEY §4 tier 1) and the small-input fallback path.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from tidb_tpu.chunk import Chunk, Column, DEFAULT_CHUNK_SIZE
+from tidb_tpu.errors import ExecutionError, QueryKilledError
+from tidb_tpu.expression import Expression
+from tidb_tpu.expression.runner import eval_on_chunk, filter_mask
+from tidb_tpu.planner.physical import (PhysDual, PhysHashAgg, PhysHashJoin,
+                                       PhysLimit, PhysProjection,
+                                       PhysSelection, PhysSort, PhysTableScan,
+                                       PhysTopN, PhysTpuFragment,
+                                       PhysUnionAll, PhysicalPlan)
+from tidb_tpu.types import FieldType
+
+
+class ExecContext:
+    """Per-statement execution context (ref: sessionctx.Context subset)."""
+
+    def __init__(self, txn=None, snapshot=None, vars: Optional[Dict] = None):
+        self.txn = txn              # storage.Transaction (reads merge staged)
+        self.snapshot = snapshot    # storage.Snapshot (autocommit reads)
+        self.vars = vars or {}
+        self.killed = False
+        self.runtime_stats: Dict[int, "OperatorStats"] = {}
+
+    @property
+    def chunk_size(self) -> int:
+        return int(self.vars.get("max_chunk_size", DEFAULT_CHUNK_SIZE))
+
+    def check_killed(self):
+        if self.killed:
+            raise QueryKilledError("Query execution was interrupted")
+
+    def scan_table(self, table_id: int):
+        """Yield (region_or_None, chunk, alive_mask) honoring txn staging."""
+        if self.txn is not None:
+            yield from self.txn.scan(table_id)
+        else:
+            for region, alive in self.snapshot.scan(table_id):
+                yield region, region.chunk, alive
+
+
+class OperatorStats:
+    """Per-operator runtime stats for EXPLAIN ANALYZE
+    (ref: util/execdetails RuntimeStatsColl)."""
+
+    __slots__ = ("rows", "wall_ns", "opens")
+
+    def __init__(self):
+        self.rows = 0
+        self.wall_ns = 0
+        self.opens = 0
+
+
+class Executor:
+    """Ref: executor/executor.go:259-265."""
+
+    def __init__(self, schema: List[FieldType],
+                 children: Sequence["Executor"] = ()):
+        self.schema = schema
+        self.children = list(children)
+        self.ctx: Optional[ExecContext] = None
+        self.stats = OperatorStats()
+
+    def open(self, ctx: ExecContext) -> None:
+        self.ctx = ctx
+        self.stats.opens += 1
+        for c in self.children:
+            c.open(ctx)
+
+    def next(self) -> Optional[Chunk]:
+        """One output batch, or None when drained. The timing/kill wrapper is
+        `child_next` (ref: the Next wrapper executor/executor.go:268-287)."""
+        raise NotImplementedError
+
+    def child_next(self, i: int = 0) -> Optional[Chunk]:
+        self.ctx.check_killed()
+        child = self.children[i]
+        t0 = time.perf_counter_ns()
+        chunk = child.next()
+        child.stats.wall_ns += time.perf_counter_ns() - t0
+        if chunk is not None:
+            child.stats.rows += chunk.num_rows
+        return chunk
+
+    def close(self) -> None:
+        for c in self.children:
+            c.close()
+
+    def drain(self) -> Chunk:
+        """Pull everything into one Chunk (blocking-operator helper)."""
+        chunks = []
+        while True:
+            ch = self.next()
+            if ch is None:
+                break
+            if ch.num_rows:
+                chunks.append(ch)
+        if not chunks:
+            return _empty_chunk(self.schema)
+        return Chunk.concat(chunks) if len(chunks) > 1 else chunks[0]
+
+
+def _empty_chunk(schema: List[FieldType]) -> Chunk:
+    cols = []
+    for ft in schema:
+        vals = (np.empty(0, dtype=object) if ft.is_varlen
+                else np.empty(0, dtype=ft.np_dtype))
+        cols.append(Column(ft, vals, None))
+    return Chunk(cols)
+
+
+def run_to_completion(root: Executor, ctx: ExecContext) -> List[Chunk]:
+    root.open(ctx)
+    try:
+        out = []
+        while True:
+            ch = root.next()
+            if ch is None:
+                return out
+            root.stats.rows += ch.num_rows
+            if ch.num_rows:
+                out.append(ch)
+    finally:
+        root.close()
+
+
+# ---------------------------------------------------------------------------
+# Simple executors
+# ---------------------------------------------------------------------------
+
+
+class DualExec(Executor):
+    """SELECT without FROM: emits n_rows empty-schema rows."""
+
+    def __init__(self, schema, n_rows: int):
+        super().__init__(schema)
+        self.n_rows = n_rows
+        self._done = False
+
+    def open(self, ctx):
+        super().open(ctx)
+        self._done = False
+
+    def next(self):
+        if self._done:
+            return None
+        self._done = True
+        return _dual_chunk(self.n_rows)
+
+
+def _dual_chunk(n: int) -> Chunk:
+    # a zero-column chunk can't carry a row count; use a hidden const column
+    from tidb_tpu import types as T
+    return Chunk([Column(T.bigint(False), np.zeros(n, dtype=np.int64), None)])
+
+
+class SelectionExec(Executor):
+    """Ref: executor/executor.go SelectionExec + VectorizedFilter."""
+
+    def __init__(self, conditions: List[Expression], child: Executor):
+        super().__init__(child.schema, [child])
+        self.conditions = conditions
+
+    def next(self):
+        while True:
+            ch = self.child_next()
+            if ch is None:
+                return None
+            mask = None
+            for cond in self.conditions:
+                m = filter_mask(cond, ch)
+                mask = m if mask is None else (mask & m)
+            out = ch.filter(mask) if mask is not None else ch
+            if out.num_rows:
+                return out
+
+
+class ProjectionExec(Executor):
+    """Ref: executor/projection.go (vectorized, single-threaded here —
+    batch-level parallelism belongs to the device path)."""
+
+    def __init__(self, exprs: List[Expression], schema, child: Executor):
+        super().__init__(schema, [child])
+        self.exprs = exprs
+
+    def next(self):
+        ch = self.child_next()
+        if ch is None:
+            return None
+        return eval_on_chunk(self.exprs, ch)
+
+
+class LimitExec(Executor):
+    def __init__(self, offset: int, count: int, child: Executor):
+        super().__init__(child.schema, [child])
+        self.offset = offset
+        self.count = count
+        self._skipped = 0
+        self._emitted = 0
+
+    def open(self, ctx):
+        super().open(ctx)
+        self._skipped = 0
+        self._emitted = 0
+
+    def next(self):
+        while self._emitted < self.count:
+            ch = self.child_next()
+            if ch is None:
+                return None
+            if self._skipped < self.offset:
+                drop = min(self.offset - self._skipped, ch.num_rows)
+                self._skipped += drop
+                ch = ch.slice(drop, ch.num_rows)
+            if ch.num_rows == 0:
+                continue
+            take = min(self.count - self._emitted, ch.num_rows)
+            self._emitted += take
+            return ch.slice(0, take)
+        return None
+
+
+class UnionAllExec(Executor):
+    def __init__(self, schema, children):
+        super().__init__(schema, children)
+        self._cur = 0
+
+    def open(self, ctx):
+        super().open(ctx)
+        self._cur = 0
+
+    def next(self):
+        while self._cur < len(self.children):
+            ch = self.child_next(self._cur)
+            if ch is not None:
+                return self._coerce(ch)
+            self._cur += 1
+        return None
+
+    def _coerce(self, ch: Chunk) -> Chunk:
+        cols = []
+        for col, ft in zip(ch.columns, self.schema):
+            if not ft.is_varlen and col.values.dtype != ft.np_dtype:
+                cols.append(Column(ft, col.values.astype(ft.np_dtype),
+                                   col.validity))
+            else:
+                cols.append(Column(ft, col.values, col.validity))
+        return Chunk(cols)
+
+
+# ---------------------------------------------------------------------------
+# Builder (ref: executor/builder.go:144 — the engine seam)
+# ---------------------------------------------------------------------------
+
+
+def build(plan: PhysicalPlan) -> Executor:
+    from tidb_tpu.executor.hash_agg import HashAggExec
+    from tidb_tpu.executor.join import HashJoinExec
+    from tidb_tpu.executor.scan import TableScanExec
+    from tidb_tpu.executor.sort import SortExec, TopNExec
+
+    if isinstance(plan, PhysTpuFragment):
+        from tidb_tpu.executor.fragment import TpuFragmentExec
+        return TpuFragmentExec(plan)
+    if isinstance(plan, PhysTableScan):
+        return TableScanExec(plan)
+    if isinstance(plan, PhysDual):
+        return DualExec(plan.schema.field_types, plan.n_rows)
+    kids = [build(c) for c in plan.children]
+    if isinstance(plan, PhysSelection):
+        return SelectionExec(plan.conditions, kids[0])
+    if isinstance(plan, PhysProjection):
+        return ProjectionExec(plan.exprs, plan.schema.field_types, kids[0])
+    if isinstance(plan, PhysHashAgg):
+        return HashAggExec(plan, kids[0])
+    if isinstance(plan, PhysHashJoin):
+        return HashJoinExec(plan, kids[0], kids[1])
+    if isinstance(plan, PhysSort):
+        return SortExec(plan.by, plan.descs, kids[0])
+    if isinstance(plan, PhysTopN):
+        return TopNExec(plan.by, plan.descs, plan.offset, plan.count, kids[0])
+    if isinstance(plan, PhysLimit):
+        return LimitExec(plan.offset, plan.count, kids[0])
+    if isinstance(plan, PhysUnionAll):
+        return UnionAllExec(plan.schema.field_types, kids)
+    raise ExecutionError(f"no executor for {type(plan).__name__}")

@@ -1,0 +1,24 @@
+"""TPU fragment extraction & execution (SURVEY §7 stages 3-5).
+
+Fragment = a maximal device-capable physical subtree fused into ONE jitted
+XLA program — the analog of the coprocessor DAG the reference pushes to
+storage (SURVEY A.2: unistore's closure executor fuses scan→selection→agg
+into a single callback; plan_to_pb.go serializes subtrees for TiFlash).
+
+Placeholder until the device operator kernels (ops/ milestone) land:
+extract_fragments is the identity, so every plan runs the CPU pipeline.
+"""
+
+from __future__ import annotations
+
+from tidb_tpu.errors import ExecutionError
+from tidb_tpu.planner.physical import PhysicalPlan
+
+
+def extract_fragments(plan: PhysicalPlan, threshold: int) -> PhysicalPlan:
+    return plan
+
+
+class TpuFragmentExec:
+    def __init__(self, plan):
+        raise ExecutionError("TPU fragment execution not yet available")

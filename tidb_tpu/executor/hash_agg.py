@@ -1,0 +1,246 @@
+"""Hash aggregation as factorize + segment-reduce (ref: executor/aggregate.go).
+
+The reference's HashAggExec runs a 2-phase parallel worker graph: partial
+workers build per-shard hash tables with AggFunc.UpdatePartialResult, final
+workers MergePartialResult per key shard (diagram aggregate.go:127-164).
+
+TPU-first reformulation (SURVEY §7 stage 4): no hash table at all. Per input
+batch, group keys are FACTORIZED into dense group ids (sort-based unique —
+what TPUs and numpy are both good at), and partial states are built with
+segment ops. Batch partials (small: one row per distinct group) are merged
+by re-factorizing the concatenated partial keys and scatter-combining
+states — `AggFunc.merge` is the same segment op as `update`, so the batch
+merge, the multi-core merge, and the cross-chip psum merge are one code
+path. DISTINCT aggs materialize (gid, value) pairs and dedupe before a
+single update pass.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tidb_tpu.chunk import Chunk, Column
+from tidb_tpu.executor import Executor, _empty_chunk
+from tidb_tpu.expression import EvalContext, Expression
+from tidb_tpu.expression.aggfuncs import AggFunc, build_agg
+from tidb_tpu.expression.runner import host_context
+from tidb_tpu.planner.physical import PhysHashAgg
+
+_OVERFLOW_GUARD = 1 << 61
+
+
+def factorize_columns(cols: Sequence[Tuple[np.ndarray, np.ndarray]]
+                      ) -> Tuple[np.ndarray, int, np.ndarray]:
+    """Dense group ids for multi-column keys, NULLs forming their own group.
+
+    → (gids int64 per row, n_groups, representative row index per group).
+    The reference's analog is getGroupKey→codec.HashGroupKey
+    (executor/aggregate.go:563, util/codec/codec.go:1200) feeding an
+    open-address map; here sort-based unique gives ids directly.
+    """
+    n = cols[0][0].shape[0] if cols else 0
+    if not cols:
+        return np.zeros(n, dtype=np.int64), min(n, 1), np.zeros(
+            min(n, 1), dtype=np.int64)
+    combined = np.zeros(n, dtype=np.int64)
+    base = 1
+    for values, validity in cols:
+        vals = values
+        if vals.dtype == object:
+            vals = np.asarray([str(v) for v in vals], dtype=object)
+        uniq, inv = np.unique(vals, return_inverse=True)
+        inv = inv.astype(np.int64) + 1
+        if validity is not None and not validity.all():
+            inv = np.where(validity, inv, 0)
+        k = len(uniq) + 1
+        if base * k > _OVERFLOW_GUARD:
+            # re-densify before the code space overflows int64
+            _, combined = np.unique(combined, return_inverse=True)
+            combined = combined.astype(np.int64)
+            base = int(combined.max()) + 1 if n else 1
+        combined = combined * k + inv
+        base = base * k
+    uniq, first_idx, gids = np.unique(combined, return_index=True,
+                                      return_inverse=True)
+    return gids.astype(np.int64), len(uniq), first_idx.astype(np.int64)
+
+
+class HashAggExec(Executor):
+    def __init__(self, plan: PhysHashAgg, child: Executor):
+        super().__init__(plan.schema.field_types, [child])
+        self.group_exprs = plan.group_exprs
+        self.descs = plan.aggs
+        self.aggs: List[AggFunc] = [build_agg(d) for d in plan.aggs]
+        self.scalar = not plan.group_exprs  # no GROUP BY → always one row
+        self._result: Optional[Chunk] = None
+        self._offset = 0
+
+    def open(self, ctx):
+        super().open(ctx)
+        self._result = None
+        self._offset = 0
+
+    # ---- core -------------------------------------------------------------
+    def _aggregate(self) -> Chunk:
+        partial_keys: List[List[Tuple[np.ndarray, np.ndarray]]] = []
+        partial_states: List[List[Tuple]] = []
+        distinct_rows: List[List[Tuple[np.ndarray, np.ndarray, np.ndarray]]] = \
+            [[] for _ in self.aggs]
+        saw_rows = False
+
+        while True:
+            ch = self.child_next()
+            if ch is None:
+                break
+            if ch.num_rows == 0:
+                continue
+            saw_rows = True
+            ctx = host_context(ch)
+            key_cols = [e.eval(ctx) for e in self.group_exprs]
+            gids, n_groups, reps = factorize_columns(key_cols)
+            if self.scalar:
+                gids = np.zeros(ch.num_rows, dtype=np.int64)
+                n_groups, reps = 1, np.zeros(1, dtype=np.int64)
+            states = []
+            for i, (agg, desc) in enumerate(zip(self.aggs, self.descs)):
+                if desc.args:
+                    # multi-arg only for COUNT(DISTINCT a, b): row counts
+                    # iff every arg is non-NULL (MySQL semantics)
+                    vs, ms = [], []
+                    for a in desc.args:
+                        v, m = a.eval(ctx)
+                        vs.append(np.asarray(v))
+                        ms.append(np.asarray(m, dtype=bool))
+                    m = ms[0]
+                    for extra in ms[1:]:
+                        m = m & extra
+                    v = vs[0]
+                else:  # COUNT(*)
+                    vs = [np.zeros(ch.num_rows, dtype=np.int64)]
+                    v = vs[0]
+                    m = np.ones(ch.num_rows, dtype=bool)
+                if desc.distinct:
+                    distinct_rows[i].append((gids, vs, m))
+                    states.append(None)
+                else:
+                    st = agg.init(np, n_groups)
+                    states.append(agg.update(np, st, gids, n_groups, v, m))
+            partial_keys.append([(np.asarray(v)[reps],
+                                  np.asarray(m, dtype=bool)[reps])
+                                 for v, m in key_cols])
+            partial_states.append(states)
+
+        return self._merge_partials(partial_keys, partial_states,
+                                    distinct_rows, saw_rows)
+
+    def _merge_partials(self, partial_keys, partial_states, distinct_rows,
+                        saw_rows: bool) -> Chunk:
+        if not saw_rows:
+            if self.scalar:
+                return self._final_chunk(
+                    [(np.empty(0), np.empty(0, dtype=bool))
+                     for _ in self.group_exprs],
+                    [a.init(np, 1) for a in self.aggs], 1, empty_input=True)
+            return _empty_chunk(self.schema)
+
+        if self.scalar:
+            # all batches share group 0: straight merge
+            n_final = 1
+            final_gids_per_batch = [np.zeros(1, dtype=np.int64)
+                                    for _ in partial_states]
+            final_keys = [(np.empty(0), np.empty(0, dtype=bool))
+                          for _ in self.group_exprs]
+        else:
+            # concatenate per-batch representative keys → re-factorize
+            cat_keys = []
+            for kc in range(len(self.group_exprs)):
+                vals = np.concatenate([pk[kc][0] for pk in partial_keys])
+                valid = np.concatenate([pk[kc][1] for pk in partial_keys])
+                cat_keys.append((vals, valid))
+            gids_all, n_final, reps = factorize_columns(cat_keys)
+            final_keys = [(v[reps], m[reps]) for v, m in cat_keys]
+            final_gids_per_batch = []
+            off = 0
+            for pk in partial_keys:
+                sz = len(pk[0][0]) if pk else (
+                    len(partial_states[0][0][0]) if partial_states else 0)
+                final_gids_per_batch.append(gids_all[off:off + sz])
+                off += sz
+
+        final_states = []
+        for i, agg in enumerate(self.aggs):
+            if self.descs[i].distinct:
+                final_states.append(self._distinct_state(
+                    i, agg, distinct_rows[i], final_gids_per_batch, n_final))
+                continue
+            st = agg.init(np, n_final)
+            for bgids, bstates in zip(final_gids_per_batch, partial_states):
+                st = agg.merge(np, st, bgids, n_final, bstates[i])
+            final_states.append(st)
+        return self._final_chunk(final_keys, final_states, n_final)
+
+    def _distinct_state(self, i: int, agg: AggFunc, rows, final_gids_per_batch,
+                        n_final: int):
+        """Dedupe (final_gid, arg-tuple) rows then one update pass."""
+        n_args = len(rows[0][1]) if rows else 1
+        all_g, all_m = [], []
+        all_vs: List[List[np.ndarray]] = [[] for _ in range(n_args)]
+        for (bgids, vs, m), fmap in zip(rows, final_gids_per_batch):
+            all_g.append(fmap[bgids])
+            all_m.append(m)
+            for k, v in enumerate(vs):
+                all_vs[k].append(v)
+        g = np.concatenate(all_g) if all_g else np.empty(0, dtype=np.int64)
+        m = np.concatenate(all_m) if all_m else np.empty(0, dtype=bool)
+        vcols = [np.concatenate(v) if v else np.empty(0) for v in all_vs]
+        # NULLs don't contribute to distinct aggs; drop before dedupe
+        g = g[m]
+        vcols = [v[m] for v in vcols]
+        ones = np.ones(len(g), dtype=bool)
+        _, _, reps = factorize_columns(
+            [(g, ones)] + [(v, ones) for v in vcols])
+        g = g[reps]
+        v0 = vcols[0][reps] if vcols else np.empty(0)
+        st = agg.init(np, n_final)
+        return agg.update(np, st, g, n_final, v0,
+                          np.ones(len(g), dtype=bool))
+
+    def _final_chunk(self, final_keys, final_states, n_final: int,
+                     empty_input: bool = False) -> Chunk:
+        cols: List[Column] = []
+        n_group_cols = len(self.group_exprs)
+        for kc in range(n_group_cols):
+            ft = self.schema[kc]
+            vals, valid = final_keys[kc]
+            if ft.is_varlen:
+                vals = np.asarray(vals, dtype=object)
+            else:
+                vals = np.asarray(vals).astype(ft.np_dtype, copy=False)
+            valid = np.asarray(valid, dtype=bool)
+            cols.append(Column(ft, vals,
+                               None if valid.all() else valid.copy()))
+        for agg, st in zip(self.aggs, final_states):
+            v, m = agg.final(np, st)
+            ft = agg.ftype
+            if ft.is_varlen:
+                v = np.asarray(v, dtype=object)
+            else:
+                v = np.asarray(v).astype(ft.np_dtype, copy=False)
+            m = np.asarray(m, dtype=bool)
+            cols.append(Column(ft, v, None if m.all() else m.copy()))
+        return Chunk(cols)
+
+    # ---- volcano ----------------------------------------------------------
+    def next(self) -> Optional[Chunk]:
+        if self._result is None:
+            self._result = self._aggregate()
+        if self._offset >= self._result.num_rows:
+            return None
+        size = self.ctx.chunk_size
+        out = self._result.slice(self._offset,
+                                 min(self._offset + size,
+                                     self._result.num_rows))
+        self._offset += out.num_rows
+        return out

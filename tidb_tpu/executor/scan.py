@@ -1,0 +1,74 @@
+"""Table scan executor (ref: executor/table_reader.go TableReaderExecutor).
+
+Reads region-by-region from the storage snapshot (or the transaction's
+UnionScan merge view), applies the alive bitmap and pushed-down filters —
+the host-side mirror of the reference's coprocessor scan+selection fragment
+(store/copr + unistore cophandler). Regions are the parallel/shard unit;
+the device path lifts whole regions to HBM.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from tidb_tpu.chunk import Chunk, Column
+from tidb_tpu.executor import Executor
+from tidb_tpu.expression.runner import filter_mask
+from tidb_tpu.planner.physical import PhysTableScan
+
+
+class TableScanExec(Executor):
+    def __init__(self, plan: PhysTableScan):
+        super().__init__(plan.schema.field_types)
+        self.table = plan.table
+        self.filters = plan.filters
+        self._iter = None
+
+    def open(self, ctx):
+        super().open(ctx)
+        self._iter = ctx.scan_table(self.table.id)
+
+    def next(self) -> Optional[Chunk]:
+        while True:
+            self.ctx.check_killed()
+            item = next(self._iter, None)
+            if item is None:
+                return None
+            _region, chunk, alive = item
+            chunk = align_chunk_to_schema(chunk, self.table)
+            mask = alive
+            for f in self.filters:
+                mask = mask & filter_mask(f, chunk)
+            if not mask.any():
+                continue
+            if mask.all():
+                return chunk
+            return chunk.filter(mask)
+
+    def close(self):
+        self._iter = None
+        super().close()
+
+
+def align_chunk_to_schema(chunk: Chunk, table) -> Chunk:
+    """Pad columns added by online DDL after this region was written
+    (lazy backfill: the schema's default materializes at read time)."""
+    n_cols = len(table.columns)
+    if chunk.num_cols == n_cols:
+        return chunk
+    cols: List[Column] = list(chunk.columns)
+    n = chunk.num_rows
+    for ci in range(chunk.num_cols, n_cols):
+        info = table.columns[ci]
+        if info.has_default and info.default is not None:
+            raw = info.ftype.encode_value(info.default)
+            if info.ftype.is_varlen:
+                vals = np.full(n, raw, dtype=object)
+            else:
+                vals = np.full(n, raw, dtype=info.ftype.np_dtype)
+            cols.append(Column(info.ftype, vals, None))
+        else:
+            cols.append(Column.all_null(info.ftype, n))
+    return Chunk(cols)

@@ -1,0 +1,132 @@
+"""Sort / TopN executors (ref: executor/sort.go).
+
+Keys are rank-encoded per column (sorted-unique codes) so one integer
+lexsort handles every type, every direction, and MySQL NULL ordering
+(NULLs first ASC, last DESC) uniformly — and the same rank encoding is
+what the device TopN kernel consumes.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from tidb_tpu.chunk import Chunk
+from tidb_tpu.executor import Executor, _empty_chunk
+from tidb_tpu.expression import Expression
+from tidb_tpu.expression.runner import host_context
+
+
+def rank_keys(by: List[Expression], descs: List[bool],
+              chunk: Chunk) -> List[np.ndarray]:
+    """Per sort key → int64 rank codes honoring direction + NULL order."""
+    ctx = host_context(chunk)
+    keys = []
+    for e, desc in zip(by, descs):
+        v, m = e.eval(ctx)
+        v = np.asarray(v)
+        m = np.asarray(m, dtype=bool)
+        if v.dtype == object:
+            v = np.asarray([str(x) for x in v], dtype=object)
+        uniq = np.unique(v[m]) if m.any() else v[:0]
+        codes = (np.searchsorted(uniq, v) if len(uniq)
+                 else np.zeros(len(v), dtype=np.int64)).astype(np.int64) + 1
+        codes = np.where(m, codes, 0)          # NULL → 0 (first, ASC)
+        if desc:
+            codes = (len(uniq) + 1) - codes    # NULL → max (last, DESC)
+        keys.append(codes)
+    return keys
+
+
+def sort_indices(by, descs, chunk: Chunk) -> np.ndarray:
+    keys = rank_keys(by, descs, chunk)
+    # np.lexsort: last key is primary → reverse; stable within equal keys
+    return np.lexsort(tuple(reversed(keys)))
+
+
+class SortExec(Executor):
+    def __init__(self, by: List[Expression], descs: List[bool],
+                 child: Executor):
+        super().__init__(child.schema, [child])
+        self.by = by
+        self.descs = descs
+        self._sorted: Optional[Chunk] = None
+        self._offset = 0
+
+    def open(self, ctx):
+        super().open(ctx)
+        self._sorted = None
+        self._offset = 0
+
+    def next(self) -> Optional[Chunk]:
+        if self._sorted is None:
+            data = self.children[0].drain()
+            if data.num_rows:
+                self._sorted = data.take(sort_indices(self.by, self.descs,
+                                                      data))
+            else:
+                self._sorted = data
+        if self._offset >= self._sorted.num_rows:
+            return None
+        size = self.ctx.chunk_size
+        out = self._sorted.slice(self._offset,
+                                 min(self._offset + size,
+                                     self._sorted.num_rows))
+        self._offset += out.num_rows
+        return out
+
+
+class TopNExec(Executor):
+    """Heap-free TopN: keep a bounded candidate set per batch — argpartition
+    against the (offset+count) bound, full sort only at the end
+    (ref: executor/sort.go TopNExec's heap, reformulated batch-wise)."""
+
+    def __init__(self, by, descs, offset: int, count: int, child: Executor):
+        super().__init__(child.schema, [child])
+        self.by = by
+        self.descs = descs
+        self.offset = offset
+        self.count = count
+        self._result: Optional[Chunk] = None
+        self._emitted = 0
+
+    def open(self, ctx):
+        super().open(ctx)
+        self._result = None
+        self._emitted = 0
+
+    def _compute(self) -> Chunk:
+        bound = self.offset + self.count
+        candidate: Optional[Chunk] = None
+        while True:
+            ch = self.child_next()
+            if ch is None:
+                break
+            if ch.num_rows == 0:
+                continue
+            merged = ch if candidate is None else Chunk.concat(
+                [candidate, ch])
+            if merged.num_rows > bound * 2:
+                # prune: keep the best `bound` rows (ordering finalized later)
+                idx = sort_indices(self.by, self.descs, merged)[:bound]
+                candidate = merged.take(np.sort(idx))
+            else:
+                candidate = merged
+        if candidate is None or candidate.num_rows == 0:
+            return _empty_chunk(self.schema)
+        idx = sort_indices(self.by, self.descs, candidate)
+        idx = idx[self.offset:bound]
+        return candidate.take(idx)
+
+    def next(self) -> Optional[Chunk]:
+        if self._result is None:
+            self._result = self._compute()
+        if self._emitted >= self._result.num_rows:
+            return None
+        size = self.ctx.chunk_size
+        out = self._result.slice(self._emitted,
+                                 min(self._emitted + size,
+                                     self._result.num_rows))
+        self._emitted += out.num_rows
+        return out

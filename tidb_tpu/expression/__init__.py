@@ -48,19 +48,22 @@ class EvalContext:
     def __init__(self, xp, columns: Sequence[Tuple], *,
                  dictionaries: Optional[Sequence[Optional[np.ndarray]]] = None,
                  prepared: Optional[Dict[int, object]] = None,
-                 on_device: bool = False):
+                 on_device: bool = False, n_rows: Optional[int] = None):
         self.xp = xp
         self._columns = list(columns)
         self.dictionaries = list(dictionaries) if dictionaries else [
             None] * len(self._columns)
         self.prepared = prepared or {}
         self.on_device = on_device
+        self._n_rows = n_rows
 
     def column(self, i: int):
         return self._columns[i]
 
     @property
     def num_rows(self):
+        if self._n_rows is not None:
+            return self._n_rows
         return self._columns[0][0].shape[0] if self._columns else 0
 
 

@@ -1,0 +1,517 @@
+"""Aggregate function framework — SoA partial states over segment ops.
+
+Ref: /root/reference/executor/aggfuncs/aggfuncs.go:143-180 — each agg defines
+a partial-result state machine (AllocPartialResult / UpdatePartialResult /
+MergePartialResult / AppendFinalResult2Chunk) so the planner can split
+aggregation into partial+final phases for parallel and distributed execution.
+
+TPU-first redesign (SURVEY A.4): the per-group partial struct becomes one
+array PER FIELD over dense group slots — e.g. partialResult4SumFloat64
+{val; notNullRowCount} (func_sum.go:40-43) becomes (sums[G], counts[G]).
+`update` scatters rows into group slots with segment ops; `merge` scatters
+*partial-state rows* into coarser group slots — the same op, which is exactly
+why the two-phase split (and the cross-shard psum/all-gather reduce) falls
+out for free. All methods are xp-generic: numpy on host, jnp under jit.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tidb_tpu import types as T
+from tidb_tpu.errors import PlanError
+from tidb_tpu.expression import Expression
+from tidb_tpu.ops import segment as seg
+from tidb_tpu.types import FieldType, TypeKind
+
+AVG_EXTRA_SCALE = 4  # MySQL: AVG(DECIMAL(p,s)) → DECIMAL(p+4, s+4)
+
+
+@dataclass
+class AggDesc:
+    """Planner-side descriptor (ref: expression/aggregation/descriptor.go:35)."""
+
+    name: str                       # count | sum | avg | min | max | ...
+    args: List[Expression]
+    distinct: bool = False
+    ftype: FieldType = None         # result type, filled by infer_agg_type
+
+    def __post_init__(self):
+        if self.ftype is None:
+            self.ftype = infer_agg_type(self.name, self.args, self.distinct)
+
+
+def infer_agg_type(name: str, args: Sequence[Expression],
+                   distinct: bool) -> FieldType:
+    at = args[0].ftype if args else None
+    if name == "count":
+        return T.bigint(False)
+    if name == "sum":
+        if at.kind.is_float or at.kind.is_string:
+            return T.double(True)
+        if at.kind is TypeKind.DECIMAL:
+            return T.decimal(min(at.precision + 22, 65), at.scale, True)
+        return T.bigint(True)  # deviation: int sums stay int64 (exact, fast)
+    if name == "avg":
+        if at.kind.is_float or at.kind.is_string:
+            return T.double(True)
+        if at.kind is TypeKind.DECIMAL:
+            return T.decimal(min(at.precision + AVG_EXTRA_SCALE, 65),
+                             min(at.scale + AVG_EXTRA_SCALE, 30), True)
+        return T.decimal(24, AVG_EXTRA_SCALE, True)
+    if name in ("min", "max", "first_row"):
+        return at.with_nullable(True)
+    if name in ("var_pop", "var_samp", "variance", "std", "stddev",
+                "stddev_pop", "stddev_samp"):
+        return T.double(True)
+    if name == "group_concat":
+        return T.varchar(nullable=True)
+    if name in ("bit_and", "bit_or", "bit_xor"):
+        return T.bigint(False)
+    raise PlanError(f"unsupported aggregate function: {name}")
+
+
+class AggFunc:
+    """One aggregate's state machine. State = tuple of (G,)-arrays."""
+
+    device_capable = True  # set False for host-only (string/object states)
+
+    def __init__(self, desc: AggDesc):
+        self.desc = desc
+        self.ftype = desc.ftype
+
+    # -- state ------------------------------------------------------------
+    def init(self, xp, n: int) -> Tuple:
+        raise NotImplementedError
+
+    def update(self, xp, state: Tuple, gid, n: int, values, validity) -> Tuple:
+        raise NotImplementedError
+
+    def merge(self, xp, state: Tuple, gid, n: int, partial: Tuple) -> Tuple:
+        raise NotImplementedError
+
+    def final(self, xp, state: Tuple):
+        """→ (values, validity) arrays of length G."""
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# COUNT (ref: executor/aggfuncs/func_count.go)
+# ---------------------------------------------------------------------------
+
+
+class CountAgg(AggFunc):
+    """COUNT(*) and COUNT(expr). State: (counts,)."""
+
+    def __init__(self, desc: AggDesc, star: bool = False):
+        super().__init__(desc)
+        self.star = star
+
+    def init(self, xp, n):
+        return (xp.zeros(n, dtype=xp.int64),)
+
+    def update(self, xp, state, gid, n, values, validity):
+        (counts,) = state
+        return (counts + seg.segment_count(xp, validity, gid, n),)
+
+    def merge(self, xp, state, gid, n, partial):
+        (counts,) = state
+        (pcounts,) = partial
+        return (counts + seg.segment_sum(xp, pcounts, gid, n),)
+
+    def final(self, xp, state):
+        (counts,) = state
+        return counts, xp.ones(counts.shape[0], dtype=bool)
+
+
+# ---------------------------------------------------------------------------
+# SUM (ref: executor/aggfuncs/func_sum.go)
+# ---------------------------------------------------------------------------
+
+
+class SumAgg(AggFunc):
+    """State: (sums, counts). Result NULL iff no non-NULL input row."""
+
+    def __init__(self, desc: AggDesc):
+        super().__init__(desc)
+        self._float = self.ftype.kind.is_float
+        self._in_scale = desc.args[0].ftype.scale
+        self._out_scale = self.ftype.scale
+
+    def _acc_dtype(self, xp):
+        if not self._float:
+            return xp.int64
+        from tidb_tpu.ops.jax_env import device_float_dtype
+        return device_float_dtype() if xp is not np else xp.float64
+
+    def _cast_in(self, xp, values):
+        dt = self._acc_dtype(xp)
+        v = values.astype(dt)
+        if self.ftype.kind is TypeKind.DECIMAL and self._out_scale > self._in_scale:
+            v = v * (10 ** (self._out_scale - self._in_scale))
+        return v
+
+    def init(self, xp, n):
+        return (xp.zeros(n, dtype=self._acc_dtype(xp)),
+                xp.zeros(n, dtype=xp.int64))
+
+    def update(self, xp, state, gid, n, values, validity):
+        sums, counts = state
+        v = self._cast_in(xp, values)
+        v = xp.where(validity, v, xp.zeros_like(v))
+        return (sums + seg.segment_sum(xp, v, gid, n),
+                counts + seg.segment_count(xp, validity, gid, n))
+
+    def merge(self, xp, state, gid, n, partial):
+        sums, counts = state
+        psums, pcounts = partial
+        return (sums + seg.segment_sum(xp, psums.astype(sums.dtype), gid, n),
+                counts + seg.segment_sum(xp, pcounts, gid, n))
+
+    def final(self, xp, state):
+        sums, counts = state
+        return sums, counts > 0
+
+
+# ---------------------------------------------------------------------------
+# AVG (ref: executor/aggfuncs/func_avg.go)
+# ---------------------------------------------------------------------------
+
+
+class AvgAgg(SumAgg):
+    """Same state as SUM; final divides. Decimal result rounds half-away."""
+
+    def final(self, xp, state):
+        sums, counts = state
+        valid = counts > 0
+        safe = xp.where(valid, counts, xp.ones_like(counts))
+        if self.ftype.kind.is_float:
+            return sums / safe.astype(sums.dtype), valid
+        # decimal: sums already at out_scale; round half-away-from-zero
+        q = xp.abs(sums) // safe
+        r = xp.abs(sums) - q * safe
+        q = q + (2 * r >= safe).astype(xp.int64)
+        return xp.where(sums < 0, -q, q), valid
+
+
+# ---------------------------------------------------------------------------
+# MIN / MAX (ref: executor/aggfuncs/func_max_min.go)
+# ---------------------------------------------------------------------------
+
+
+class MinMaxAgg(AggFunc):
+    """State: (vals, seen). Numeric path is segment_min/max; host strings
+    sort-then-first (object arrays have no scatter identity)."""
+
+    def __init__(self, desc: AggDesc, is_min: bool):
+        super().__init__(desc)
+        self.is_min = is_min
+        self._is_string = self.ftype.kind.is_string
+        if self._is_string:
+            self.device_capable = False  # dictionary codes differ per chunk
+
+    def _identity(self, xp, n):
+        if self._is_string:
+            return np.full(n, None, dtype=object)
+        dt = self.desc.args[0].ftype.np_dtype
+        if xp is not np and np.dtype(dt) == np.dtype(np.float64):
+            from tidb_tpu.ops.jax_env import device_float_dtype
+            dt = device_float_dtype()
+        ident = (seg._max_identity(np.dtype(dt)) if self.is_min
+                 else seg._min_identity(np.dtype(dt)))
+        return xp.full(n, ident, dtype=dt)
+
+    def init(self, xp, n):
+        return (self._identity(xp, n), xp.zeros(n, dtype=bool))
+
+    def _combine(self, xp, data, gid, n):
+        return (seg.segment_min(xp, data, gid, n) if self.is_min
+                else seg.segment_max(xp, data, gid, n))
+
+    def update(self, xp, state, gid, n, values, validity):
+        vals, seen = state
+        if self._is_string:
+            return self._update_string(state, gid, n, values, validity)
+        ident = self._identity(xp, 1)[0]
+        v = xp.where(validity, values.astype(vals.dtype),
+                     xp.full_like(vals[:1], ident)[0])
+        vals2 = self._combine(xp, xp.concatenate([vals, v]),
+                              xp.concatenate([xp.arange(n), gid]), n)
+        return (vals2, seen | seg.segment_any(xp, validity, gid, n))
+
+    def _update_string(self, state, gid, n, values, validity):
+        vals, seen = state
+        order = np.argsort(values[validity].astype(str), kind="stable")
+        if not self.is_min:
+            order = order[::-1]
+        g = gid[validity][order]
+        v = values[validity][order]
+        first, found = seg.segment_first(np, v, np.ones(len(v), dtype=bool),
+                                         g, n)
+        out = vals.copy()
+        for i in range(n):
+            if found[i]:
+                cand = first[i]
+                cur = out[i]
+                if cur is None:
+                    out[i] = cand
+                elif self.is_min:
+                    out[i] = min(cur, cand)
+                else:
+                    out[i] = max(cur, cand)
+        return (out, seen | found)
+
+    def merge(self, xp, state, gid, n, partial):
+        pvals, pseen = partial
+        return self.update(xp, state, gid, n, pvals, pseen)
+
+    def final(self, xp, state):
+        vals, seen = state
+        if self._is_string:
+            return np.array([v if v is not None else ""
+                             for v in vals], dtype=object), seen
+        return vals, seen
+
+
+# ---------------------------------------------------------------------------
+# FIRST_ROW (ref: executor/aggfuncs/func_first_row.go) — planner-injected for
+# non-grouped select items; any row of the group is a correct answer.
+# ---------------------------------------------------------------------------
+
+
+class FirstRowAgg(AggFunc):
+    """State: (vals, val_validity, seen)."""
+
+    def __init__(self, desc: AggDesc):
+        super().__init__(desc)
+        self._is_string = self.ftype.kind.is_string
+        if self._is_string:
+            self.device_capable = False
+
+    def init(self, xp, n):
+        if self._is_string:
+            vals = np.full(n, "", dtype=object)
+        else:
+            dt = self.desc.args[0].ftype.np_dtype
+            if xp is not np and np.dtype(dt) == np.dtype(np.float64):
+                from tidb_tpu.ops.jax_env import device_float_dtype
+                dt = device_float_dtype()
+            vals = xp.zeros(n, dtype=dt)
+        return (vals, xp.zeros(n, dtype=bool), xp.zeros(n, dtype=bool))
+
+    def update(self, xp, state, gid, n, values, validity):
+        vals, vvalid, seen = state
+        rows = xp.ones(gid.shape[0], dtype=bool)  # first row, NULL or not
+        fv, found = seg.segment_first(xp, values, rows, gid, n)
+        fm, _ = seg.segment_first(xp, validity, rows, gid, n)
+        take = found & ~seen
+        if self._is_string:
+            out = vals.copy()
+            out[take] = fv[take]
+        else:
+            out = xp.where(take, fv.astype(vals.dtype), vals)
+        return (out, xp.where(take, fm, vvalid), seen | found)
+
+    def merge(self, xp, state, gid, n, partial):
+        pvals, pvalid, pseen = partial
+        vals, vvalid, seen = state
+        fv, found = seg.segment_first(xp, pvals, pseen, gid, n)
+        fm, _ = seg.segment_first(xp, pvalid, pseen, gid, n)
+        take = found & ~seen
+        if self._is_string:
+            out = vals.copy()
+            out[take] = fv[take]
+        else:
+            out = xp.where(take, fv.astype(vals.dtype), vals)
+        return (out, xp.where(take, fm, vvalid), seen | found)
+
+    def final(self, xp, state):
+        vals, vvalid, seen = state
+        return vals, vvalid & seen
+
+
+# ---------------------------------------------------------------------------
+# Variance family (ref: executor/aggfuncs/func_varpop.go) — (n, Σx, Σx²)
+# ---------------------------------------------------------------------------
+
+
+class VarianceAgg(AggFunc):
+    def __init__(self, desc: AggDesc, sample: bool, stddev: bool):
+        super().__init__(desc)
+        self.sample = sample
+        self.stddev = stddev
+        self._in_ftype = desc.args[0].ftype
+
+    def _fdt(self, xp):
+        if xp is np:
+            return np.float64
+        from tidb_tpu.ops.jax_env import device_float_dtype
+        return device_float_dtype()
+
+    def init(self, xp, n):
+        fdt = self._fdt(xp)
+        return (xp.zeros(n, dtype=xp.int64), xp.zeros(n, dtype=fdt),
+                xp.zeros(n, dtype=fdt))
+
+    def _as_float(self, xp, values):
+        v = values.astype(self._fdt(xp))
+        if self._in_ftype.kind is TypeKind.DECIMAL and self._in_ftype.scale:
+            v = v / (10 ** self._in_ftype.scale)
+        return v
+
+    def update(self, xp, state, gid, n, values, validity):
+        cnt, s1, s2 = state
+        v = self._as_float(xp, values)
+        v = xp.where(validity, v, xp.zeros_like(v))
+        return (cnt + seg.segment_count(xp, validity, gid, n),
+                s1 + seg.segment_sum(xp, v, gid, n),
+                s2 + seg.segment_sum(xp, v * v, gid, n))
+
+    def merge(self, xp, state, gid, n, partial):
+        cnt, s1, s2 = state
+        pc, p1, p2 = partial
+        return (cnt + seg.segment_sum(xp, pc, gid, n),
+                s1 + seg.segment_sum(xp, p1.astype(s1.dtype), gid, n),
+                s2 + seg.segment_sum(xp, p2.astype(s2.dtype), gid, n))
+
+    def final(self, xp, state):
+        cnt, s1, s2 = state
+        need = 2 if self.sample else 1
+        valid = cnt >= need
+        fc = cnt.astype(s1.dtype)
+        safe = xp.where(valid, fc, xp.ones_like(fc))
+        mean = s1 / safe
+        var = s2 / safe - mean * mean
+        var = xp.maximum(var, 0.0)  # numerical floor
+        if self.sample:
+            denom = xp.where(valid, fc - 1.0, xp.ones_like(fc))
+            var = var * fc / denom
+        out = xp.sqrt(var) if self.stddev else var
+        return out, valid
+
+
+# ---------------------------------------------------------------------------
+# Bit aggregates (ref: executor/aggfuncs/func_bitfuncs.go)
+# ---------------------------------------------------------------------------
+
+
+class BitAgg(AggFunc):
+    device_capable = False  # bitwise segment scatter: host ufunc.at only
+
+    def __init__(self, desc: AggDesc, op: str):
+        super().__init__(desc)
+        self.op = op  # and | or | xor
+
+    def init(self, xp, n):
+        start = -1 if self.op == "and" else 0  # all-ones identity for AND
+        return (np.full(n, start, dtype=np.int64),)
+
+    def update(self, xp, state, gid, n, values, validity):
+        (acc,) = state
+        out = acc.copy()
+        v = values[validity].astype(np.int64)
+        g = gid[validity]
+        ufn = {"and": np.bitwise_and, "or": np.bitwise_or,
+               "xor": np.bitwise_xor}[self.op]
+        ufn.at(out, g, v)
+        return (out,)
+
+    def merge(self, xp, state, gid, n, partial):
+        (pacc,) = partial
+        return self.update(xp, state, gid, n, pacc,
+                           np.ones(len(pacc), dtype=bool))
+
+    def final(self, xp, state):
+        (acc,) = state
+        # MySQL: unsigned 64-bit result; keep the int64 bit pattern
+        return acc, np.ones(len(acc), dtype=bool)
+
+
+# ---------------------------------------------------------------------------
+# GROUP_CONCAT (ref: executor/aggfuncs/func_group_concat.go) — host only
+# ---------------------------------------------------------------------------
+
+
+class GroupConcatAgg(AggFunc):
+    device_capable = False
+
+    def __init__(self, desc: AggDesc, separator: str = ","):
+        super().__init__(desc)
+        self.sep = separator
+
+    def init(self, xp, n):
+        return ([[] for _ in range(n)],)
+
+    def update(self, xp, state, gid, n, values, validity):
+        (parts,) = state
+        for g, v, ok in zip(np.asarray(gid), values, np.asarray(validity)):
+            if ok:
+                parts[int(g)].append(_display(v, self.desc.args[0].ftype))
+        return (parts,)
+
+    def merge(self, xp, state, gid, n, partial):
+        (parts,) = state
+        (pparts,) = partial
+        for g, lst in zip(np.asarray(gid), pparts):
+            parts[int(g)].extend(lst)
+        return (parts,)
+
+    def final(self, xp, state):
+        (parts,) = state
+        vals = np.array([self.sep.join(p) if p else "" for p in parts],
+                        dtype=object)
+        valid = np.array([bool(p) for p in parts], dtype=bool)
+        return vals, valid
+
+
+def _display(raw, ftype: FieldType) -> str:
+    v = ftype.decode_value(raw)
+    return str(v)
+
+
+# ---------------------------------------------------------------------------
+# Builder (ref: executor/aggfuncs/builder.go)
+# ---------------------------------------------------------------------------
+
+
+def build_agg(desc: AggDesc) -> AggFunc:
+    n = desc.name
+    if len(desc.args) > 1:
+        # only COUNT(DISTINCT a, b, ...) takes multiple args (MySQL);
+        # the executor dedupes over the arg tuple, NULL in any arg excluded
+        if not (n == "count" and desc.distinct):
+            raise PlanError(
+                f"{n}() with {len(desc.args)} arguments is not supported")
+    if n == "count":
+        return CountAgg(desc, star=not desc.args)
+    if n == "sum":
+        return SumAgg(desc)
+    if n == "avg":
+        return AvgAgg(desc)
+    if n == "min":
+        return MinMaxAgg(desc, is_min=True)
+    if n == "max":
+        return MinMaxAgg(desc, is_min=False)
+    if n == "first_row":
+        return FirstRowAgg(desc)
+    if n in ("var_pop", "variance"):
+        return VarianceAgg(desc, sample=False, stddev=False)
+    if n == "var_samp":
+        return VarianceAgg(desc, sample=True, stddev=False)
+    if n in ("std", "stddev", "stddev_pop"):
+        return VarianceAgg(desc, sample=False, stddev=True)
+    if n == "stddev_samp":
+        return VarianceAgg(desc, sample=True, stddev=True)
+    if n == "group_concat":
+        return GroupConcatAgg(desc)
+    if n in ("bit_and", "bit_or", "bit_xor"):
+        return BitAgg(desc, n.split("_")[1])
+    raise PlanError(f"unsupported aggregate function: {n}")
+
+
+AGG_NAMES = {"count", "sum", "avg", "min", "max", "first_row", "var_pop",
+             "variance", "var_samp", "std", "stddev", "stddev_pop",
+             "stddev_samp", "group_concat", "bit_and", "bit_or", "bit_xor"}

@@ -1,0 +1,111 @@
+"""Segment (grouped) reduction primitives, numpy + jax backends.
+
+The TPU-first reformulation of TiDB's hash aggregation (SURVEY §7 stage 4):
+open-address hash tables have no efficient TPU form, so grouped reduction is
+expressed as segment ops — scatter-combine rows into dense group slots. On
+numpy these use `ufunc.at` (exact int64 — np.bincount would round through
+float64); under jit they lower to `jax.ops.segment_*`, which XLA turns into
+efficient sorted-scatter updates.
+
+All functions take `num_segments` statically so jitted shapes stay static.
+Rows may carry gid == num_segments-1 padding; callers mask validity instead.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _is_np(xp) -> bool:
+    return xp is np
+
+
+def segment_sum(xp, data, segment_ids, num_segments: int):
+    if _is_np(xp):
+        out = np.zeros(num_segments, dtype=data.dtype)
+        np.add.at(out, segment_ids, data)
+        return out
+    from tidb_tpu.ops.jax_env import jax
+    return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+
+
+def segment_count(xp, mask, segment_ids, num_segments: int):
+    """Count of True rows per segment → int64."""
+    if _is_np(xp):
+        out = np.zeros(num_segments, dtype=np.int64)
+        np.add.at(out, segment_ids, mask.astype(np.int64))
+        return out
+    from tidb_tpu.ops.jax_env import jax, jnp
+    return jax.ops.segment_sum(mask.astype(jnp.int64), segment_ids,
+                               num_segments=num_segments)
+
+
+def segment_min(xp, data, segment_ids, num_segments: int):
+    if _is_np(xp):
+        out = np.full(num_segments, _max_identity(data.dtype),
+                      dtype=data.dtype)
+        np.minimum.at(out, segment_ids, data)
+        return out
+    from tidb_tpu.ops.jax_env import jax
+    return jax.ops.segment_min(data, segment_ids, num_segments=num_segments)
+
+
+def segment_max(xp, data, segment_ids, num_segments: int):
+    if _is_np(xp):
+        out = np.full(num_segments, _min_identity(data.dtype),
+                      dtype=data.dtype)
+        np.maximum.at(out, segment_ids, data)
+        return out
+    from tidb_tpu.ops.jax_env import jax
+    return jax.ops.segment_max(data, segment_ids, num_segments=num_segments)
+
+
+def segment_any(xp, mask, segment_ids, num_segments: int):
+    """True iff any True row lands in the segment."""
+    if _is_np(xp):
+        out = np.zeros(num_segments, dtype=bool)
+        np.logical_or.at(out, segment_ids, mask)
+        return out
+    from tidb_tpu.ops.jax_env import jax, jnp
+    return jax.ops.segment_max(mask.astype(jnp.int32), segment_ids,
+                               num_segments=num_segments) > 0
+
+
+def segment_first(xp, data, mask, segment_ids, num_segments: int):
+    """First masked value per segment, in row order → (values, found)."""
+    n = data.shape[0]
+    if _is_np(xp):
+        idx = np.full(num_segments, n, dtype=np.int64)
+        rows = np.where(mask, np.arange(n, dtype=np.int64), n)
+        np.minimum.at(idx, segment_ids, rows)
+        found = idx < n
+        safe = np.where(found, idx, 0)
+        return data[safe], found
+    from tidb_tpu.ops.jax_env import jax, jnp
+    rows = xp.where(mask, xp.arange(n, dtype=xp.int64), n)
+    idx = jax.ops.segment_min(rows, segment_ids, num_segments=num_segments)
+    found = idx < n
+    safe = xp.where(found, idx, 0)
+    return data[safe], found
+
+
+def _max_identity(dtype):
+    dtype = np.dtype(dtype)
+    if dtype.kind in "iu":
+        return np.iinfo(dtype).max
+    if dtype.kind == "f":
+        return np.inf
+    if dtype.kind == "b":
+        return True
+    raise AssertionError(f"no identity for {dtype}")
+
+
+def _min_identity(dtype):
+    dtype = np.dtype(dtype)
+    if dtype.kind in "iu":
+        return np.iinfo(dtype).min
+    if dtype.kind == "f":
+        return -np.inf
+    if dtype.kind == "b":
+        return False
+    raise AssertionError(f"no identity for {dtype}")

@@ -1,0 +1,28 @@
+"""Planner / optimizer (ref: /root/reference/planner/).
+
+Pipeline (ref: planner/optimize.go:126 → core/optimizer.go:262):
+
+    AST ──build──► logical plan ──logical rules──► logical plan
+        ──physical──► physical plan (engine-tagged: cpu | tpu)
+
+The reference's fixed-order rule list (planner/core/optimizer.go:74-90) maps
+to `rules.LOGICAL_RULES`; its cost-based task assignment (RootTask vs
+CopTask vs MppTask, planner/property/task_type.go) maps to the engine gate in
+`physical.py` — subtrees whose operators are device-capable and whose
+estimated input rows exceed the row threshold run as fused TPU fragments,
+exactly how the reference routes subtrees to TiFlash MPP.
+"""
+
+from tidb_tpu.planner.builder import PlanBuilder  # noqa: F401
+from tidb_tpu.planner.logical import (  # noqa: F401
+    LogicalPlan, Schema, SchemaColumn)
+from tidb_tpu.planner.physical import PhysicalPlan, physical_optimize  # noqa: F401
+from tidb_tpu.planner.rules import logical_optimize  # noqa: F401
+
+
+def optimize(stmt, info_schema, ctx):
+    """AST statement → physical plan (ref: planner.Optimize)."""
+    builder = PlanBuilder(info_schema, ctx)
+    logical = builder.build(stmt)
+    logical = logical_optimize(logical)
+    return physical_optimize(logical, ctx)

@@ -1,0 +1,702 @@
+"""AST → logical plan builder (ref: planner/core/logical_plan_builder.go).
+
+Mirrors the reference's build order (buildSelect): FROM → WHERE → aggregation
+extraction → HAVING → DISTINCT → ORDER BY → LIMIT → projection. Aggregate
+handling follows TiDB's loose MySQL semantics: non-grouped plain columns in
+the select list are wrapped in FIRST_ROW aggregates
+(planner/core/logical_plan_builder.go AggregateFuncExtractor pattern).
+
+Subqueries: uncorrelated scalar/IN/EXISTS subqueries are planned and executed
+eagerly at build time, substituting constants — the reference instead
+rewrites to (semi-)apply joins (expression_rewriter.go); correlated
+subqueries are deferred to a later round.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from tidb_tpu import types as T
+from tidb_tpu.errors import PlanError, UnknownColumnError
+from tidb_tpu.expression import (ColumnRef, Constant, Expression, ScalarFunc,
+                                 cast, func, lit)
+from tidb_tpu.expression.aggfuncs import AGG_NAMES, AggDesc
+from tidb_tpu.parser import ast
+from tidb_tpu.planner.logical import (LogicalAggregation, LogicalDataSource,
+                                      LogicalDual, LogicalJoin, LogicalLimit,
+                                      LogicalPlan, LogicalProjection,
+                                      LogicalSelection, LogicalSort,
+                                      LogicalUnionAll, Schema, SchemaColumn)
+from tidb_tpu.types import FieldType, TypeKind
+
+# scalar function names accepted from SQL (normalized spellings)
+_SCALAR_FUNCS = {
+    "abs", "ceil", "ceiling", "floor", "round", "sqrt", "pow", "power",
+    "length", "char_length", "character_length", "upper", "ucase", "lower",
+    "lcase", "reverse", "ltrim", "rtrim", "trim", "ascii", "hex",
+    "year", "month", "dayofmonth", "day", "date",
+    "if", "ifnull", "coalesce", "nullif", "isnull",
+}
+_CANON = {"ceiling": "ceil", "power": "pow", "ucase": "upper",
+          "lcase": "lower", "character_length": "char_length",
+          "day": "dayofmonth"}
+
+
+class SubqueryEvaluator:
+    """Callback bundle the session provides for eager subquery execution."""
+
+    def __init__(self, run: Callable[[ast.SelectStmt], Tuple[List[tuple],
+                                                             List[FieldType]]]):
+        self.run = run
+
+
+class ExpressionRewriter:
+    """ast.ExprNode → expression.Expression over a Schema.
+
+    With `agg_ctx` set (post-aggregation scope), sub-expressions matching a
+    GROUP BY expression map to the agg output, aggregate calls map to their
+    slots, and stray columns become FIRST_ROW aggregates.
+    """
+
+    def __init__(self, schema: Schema,
+                 subq: Optional[SubqueryEvaluator] = None,
+                 agg_ctx: Optional["AggContext"] = None,
+                 outer: Optional["ExpressionRewriter"] = None):
+        self.schema = schema
+        self.subq = subq
+        self.agg_ctx = agg_ctx
+
+    # -- entry -------------------------------------------------------------
+    def rewrite(self, node: ast.ExprNode) -> Expression:
+        if self.agg_ctx is not None:
+            hit = self.agg_ctx.match_group(node)
+            if hit is not None:
+                return hit
+            if isinstance(node, ast.FuncCall) and \
+                    node.name.lower() in AGG_NAMES:
+                return self.agg_ctx.map_agg(node)
+            if isinstance(node, ast.Name):
+                if node.qualifier is None:
+                    alias_hit = self.agg_ctx.alias_map.get(node.column.lower())
+                    if alias_hit is not None:
+                        return alias_hit
+                return self.agg_ctx.map_bare_column(node)
+        return self._dispatch(node)
+
+    # -- dispatch ----------------------------------------------------------
+    def _dispatch(self, node: ast.ExprNode) -> Expression:
+        if isinstance(node, ast.Literal):
+            return self._literal(node)
+        if isinstance(node, ast.Name):
+            idx = self.schema.find(node.column, node.qualifier)
+            return self.schema.column_ref(idx)
+        if isinstance(node, ast.UnaryOp):
+            arg = self.rewrite(node.operand)
+            if node.op == "minus":
+                if isinstance(arg, Constant) and arg.value is not None:
+                    return lit(-arg.value, arg.ftype)
+                return func("unary_minus", arg)
+            if node.op == "not":
+                return func("not", arg)
+            raise PlanError(f"unknown unary op {node.op}")
+        if isinstance(node, ast.BinaryOp):
+            left = self.rewrite(node.left)
+            right = self.rewrite(node.right)
+            return func(node.op, left, right)
+        if isinstance(node, ast.IsNull):
+            e = func("isnull", self.rewrite(node.expr))
+            return func("not", e) if node.negated else e
+        if isinstance(node, ast.Between):
+            e = self.rewrite(node.expr)
+            low = self.rewrite(node.low)
+            high = self.rewrite(node.high)
+            out = func("and", func("ge", e, low), func("le", e, high))
+            return func("not", out) if node.negated else out
+        if isinstance(node, ast.LikeExpr):
+            e = func("like", self.rewrite(node.expr),
+                     self.rewrite(node.pattern))
+            return func("not", e) if node.negated else e
+        if isinstance(node, ast.InExpr):
+            return self._in(node)
+        if isinstance(node, ast.ExistsExpr):
+            return self._exists(node)
+        if isinstance(node, ast.Subquery):
+            return self._scalar_subquery(node)
+        if isinstance(node, ast.CaseExpr):
+            return self._case(node)
+        if isinstance(node, ast.CastExpr):
+            return cast(self.rewrite(node.expr), node.target)
+        if isinstance(node, ast.FuncCall):
+            return self._func_call(node)
+        raise PlanError(f"cannot rewrite expression node {node!r}")
+
+    # -- leaves ------------------------------------------------------------
+    def _literal(self, node: ast.Literal) -> Constant:
+        if node.kind == "null":
+            return lit(None)
+        return lit(node.value)
+
+    def _func_call(self, node: ast.FuncCall) -> Expression:
+        name = node.name.lower()
+        name = _CANON.get(name, name)
+        if name in AGG_NAMES:
+            raise PlanError(
+                f"aggregate function {name}() in a non-aggregate context")
+        if name not in _SCALAR_FUNCS:
+            raise PlanError(f"unsupported function: {node.name}")
+        args = [self.rewrite(a) for a in node.args]
+        if name == "nullif":
+            # NULLIF(a,b) ≡ CASE WHEN a=b THEN NULL ELSE a
+            a, b = args
+            return ScalarFunc("if", [func("eq", a, b),
+                                     Constant(None, a.ftype), a], a.ftype)
+        return func(name, *args)
+
+    # -- subqueries (eager) -------------------------------------------------
+    def _require_subq(self):
+        if self.subq is None:
+            raise PlanError("subqueries are not supported in this context")
+
+    def _scalar_subquery(self, node: ast.Subquery) -> Constant:
+        self._require_subq()
+        rows, ftypes = self.subq.run(node.select)
+        if len(ftypes) != 1:
+            raise PlanError("Operand should contain 1 column(s)")
+        if len(rows) > 1:
+            raise PlanError("Subquery returns more than 1 row")
+        if not rows:
+            return Constant(None, ftypes[0].with_nullable(True))
+        return Constant(rows[0][0], ftypes[0].with_nullable(True))
+
+    def _in(self, node: ast.InExpr) -> Expression:
+        e = self.rewrite(node.expr)
+        if node.subquery is not None:
+            self._require_subq()
+            rows, ftypes = self.subq.run(node.subquery.select)
+            if len(ftypes) != 1:
+                raise PlanError("Operand should contain 1 column(s)")
+            items = [Constant(r[0], ftypes[0]) for r in rows]
+            if not items:
+                out = lit(False)  # x IN (empty) is FALSE (even for NULL x)
+                return func("not", out) if node.negated else out
+        else:
+            items = [self.rewrite(i) for i in node.items]
+        out = func("in", e, *items)
+        return func("not", out) if node.negated else out
+
+    def _exists(self, node: ast.ExistsExpr) -> Expression:
+        self._require_subq()
+        sel = node.subquery.select
+        rows, _ = self.subq.run(sel)
+        val = bool(rows)
+        return lit(not val if node.negated else val)
+
+    def _case(self, node: ast.CaseExpr) -> Expression:
+        args: List[Expression] = []
+        for when, then in node.whens:
+            if node.operand is not None:
+                cond = func("eq", self.rewrite(node.operand),
+                            self.rewrite(when))
+            else:
+                cond = self.rewrite(when)
+            args.append(cond)
+            args.append(self.rewrite(then))
+        if node.else_ is not None:
+            args.append(self.rewrite(node.else_))
+        from tidb_tpu.expression import infer_type
+        return ScalarFunc("case", args, infer_type("case", args))
+
+
+class AggContext:
+    """Aggregation scope shared by select/having/order rewriters."""
+
+    def __init__(self, child_schema: Schema, subq: Optional[SubqueryEvaluator]):
+        self.child_schema = child_schema
+        self.child_rewriter = ExpressionRewriter(child_schema, subq)
+        self.group_exprs: List[Expression] = []
+        self.group_keys: List[str] = []          # repr of rewritten group expr
+        self.group_names: List[str] = []
+        self.aggs: List[AggDesc] = []
+        self.agg_keys: Dict[str, int] = {}       # repr key → agg slot
+        self.alias_map: Dict[str, Expression] = {}  # select alias → expr
+
+    # group exprs are registered before any rewriting
+    def add_group(self, node: ast.ExprNode, name: str) -> None:
+        e = self.child_rewriter.rewrite(node)
+        key = repr(e)
+        if key not in self.group_keys:
+            self.group_exprs.append(e)
+            self.group_keys.append(key)
+            self.group_names.append(name)
+
+    def _slot(self, agg_index: int) -> ColumnRef:
+        i = len(self.group_exprs) + agg_index
+        a = self.aggs[agg_index]
+        return ColumnRef(i, a.ftype, a.name)
+
+    def _group_slot(self, group_index: int) -> ColumnRef:
+        e = self.group_exprs[group_index]
+        return ColumnRef(group_index, e.ftype,
+                         self.group_names[group_index])
+
+    def match_group(self, node: ast.ExprNode) -> Optional[ColumnRef]:
+        try:
+            e = self.child_rewriter.rewrite(node)
+        except (PlanError, UnknownColumnError):
+            return None
+        key = repr(e)
+        if key in self.group_keys:
+            return self._group_slot(self.group_keys.index(key))
+        return None
+
+    def map_agg(self, node: ast.FuncCall) -> ColumnRef:
+        name = node.name.lower()
+        if name == "count" and len(node.args) == 1 and \
+                isinstance(node.args[0], ast.Star):
+            args: List[Expression] = []
+        else:
+            args = [self.child_rewriter.rewrite(a) for a in node.args]
+        key = f"{name}|{node.distinct}|{[repr(a) for a in args]}"
+        if key in self.agg_keys:
+            return self._slot(self.agg_keys[key])
+        desc = AggDesc(name, args, node.distinct)
+        self.aggs.append(desc)
+        self.agg_keys[key] = len(self.aggs) - 1
+        return self._slot(len(self.aggs) - 1)
+
+    def map_bare_column(self, node: ast.Name) -> ColumnRef:
+        """Non-grouped plain column → FIRST_ROW wrap (MySQL loose mode)."""
+        idx = self.child_schema.find(node.column, node.qualifier)
+        ref = self.child_schema.column_ref(idx)
+        key = f"first_row|False|{[repr(ref)]}"
+        if key in self.agg_keys:
+            return self._slot(self.agg_keys[key])
+        desc = AggDesc("first_row", [ref], False)
+        self.aggs.append(desc)
+        self.agg_keys[key] = len(self.aggs) - 1
+        return self._slot(len(self.aggs) - 1)
+
+    def build_node(self, child: LogicalPlan) -> LogicalAggregation:
+        return LogicalAggregation(self.group_exprs, self.aggs, child,
+                                  self.group_names)
+
+
+def _has_agg(node: ast.Node) -> bool:
+    """Does this expression subtree contain an aggregate call?"""
+    if isinstance(node, ast.FuncCall):
+        if node.name.lower() in AGG_NAMES:
+            return True
+        return any(_has_agg(a) for a in node.args)
+    for attr in ("operand", "expr", "left", "right", "low", "high",
+                 "pattern", "else_"):
+        v = getattr(node, attr, None)
+        if isinstance(v, ast.Node) and _has_agg(v):
+            return True
+    if isinstance(node, ast.CaseExpr):
+        return any(_has_agg(w) or _has_agg(t) for w, t in node.whens)
+    if isinstance(node, ast.InExpr) and node.items:
+        return any(_has_agg(i) for i in node.items)
+    return False
+
+
+class PlanBuilder:
+    """Ref: planner/core/planbuilder.go PlanBuilder."""
+
+    def __init__(self, info_schema, ctx=None,
+                 subq: Optional[SubqueryEvaluator] = None):
+        self.info_schema = info_schema
+        self.ctx = ctx
+        self.subq = subq or getattr(ctx, "subquery_evaluator", None)
+
+    # -- statements ---------------------------------------------------------
+    def build(self, stmt: ast.StmtNode) -> LogicalPlan:
+        if isinstance(stmt, ast.SelectStmt):
+            return self.build_select(stmt)
+        if isinstance(stmt, ast.SetOpStmt):
+            return self.build_setop(stmt)
+        raise PlanError(f"cannot build plan for {type(stmt).__name__}")
+
+    # -- FROM ---------------------------------------------------------------
+    def build_table_ref(self, ref: ast.TableRef) -> LogicalPlan:
+        if isinstance(ref, ast.TableName):
+            info = self.info_schema.table(ref.name)
+            return LogicalDataSource(info, ref.alias)
+        if isinstance(ref, ast.SubqueryTable):
+            sub = self.build(ref.select)
+            # re-qualify output columns under the derived-table alias
+            cols = [SchemaColumn(c.name, c.ftype, ref.alias)
+                    for c in sub.schema.columns]
+            sub.schema = Schema(cols)
+            return sub
+        if isinstance(ref, ast.JoinExpr):
+            return self.build_join(ref)
+        raise PlanError(f"unsupported table reference {ref!r}")
+
+    def build_join(self, j: ast.JoinExpr) -> LogicalPlan:
+        left = self.build_table_ref(j.left)
+        right = self.build_table_ref(j.right)
+        kind = "inner" if j.kind == "cross" else j.kind
+        joined_schema = Schema.concat(left.schema, right.schema)
+        conds: List[Expression] = []
+        if j.using:
+            for name in j.using:
+                li = left.schema.find(name)
+                ri = right.schema.find(name)
+                conds.append(func("eq", left.schema.column_ref(li),
+                                  _shift(right.schema.column_ref(ri),
+                                         len(left.schema))))
+        elif j.on is not None:
+            rw = ExpressionRewriter(joined_schema, self.subq)
+            conds = split_conjunction(rw.rewrite(j.on))
+        equi, other = classify_join_conditions(conds, len(left.schema))
+        return LogicalJoin(kind, left, right, equi, other)
+
+    # -- SELECT --------------------------------------------------------------
+    def build_select(self, sel: ast.SelectStmt) -> LogicalPlan:
+        # FROM
+        if sel.from_ is None:
+            plan: LogicalPlan = LogicalDual()
+        else:
+            plan = self.build_table_ref(sel.from_)
+
+        # expand stars now so the item list is concrete
+        items = self._expand_stars(sel.items, plan.schema)
+
+        # WHERE (pre-aggregation scope)
+        if sel.where is not None:
+            rw = ExpressionRewriter(plan.schema, self.subq)
+            plan = LogicalSelection(split_conjunction(rw.rewrite(sel.where)),
+                                    plan)
+
+        needs_agg = bool(sel.group_by) or \
+            any(_has_agg(it.expr) for it in items) or \
+            (sel.having is not None and _has_agg(sel.having)) or \
+            any(_has_agg(e) for e, _ in sel.order_by)
+
+        if needs_agg:
+            plan, proj_exprs, names, pre_rw = self._build_aggregation(
+                sel, items, plan)
+        else:
+            pre_rw = ExpressionRewriter(plan.schema, self.subq)
+            proj_exprs = [pre_rw.rewrite(it.expr) for it in items]
+            names = [self._item_name(it) for it in items]
+            if sel.having is not None:
+                raise PlanError("HAVING requires aggregation or GROUP BY")
+
+        # ORDER BY resolves BEFORE projection so it can reference columns
+        # outside the select list — those ride as hidden projection columns
+        # trimmed afterwards (MySQL semantics; the reference appends extra
+        # schema columns the same way).
+        n_visible = len(proj_exprs)
+        sort_idx: List[int] = []
+        descs: List[bool] = []
+        if sel.order_by:
+            sort_idx, descs = self._resolve_order(
+                sel, items, names, proj_exprs, pre_rw)
+
+        quals = self._item_qualifiers(items, plan.schema) + \
+            [None] * (len(proj_exprs) - len(items))
+        all_names = names + [f"_order_{i}" for i in
+                             range(len(proj_exprs) - len(names))]
+        proj = LogicalProjection(proj_exprs, all_names, plan, quals)
+        out: LogicalPlan = proj
+
+        # DISTINCT → group by all *visible* output columns
+        if sel.distinct:
+            if len(proj_exprs) > n_visible:
+                raise PlanError(
+                    "ORDER BY columns must appear in SELECT DISTINCT list")
+            refs = [out.schema.column_ref(i) for i in range(n_visible)]
+            out = LogicalAggregation(refs, [], out, all_names[:n_visible])
+            out.schema = Schema([SchemaColumn(c.name, c.ftype, c.qualifier)
+                                 for c in proj.schema.columns])
+
+        if sort_idx:
+            by = [out.schema.column_ref(i) for i in sort_idx]
+            out = LogicalSort(by, descs, out)
+
+        if sel.limit is not None:
+            offset, count = sel.limit
+            out = LogicalLimit(offset, count, out)
+
+        if len(proj_exprs) > n_visible:  # trim hidden order-by columns
+            refs = [out.schema.column_ref(i) for i in range(n_visible)]
+            out = LogicalProjection(
+                refs, names, out,
+                self._item_qualifiers(items, plan.schema))
+        return out
+
+    def _resolve_order(self, sel: ast.SelectStmt, items, names,
+                       proj_exprs: List[Expression],
+                       pre_rw: "ExpressionRewriter"):
+        """Resolve ORDER BY terms → projection column indices, appending
+        hidden columns to proj_exprs for terms outside the select list."""
+        sort_idx: List[int] = []
+        descs: List[bool] = []
+        reprs = {repr(e): i for i, e in enumerate(proj_exprs)}
+        n_items = len(items)
+        for e, desc in sel.order_by:
+            descs.append(desc)
+            if isinstance(e, ast.Literal) and isinstance(e.value, int) and \
+                    not isinstance(e.value, bool):
+                k = e.value
+                if not 1 <= k <= n_items:
+                    raise PlanError(f"Unknown column '{k}' in 'order clause'")
+                sort_idx.append(k - 1)
+                continue
+            if isinstance(e, ast.Name) and e.qualifier is None:
+                hit = None
+                for i, it in enumerate(items):
+                    if it.alias and it.alias.lower() == e.column.lower():
+                        hit = i
+                        break
+                if hit is None:
+                    for i, n in enumerate(names):
+                        if n.lower() == e.column.lower():
+                            hit = i
+                            break
+                if hit is not None:
+                    sort_idx.append(hit)
+                    continue
+            rewritten = pre_rw.rewrite(e)
+            key = repr(rewritten)
+            if key in reprs:
+                sort_idx.append(reprs[key])
+            else:
+                proj_exprs.append(rewritten)
+                reprs[key] = len(proj_exprs) - 1
+                sort_idx.append(len(proj_exprs) - 1)
+        return sort_idx, descs
+
+    # -- aggregation ---------------------------------------------------------
+    def _build_aggregation(self, sel: ast.SelectStmt,
+                           items: List[ast.SelectItem], child: LogicalPlan):
+        agg_ctx = AggContext(child.schema, self.subq)
+        # GROUP BY list: ordinals, aliases, expressions
+        for g in sel.group_by:
+            node = self._resolve_group_item(g, items)
+            name = node.column if isinstance(node, ast.Name) else \
+                self._item_name_for(node, items)
+            agg_ctx.add_group(node, name)
+
+        post_rw = ExpressionRewriter(child.schema, self.subq, agg_ctx)
+        proj_exprs = [post_rw.rewrite(it.expr) for it in items]
+        names = [self._item_name(it) for it in items]
+        for it, e in zip(items, proj_exprs):
+            if it.alias:
+                agg_ctx.alias_map[it.alias.lower()] = e
+
+        # pre-resolve HAVING and ORDER BY through the agg scope BEFORE the
+        # node is built, so they can introduce new aggregates
+        having = post_rw.rewrite(sel.having) if sel.having is not None \
+            else None
+        for e, _ in sel.order_by:
+            if not self._order_term_is_positional(e, items, names):
+                post_rw.rewrite(e)  # registers any new agg slots
+
+        plan: LogicalPlan = agg_ctx.build_node(child)
+        if having is not None:
+            plan = LogicalSelection(split_conjunction(having), plan)
+        return plan, proj_exprs, names, post_rw
+
+    @staticmethod
+    def _order_term_is_positional(e: ast.ExprNode, items, names) -> bool:
+        if isinstance(e, ast.Literal) and isinstance(e.value, int):
+            return True
+        if isinstance(e, ast.Name) and e.qualifier is None:
+            for it in items:
+                if it.alias and it.alias.lower() == e.column.lower():
+                    return True
+            return any(n.lower() == e.column.lower() for n in names)
+        return False
+
+    def _resolve_group_item(self, g: ast.ExprNode,
+                            items: List[ast.SelectItem]) -> ast.ExprNode:
+        if isinstance(g, ast.Literal) and isinstance(g.value, int) and \
+                not isinstance(g.value, bool):
+            k = g.value
+            if not 1 <= k <= len(items):
+                raise PlanError(f"Unknown column '{k}' in 'group statement'")
+            return items[k - 1].expr
+        if isinstance(g, ast.Name) and g.qualifier is None:
+            for it in items:
+                if it.alias and it.alias.lower() == g.column.lower():
+                    return it.expr
+        return g
+
+    # -- set ops --------------------------------------------------------------
+    def build_setop(self, stmt: ast.SetOpStmt) -> LogicalPlan:
+        left = self.build(stmt.left)
+        right = self.build(stmt.right)
+        if stmt.op != "union":
+            raise PlanError(f"set operator {stmt.op} not supported yet")
+        if len(left.schema) != len(right.schema):
+            raise PlanError(
+                "The used SELECT statements have a different number of columns")
+        # result types: column-wise merge; names from the left branch
+        cols = []
+        for lc, rc in zip(left.schema.columns, right.schema.columns):
+            ft = _merge_setop_type(lc.ftype, rc.ftype)
+            cols.append(SchemaColumn(lc.name, ft))
+        schema = Schema(cols)
+        left = _coerce_branch(left, schema)
+        right = _coerce_branch(right, schema)
+        out: LogicalPlan = LogicalUnionAll([left, right], schema)
+        if not stmt.all:
+            refs = [schema.column_ref(i) for i in range(len(schema))]
+            out = LogicalAggregation(refs, [], out, schema.names)
+            out.schema = Schema(cols)
+        if stmt.order_by:
+            rw = ExpressionRewriter(out.schema, self.subq)
+            by, descs = [], []
+            for e, d in stmt.order_by:
+                by.append(rw.rewrite(e))
+                descs.append(d)
+            out = LogicalSort(by, descs, out)
+        if stmt.limit is not None:
+            out = LogicalLimit(stmt.limit[0], stmt.limit[1], out)
+        return out
+
+    # -- helpers ---------------------------------------------------------------
+    def _expand_stars(self, items: Sequence[ast.SelectItem],
+                      schema: Schema) -> List[ast.SelectItem]:
+        out: List[ast.SelectItem] = []
+        for it in items:
+            if isinstance(it.expr, ast.Star):
+                q = it.expr.table
+                matched = False
+                for c in schema.columns:
+                    if q is None or (c.qualifier or "").lower() == q.lower():
+                        parts = (c.qualifier, c.name) if c.qualifier else \
+                            (c.name,)
+                        out.append(ast.SelectItem(ast.Name(tuple(parts))))
+                        matched = True
+                if q is not None and not matched:
+                    raise PlanError(f"Unknown table '{q}'")
+                if q is None and not matched:
+                    raise PlanError("SELECT * with no tables")
+            else:
+                out.append(it)
+        return out
+
+    @staticmethod
+    def _item_name(it: ast.SelectItem) -> str:
+        if it.alias:
+            return it.alias
+        if isinstance(it.expr, ast.Name):
+            return it.expr.column
+        return _expr_display(it.expr)
+
+    @staticmethod
+    def _item_name_for(node: ast.ExprNode, items) -> str:
+        for it in items:
+            if it.expr is node and it.alias:
+                return it.alias
+        if isinstance(node, ast.Name):
+            return node.column
+        return _expr_display(node)
+
+    @staticmethod
+    def _item_qualifiers(items, schema: Schema):
+        quals = []
+        for it in items:
+            if it.alias is None and isinstance(it.expr, ast.Name):
+                quals.append(it.expr.qualifier)
+            else:
+                quals.append(None)
+        return quals
+
+
+# ---------------------------------------------------------------------------
+# Expression utilities
+# ---------------------------------------------------------------------------
+
+
+def split_conjunction(e: Expression) -> List[Expression]:
+    """a AND b AND c → [a, b, c] (ref: expression/util.go SplitCNFItems)."""
+    if isinstance(e, ScalarFunc) and e.op == "and":
+        return split_conjunction(e.args[0]) + split_conjunction(e.args[1])
+    return [e]
+
+
+def classify_join_conditions(conds: List[Expression], left_width: int):
+    """Split ON conditions into equi pairs (left key, right key) and the rest.
+
+    Ref: planner/core/logical_plans.go extractOnCondition."""
+    equi: List[Tuple[Expression, Expression]] = []
+    other: List[Expression] = []
+    for c in conds:
+        if isinstance(c, ScalarFunc) and c.op == "eq":
+            l, r = c.args
+            lrefs, rrefs = l.references(), r.references()
+            if lrefs and rrefs:
+                l_on_left = all(i < left_width for i in lrefs)
+                r_on_right = all(i >= left_width for i in rrefs)
+                l_on_right = all(i >= left_width for i in lrefs)
+                r_on_left = all(i < left_width for i in rrefs)
+                if l_on_left and r_on_right:
+                    equi.append((l, _shift(r, -left_width)))
+                    continue
+                if l_on_right and r_on_left:
+                    equi.append((r, _shift(l, -left_width)))
+                    continue
+        other.append(c)
+    return equi, other
+
+
+def _shift(e: Expression, delta: int) -> Expression:
+    """Clone an expression with all column indices shifted by delta."""
+    if isinstance(e, ColumnRef):
+        return ColumnRef(e.index + delta, e.ftype, e.name)
+    if isinstance(e, ScalarFunc):
+        return ScalarFunc(e.op, [_shift(a, delta) for a in e.args], e.ftype)
+    return e
+
+
+def _expr_display(node: ast.ExprNode) -> str:
+    if isinstance(node, ast.FuncCall):
+        inner = ", ".join(_expr_display(a) for a in node.args)
+        if node.distinct:
+            inner = "distinct " + inner
+        return f"{node.name.lower()}({inner})"
+    if isinstance(node, ast.Star):
+        return "*"
+    if isinstance(node, ast.Name):
+        return node.column
+    if isinstance(node, ast.Literal):
+        return repr(node.value) if not isinstance(node.value, str) \
+            else node.value
+    if isinstance(node, ast.BinaryOp):
+        sym = {"plus": "+", "minus": "-", "mul": "*", "div": "/",
+               "mod": "%", "eq": "=", "ne": "<>", "lt": "<", "le": "<=",
+               "gt": ">", "ge": ">=", "and": "and", "or": "or"}.get(
+            node.op, node.op)
+        return f"{_expr_display(node.left)} {sym} {_expr_display(node.right)}"
+    if isinstance(node, ast.UnaryOp):
+        return ("-" if node.op == "minus" else "not ") + \
+            _expr_display(node.operand)
+    return type(node).__name__.lower()
+
+
+def _merge_setop_type(a: FieldType, b: FieldType) -> FieldType:
+    if a.kind == b.kind and a.scale == b.scale:
+        return a.with_nullable(a.nullable or b.nullable)
+    if a.kind.is_string or b.kind.is_string:
+        return T.varchar(nullable=a.nullable or b.nullable)
+    return T.merge_numeric(a, b)
+
+
+def _coerce_branch(plan: LogicalPlan, target: Schema) -> LogicalPlan:
+    """Insert a cast projection when a UNION branch's types differ."""
+    needs = any(c.ftype.kind != t.ftype.kind or c.ftype.scale != t.ftype.scale
+                for c, t in zip(plan.schema.columns, target.columns))
+    if not needs:
+        return plan
+    exprs = []
+    for i, (c, t) in enumerate(zip(plan.schema.columns, target.columns)):
+        ref = plan.schema.column_ref(i)
+        if c.ftype.kind != t.ftype.kind or c.ftype.scale != t.ftype.scale:
+            exprs.append(cast(ref, t.ftype))
+        else:
+            exprs.append(ref)
+    return LogicalProjection(exprs, target.names, plan)

@@ -1,0 +1,246 @@
+"""Logical plan operators (ref: planner/core/logical_plans.go).
+
+Column identity is positional: every operator's output is a `Schema` — an
+ordered list of (name, qualifier, ftype) — and expressions reference inputs
+by index (`expression.ColumnRef.index`). Joins concatenate child schemas
+left-then-right, the reference's convention.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import List, Optional, Sequence, Tuple
+
+from tidb_tpu.catalog import TableInfo
+from tidb_tpu.errors import PlanError, UnknownColumnError
+from tidb_tpu.expression import ColumnRef, Expression
+from tidb_tpu.expression.aggfuncs import AggDesc
+from tidb_tpu.types import FieldType
+
+
+@dataclass(frozen=True)
+class SchemaColumn:
+    name: str
+    ftype: FieldType
+    qualifier: Optional[str] = None  # table alias
+
+
+class Schema:
+    def __init__(self, columns: Sequence[SchemaColumn]):
+        self.columns = list(columns)
+
+    def __len__(self):
+        return len(self.columns)
+
+    def __iter__(self):
+        return iter(self.columns)
+
+    @property
+    def field_types(self) -> List[FieldType]:
+        return [c.ftype for c in self.columns]
+
+    @property
+    def names(self) -> List[str]:
+        return [c.name for c in self.columns]
+
+    def find(self, name: str, qualifier: Optional[str] = None) -> int:
+        """Resolve a possibly-qualified name → column index.
+
+        Ambiguity across tables is an error (ER_NON_UNIQ_ERROR analog)."""
+        lname, lq = name.lower(), qualifier.lower() if qualifier else None
+        hits = [i for i, c in enumerate(self.columns)
+                if c.name.lower() == lname
+                and (lq is None or (c.qualifier or "").lower() == lq)]
+        if not hits:
+            raise UnknownColumnError(
+                f"Unknown column '{qualifier + '.' if qualifier else ''}{name}'")
+        if len(hits) > 1:
+            raise PlanError(f"Column '{name}' in field list is ambiguous")
+        return hits[0]
+
+    def try_find(self, name: str, qualifier: Optional[str] = None) -> Optional[int]:
+        try:
+            return self.find(name, qualifier)
+        except (UnknownColumnError, PlanError):
+            return None
+
+    def column_ref(self, i: int) -> ColumnRef:
+        c = self.columns[i]
+        return ColumnRef(i, c.ftype, c.name)
+
+    @staticmethod
+    def concat(a: "Schema", b: "Schema") -> "Schema":
+        return Schema(list(a.columns) + list(b.columns))
+
+    @staticmethod
+    def from_table(info: TableInfo, alias: Optional[str] = None) -> "Schema":
+        q = alias or info.name
+        return Schema([SchemaColumn(c.name, c.ftype, q) for c in info.columns])
+
+
+# ---------------------------------------------------------------------------
+# Operators
+# ---------------------------------------------------------------------------
+
+
+class LogicalPlan:
+    schema: Schema
+    children: List["LogicalPlan"]
+
+    def __init__(self, schema: Schema, children: Sequence["LogicalPlan"] = ()):
+        self.schema = schema
+        self.children = list(children)
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__.replace("Logical", "")
+
+    def describe(self) -> str:
+        return ""
+
+    def tree_lines(self, indent: int = 0) -> List[str]:
+        d = self.describe()
+        lines = ["  " * indent + self.name + (f" {d}" if d else "")]
+        for c in self.children:
+            lines.extend(c.tree_lines(indent + 1))
+        return lines
+
+
+class LogicalDataSource(LogicalPlan):
+    """Ref: planner/core/logical_plans.go DataSource."""
+
+    def __init__(self, table: TableInfo, alias: Optional[str] = None):
+        super().__init__(Schema.from_table(table, alias))
+        self.table = table
+        self.alias = alias or table.name
+        self.filters: List[Expression] = []     # pushed-down predicates
+        self.used_columns: Optional[List[int]] = None  # pruned scan set
+        self.estimated_rows: Optional[int] = None
+
+    def describe(self):
+        s = f"table:{self.table.name}"
+        if self.alias != self.table.name:
+            s += f" as {self.alias}"
+        if self.filters:
+            s += f" filters:{self.filters}"
+        if self.used_columns is not None:
+            s += f" cols:{self.used_columns}"
+        return s
+
+
+class LogicalDual(LogicalPlan):
+    """SELECT without FROM — one anonymous row (ref: PhysicalTableDual)."""
+
+    def __init__(self, n_rows: int = 1):
+        super().__init__(Schema([]))
+        self.n_rows = n_rows
+
+
+class LogicalSelection(LogicalPlan):
+    def __init__(self, conditions: List[Expression], child: LogicalPlan):
+        super().__init__(child.schema, [child])
+        self.conditions = conditions
+
+    def describe(self):
+        return f"{self.conditions}"
+
+
+class LogicalProjection(LogicalPlan):
+    def __init__(self, exprs: List[Expression], names: List[str],
+                 child: LogicalPlan,
+                 qualifiers: Optional[List[Optional[str]]] = None):
+        quals = qualifiers or [None] * len(exprs)
+        schema = Schema([SchemaColumn(n, e.ftype, q)
+                         for e, n, q in zip(exprs, names, quals)])
+        super().__init__(schema, [child])
+        self.exprs = exprs
+
+    def describe(self):
+        return f"{self.exprs}"
+
+
+class LogicalAggregation(LogicalPlan):
+    """Output schema: group-by columns first, then aggregate results."""
+
+    def __init__(self, group_exprs: List[Expression], aggs: List[AggDesc],
+                 child: LogicalPlan, group_names: Optional[List[str]] = None):
+        names = group_names or [f"group_{i}" for i in range(len(group_exprs))]
+        cols = [SchemaColumn(n, e.ftype) for n, e in zip(names, group_exprs)]
+        cols += [SchemaColumn(a.name, a.ftype) for a in aggs]
+        super().__init__(Schema(cols), [child])
+        self.group_exprs = group_exprs
+        self.aggs = aggs
+
+    def describe(self):
+        return (f"group:{self.group_exprs} "
+                f"aggs:{[(a.name, a.args) for a in self.aggs]}")
+
+
+class LogicalJoin(LogicalPlan):
+    """kind: inner | left | right | cross | semi | anti.
+
+    Equi conditions are (left_expr, right_expr) pairs with indices local to
+    each child; other_conditions index the concatenated schema."""
+
+    def __init__(self, kind: str, left: LogicalPlan, right: LogicalPlan,
+                 equi: List[Tuple[Expression, Expression]],
+                 other_conditions: List[Expression]):
+        if kind in ("semi", "anti"):
+            schema = Schema(list(left.schema.columns))
+        else:
+            schema = Schema.concat(left.schema, right.schema)
+            if kind in ("left", "right"):
+                # inner side becomes nullable in the output
+                cols = schema.columns
+                lo, hi = ((len(left.schema), len(schema)) if kind == "left"
+                          else (0, len(left.schema)))
+                for i in range(lo, hi):
+                    c = cols[i]
+                    cols[i] = replace(c, ftype=c.ftype.with_nullable(True))
+        super().__init__(schema, [left, right])
+        self.kind = kind
+        self.equi = equi
+        self.other_conditions = other_conditions
+
+    def describe(self):
+        return f"{self.kind} equi:{self.equi} other:{self.other_conditions}"
+
+
+class LogicalSort(LogicalPlan):
+    def __init__(self, by: List[Expression], descs: List[bool],
+                 child: LogicalPlan):
+        super().__init__(child.schema, [child])
+        self.by = by
+        self.descs = descs
+
+    def describe(self):
+        return f"by:{list(zip(self.by, self.descs))}"
+
+
+class LogicalLimit(LogicalPlan):
+    def __init__(self, offset: int, count: int, child: LogicalPlan):
+        super().__init__(child.schema, [child])
+        self.offset = offset
+        self.count = count
+
+    def describe(self):
+        return f"offset:{self.offset} count:{self.count}"
+
+
+class LogicalTopN(LogicalPlan):
+    def __init__(self, by: List[Expression], descs: List[bool],
+                 offset: int, count: int, child: LogicalPlan):
+        super().__init__(child.schema, [child])
+        self.by = by
+        self.descs = descs
+        self.offset = offset
+        self.count = count
+
+    def describe(self):
+        return (f"by:{list(zip(self.by, self.descs))} "
+                f"offset:{self.offset} count:{self.count}")
+
+
+class LogicalUnionAll(LogicalPlan):
+    def __init__(self, children: List[LogicalPlan], schema: Schema):
+        super().__init__(schema, children)

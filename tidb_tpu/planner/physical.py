@@ -1,0 +1,284 @@
+"""Physical planning (ref: planner/core/find_best_task.go, task_type.go).
+
+The reference runs a cost-based search over root/cop/mpp task types; the
+analytical subset here has essentially one good physical shape per logical
+operator (hash agg, hash join, merged TopN), so physical planning is a
+direct mapping plus two genuinely cost-based choices, the same two the
+reference's MPP path makes:
+
+  * join build-side selection by estimated cardinality
+    (exhaust_physical_plans.go hash-join enumeration);
+  * engine routing: subtrees whose operators are device-capable and whose
+    estimated input rows clear `tpu_row_threshold` are tagged engine="tpu"
+    and later fused into one jitted program — the TiFlash/MppTaskType
+    precedent (planner/property/task_type.go:43).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from tidb_tpu.expression import Expression
+from tidb_tpu.expression.aggfuncs import AggDesc, build_agg
+from tidb_tpu.planner.logical import (LogicalAggregation, LogicalDataSource,
+                                      LogicalDual, LogicalJoin, LogicalLimit,
+                                      LogicalPlan, LogicalProjection,
+                                      LogicalSelection, LogicalSort,
+                                      LogicalTopN, LogicalUnionAll, Schema)
+
+DEFAULT_TPU_ROW_THRESHOLD = 32768
+
+
+class PhysicalPlan:
+    schema: Schema
+    children: List["PhysicalPlan"]
+    engine: str = "cpu"          # cpu | tpu (fragment-fused)
+    est_rows: float = 0.0
+
+    def __init__(self, schema: Schema, children=()):
+        self.schema = schema
+        self.children = list(children)
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__.replace("Phys", "")
+
+    def describe(self) -> str:
+        return ""
+
+    def explain_lines(self, indent: int = 0) -> List[Tuple[str, str, str]]:
+        """rows of (operator, estRows, info) for EXPLAIN."""
+        d = self.describe()
+        rows = [("  " * indent + ("└─" if indent else "") + self.name,
+                 f"{self.est_rows:.0f}", d)]
+        for c in self.children:
+            rows.extend(c.explain_lines(indent + 1))
+        return rows
+
+
+class PhysTableScan(PhysicalPlan):
+    def __init__(self, ds: LogicalDataSource):
+        super().__init__(ds.schema)
+        self.table = ds.table
+        self.alias = ds.alias
+        self.filters = ds.filters
+        self.used_columns = ds.used_columns
+
+    def describe(self):
+        s = f"table:{self.table.name}"
+        if self.filters:
+            s += f", filters:{self.filters}"
+        return s
+
+
+class PhysDual(PhysicalPlan):
+    def __init__(self, schema: Schema, n_rows: int):
+        super().__init__(schema)
+        self.n_rows = n_rows
+
+
+class PhysSelection(PhysicalPlan):
+    def __init__(self, conditions, child):
+        super().__init__(child.schema, [child])
+        self.conditions = conditions
+
+    def describe(self):
+        return f"{self.conditions}"
+
+
+class PhysProjection(PhysicalPlan):
+    def __init__(self, exprs, schema, child):
+        super().__init__(schema, [child])
+        self.exprs = exprs
+
+    def describe(self):
+        return f"{self.exprs}"
+
+
+class PhysHashAgg(PhysicalPlan):
+    """Two-phase segment-reduce aggregation (ref: executor/aggregate.go)."""
+
+    def __init__(self, group_exprs, aggs: List[AggDesc], schema, child):
+        super().__init__(schema, [child])
+        self.group_exprs = group_exprs
+        self.aggs = aggs
+
+    def describe(self):
+        return (f"group:{self.group_exprs} "
+                f"funcs:{[(a.name, a.args, a.distinct) for a in self.aggs]}")
+
+
+class PhysHashJoin(PhysicalPlan):
+    """build_right: which child is the hash-table side (ref: join.go)."""
+
+    def __init__(self, kind, left, right, equi, other_conditions, schema,
+                 build_right: bool):
+        super().__init__(schema, [left, right])
+        self.kind = kind
+        self.equi = equi
+        self.other_conditions = other_conditions
+        self.build_right = build_right
+
+    def describe(self):
+        return (f"{self.kind} join, build:{'right' if self.build_right else 'left'}, "
+                f"equi:{self.equi}" +
+                (f", other:{self.other_conditions}"
+                 if self.other_conditions else ""))
+
+
+class PhysSort(PhysicalPlan):
+    def __init__(self, by, descs, child):
+        super().__init__(child.schema, [child])
+        self.by = by
+        self.descs = descs
+
+    def describe(self):
+        return f"by:{list(zip(self.by, self.descs))}"
+
+
+class PhysTopN(PhysicalPlan):
+    def __init__(self, by, descs, offset, count, child):
+        super().__init__(child.schema, [child])
+        self.by = by
+        self.descs = descs
+        self.offset = offset
+        self.count = count
+
+    def describe(self):
+        return (f"by:{list(zip(self.by, self.descs))}, "
+                f"offset:{self.offset}, count:{self.count}")
+
+
+class PhysLimit(PhysicalPlan):
+    def __init__(self, offset, count, child):
+        super().__init__(child.schema, [child])
+        self.offset = offset
+        self.count = count
+
+    def describe(self):
+        return f"offset:{self.offset}, count:{self.count}"
+
+
+class PhysUnionAll(PhysicalPlan):
+    def __init__(self, schema, children):
+        super().__init__(schema, children)
+
+
+class PhysTpuFragment(PhysicalPlan):
+    """A fused subtree executed as one jitted device program.
+
+    Ref precedent: the coprocessor/MPP DAG fragment pushed to storage
+    (SURVEY §2.4.7, A.2 closure executor) — fusion at fragment granularity,
+    one compiled program per fragment, not per operator.
+    """
+
+    engine = "tpu"
+
+    def __init__(self, root: PhysicalPlan):
+        super().__init__(root.schema)
+        self.root = root
+
+    def describe(self):
+        return f"fused:[{self.root.name}]"
+
+    def explain_lines(self, indent: int = 0):
+        rows = [("  " * indent + ("└─" if indent else "") + "TpuFragment",
+                 f"{self.est_rows:.0f}", "engine:tpu")]
+        rows.extend(self.root.explain_lines(indent + 1))
+        return rows
+
+
+# ---------------------------------------------------------------------------
+# Cardinality estimation (crude; statistics-driven CBO arrives later)
+# ---------------------------------------------------------------------------
+
+SELECTIVITY = 0.25       # default filter selectivity (ref: selectionFactor 0.8
+                         # per condition; we fold to one factor)
+AGG_REDUCTION = 8.0
+
+
+def estimate(plan: PhysicalPlan, ctx) -> float:
+    if isinstance(plan, PhysTableScan):
+        n = float(_table_rows(plan.table, ctx))
+        if plan.filters:
+            n *= SELECTIVITY ** min(len(plan.filters), 2)
+        return max(n, 1.0)
+    if isinstance(plan, PhysDual):
+        return float(plan.n_rows)
+    kids = [estimate(c, ctx) for c in plan.children]
+    for c, k in zip(plan.children, kids):
+        c.est_rows = k
+    if isinstance(plan, PhysSelection):
+        return max(kids[0] * SELECTIVITY, 1.0)
+    if isinstance(plan, PhysHashAgg):
+        if not plan.group_exprs:
+            return 1.0
+        return max(kids[0] / AGG_REDUCTION, 1.0)
+    if isinstance(plan, PhysHashJoin):
+        if plan.kind in ("semi", "anti"):
+            return max(kids[0] * 0.5, 1.0)
+        return max(max(kids), 1.0)
+    if isinstance(plan, (PhysTopN, PhysLimit)):
+        return float(min(kids[0], plan.count + plan.offset))
+    if isinstance(plan, PhysUnionAll):
+        return float(sum(kids))
+    return kids[0] if kids else 1.0
+
+
+def _table_rows(table, ctx) -> int:
+    fn = getattr(ctx, "table_row_count", None)
+    if fn is None:
+        return 100000
+    return max(fn(table.id), 1)
+
+
+# ---------------------------------------------------------------------------
+# Logical → physical
+# ---------------------------------------------------------------------------
+
+
+def physical_optimize(plan: LogicalPlan, ctx) -> PhysicalPlan:
+    phys = _to_physical(plan, ctx)
+    phys.est_rows = estimate(phys, ctx)
+    use_tpu = bool(getattr(ctx, "use_tpu", False))
+    if use_tpu:
+        from tidb_tpu.executor.fragment import extract_fragments
+        threshold = int(getattr(ctx, "tpu_row_threshold",
+                                DEFAULT_TPU_ROW_THRESHOLD))
+        phys = extract_fragments(phys, threshold)
+    return phys
+
+
+def _to_physical(plan: LogicalPlan, ctx) -> PhysicalPlan:
+    if isinstance(plan, LogicalDataSource):
+        return PhysTableScan(plan)
+    if isinstance(plan, LogicalDual):
+        return PhysDual(plan.schema, plan.n_rows)
+    kids = [_to_physical(c, ctx) for c in plan.children]
+    if isinstance(plan, LogicalSelection):
+        return PhysSelection(plan.conditions, kids[0])
+    if isinstance(plan, LogicalProjection):
+        return PhysProjection(plan.exprs, plan.schema, kids[0])
+    if isinstance(plan, LogicalAggregation):
+        return PhysHashAgg(plan.group_exprs, plan.aggs, plan.schema, kids[0])
+    if isinstance(plan, LogicalJoin):
+        left, right = kids
+        lrows = estimate(left, ctx)
+        rrows = estimate(right, ctx)
+        if plan.kind in ("left", "semi", "anti"):
+            build_right = True    # probe the outer side
+        elif plan.kind == "right":
+            build_right = False
+        else:
+            build_right = rrows <= lrows
+        return PhysHashJoin(plan.kind, left, right, plan.equi,
+                            plan.other_conditions, plan.schema, build_right)
+    if isinstance(plan, LogicalSort):
+        return PhysSort(plan.by, plan.descs, kids[0])
+    if isinstance(plan, LogicalTopN):
+        return PhysTopN(plan.by, plan.descs, plan.offset, plan.count, kids[0])
+    if isinstance(plan, LogicalLimit):
+        return PhysLimit(plan.offset, plan.count, kids[0])
+    if isinstance(plan, LogicalUnionAll):
+        return PhysUnionAll(plan.schema, kids)
+    raise AssertionError(f"no physical mapping for {type(plan).__name__}")

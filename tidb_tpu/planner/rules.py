@@ -1,0 +1,332 @@
+"""Logical rewrite rules (ref: planner/core/optimizer.go:74-90 optRuleList).
+
+The reference applies a fixed-order rule list: column pruning, predicate
+pushdown, aggregation pushdown, TopN pushdown, etc. We keep the same
+fixed-order shape with the rules that matter for the analytical path:
+
+    1. constant folding          (expression_rewriter's foldConstant)
+    2. predicate pushdown        (rule_predicate_push_down.go)
+    3. Sort+Limit fusion → TopN  (rule_topn_push_down.go)
+    4. scan column marking       (rule_column_pruning.go — here only marks
+       DataSource.used_columns: columnar storage makes unread columns free
+       host-side, but the mark bounds host→device transfer)
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Set
+
+import numpy as np
+
+from tidb_tpu.errors import TiDBTPUError
+from tidb_tpu.expression import (ColumnRef, Constant, EvalContext, Expression,
+                                 ScalarFunc)
+from tidb_tpu.planner.logical import (LogicalAggregation, LogicalDataSource,
+                                      LogicalDual, LogicalJoin, LogicalLimit,
+                                      LogicalPlan, LogicalProjection,
+                                      LogicalSelection, LogicalSort,
+                                      LogicalTopN, LogicalUnionAll)
+
+
+def logical_optimize(plan: LogicalPlan) -> LogicalPlan:
+    plan = fold_constants_plan(plan)
+    plan = push_predicates(plan)
+    plan = fuse_topn(plan)
+    mark_used_columns(plan)
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# 1. Constant folding
+# ---------------------------------------------------------------------------
+
+
+def fold_expr(e: Expression) -> Expression:
+    if isinstance(e, Constant) or isinstance(e, ColumnRef):
+        return e
+    if isinstance(e, ScalarFunc):
+        args = [fold_expr(a) for a in e.args]
+        e = ScalarFunc(e.op, args, e.ftype)
+        if e.is_constant() and e.op not in ("like",):
+            try:
+                ctx = EvalContext(np, [], on_device=False, n_rows=1)
+                v, m = e.eval(ctx)
+                if not bool(np.asarray(m)[0]):
+                    return Constant(None, e.ftype)
+                raw = np.asarray(v)[0]
+                val = e.ftype.decode_value(raw) \
+                    if not e.ftype.kind.is_string else str(raw)
+                if e.ftype.np_dtype.kind == "b" or (
+                        hasattr(raw, "dtype") and raw.dtype == bool):
+                    val = int(bool(raw))
+                return Constant(val, e.ftype)
+            except TiDBTPUError:
+                return e  # leave runtime-erroring constants to execution
+    return e
+
+
+def fold_constants_plan(plan: LogicalPlan) -> LogicalPlan:
+    plan.children = [fold_constants_plan(c) for c in plan.children]
+    if isinstance(plan, LogicalSelection):
+        plan.conditions = [fold_expr(c) for c in plan.conditions]
+        # TRUE conditions vanish; a FALSE/NULL condition empties the input
+        kept = []
+        for c in plan.conditions:
+            if isinstance(c, Constant):
+                if c.value is not None and _truthy(c.value):
+                    continue
+            kept.append(c)
+        if not kept:
+            return plan.children[0]
+        plan.conditions = kept
+    elif isinstance(plan, LogicalProjection):
+        plan.exprs = [fold_expr(e) for e in plan.exprs]
+    elif isinstance(plan, LogicalAggregation):
+        plan.group_exprs = [fold_expr(e) for e in plan.group_exprs]
+        for a in plan.aggs:
+            a.args = [fold_expr(x) for x in a.args]
+    elif isinstance(plan, (LogicalSort, LogicalTopN)):
+        plan.by = [fold_expr(e) for e in plan.by]
+    elif isinstance(plan, LogicalJoin):
+        plan.other_conditions = [fold_expr(e) for e in plan.other_conditions]
+    elif isinstance(plan, LogicalDataSource):
+        plan.filters = [fold_expr(e) for e in plan.filters]
+    return plan
+
+
+def _truthy(v) -> bool:
+    try:
+        return bool(v) and v != 0
+    except Exception:
+        return True
+
+
+# ---------------------------------------------------------------------------
+# 2. Predicate pushdown (ref: planner/core/rule_predicate_push_down.go)
+# ---------------------------------------------------------------------------
+
+
+def push_predicates(plan: LogicalPlan) -> LogicalPlan:
+    if isinstance(plan, LogicalSelection):
+        child = push_predicates(plan.children[0])
+        remaining = _push_into(plan.conditions, child)
+        if remaining:
+            plan.children = [child]
+            plan.conditions = remaining
+            return plan
+        return child
+    plan.children = [push_predicates(c) for c in plan.children]
+    return plan
+
+
+def _push_into(conds: List[Expression], plan: LogicalPlan) -> List[Expression]:
+    """Try to sink conditions into `plan`; return those that couldn't sink."""
+    if isinstance(plan, LogicalDataSource):
+        plan.filters.extend(conds)
+        return []
+    if isinstance(plan, LogicalSelection):
+        leftover = _push_into(conds, plan.children[0])
+        plan.conditions.extend(leftover)
+        return []
+    if isinstance(plan, LogicalProjection):
+        remaining = []
+        substitutable = {i: e for i, e in enumerate(plan.exprs)}
+        pushed = []
+        for c in conds:
+            sub = _substitute(c, substitutable)
+            if sub is not None:
+                pushed.append(sub)
+            else:
+                remaining.append(c)
+        if pushed:
+            leftover = _push_into(pushed, plan.children[0])
+            if leftover:
+                plan.children = [LogicalSelection(leftover, plan.children[0])]
+        return remaining
+    if isinstance(plan, LogicalJoin):
+        return _push_into_join(conds, plan)
+    if isinstance(plan, LogicalAggregation):
+        # only group-key predicates may cross an aggregation
+        n_groups = len(plan.group_exprs)
+        substitutable = {i: e for i, e in enumerate(plan.group_exprs)}
+        remaining, pushed = [], []
+        for c in conds:
+            if all(i < n_groups for i in c.references()):
+                sub = _substitute(c, substitutable)
+                if sub is not None:
+                    pushed.append(sub)
+                    continue
+            remaining.append(c)
+        if pushed:
+            leftover = _push_into(pushed, plan.children[0])
+            if leftover:
+                plan.children = [LogicalSelection(leftover, plan.children[0])]
+        return remaining
+    if isinstance(plan, (LogicalSort, LogicalTopN)):
+        if isinstance(plan, LogicalSort):  # limit-free sort: safe to cross
+            leftover = _push_into(conds, plan.children[0])
+            if leftover:
+                plan.children = [LogicalSelection(leftover, plan.children[0])]
+            return []
+        return conds
+    if isinstance(plan, LogicalUnionAll):
+        for i, child in enumerate(plan.children):
+            cloned = [_clone(c) for c in conds]
+            leftover = _push_into(cloned, child)
+            if leftover:
+                plan.children[i] = LogicalSelection(leftover, child)
+        return []
+    return conds
+
+
+def _push_into_join(conds: List[Expression], join: LogicalJoin) -> List[Expression]:
+    lw = len(join.children[0].schema)
+    remaining: List[Expression] = []
+    left_push: List[Expression] = []
+    right_push: List[Expression] = []
+    for c in conds:
+        refs = c.references()
+        on_left = all(i < lw for i in refs)
+        on_right = all(i >= lw for i in refs)
+        if join.kind in ("inner", "semi", "anti"):
+            if on_left:
+                left_push.append(c)
+            elif on_right and join.kind == "inner":
+                right_push.append(_shift_refs(c, -lw))
+            else:
+                remaining.append(c)
+        elif join.kind == "left":
+            # WHERE preds on the outer (left) side sink; inner-side preds
+            # must stay above (they filter null-extended rows)
+            if on_left:
+                left_push.append(c)
+            else:
+                remaining.append(c)
+        elif join.kind == "right":
+            if on_right:
+                right_push.append(_shift_refs(c, -lw))
+            else:
+                remaining.append(c)
+        else:
+            remaining.append(c)
+    for conds_side, idx in ((left_push, 0), (right_push, 1)):
+        if conds_side:
+            leftover = _push_into(conds_side, join.children[idx])
+            if leftover:
+                join.children[idx] = LogicalSelection(leftover,
+                                                      join.children[idx])
+    return remaining
+
+
+def _substitute(e: Expression, mapping) -> Optional[Expression]:
+    """Replace col refs via mapping {index: expr}; None if any ref missing."""
+    if isinstance(e, ColumnRef):
+        return mapping.get(e.index)
+    if isinstance(e, Constant):
+        return e
+    if isinstance(e, ScalarFunc):
+        args = []
+        for a in e.args:
+            s = _substitute(a, mapping)
+            if s is None:
+                return None
+            args.append(s)
+        return ScalarFunc(e.op, args, e.ftype)
+    return None
+
+
+def _shift_refs(e: Expression, delta: int) -> Expression:
+    if isinstance(e, ColumnRef):
+        return ColumnRef(e.index + delta, e.ftype, e.name)
+    if isinstance(e, ScalarFunc):
+        return ScalarFunc(e.op, [_shift_refs(a, delta) for a in e.args],
+                          e.ftype)
+    return e
+
+
+def _clone(e: Expression) -> Expression:
+    return _shift_refs(e, 0)
+
+
+# ---------------------------------------------------------------------------
+# 3. TopN fusion (ref: planner/core/rule_topn_push_down.go)
+# ---------------------------------------------------------------------------
+
+
+def fuse_topn(plan: LogicalPlan) -> LogicalPlan:
+    plan.children = [fuse_topn(c) for c in plan.children]
+    if isinstance(plan, LogicalLimit) and \
+            isinstance(plan.children[0], LogicalSort):
+        sort = plan.children[0]
+        return LogicalTopN(sort.by, sort.descs, plan.offset, plan.count,
+                           sort.children[0])
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# 4. Scan column marking (ref: planner/core/rule_column_pruning.go)
+# ---------------------------------------------------------------------------
+
+
+def mark_used_columns(plan: LogicalPlan,
+                      required: Optional[Set[int]] = None) -> None:
+    """Record which table columns each DataSource must materialize.
+
+    Unlike the reference (which rewrites schemas bottom-up), scan output
+    keeps full-table column positions — columnar host storage makes unread
+    columns free — and the mark is consumed by the device-transfer layer.
+    """
+    if isinstance(plan, LogicalDataSource):
+        used: Set[int] = set(required) if required is not None else set(
+            range(len(plan.schema)))
+        for f in plan.filters:
+            used.update(f.references())
+        plan.used_columns = sorted(used)
+        return
+    # compute child requirements per operator
+    if isinstance(plan, LogicalProjection):
+        child_req: Set[int] = set()
+        for e in plan.exprs:
+            child_req.update(e.references())
+        mark_used_columns(plan.children[0], child_req)
+        return
+    if isinstance(plan, LogicalAggregation):
+        child_req = set()
+        for e in plan.group_exprs:
+            child_req.update(e.references())
+        for a in plan.aggs:
+            for x in a.args:
+                child_req.update(x.references())
+        mark_used_columns(plan.children[0], child_req)
+        return
+    if isinstance(plan, LogicalSelection):
+        req = set(required) if required is not None else set(
+            range(len(plan.schema)))
+        for c in plan.conditions:
+            req.update(c.references())
+        mark_used_columns(plan.children[0], req)
+        return
+    if isinstance(plan, (LogicalSort, LogicalTopN)):
+        req = set(required) if required is not None else set(
+            range(len(plan.schema)))
+        for e in plan.by:
+            req.update(e.references())
+        mark_used_columns(plan.children[0], req)
+        return
+    if isinstance(plan, LogicalJoin):
+        lw = len(plan.children[0].schema)
+        req = set(required) if required is not None else set(
+            range(len(plan.schema)))
+        for l, r in plan.equi:
+            req.update(l.references())
+            req.update(i + lw for i in r.references())
+        for c in plan.other_conditions:
+            req.update(c.references())
+        lreq = {i for i in req if i < lw}
+        rreq = {i - lw for i in req if i >= lw and
+                i - lw < len(plan.children[1].schema)}
+        mark_used_columns(plan.children[0], lreq)
+        mark_used_columns(plan.children[1], rreq)
+        return
+    for c in plan.children:
+        mark_used_columns(c, None)

@@ -1,0 +1,533 @@
+"""Session / statement lifecycle (ref: /root/reference/session/session.go).
+
+`Engine` is the per-process singleton owning catalog + storage (the
+domain.Domain analog, domain/domain.go:69-99); `Session` is one connection's
+state: variables, the active transaction, and `execute(sql)` — the
+ExecuteStmt path (session/session.go:1614): parse → plan → build executor →
+drain → ResultSet. DML runs through the same planner for its WHERE clauses
+and scans through the transaction's UnionScan merge view (staged writes
+visible to the writing session, invisible to others until commit).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tidb_tpu import types as T
+from tidb_tpu.catalog import Catalog, ColumnInfo, IndexInfo, TableInfo
+from tidb_tpu.chunk import Chunk, Column
+from tidb_tpu.errors import (ExecutionError, PlanError, TiDBTPUError)
+from tidb_tpu.executor import ExecContext, build, run_to_completion
+from tidb_tpu.expression import Expression
+from tidb_tpu.expression.runner import eval_on_chunk, filter_mask
+from tidb_tpu.parser import ast, parse
+from tidb_tpu.planner import optimize
+from tidb_tpu.planner.builder import ExpressionRewriter, SubqueryEvaluator
+from tidb_tpu.planner.logical import Schema
+from tidb_tpu.storage import Store, Transaction
+from tidb_tpu.types import FieldType
+
+DEFAULT_VARS: Dict[str, object] = {
+    # ref: sessionctx/variable/tidb_vars.go — the knobs our engine honors
+    "max_chunk_size": 65536,
+    "tidb_tpu_engine": "auto",        # on | off | auto (auto: on when TPU)
+    "tidb_tpu_row_threshold": 32768,  # min est. rows to route to device
+    "tidb_mem_quota_query": 8 << 30,
+    "sql_mode": "STRICT_TRANS_TABLES",
+    "autocommit": 1,
+}
+
+
+@dataclass
+class ResultSet:
+    names: List[str]
+    ftypes: List[FieldType]
+    rows: List[tuple]
+    affected_rows: int = 0
+    is_query: bool = True
+
+    def scalar(self):
+        return self.rows[0][0] if self.rows else None
+
+
+def ok(affected: int = 0) -> ResultSet:
+    return ResultSet([], [], [], affected_rows=affected, is_query=False)
+
+
+class Engine:
+    """Process-wide catalog + storage owner (the Domain analog)."""
+
+    def __init__(self):
+        self.catalog = Catalog()
+        self.store = Store()
+        self.stats_lock = threading.Lock()
+        self.table_stats: Dict[int, int] = {}  # table_id → analyzed row count
+
+    def new_session(self) -> "Session":
+        return Session(self)
+
+
+class _PlanContext:
+    """What the planner needs from the session (estimates + engine gate)."""
+
+    def __init__(self, session: "Session"):
+        self.session = session
+        self.subquery_evaluator = session._subquery_evaluator()
+
+    def table_row_count(self, table_id: int) -> int:
+        eng = self.session.engine
+        with eng.stats_lock:
+            if table_id in eng.table_stats:
+                return eng.table_stats[table_id]
+        snap = self.session._read_view_snapshot()
+        if snap.has_table(table_id):
+            return snap.table_data(table_id).live_rows
+        return 1
+
+    @property
+    def use_tpu(self) -> bool:
+        mode = str(self.session.vars.get("tidb_tpu_engine", "auto"))
+        if mode == "off":
+            return False
+        if mode == "on":
+            return True
+        from tidb_tpu.ops.jax_env import on_tpu
+        return on_tpu()
+
+    @property
+    def tpu_row_threshold(self) -> int:
+        return int(self.session.vars.get("tidb_tpu_row_threshold", 32768))
+
+
+class Session:
+    def __init__(self, engine: Optional[Engine] = None):
+        self.engine = engine or Engine()
+        self.vars: Dict[str, object] = dict(DEFAULT_VARS)
+        self.txn: Optional[Transaction] = None
+        self.last_plan = None
+
+    # ---- public API --------------------------------------------------------
+    def execute(self, sql: str) -> List[ResultSet]:
+        return [self._execute_stmt(s) for s in parse(sql)]
+
+    def query(self, sql: str) -> ResultSet:
+        results = self.execute(sql)
+        return results[-1]
+
+    # ---- txn plumbing ------------------------------------------------------
+    def _read_view_snapshot(self):
+        if self.txn is not None:
+            return self.txn.snapshot
+        return self.engine.store.snapshot()
+
+    def _exec_ctx(self) -> ExecContext:
+        if self.txn is not None:
+            return ExecContext(txn=self.txn, vars=self.vars)
+        return ExecContext(snapshot=self.engine.store.snapshot(),
+                           vars=self.vars)
+
+    def _write_txn(self) -> Tuple[Transaction, bool]:
+        """→ (txn, autocommit): DML inside BEGIN uses the session txn;
+        otherwise a single-statement txn committed at the end."""
+        if self.txn is not None:
+            return self.txn, False
+        return self.engine.store.begin(), True
+
+    # ---- dispatch ----------------------------------------------------------
+    def _execute_stmt(self, stmt: ast.StmtNode) -> ResultSet:
+        if isinstance(stmt, (ast.SelectStmt, ast.SetOpStmt)):
+            return self._run_query(stmt)
+        if isinstance(stmt, ast.CreateTable):
+            return self._create_table(stmt)
+        if isinstance(stmt, ast.DropTable):
+            for name in stmt.names:
+                info = self.engine.catalog.drop_table(name, stmt.if_exists)
+                if info is not None:
+                    self.engine.store.drop_table(info.id)
+            return ok()
+        if isinstance(stmt, ast.TruncateTable):
+            info = self.engine.catalog.info_schema.table(stmt.name)
+            self.engine.store.truncate_table(info.id)
+            return ok()
+        if isinstance(stmt, ast.Insert):
+            return self._insert(stmt)
+        if isinstance(stmt, ast.Delete):
+            return self._delete(stmt)
+        if isinstance(stmt, ast.Update):
+            return self._update(stmt)
+        if isinstance(stmt, ast.Explain):
+            return self._explain(stmt)
+        if isinstance(stmt, ast.SetStmt):
+            return self._set(stmt)
+        if isinstance(stmt, ast.ShowStmt):
+            return self._show(stmt)
+        if isinstance(stmt, ast.UseStmt):
+            return ok()
+        if isinstance(stmt, ast.BeginStmt):
+            if self.txn is not None:
+                self.txn.commit()  # implicit commit (MySQL semantics)
+            self.txn = self.engine.store.begin()
+            return ok()
+        if isinstance(stmt, ast.CommitStmt):
+            if self.txn is not None:
+                try:
+                    self.txn.commit()
+                finally:
+                    self.txn = None
+            return ok()
+        if isinstance(stmt, ast.RollbackStmt):
+            if self.txn is not None:
+                self.txn.rollback()
+                self.txn = None
+            return ok()
+        if isinstance(stmt, ast.AnalyzeTable):
+            return self._analyze(stmt)
+        raise PlanError(f"unsupported statement: {type(stmt).__name__}")
+
+    # ---- SELECT ------------------------------------------------------------
+    def _subquery_evaluator(self) -> SubqueryEvaluator:
+        def run(sel: ast.SelectStmt):
+            rs = self._run_query(sel)
+            return rs.rows, rs.ftypes
+        return SubqueryEvaluator(run)
+
+    def _plan(self, stmt):
+        ctx = _PlanContext(self)
+        return optimize(stmt, self.engine.catalog.info_schema, ctx)
+
+    def _run_query_chunks(self, stmt):
+        plan = self._plan(stmt)
+        self.last_plan = plan
+        exec_root = build(plan)
+        chunks = run_to_completion(exec_root, self._exec_ctx())
+        return plan, chunks
+
+    def _run_query(self, stmt) -> ResultSet:
+        plan, chunks = self._run_query_chunks(stmt)
+        rows: List[tuple] = []
+        for ch in chunks:
+            rows.extend(ch.rows())
+        return ResultSet(plan.schema.names, plan.schema.field_types, rows)
+
+    # ---- DDL ---------------------------------------------------------------
+    def _create_table(self, stmt: ast.CreateTable) -> ResultSet:
+        from tidb_tpu.expression import Constant
+        from tidb_tpu.planner.rules import fold_expr
+        cols = []
+        for c in stmt.columns:
+            default = None
+            has_default = False
+            if c.default is not None:
+                rw = ExpressionRewriter(Schema([]))
+                folded = fold_expr(rw.rewrite(c.default))
+                if not isinstance(folded, Constant):
+                    raise PlanError("DEFAULT must fold to a constant")
+                default = folded.value
+                has_default = True
+            nullable = c.ftype.nullable and not c.primary_key
+            cols.append(ColumnInfo(c.name, c.ftype.with_nullable(nullable),
+                                   primary_key=c.primary_key,
+                                   default=default, has_default=has_default))
+        pk = list(stmt.primary_key) or [c.name for c in stmt.columns
+                                        if c.primary_key]
+        idx = [IndexInfo(i.name, tuple(i.columns), i.unique)
+               for i in stmt.indexes]
+        info = self.engine.catalog.create_table(stmt.name, cols, pk, idx,
+                                                stmt.if_not_exists)
+        if info is not None:
+            self.engine.store.create_table(info.id)
+        return ok()
+
+    # ---- DML ---------------------------------------------------------------
+    def _insert(self, stmt: ast.Insert) -> ResultSet:
+        info = self.engine.catalog.info_schema.table(stmt.table)
+        names = _validate_insert_columns(stmt.columns, info)
+        if stmt.select is not None:
+            chunk = self._select_chunk_for_insert(stmt.select, info, names)
+        else:
+            chunk = self._rows_chunk(stmt, info, names)
+        txn, auto = self._write_txn()
+        txn.append(info.id, chunk)
+        if auto:
+            txn.commit()
+        return ok(chunk.num_rows)
+
+    def _rows_chunk(self, stmt: ast.Insert, info: TableInfo,
+                    names: List[str]) -> Chunk:
+        from tidb_tpu.expression import Constant
+        from tidb_tpu.planner.rules import fold_expr
+        rw = ExpressionRewriter(Schema([]))
+        rows = []
+        for vals in stmt.rows:
+            if len(vals) != len(names):
+                raise PlanError("Column count doesn't match value count")
+            evaluated = []
+            for v in vals:
+                folded = fold_expr(rw.rewrite(v))
+                if not isinstance(folded, Constant):
+                    raise PlanError("INSERT values must be constants")
+                evaluated.append(folded.value)
+            rows.append(evaluated)
+        out_rows = _assemble_rows(rows, info, names)
+        _check_not_null(out_rows, info)
+        return Chunk.from_rows(info.field_types, out_rows)
+
+    def _select_chunk_for_insert(self, select, info: TableInfo,
+                                 names: List[str]) -> Chunk:
+        """INSERT ... SELECT stays columnar: one cast-projection per source
+        chunk instead of a per-row Python round trip (ref: the reference's
+        insertRowsFromSelect also streams chunks, insert_common.go)."""
+        from tidb_tpu.expression import Constant, cast as _cast
+        plan, chunks = self._run_query_chunks(select)
+        src_schema = plan.schema
+        if len(src_schema) != len(names):
+            raise PlanError("Column count doesn't match value count")
+        pos_of = {n.lower(): i for i, n in enumerate(names)}
+        exprs = []
+        for c in info.columns:
+            pos = pos_of.get(c.name.lower())
+            if pos is not None:
+                ref = src_schema.column_ref(pos)
+                if (ref.ftype.kind != c.ftype.kind or
+                        ref.ftype.scale != c.ftype.scale):
+                    exprs.append(_cast(ref, c.ftype))
+                else:
+                    exprs.append(ref)
+            elif c.has_default:
+                exprs.append(Constant(c.default, c.ftype))
+            else:
+                exprs.append(Constant(None, c.ftype.with_nullable(True)))
+        out = [eval_on_chunk(exprs, ch) for ch in chunks if ch.num_rows]
+        chunk = Chunk.concat(out) if len(out) > 1 else (
+            out[0] if out else Chunk.from_rows(info.field_types, []))
+        chunk = Chunk([Column(c.ftype, col.values, col.validity)
+                       for c, col in zip(info.columns, chunk.columns)])
+        _check_not_null_chunk(chunk, info)
+        return chunk
+
+    def _match_masks(self, info: TableInfo, where: Optional[ast.ExprNode],
+                     txn: Transaction):
+        """Scan the table under `txn`, returning (region_masks, staged_keep,
+        matched_chunks): committed-region delete masks keyed by region id,
+        keep-masks for staged inserts, and the matched rows themselves."""
+        from tidb_tpu.executor.scan import align_chunk_to_schema
+        schema = Schema.from_table(info)
+        cond: Optional[Expression] = None
+        if where is not None:
+            rw = ExpressionRewriter(schema, self._subquery_evaluator())
+            cond = rw.rewrite(where)
+        region_masks: Dict[int, np.ndarray] = {}
+        staged_keep: List[np.ndarray] = []
+        matched: List[Chunk] = []
+        for region, chunk, alive in txn.scan(info.id):
+            chunk = align_chunk_to_schema(chunk, info)
+            hit = alive.copy()
+            if cond is not None:
+                hit &= filter_mask(cond, chunk)
+            if region is not None:
+                if hit.any():
+                    region_masks[region.id] = hit
+                    matched.append(chunk.filter(hit))
+            else:
+                staged_keep.append(~hit)
+                if hit.any():
+                    matched.append(chunk.filter(hit))
+        return region_masks, staged_keep, matched
+
+    def _delete(self, stmt: ast.Delete) -> ResultSet:
+        info = self.engine.catalog.info_schema.table(stmt.table.name)
+        txn, auto = self._write_txn()
+        try:
+            region_masks, staged_keep, _ = self._match_masks(
+                info, stmt.where, txn)
+            n = sum(int(m.sum()) for m in region_masks.values())
+            n += sum(int((~k).sum()) for k in staged_keep)
+            if region_masks:
+                txn.delete(info.id, region_masks)
+            if staged_keep:
+                txn.delete_staged(info.id, np.concatenate(staged_keep))
+            if auto:
+                txn.commit()
+            return ok(n)
+        except TiDBTPUError:
+            if auto:
+                txn.rollback()
+            raise
+
+    def _update(self, stmt: ast.Update) -> ResultSet:
+        from tidb_tpu.expression import cast as _cast
+        info = self.engine.catalog.info_schema.table(stmt.table.name)
+        schema = Schema.from_table(info)
+        rw = ExpressionRewriter(schema, self._subquery_evaluator())
+        assigns: Dict[str, Expression] = {}
+        for name, expr in stmt.assignments:
+            info.column(name)  # validates the column exists
+            assigns[name.lower()] = rw.rewrite(expr)
+        txn, auto = self._write_txn()
+        try:
+            region_masks, staged_keep, matched = self._match_masks(
+                info, stmt.where, txn)
+            if not matched:
+                if auto:
+                    txn.commit()
+                return ok(0)
+            old = Chunk.concat(matched) if len(matched) > 1 else matched[0]
+            exprs = []
+            for i, c in enumerate(info.columns):
+                e = assigns.get(c.name.lower())
+                if e is None:
+                    exprs.append(schema.column_ref(i))
+                elif (e.ftype.kind != c.ftype.kind or
+                      e.ftype.scale != c.ftype.scale):
+                    exprs.append(_cast(e, c.ftype))
+                else:
+                    exprs.append(e)
+            new_chunk = eval_on_chunk(exprs, old)
+            new_chunk = Chunk([Column(c.ftype, col.values, col.validity)
+                               for c, col in zip(info.columns,
+                                                 new_chunk.columns)])
+            _check_not_null_chunk(new_chunk, info)
+            if region_masks:
+                txn.delete(info.id, region_masks)
+            if staged_keep:
+                txn.delete_staged(info.id, np.concatenate(staged_keep))
+            txn.append(info.id, new_chunk)
+            if auto:
+                txn.commit()
+            return ok(new_chunk.num_rows)
+        except TiDBTPUError:
+            if auto:
+                txn.rollback()
+            raise
+
+    # ---- utility statements -------------------------------------------------
+    def _explain(self, stmt: ast.Explain) -> ResultSet:
+        plan = self._plan(stmt.stmt)
+        if stmt.analyze:
+            exec_root = build(plan)
+            ctx = self._exec_ctx()
+            t0 = time.perf_counter()
+            run_to_completion(exec_root, ctx)
+            wall = time.perf_counter() - t0
+            rows = [(op, est, _actual(exec_root, i), info)
+                    for i, (op, est, info) in enumerate(plan.explain_lines())]
+            rows.append(("(total)", "", f"{wall * 1e3:.1f}ms", ""))
+            return ResultSet(["id", "estRows", "actual", "info"],
+                             [T.varchar()] * 4, rows)
+        rows = list(plan.explain_lines())
+        return ResultSet(["id", "estRows", "info"], [T.varchar()] * 3, rows)
+
+    def _set(self, stmt: ast.SetStmt) -> ResultSet:
+        from tidb_tpu.expression import Constant
+        from tidb_tpu.planner.rules import fold_expr
+        rw = ExpressionRewriter(Schema([]))
+        for name, expr in stmt.assignments:
+            folded = fold_expr(rw.rewrite(expr))
+            value = folded.value if isinstance(folded, Constant) else None
+            self.vars[name.lower().lstrip("@")] = value
+        return ok()
+
+    def _show(self, stmt: ast.ShowStmt) -> ResultSet:
+        info_schema = self.engine.catalog.info_schema
+        if stmt.kind == "tables":
+            rows = [(t.name,) for t in info_schema.list_tables()]
+            return ResultSet(["Tables"], [T.varchar()], rows)
+        if stmt.kind == "columns":
+            t = info_schema.table(stmt.target)
+            rows = [(c.name, str(c.ftype),
+                     "YES" if c.ftype.nullable else "NO",
+                     "PRI" if c.primary_key else "",
+                     None if not c.has_default else str(c.default))
+                    for c in t.columns]
+            return ResultSet(["Field", "Type", "Null", "Key", "Default"],
+                             [T.varchar()] * 5, rows)
+        if stmt.kind == "variables":
+            rows = sorted((k, str(v)) for k, v in self.vars.items())
+            return ResultSet(["Variable_name", "Value"],
+                             [T.varchar(), T.varchar()], rows)
+        if stmt.kind == "create_table":
+            t = info_schema.table(stmt.target)
+            body = ",\n  ".join(f"`{c.name}` {c.ftype}" for c in t.columns)
+            ddl = f"CREATE TABLE `{t.name}` (\n  {body}\n)"
+            return ResultSet(["Table", "Create Table"],
+                             [T.varchar(), T.varchar()], [(t.name, ddl)])
+        raise PlanError(f"unsupported SHOW {stmt.kind}")
+
+    def _analyze(self, stmt: ast.AnalyzeTable) -> ResultSet:
+        snap = self._read_view_snapshot()
+        for name in stmt.names:
+            info = self.engine.catalog.info_schema.table(name)
+            if snap.has_table(info.id):
+                with self.engine.stats_lock:
+                    self.engine.table_stats[info.id] = \
+                        snap.table_data(info.id).live_rows
+        return ok()
+
+
+def _actual(exec_root, flat_index: int) -> str:
+    nodes = []
+
+    def walk(e):
+        nodes.append(e)
+        for c in getattr(e, "children", []):
+            walk(c)
+    walk(exec_root)
+    if flat_index < len(nodes):
+        s = nodes[flat_index].stats
+        return f"rows:{s.rows} time:{s.wall_ns / 1e6:.1f}ms"
+    return ""
+
+
+def _check_not_null(rows, info: TableInfo):
+    for r in rows:
+        for v, c in zip(r, info.columns):
+            if v is None and not c.ftype.nullable:
+                raise ExecutionError(f"Column '{c.name}' cannot be null")
+
+
+def _check_not_null_chunk(chunk: Chunk, info: TableInfo):
+    for col, c in zip(chunk.columns, info.columns):
+        if not c.ftype.nullable and col.validity is not None \
+                and not col.validity.all():
+            raise ExecutionError(f"Column '{c.name}' cannot be null")
+
+
+def _validate_insert_columns(columns: Optional[List[str]],
+                             info: TableInfo) -> List[str]:
+    if columns is None:
+        return [c.name for c in info.columns]
+    seen = set()
+    for n in columns:
+        info.column(n)  # raises UnknownColumnError for unknown names
+        if n.lower() in seen:
+            raise PlanError(f"Column '{n}' specified twice")
+        seen.add(n.lower())
+    return list(columns)
+
+
+def _assemble_rows(rows: List[List], info: TableInfo,
+                   names: List[str]) -> List[List]:
+    """Map value rows (ordered by `names`) onto full table-column order,
+    filling defaults/NULLs for unmentioned columns."""
+    name_to_pos = {n.lower(): i for i, n in enumerate(names)}
+    out_rows = []
+    for r in rows:
+        row = []
+        for c in info.columns:
+            pos = name_to_pos.get(c.name.lower())
+            if pos is not None:
+                row.append(r[pos])
+            elif c.has_default:
+                row.append(c.default)
+            elif c.ftype.nullable:
+                row.append(None)
+            else:
+                raise ExecutionError(
+                    f"Field '{c.name}' doesn't have a default value")
+        out_rows.append(row)
+    return out_rows

@@ -1,0 +1,293 @@
+"""In-memory columnar region store with snapshot reads + optimistic txns.
+
+Ref: /root/reference/store/mockstore/unistore/ — the reference embeds a full
+TiKV mock (badger MVCC, Percolator 2PC, region splits) so the whole SQL stack
+runs in one process. The TPU-first re-design stores data COLUMNAR from the
+start (the reference stores rows and re-columnarizes in every coprocessor
+scan): a table is an append-only list of immutable Regions, each one Chunk of
+up to REGION_ROWS rows plus a copy-on-write deletion bitmap. Regions are the
+parallel-scan unit exactly like TiKV regions are the coprocessor-task unit
+(store/copr/coprocessor.go:178) — and, later, the device-shard unit.
+
+Concurrency model (ref: optimistic txns, session/txn.go + Percolator):
+  * readers take an immutable Snapshot (region list + bitmap refs) — no locks;
+  * writers stage inserts/deletes in a MemBuffer (ref: txn memBuffer) and
+    apply atomically at commit under the store lock;
+  * conflicts: first-committer-wins on row deletes (a row deleted by two
+    overlapping txns raises TxnConflict for the second — the Percolator
+    write-conflict analog).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tidb_tpu.chunk import Chunk, Column
+from tidb_tpu.errors import TxnError, UnknownTableError
+
+REGION_ROWS = 1 << 16  # region split threshold (ref: TiKV region ~96MB)
+
+
+@dataclass(frozen=True)
+class Region:
+    """One immutable slab of rows. `deleted` is copy-on-write: never mutated
+    after publication, so snapshot readers are race-free."""
+
+    id: int
+    chunk: Chunk
+    deleted: np.ndarray  # bool (n_rows,)
+
+    @property
+    def num_rows(self) -> int:
+        return self.chunk.num_rows
+
+    @property
+    def live_rows(self) -> int:
+        return int((~self.deleted).sum())
+
+
+@dataclass(frozen=True)
+class TableData:
+    regions: Tuple[Region, ...]
+
+    @property
+    def live_rows(self) -> int:
+        return sum(r.live_rows for r in self.regions)
+
+
+class Snapshot:
+    """Immutable point-in-time view (ref: kv.Snapshot, kv/kv.go:373)."""
+
+    def __init__(self, tables: Dict[int, TableData], version: int):
+        self._tables = tables
+        self.version = version
+
+    def table_data(self, table_id: int) -> TableData:
+        td = self._tables.get(table_id)
+        if td is None:
+            raise UnknownTableError(f"no storage for table id {table_id}")
+        return td
+
+    def has_table(self, table_id: int) -> bool:
+        return table_id in self._tables
+
+    def scan(self, table_id: int) -> Iterable[Tuple[Region, np.ndarray]]:
+        """Yield (region, alive_mask) pairs — the coprocessor-task stream."""
+        for r in self.table_data(table_id).regions:
+            yield r, ~r.deleted
+
+
+class Store:
+    """The storage engine singleton (ref: kv.Storage, kv/kv.go:409)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tables: Dict[int, TableData] = {}
+        self._region_ids = itertools.count(1)
+        self._version = 0
+
+    # ---- lifecycle -------------------------------------------------------
+    def create_table(self, table_id: int) -> None:
+        with self._lock:
+            self._tables.setdefault(table_id, TableData(()))
+            self._version += 1
+
+    def drop_table(self, table_id: int) -> None:
+        with self._lock:
+            self._tables.pop(table_id, None)
+            self._version += 1
+
+    def truncate_table(self, table_id: int) -> None:
+        with self._lock:
+            if table_id not in self._tables:
+                raise UnknownTableError(f"no storage for table id {table_id}")
+            self._tables[table_id] = TableData(())
+            self._version += 1
+
+    # ---- reads -----------------------------------------------------------
+    def snapshot(self) -> Snapshot:
+        with self._lock:
+            return Snapshot(dict(self._tables), self._version)
+
+    # ---- writes (autocommit fast path) -----------------------------------
+    def append(self, table_id: int, chunk: Chunk) -> None:
+        """Append rows, splitting into REGION_ROWS regions."""
+        with self._lock:
+            self._append_locked(table_id, chunk)
+            self._version += 1
+
+    def _append_locked(self, table_id: int, chunk: Chunk) -> None:
+        td = self._tables.get(table_id)
+        if td is None:
+            raise UnknownTableError(f"no storage for table id {table_id}")
+        regions = list(td.regions)
+        # top off the last region if it has headroom and is undeleted-pure
+        for start in range(0, chunk.num_rows, REGION_ROWS):
+            part = chunk.slice(start, min(start + REGION_ROWS, chunk.num_rows))
+            if (regions and regions[-1].num_rows + part.num_rows <= REGION_ROWS
+                    and not regions[-1].deleted.any()):
+                last = regions[-1]
+                merged = Chunk.concat([last.chunk, part])
+                regions[-1] = Region(last.id, merged,
+                                     np.zeros(merged.num_rows, dtype=bool))
+            else:
+                regions.append(Region(next(self._region_ids), part,
+                                      np.zeros(part.num_rows, dtype=bool)))
+        self._tables[table_id] = TableData(tuple(regions))
+
+    def delete(self, table_id: int, region_masks: Dict[int, np.ndarray]) -> int:
+        """Mark rows deleted; masks are keyed by region id. Returns count."""
+        with self._lock:
+            n = self._delete_locked(table_id, region_masks)
+            self._version += 1
+            return n
+
+    def _pad_mask(self, mask: np.ndarray, region: Region) -> np.ndarray:
+        """A staged mask may be shorter than the region if rows were appended
+        (top-off) after the txn's snapshot: regions only ever grow at the
+        tail, so the mask covers an unchanged prefix — pad with False."""
+        if len(mask) == region.num_rows:
+            return mask
+        if len(mask) > region.num_rows:
+            raise TxnError("write conflict: region shrank (truncated)")
+        padded = np.zeros(region.num_rows, dtype=bool)
+        padded[:len(mask)] = mask
+        return padded
+
+    def _validate_deletes_locked(self, table_id: int,
+                                 region_masks: Dict[int, np.ndarray]) -> None:
+        """Conflict checks only — no mutation (keeps commit atomic)."""
+        td = self._tables.get(table_id)
+        if td is None:
+            raise TxnError("write conflict: table dropped")
+        by_id = {r.id: r for r in td.regions}
+        for rid, mask in region_masks.items():
+            r = by_id.get(rid)
+            if r is None:
+                raise TxnError("write conflict: region gone (truncated)")
+            mask = self._pad_mask(mask, r)
+            if (r.deleted & mask).any():
+                raise TxnError(
+                    "write conflict: row deleted by a concurrent transaction")
+
+    def _delete_locked(self, table_id: int,
+                       region_masks: Dict[int, np.ndarray]) -> int:
+        td = self._tables.get(table_id)
+        if td is None:
+            raise UnknownTableError(f"no storage for table id {table_id}")
+        deleted_count = 0
+        regions = list(td.regions)
+        by_id = {r.id: i for i, r in enumerate(regions)}
+        for rid, mask in region_masks.items():
+            idx = by_id.get(rid)
+            if idx is None:
+                continue
+            r = regions[idx]
+            mask = self._pad_mask(mask, r)
+            effective = mask & ~r.deleted
+            deleted_count += int(effective.sum())
+            regions[idx] = Region(r.id, r.chunk, r.deleted | mask)
+        self._tables[table_id] = TableData(tuple(regions))
+        return deleted_count
+
+    # ---- transactions ----------------------------------------------------
+    def begin(self) -> "Transaction":
+        return Transaction(self, self.snapshot())
+
+    def commit(self, txn: "Transaction") -> None:
+        with self._lock:
+            # first-committer-wins: validate EVERYTHING before applying
+            # anything, so a conflict leaves no partial writes behind
+            for tid, masks in txn.staged_deletes.items():
+                self._validate_deletes_locked(tid, masks)
+            for tid in txn.staged_inserts:
+                if tid not in self._tables:
+                    raise TxnError("write conflict: table dropped")
+            for tid, masks in txn.staged_deletes.items():
+                self._delete_locked(tid, masks)
+            for tid, chunks in txn.staged_inserts.items():
+                for ch in chunks:
+                    self._append_locked(tid, ch)
+            self._version += 1
+
+    # ---- introspection ---------------------------------------------------
+    def stats(self) -> Dict[int, Tuple[int, int]]:
+        """table_id → (regions, live rows)."""
+        with self._lock:
+            return {tid: (len(td.regions), td.live_rows)
+                    for tid, td in self._tables.items()}
+
+
+class Transaction:
+    """Optimistic txn: staged writes + snapshot reads (ref: session/txn.go
+    LazyTxn + kv memBuffer). Readers inside the txn merge staged state via
+    `scan` — the UnionScanExec pattern (executor/union_scan.go)."""
+
+    def __init__(self, store: Store, snapshot: Snapshot):
+        self._store = store
+        self.snapshot = snapshot
+        self.staged_inserts: Dict[int, List[Chunk]] = {}
+        self.staged_deletes: Dict[int, Dict[int, np.ndarray]] = {}
+        self.active = True
+
+    # ---- writes ----------------------------------------------------------
+    def append(self, table_id: int, chunk: Chunk) -> None:
+        self.staged_inserts.setdefault(table_id, []).append(chunk)
+
+    def delete(self, table_id: int, region_masks: Dict[int, np.ndarray]) -> int:
+        staged = self.staged_deletes.setdefault(table_id, {})
+        n = 0
+        for rid, mask in region_masks.items():
+            prev = staged.get(rid)
+            if prev is None:
+                staged[rid] = mask.copy()
+                n += int(mask.sum())
+            else:
+                n += int((mask & ~prev).sum())
+                staged[rid] = prev | mask
+        return n
+
+    def delete_staged(self, table_id: int, keep_mask: np.ndarray) -> None:
+        """Remove rows from this txn's own staged inserts (delete-after-insert
+        inside one txn)."""
+        chunks = self.staged_inserts.get(table_id)
+        if not chunks:
+            return
+        merged = Chunk.concat(chunks) if len(chunks) > 1 else chunks[0]
+        kept = merged.filter(keep_mask)
+        self.staged_inserts[table_id] = [kept] if kept.num_rows else []
+
+    # ---- reads (UnionScan merge) -----------------------------------------
+    def scan(self, table_id: int) -> Iterable[Tuple[Optional[Region], Chunk, np.ndarray]]:
+        """Yield (region_or_None, chunk, alive_mask): committed regions with
+        staged deletes applied, then staged-insert chunks."""
+        staged_del = self.staged_deletes.get(table_id, {})
+        if self.snapshot.has_table(table_id):
+            for r, alive in self.snapshot.scan(table_id):
+                mask = alive
+                sd = staged_del.get(r.id)
+                if sd is not None:
+                    mask = mask & ~sd
+                yield r, r.chunk, mask
+        for ch in self.staged_inserts.get(table_id, []):
+            if ch.num_rows:
+                yield None, ch, np.ones(ch.num_rows, dtype=bool)
+
+    # ---- lifecycle -------------------------------------------------------
+    def commit(self) -> None:
+        if not self.active:
+            raise TxnError("transaction is not active")
+        try:
+            self._store.commit(self)
+        finally:
+            self.active = False
+
+    def rollback(self) -> None:
+        self.active = False
+        self.staged_inserts.clear()
+        self.staged_deletes.clear()
