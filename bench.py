@@ -1,0 +1,151 @@
+"""Benchmark: TPC-H Q1 hash-aggregation rows/sec, device engine vs the CPU
+vectorized volcano baseline (BASELINE.json config #2; north-star metric).
+
+Generates lineitem-shaped columns (the mockDataSource pattern of the
+reference's executor/benchmark_test.go — no storage round trip), loads them
+into the columnar region store, then times
+
+    SELECT l_returnflag, l_linestatus, SUM(l_quantity),
+           SUM(l_extendedprice), SUM(l_extendedprice * (1 - l_discount)),
+           SUM(l_extendedprice * (1 - l_discount) * (1 + l_tax)),
+           AVG(l_quantity), AVG(l_extendedprice), AVG(l_discount), COUNT(*)
+    FROM lineitem WHERE l_shipdate <= '1998-09-02'
+    GROUP BY l_returnflag, l_linestatus ORDER BY l_returnflag, l_linestatus
+
+once through the CPU pipeline and once through the fused TPU fragment.
+Prints ONE JSON line: value = device rows/sec, vs_baseline = speedup over
+the CPU engine on this host.
+
+Env: BENCH_SF (default 1.0) scales row count (SF=1 → 6,001,215 rows);
+BENCH_REPS (default 3) timed repetitions (best-of).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+Q1 = """SELECT l_returnflag, l_linestatus, SUM(l_quantity),
+ SUM(l_extendedprice), SUM(l_extendedprice * (1 - l_discount)),
+ SUM(l_extendedprice * (1 - l_discount) * (1 + l_tax)),
+ AVG(l_quantity), AVG(l_extendedprice), AVG(l_discount), COUNT(*)
+ FROM lineitem WHERE l_shipdate <= '1998-09-02'
+ GROUP BY l_returnflag, l_linestatus ORDER BY l_returnflag, l_linestatus"""
+
+
+def log(msg: str):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def make_lineitem(n: int):
+    """Lineitem Q1 columns with TPC-H-like value distributions."""
+    rng = np.random.default_rng(42)
+    qty = rng.integers(100, 5001, n).astype(np.int64)          # 1.00..50.00
+    price = rng.integers(90_000, 10_500_001, n).astype(np.int64)
+    disc = rng.integers(0, 11, n).astype(np.int64)             # 0.00..0.10
+    tax = rng.integers(0, 9, n).astype(np.int64)               # 0.00..0.08
+    # returnflag correlates with shipdate in TPC-H; uniform is fine for perf
+    rflag = np.array(["A", "N", "R"], dtype=object)[rng.integers(0, 3, n)]
+    lstatus = np.array(["F", "O"], dtype=object)[rng.integers(0, 2, n)]
+    shipdate = rng.integers(8036, 10590, n).astype(np.int32)   # 1992..1998
+    return qty, price, disc, tax, rflag, lstatus, shipdate
+
+
+def build_engine(n_rows: int):
+    from tidb_tpu.chunk import Chunk, Column
+    from tidb_tpu.session import Engine
+
+    eng = Engine()
+    s = eng.new_session()
+    s.execute(
+        "CREATE TABLE lineitem (l_quantity DECIMAL(15,2), "
+        "l_extendedprice DECIMAL(15,2), l_discount DECIMAL(15,2), "
+        "l_tax DECIMAL(15,2), l_returnflag CHAR(1), l_linestatus CHAR(1), "
+        "l_shipdate DATE)")
+    info = eng.catalog.info_schema.table("lineitem")
+    qty, price, disc, tax, rflag, lstatus, shipdate = make_lineitem(n_rows)
+    fts = [c.ftype for c in info.columns]
+    chunk = Chunk([
+        Column(fts[0], qty, None), Column(fts[1], price, None),
+        Column(fts[2], disc, None), Column(fts[3], tax, None),
+        Column(fts[4], rflag, None), Column(fts[5], lstatus, None),
+        Column(fts[6], shipdate, None)])
+    txn = eng.store.begin()
+    txn.append(info.id, chunk)
+    txn.commit()
+    s.execute("ANALYZE TABLE lineitem")
+    return eng, s
+
+
+def time_query(s, reps: int) -> float:
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        rs = s.query(Q1)
+        dt = time.perf_counter() - t0
+        best = min(best, dt)
+        assert rs.rows, "Q1 returned no rows"
+    return best
+
+
+def main():
+    sf = float(os.environ.get("BENCH_SF", "1"))
+    reps = int(os.environ.get("BENCH_REPS", "3"))
+    n_rows = int(sf * 6_001_215)
+    log(f"generating lineitem SF={sf} ({n_rows:,} rows)")
+    eng, s = build_engine(n_rows)
+
+    from tidb_tpu.ops.jax_env import backend
+    log(f"jax backend: {backend()}")
+
+    # CPU baseline (the reference-equivalent vectorized volcano engine)
+    s.vars["tidb_tpu_engine"] = "off"
+    log("warming CPU path…")
+    time_query(s, 1)
+    cpu_t = time_query(s, reps)
+    log(f"CPU engine: {cpu_t:.3f}s ({n_rows / cpu_t / 1e6:.1f}M rows/s)")
+
+    # Device path (fused fragment)
+    s.vars["tidb_tpu_engine"] = "on"
+    s.vars["tidb_tpu_row_threshold"] = 32768
+    log("warming device path (compile)…")
+    time_query(s, 1)
+    # verify the fragment actually routed to the device engine
+    from tidb_tpu.executor import build as build_exec
+    from tidb_tpu.executor.fragment import TpuFragmentExec
+    from tidb_tpu.executor import run_to_completion
+    from tidb_tpu.parser import parse
+    plan = s._plan(parse(Q1)[0])
+    root = build_exec(plan)
+    run_to_completion(root, s._exec_ctx())
+    frags = []
+
+    def walk(e):
+        if isinstance(e, TpuFragmentExec):
+            frags.append(e)
+        for c in getattr(e, "children", []):
+            walk(c)
+
+    walk(root)
+    used_device = bool(frags) and all(f.used_device for f in frags)
+    log(f"device fragment active: {used_device}")
+
+    dev_t = time_query(s, reps)
+    log(f"TPU engine: {dev_t:.3f}s ({n_rows / dev_t / 1e6:.1f}M rows/s)")
+
+    value = n_rows / dev_t
+    vs = cpu_t / dev_t
+    print(json.dumps({
+        "metric": "tpch_q1_hashagg_rows_per_sec",
+        "value": round(value, 1),
+        "unit": "rows/sec",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,17 +1,52 @@
-"""Test environment: force an 8-device virtual CPU mesh before jax loads.
+"""Test environment: force an 8-device virtual CPU mesh.
 
 Mirrors the reference's testing strategy (SURVEY §4): the whole distributed
 surface is exercised in-process — unistore fakes a TiKV cluster in one Go
 process; we fake an 8-chip TPU pod slice with XLA host devices.
-"""
+
+On machines where a TPU site hook (sitecustomize) imports jax at
+interpreter start, env vars set here are too late — so pytest_configure
+re-execs the test process once with a scrubbed environment (after
+suspending pytest's fd capture so the new process owns the terminal).
+This also keeps tests off the real chip entirely: it is single-tenant,
+and benches own it."""
 
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+_WANT_XLA = "--xla_force_host_platform_device_count=8"
+
+
+def _needs_reexec() -> bool:
+    if os.environ.get("_TIDB_TPU_TEST_REEXEC") == "1":
+        return False
+    return ("jax" in sys.modules
+            or bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
+            or os.environ.get("JAX_PLATFORMS") not in (None, "cpu"))
+
+
+def pytest_configure(config):
+    if not _needs_reexec():
+        return
+    capman = config.pluginmanager.getplugin("capturemanager")
+    if capman is not None:
+        capman.suspend_global_capture(in_=True)
+    env = dict(os.environ)
+    env["_TIDB_TPU_TEST_REEXEC"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)   # the site hook gates on this
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags + " " + _WANT_XLA).strip()
+    env["JAX_ENABLE_X64"] = "1"
+    os.execve(sys.executable,
+              [sys.executable, "-m", "pytest"] + sys.argv[1:], env)
+
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " " + _WANT_XLA).strip()
 os.environ["JAX_ENABLE_X64"] = "1"
 
 import pytest  # noqa: E402

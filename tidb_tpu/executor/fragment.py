@@ -1,24 +1,742 @@
 """TPU fragment extraction & execution (SURVEY §7 stages 3-5).
 
-Fragment = a maximal device-capable physical subtree fused into ONE jitted
-XLA program — the analog of the coprocessor DAG the reference pushes to
-storage (SURVEY A.2: unistore's closure executor fuses scan→selection→agg
-into a single callback; plan_to_pb.go serializes subtrees for TiFlash).
+A fragment is a maximal device-capable chain `scan → selection* →
+projection* → [hash-agg | topN | sort]` fused into ONE jitted XLA program —
+the analog of the coprocessor DAG the reference pushes to storage
+(SURVEY A.2: unistore's closure executor fuses scan→selection→agg into a
+single callback, closure_exec.go; plan_to_pb.go ships subtrees to TiFlash).
+Fusion at fragment granularity is the whole game on TPU: one host→HBM
+transfer, one compiled program, no per-operator launch/transfer overhead
+(SURVEY §7 "host↔device bandwidth").
 
-Placeholder until the device operator kernels (ops/ milestone) land:
-extract_fragments is the identity, so every plan runs the CPU pipeline.
+Execution model:
+  * the scan side is materialized host-side (regions are already columnar),
+    string columns are dictionary-encoded ONCE (unified, sorted dictionary →
+    codes are rank order, so ORDER BY / range predicates work on codes);
+  * rows are padded into fixed power-of-two slabs so XLA sees a small set of
+    static shapes; the logical row count rides along and becomes a `live`
+    mask (the reference's sel vector / requiredRows, SURVEY §7 hard parts);
+  * grouped aggregation is sort-based factorize + segment ops
+    (ops/factorize.py) with a static group capacity; capacity overflow is
+    detected via the returned n_groups and retried with a doubled cap;
+  * filters never compact on device — they just narrow the live mask that
+    every downstream kernel consumes (masking beats data movement);
+  * any device failure (untraceable builtin, unsupported shape) falls back
+    to building the embedded CPU subtree — the reference's allowlist
+    philosophy (expression.go scalarExprSupportedByTiFlash) enforced by
+    trying, not by cataloguing.
+
+Compiled programs are cached process-wide keyed by plan structure + dtypes +
+slab/group capacities, so repeated queries skip retracing (the plan-cache
+analog for the device engine).
 """
 
 from __future__ import annotations
 
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tidb_tpu.chunk import Chunk, Column
 from tidb_tpu.errors import ExecutionError
-from tidb_tpu.planner.physical import PhysicalPlan
+from tidb_tpu.expression import EvalContext, Expression, ColumnRef
+from tidb_tpu.expression.aggfuncs import AggFunc, build_agg
+from tidb_tpu.planner.physical import (PhysHashAgg, PhysProjection,
+                                       PhysSelection, PhysSort, PhysTableScan,
+                                       PhysTopN, PhysTpuFragment,
+                                       PhysicalPlan)
+from tidb_tpu.types import FieldType
+
+DEFAULT_MAX_SLAB_ROWS = 1 << 23   # 8M rows per device slab
+DEFAULT_GROUP_CAP = 1 << 16
+MIN_SLAB = 1024
+
+
+class FragmentFallback(Exception):
+    """Raised when the device path cannot run this fragment."""
+
+
+# ---------------------------------------------------------------------------
+# Planner side: chain detection (the engine allowlist gate)
+# ---------------------------------------------------------------------------
+
+
+def _linearize(root: PhysicalPlan) -> Optional[List[PhysicalPlan]]:
+    """root→leaf chain [root, ..., scan], or None if the shape is wrong."""
+    nodes: List[PhysicalPlan] = []
+    cur = root
+    while True:
+        nodes.append(cur)
+        if isinstance(cur, PhysTableScan):
+            return nodes
+        mid_ok = isinstance(cur, (PhysSelection, PhysProjection))
+        root_ok = cur is root and isinstance(cur, (PhysHashAgg, PhysTopN,
+                                                   PhysSort))
+        if not (mid_ok or root_ok) or len(cur.children) != 1:
+            return None
+        cur = cur.children[0]
+
+
+def _string_exprs_are_refs(exprs: Sequence[Expression]) -> bool:
+    return all(isinstance(e, ColumnRef) or not e.ftype.kind.is_string
+               for e in exprs)
+
+
+def _fragment_ok(plan: PhysicalPlan, threshold: int) -> bool:
+    chain = _linearize(plan)
+    if chain is None:
+        return False
+    scan = chain[-1]
+    if getattr(scan, "est_rows", 0.0) < threshold:
+        # route small inputs to CPU: launch+transfer dominates (SURVEY §7
+        # cost-model honesty; the reference's TiFlash row-threshold gate)
+        return False
+    reduction = isinstance(plan, (PhysHashAgg, PhysTopN, PhysSort))
+    worthwhile = reduction or bool(scan.filters)
+    for node in chain:
+        if isinstance(node, PhysHashAgg):
+            for desc in node.aggs:
+                if desc.distinct:
+                    return False
+                try:
+                    if not build_agg(desc).device_capable:
+                        return False
+                except Exception:
+                    return False
+                if desc.args and desc.args[0].ftype.kind.is_string \
+                        and desc.name != "count":
+                    return False
+            if not _string_exprs_are_refs(node.group_exprs):
+                return False
+        elif isinstance(node, (PhysTopN, PhysSort)):
+            if not _string_exprs_are_refs(node.by):
+                return False
+        elif isinstance(node, PhysSelection):
+            worthwhile = True
+        elif isinstance(node, PhysProjection):
+            if not _string_exprs_are_refs(node.exprs):
+                return False
+            if any(not isinstance(e, ColumnRef) for e in node.exprs):
+                worthwhile = True
+    return worthwhile
 
 
 def extract_fragments(plan: PhysicalPlan, threshold: int) -> PhysicalPlan:
+    """Top-down maximal-chain extraction: try the largest fuse at each node
+    first so HashAgg(Sel(Scan)) becomes one fragment, not a CPU agg over a
+    fragment filter."""
+    if _fragment_ok(plan, threshold):
+        frag = PhysTpuFragment(plan)
+        frag.est_rows = plan.est_rows
+        return frag
+    plan.children = [extract_fragments(c, threshold) for c in plan.children]
     return plan
 
 
+# ---------------------------------------------------------------------------
+# Execution
+# ---------------------------------------------------------------------------
+
+
+def _pow2(n: int, lo: int = MIN_SLAB) -> int:
+    cap = lo
+    while cap < n:
+        cap <<= 1
+    return cap
+
+
+_COMPILE_CACHE: Dict[str, Tuple] = {}
+
+
+def _chain_signature(chain: List[PhysicalPlan], used_cols: Sequence[int],
+                     in_types: Sequence[FieldType], slab_cap: int,
+                     group_cap: int) -> str:
+    parts = [f"slab={slab_cap}", f"gcap={group_cap}",
+             "cols=" + ",".join(f"{i}:{ft}" for i, ft in
+                                zip(used_cols, in_types))]
+    for node in chain:
+        if isinstance(node, PhysTableScan):
+            parts.append(f"Scan(filters={node.filters!r})")
+        elif isinstance(node, PhysSelection):
+            parts.append(f"Sel({node.conditions!r})")
+        elif isinstance(node, PhysProjection):
+            parts.append(f"Proj({node.exprs!r})")
+        elif isinstance(node, PhysHashAgg):
+            parts.append(
+                f"Agg(g={node.group_exprs!r}, "
+                f"a={[(d.name, repr(d.args), str(d.ftype)) for d in node.aggs]})")
+        elif isinstance(node, (PhysTopN, PhysSort)):
+            k = getattr(node, "count", None)
+            off = getattr(node, "offset", 0)
+            parts.append(f"{type(node).__name__}(by={node.by!r}, "
+                         f"descs={node.descs}, k={k}, off={off})")
+    return "|".join(parts)
+
+
+def _used_column_indices(chain: List[PhysicalPlan]) -> List[int]:
+    """Scan-schema column indices referenced anywhere in the chain.
+
+    Only expressions evaluated against the SCAN schema matter: once a
+    Projection rebinds the column space, later refs point at projection
+    outputs. We walk leaf-up and stop collecting at the first Projection.
+    """
+    used = set()
+    for node in reversed(chain):
+        if isinstance(node, PhysTableScan):
+            for f in node.filters:
+                used.update(f.references())
+        elif isinstance(node, PhysSelection):
+            for c in node.conditions:
+                used.update(c.references())
+            if node is chain[0]:
+                # Selection-rooted fragment emits every child column
+                used.update(range(len(node.schema)))
+        elif isinstance(node, PhysProjection):
+            for e in node.exprs:
+                used.update(e.references())
+            return sorted(used)
+        elif isinstance(node, PhysHashAgg):
+            for e in node.group_exprs:
+                used.update(e.references())
+            for d in node.aggs:
+                for a in d.args:
+                    used.update(a.references())
+        elif isinstance(node, (PhysTopN, PhysSort)):
+            for e in node.by:
+                used.update(e.references())
+            # sort/topn emit every child column
+            n_cols = len(node.schema)
+            used.update(range(n_cols))
+    return sorted(used)
+
+
+def _stage_exprs(node: PhysicalPlan) -> List[Expression]:
+    """Expressions this node evaluates against its input columns."""
+    if isinstance(node, PhysTableScan):
+        return list(node.filters)
+    if isinstance(node, PhysSelection):
+        return list(node.conditions)
+    if isinstance(node, PhysProjection):
+        return list(node.exprs)
+    if isinstance(node, PhysHashAgg):
+        out = list(node.group_exprs)
+        for d in node.aggs:
+            out.extend(d.args)
+        return out
+    if isinstance(node, (PhysTopN, PhysSort)):
+        return list(node.by)
+    return []
+
+
+class _FragmentProgram:
+    """Traceable fragment: closures over the (first) plan's expression
+    objects; later structurally-identical plans reuse the compiled XLA
+    executable and only re-supply prepared host inputs positionally."""
+
+    def __init__(self, chain: List[PhysicalPlan], used_cols: List[int],
+                 in_types: List[FieldType], slab_cap: int, group_cap: int):
+        from tidb_tpu.ops.jax_env import jax
+        self.chain = chain
+        self.used_cols = used_cols
+        self.in_types = in_types
+        self.slab_cap = slab_cap
+        self.group_cap = group_cap
+        self.root = chain[0]
+        if isinstance(self.root, PhysHashAgg):
+            self.aggs: List[AggFunc] = [build_agg(d) for d in self.root.aggs]
+        self.prep_nodes: List[Expression] = []  # walk order, structural
+        for node in reversed(chain):
+            for e in _stage_exprs(node):
+                for sub in e.walk():
+                    if type(sub).prepare is not Expression.prepare:
+                        self.prep_nodes.append(sub)
+        self.partial = jax.jit(self._partial)
+        self.merge = jax.jit(self._merge)
+
+    # -- host-side per-execution preparation --------------------------------
+    def collect_preps(self, dicts_by_index: Dict[int, Optional[np.ndarray]]):
+        """Prepared host inputs (dictionary ranks/LUTs) in structural order.
+
+        Dictionary flow assumes string projections are bare ColumnRefs
+        (enforced by _fragment_ok), so the scan dictionaries survive every
+        stage unchanged modulo index remapping.
+        """
+        vals = []
+        dicts = _dict_list(dicts_by_index)
+        stage_dicts = dicts
+        for node in reversed(self.chain):
+            for e in _stage_exprs(node):
+                for sub in e.walk():
+                    if type(sub).prepare is not Expression.prepare:
+                        vals.append(sub.prepare(stage_dicts))
+            if isinstance(node, PhysProjection):
+                stage_dicts = [
+                    stage_dicts[e.index] if isinstance(e, ColumnRef)
+                    and e.index < len(stage_dicts) else None
+                    for e in node.exprs]
+        return vals
+
+    # -- traced stages -------------------------------------------------------
+    def _eval_chain(self, cols, n_rows, prep_vals):
+        """cols: dict index→(values, validity); returns (ctx_cols, live,
+        root_node) after all mid-chain stages."""
+        from tidb_tpu.ops.jax_env import jnp
+        prepared = {id(node): v for node, v in zip(self.prep_nodes, prep_vals)
+                    if v is not None}
+        live = jnp.arange(self.slab_cap, dtype=jnp.int32) < n_rows
+        max_idx = max(cols) if cols else -1
+        col_list: List = [cols.get(i) for i in range(max_idx + 1)]
+        ctx = EvalContext(jnp, col_list, prepared=prepared, on_device=True,
+                          n_rows=self.slab_cap)
+        for node in reversed(self.chain):
+            if isinstance(node, PhysTableScan):
+                for f in node.filters:
+                    v, m = f.eval(ctx)
+                    live = live & (v != 0) & m
+            elif isinstance(node, PhysSelection):
+                for c in node.conditions:
+                    v, m = c.eval(ctx)
+                    live = live & (v != 0) & m
+            elif isinstance(node, PhysProjection):
+                new_cols = [e.eval(ctx) for e in node.exprs]
+                ctx = EvalContext(jnp, new_cols, prepared=prepared,
+                                  on_device=True, n_rows=self.slab_cap)
+        return ctx, live
+
+    def _partial(self, cols, n_rows, prep_vals):
+        from tidb_tpu.ops.jax_env import jnp
+        from tidb_tpu.ops import factorize as F
+        ctx, live = self._eval_chain(cols, n_rows, prep_vals)
+        root = self.root
+        if isinstance(root, PhysHashAgg):
+            return self._agg_partial(ctx, live, root)
+        if isinstance(root, (PhysTopN, PhysSort)):
+            keys = [e.eval(ctx) for e in root.by]
+            out_cols = [ctx.column(i) for i in range(len(root.schema))]
+            if isinstance(root, PhysTopN):
+                k = min(root.count + root.offset, self.slab_cap)
+                idx, n_out = F.topn(keys, root.descs, live, k)
+            else:
+                idx, n_out = F.sort_perm(keys, root.descs, live)
+            gathered = [(jnp.asarray(v)[idx], jnp.asarray(m)[idx])
+                        for v, m in out_cols]
+            return {"cols": gathered, "n_out": n_out}
+        # Selection/Projection root: columns + live mask, host compacts
+        out_cols = [ctx.column(i) for i in range(len(root.schema))]
+        return {"cols": [(jnp.asarray(v), jnp.asarray(m))
+                         for v, m in out_cols], "live": live}
+
+    def _agg_partial(self, ctx, live, root: PhysHashAgg):
+        from tidb_tpu.ops.jax_env import jnp
+        from tidb_tpu.ops import factorize as F
+        cap = self.group_cap
+        if root.group_exprs:
+            keys = [e.eval(ctx) for e in root.group_exprs]
+            gids, n_groups, rep = F.factorize(keys, live, cap)
+            # dead rows → out-of-range id: segment ops drop them, which is
+            # required for order-sensitive states (first_row)
+            gids = jnp.where(live, gids, jnp.int32(cap))
+            key_out = [(jnp.asarray(v)[rep], jnp.asarray(m)[rep] &
+                        (jnp.arange(cap) < n_groups)) for v, m in keys]
+        else:
+            gids = jnp.where(live, jnp.int32(0), jnp.int32(cap))
+            n_groups = jnp.int32(1)
+            key_out = []
+        states = []
+        for agg, desc in zip(self.aggs, root.aggs):
+            if desc.args:
+                v, m = desc.args[0].eval(ctx)
+                v = jnp.asarray(v)
+                m = jnp.asarray(m) & live
+            else:
+                v = jnp.zeros(self.slab_cap, dtype=jnp.int64)
+                m = live
+            st = agg.init(jnp, cap)
+            states.append(agg.update(jnp, st, gids, cap, v, m))
+        slot_live = jnp.arange(cap, dtype=jnp.int32) < n_groups
+        return {"keys": key_out, "states": states, "n_groups": n_groups,
+                "slot_live": slot_live}
+
+    def _merge(self, key_cols, states, slot_live):
+        """Merge stacked slab partials: re-factorize partial keys, sanitize
+        dead slots to identities, scatter-merge states (AggFunc.merge is the
+        same segment op as update — SURVEY A.4)."""
+        from tidb_tpu.ops.jax_env import jnp
+        from tidb_tpu.ops import factorize as F
+        cap = self.group_cap
+        root = self.root
+        if root.group_exprs:
+            gids, n_final, rep = F.factorize(key_cols, slot_live, cap)
+            gids = jnp.where(slot_live, gids, jnp.int32(cap))
+            key_out = [(jnp.asarray(v)[rep], jnp.asarray(m)[rep] &
+                        (jnp.arange(cap) < n_final)) for v, m in key_cols]
+        else:
+            gids = jnp.where(slot_live, jnp.int32(0), jnp.int32(cap))
+            n_final = jnp.int32(1)
+            key_out = []
+        out_states = []
+        for agg, partial in zip(self.aggs, states):
+            clean = tuple(
+                jnp.where(slot_live, arr,
+                          jnp.zeros_like(arr) if arr.dtype != jnp.bool_
+                          else jnp.zeros_like(arr))
+                for arr in partial)
+            st = agg.init(jnp, cap)
+            out_states.append(agg.merge(jnp, st, gids, cap, clean))
+        return {"keys": key_out, "states": out_states, "n_groups": n_final}
+
+
+def _dict_list(dicts_by_index: Dict[int, Optional[np.ndarray]]) -> List:
+    if not dicts_by_index:
+        return []
+    n = max(dicts_by_index) + 1
+    return [dicts_by_index.get(i) for i in range(n)]
+
+
+def get_program(chain, used_cols, in_types, slab_cap, group_cap
+                ) -> _FragmentProgram:
+    sig = _chain_signature(chain, used_cols, in_types, slab_cap, group_cap)
+    prog = _COMPILE_CACHE.get(sig)
+    if prog is None:
+        prog = _FragmentProgram(chain, used_cols, in_types, slab_cap,
+                                group_cap)
+        _COMPILE_CACHE[sig] = prog
+    return prog
+
+
+# ---------------------------------------------------------------------------
+# Executor
+# ---------------------------------------------------------------------------
+
+
 class TpuFragmentExec:
-    def __init__(self, plan):
-        raise ExecutionError("TPU fragment execution not yet available")
+    """Volcano leaf running the fused device program (built by executor
+    build(), the builder.go:144 seam)."""
+
+    def __init__(self, plan: PhysTpuFragment):
+        from tidb_tpu.executor import OperatorStats
+        self.plan = plan
+        self.schema = plan.schema.field_types
+        self.children: List = []
+        self.ctx = None
+        self.stats = OperatorStats()
+        self.used_device = False
+        self._result: Optional[Chunk] = None
+        self._cpu_root = None
+        self._offset = 0
+
+    def open(self, ctx) -> None:
+        self.ctx = ctx
+        self.stats.opens += 1
+        self._result = None
+        self._offset = 0
+        self.used_device = False
+
+    def next(self) -> Optional[Chunk]:
+        if self._cpu_root is not None:
+            return self._cpu_root.next()
+        if self._result is None:
+            try:
+                self._result = self._run_device()
+                self.used_device = True
+            except FragmentFallback:
+                return self._fallback_next()
+            except Exception:
+                return self._fallback_next()
+        if self._offset >= self._result.num_rows:
+            return None
+        size = self.ctx.chunk_size
+        out = self._result.slice(
+            self._offset, min(self._offset + size, self._result.num_rows))
+        self._offset += out.num_rows
+        return out
+
+    def _fallback_next(self) -> Optional[Chunk]:
+        from tidb_tpu.executor import build
+        self._cpu_root = build(self.plan.root)
+        self._cpu_root.open(self.ctx)
+        return self._cpu_root.next()
+
+    def close(self) -> None:
+        if self._cpu_root is not None:
+            self._cpu_root.close()
+            self._cpu_root = None
+        self._result = None
+
+    # ---- device pipeline ---------------------------------------------------
+    def _materialize_scan(self) -> Chunk:
+        from tidb_tpu.executor.scan import align_chunk_to_schema
+        chain = _linearize(self.plan.root)
+        scan: PhysTableScan = chain[-1]
+        chunks = []
+        for _region, chunk, alive in self.ctx.scan_table(scan.table.id):
+            chunk = align_chunk_to_schema(chunk, scan.table)
+            if not alive.all():
+                chunk = chunk.filter(alive)
+            if chunk.num_rows:
+                chunks.append(chunk)
+        if not chunks:
+            raise FragmentFallback("empty input")
+        return Chunk.concat(chunks) if len(chunks) > 1 else chunks[0]
+
+    def _run_device(self) -> Chunk:
+        from tidb_tpu.chunk.device import encode_strings
+        from tidb_tpu.ops.jax_env import jnp, device_float_dtype
+
+        chain = _linearize(self.plan.root)
+        if chain is None:
+            raise FragmentFallback("not a chain")
+        big = self._materialize_scan()
+        total = big.num_rows
+        vars_ = self.ctx.vars
+        max_slab = int(vars_.get("tidb_tpu_max_slab_rows",
+                                 DEFAULT_MAX_SLAB_ROWS))
+        group_cap = int(vars_.get("tidb_tpu_group_cap", DEFAULT_GROUP_CAP))
+
+        used = _used_column_indices(chain)
+        in_types = [big.columns[i].ftype for i in used]
+
+        # one unified dictionary per string column (sorted → rank codes)
+        host_cols: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        dicts: Dict[int, Optional[np.ndarray]] = {}
+        for i in used:
+            col = big.columns[i]
+            if col.ftype.is_varlen:
+                codes, dictionary = encode_strings(col)
+                host_cols[i] = (codes, col.valid_mask())
+                dicts[i] = dictionary
+            else:
+                vals = col.values
+                if vals.dtype == np.dtype(np.float64):
+                    vals = vals.astype(np.dtype(device_float_dtype()))
+                host_cols[i] = (vals, col.valid_mask())
+                dicts[i] = None
+
+        slab_cap = _pow2(min(total, max_slab))
+        n_slabs = (total + slab_cap - 1) // slab_cap
+
+        root = chain[0]
+        if isinstance(root, PhysSort) and n_slabs > 1:
+            raise FragmentFallback("multi-slab global sort")
+
+        while True:
+            prog = get_program(chain, used, in_types, slab_cap, group_cap)
+            prep_vals = prog.collect_preps(dicts)
+            try:
+                result = self._execute(prog, chain, host_cols, dicts, total,
+                                       slab_cap, n_slabs, prep_vals)
+            except _GroupCapOverflow:
+                if group_cap >= slab_cap * max(n_slabs, 1):
+                    raise FragmentFallback("group cap overflow")
+                group_cap = min(group_cap * 4, slab_cap * max(n_slabs, 1))
+                continue
+            return result
+
+    def _slab(self, host_cols, slab_idx: int, slab_cap: int, total: int):
+        from tidb_tpu.ops.jax_env import jnp
+        start = slab_idx * slab_cap
+        stop = min(start + slab_cap, total)
+        n = stop - start
+        cols = {}
+        for i, (vals, valid) in host_cols.items():
+            v = vals[start:stop]
+            m = valid[start:stop]
+            if n < slab_cap:
+                pv = np.zeros(slab_cap, dtype=v.dtype)
+                pv[:n] = v
+                pm = np.zeros(slab_cap, dtype=bool)
+                pm[:n] = m
+                v, m = pv, pm
+            cols[i] = (jnp.asarray(v), jnp.asarray(m))
+        return cols, n
+
+    def _execute(self, prog: "_FragmentProgram", chain, host_cols, dicts,
+                 total: int, slab_cap: int, n_slabs: int, prep_vals) -> Chunk:
+        root = chain[0]
+        if isinstance(root, PhysHashAgg):
+            return self._execute_agg(prog, root, host_cols, dicts, total,
+                                     slab_cap, n_slabs, prep_vals)
+        if isinstance(root, (PhysTopN, PhysSort)):
+            return self._execute_order(prog, root, host_cols, dicts, total,
+                                       slab_cap, n_slabs, prep_vals)
+        return self._execute_filter(prog, root, host_cols, dicts, total,
+                                    slab_cap, n_slabs, prep_vals)
+
+    # -- hash agg ------------------------------------------------------------
+    def _execute_agg(self, prog, root: PhysHashAgg, host_cols, dicts, total,
+                     slab_cap, n_slabs, prep_vals) -> Chunk:
+        from tidb_tpu.ops.jax_env import jnp
+        partials = []
+        for s in range(n_slabs):
+            cols, n = self._slab(host_cols, s, slab_cap, total)
+            partials.append(prog.partial(cols, jnp.int32(n), prep_vals))
+        if n_slabs == 1:
+            out = partials[0]
+        else:
+            key_cols = []
+            for kc in range(len(root.group_exprs)):
+                v = jnp.concatenate([p["keys"][kc][0] for p in partials])
+                m = jnp.concatenate([p["keys"][kc][1] for p in partials])
+                key_cols.append((v, m))
+            states = []
+            for ai in range(len(root.aggs)):
+                states.append(tuple(
+                    jnp.concatenate([p["states"][ai][f] for p in partials])
+                    for f in range(len(partials[0]["states"][ai]))))
+            slot_live = jnp.concatenate([p["slot_live"] for p in partials])
+            out = prog.merge(key_cols, states, slot_live)
+        n_final = int(out["n_groups"])
+        if n_final > prog.group_cap:
+            raise _GroupCapOverflow()
+        if root.group_exprs and n_final == 0:
+            from tidb_tpu.executor import _empty_chunk
+            return _empty_chunk(self.schema)
+        return self._agg_chunk(root, out, dicts, max(n_final, 1))
+
+    def _agg_chunk(self, root: PhysHashAgg, out, dicts, n_final) -> Chunk:
+        cols: List[Column] = []
+        for kc, e in enumerate(root.group_exprs):
+            ft = self.schema[kc]
+            v = np.asarray(out["keys"][kc][0])[:n_final]
+            m = np.asarray(out["keys"][kc][1])[:n_final]
+            cols.append(_decode_col(ft, v, m, _expr_dict(e, dicts)))
+        for agg, st in zip([build_agg(d) for d in root.aggs], out["states"]):
+            # states sized group_cap; trim before host finalization
+            np_state = tuple(np.asarray(a)[:n_final] for a in st)
+            v, m = agg.final(np, np_state)
+            cols.append(_decode_col(agg.ftype, np.asarray(v),
+                                    np.asarray(m, dtype=bool), None))
+        return Chunk(cols)
+
+    # -- topn / sort ---------------------------------------------------------
+    def _execute_order(self, prog, root, host_cols, dicts, total, slab_cap,
+                       n_slabs, prep_vals) -> Chunk:
+        from tidb_tpu.ops.jax_env import jnp
+        pieces: List[Chunk] = []
+        for s in range(n_slabs):
+            cols, n = self._slab(host_cols, s, slab_cap, total)
+            out = prog.partial(cols, jnp.int32(n), prep_vals)
+            n_out = int(out["n_out"])
+            pieces.append(self._cols_chunk(root, out["cols"], dicts, n_out))
+        if len(pieces) == 1:
+            merged = pieces[0]
+        else:
+            # per-slab top-(k+off) candidates merged on host (small)
+            merged = Chunk.concat(pieces)
+            merged = _host_order(merged, root, self.plan.root.schema)
+        if isinstance(root, PhysTopN):
+            lo = min(root.offset, merged.num_rows)
+            hi = min(root.offset + root.count, merged.num_rows)
+            merged = merged.slice(lo, hi)
+        return merged
+
+    def _cols_chunk(self, root, dev_cols, dicts, n: int) -> Chunk:
+        child_types = [ft for ft in root.schema.field_types]
+        out = []
+        for ci, ((v, m), ft) in enumerate(zip(dev_cols, child_types)):
+            vals = np.asarray(v)[:n]
+            mask = np.asarray(m)[:n]
+            out.append(_decode_col(ft, vals, mask,
+                                   _positional_dict(root, ci, dicts)))
+        return Chunk(out)
+
+    # -- selection / projection ----------------------------------------------
+    def _execute_filter(self, prog, root, host_cols, dicts, total, slab_cap,
+                        n_slabs, prep_vals) -> Chunk:
+        from tidb_tpu.ops.jax_env import jnp
+        pieces: List[Chunk] = []
+        for s in range(n_slabs):
+            cols, n = self._slab(host_cols, s, slab_cap, total)
+            out = prog.partial(cols, jnp.int32(n), prep_vals)
+            live = np.asarray(out["live"])
+            idx = np.nonzero(live)[0]
+            piece = []
+            for ci, ((v, m), ft) in enumerate(
+                    zip(out["cols"], root.schema.field_types)):
+                vals = np.asarray(v)[idx]
+                mask = np.asarray(m)[idx]
+                piece.append(_decode_col(ft, vals, mask,
+                                         _positional_dict(root, ci, dicts)))
+            pieces.append(Chunk(piece))
+        return Chunk.concat(pieces) if len(pieces) > 1 else pieces[0]
+
+
+class _GroupCapOverflow(Exception):
+    pass
+
+
+def _expr_dict(e: Expression, dicts) -> Optional[np.ndarray]:
+    if isinstance(e, ColumnRef):
+        return dicts.get(e.index)
+    return None
+
+
+def _positional_dict(node: PhysicalPlan, out_idx: int, dicts
+                     ) -> Optional[np.ndarray]:
+    """Dictionary for output column `out_idx` of a non-agg root: identity
+    through Selection/TopN/Sort; via ColumnRef for Projection outputs."""
+    cur = node
+    idx = out_idx
+    while True:
+        if isinstance(cur, PhysTableScan):
+            return dicts.get(idx)
+        if isinstance(cur, PhysProjection):
+            e = cur.exprs[idx]
+            if isinstance(e, ColumnRef):
+                idx = e.index
+            else:
+                return None
+        cur = cur.children[0] if cur.children else None
+        if cur is None:
+            return None
+
+
+def _decode_col(ft: FieldType, vals: np.ndarray, mask: np.ndarray,
+                dictionary: Optional[np.ndarray]) -> Column:
+    if ft.is_varlen:
+        if dictionary is None:
+            raise FragmentFallback("string column without dictionary")
+        neg = vals < 0
+        if neg.any():
+            mask = mask & ~neg
+        if len(dictionary):
+            decoded = dictionary[np.clip(vals, 0, len(dictionary) - 1)]
+            decoded = np.asarray(decoded, dtype=object)
+        else:
+            decoded = np.full(len(vals), "", dtype=object)
+        vals = decoded
+    elif vals.dtype != ft.np_dtype:
+        vals = vals.astype(ft.np_dtype)
+    mask = np.asarray(mask, dtype=bool)
+    return Column(ft, vals, None if mask.all() else mask.copy())
+
+
+def _host_order(chunk: Chunk, root, schema) -> Chunk:
+    """k-way candidate merge for multi-slab TopN: re-sort the (small)
+    concatenated candidates on host with MySQL NULL ordering (NULLs first
+    ASC, last DESC)."""
+    from tidb_tpu.expression.runner import eval_on_chunk
+    lex_keys: List[np.ndarray] = []   # np.lexsort: LAST key is primary
+    for e, desc in zip(root.by, root.descs):
+        if isinstance(e, ColumnRef):
+            col = chunk.columns[e.index]
+        else:
+            col = eval_on_chunk([e], chunk).columns[0]
+        vals = col.values
+        valid = col.valid_mask()
+        if vals.dtype == object:
+            ranks = {v: i for i, v in
+                     enumerate(sorted({str(x) for x in vals}))}
+            vals = np.array([ranks[str(v)] for v in vals], dtype=np.int64)
+        if desc:
+            val_key = -vals.astype(np.float64) if vals.dtype.kind == "f" \
+                else ~vals.astype(np.int64)
+            null_key = ~valid            # NULLs last
+        else:
+            val_key = vals
+            null_key = valid             # NULLs first (False < True)
+        # primary-first ORDER BY list → reversed for lexsort; within one
+        # column the null flag outranks the value
+        lex_keys = [val_key, null_key] + lex_keys
+    order = np.lexsort(lex_keys) if lex_keys else np.arange(chunk.num_rows)
+    return chunk.take(order)

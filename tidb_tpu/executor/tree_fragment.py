@@ -1,0 +1,406 @@
+"""Join-tree device fragments: scan→filter→join*→aggregate in ONE program.
+
+Extends the linear-chain fragments (executor/fragment.py) to plan subtrees
+containing equi hash joins — the TPC-H Q3/Q5 shape. The whole tree traces
+into a single jitted XLA program per query: every table is lifted to HBM
+once as a padded slab, joins run as sort + binary-search against unique
+build sides (ops/join.py; the reference's hashRowContainer probe,
+hash_table.go:110, without the hash table), and the root reduction reuses
+the factorize/segment machinery.
+
+Restrictions (fall back to the CPU volcano path otherwise):
+  * every table fits one slab (no multi-slab join builds yet);
+  * equi keys are non-string (dictionary unification across sides TBD);
+  * build sides are unique on the key (the PK-FK shape) — checked on
+    device, reported back, and non-unique builds fall back at runtime.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tidb_tpu.chunk import Chunk, Column
+from tidb_tpu.expression import ColumnRef, EvalContext, Expression
+from tidb_tpu.expression.aggfuncs import build_agg
+from tidb_tpu.planner.physical import (PhysHashAgg, PhysHashJoin,
+                                       PhysProjection, PhysSelection,
+                                       PhysSort, PhysTableScan, PhysTopN,
+                                       PhysicalPlan)
+
+JOIN_KINDS = ("inner", "left", "right", "semi", "anti")
+
+
+def has_join(plan: PhysicalPlan) -> bool:
+    if isinstance(plan, PhysHashJoin):
+        return True
+    return any(has_join(c) for c in plan.children)
+
+
+def tree_ok(plan: PhysicalPlan, threshold: int) -> bool:
+    """Static eligibility of a join tree (runtime checks catch the rest)."""
+    from tidb_tpu.executor.fragment import _string_exprs_are_refs
+
+    max_scan = [0.0]
+
+    def walk(node: PhysicalPlan, is_root: bool) -> bool:
+        if isinstance(node, PhysTableScan):
+            max_scan[0] = max(max_scan[0], getattr(node, "est_rows", 0.0))
+            return True
+        if isinstance(node, PhysSelection):
+            return walk(node.children[0], False)
+        if isinstance(node, PhysProjection):
+            if not _string_exprs_are_refs(node.exprs):
+                return False
+            return walk(node.children[0], False)
+        if isinstance(node, PhysHashJoin):
+            if node.kind not in JOIN_KINDS or not node.equi:
+                return False
+            for le, re in node.equi:
+                if le.ftype.kind.is_string or re.ftype.kind.is_string:
+                    return False
+            return walk(node.children[0], False) and \
+                walk(node.children[1], False)
+        if is_root and isinstance(node, PhysHashAgg):
+            for desc in node.aggs:
+                if desc.distinct:
+                    return False
+                try:
+                    if not build_agg(desc).device_capable:
+                        return False
+                except Exception:
+                    return False
+                if desc.args and desc.args[0].ftype.kind.is_string \
+                        and desc.name != "count":
+                    return False
+            if not _string_exprs_are_refs(node.group_exprs):
+                return False
+            return walk(node.children[0], False)
+        if is_root and isinstance(node, (PhysTopN, PhysSort)):
+            if not _string_exprs_are_refs(node.by):
+                return False
+            return walk(node.children[0], False)
+        return False
+
+    return walk(plan, True) and has_join(plan) and max_scan[0] >= threshold
+
+
+def _scans(plan: PhysicalPlan) -> List[PhysTableScan]:
+    if isinstance(plan, PhysTableScan):
+        return [plan]
+    out: List[PhysTableScan] = []
+    for c in plan.children:
+        out.extend(_scans(c))
+    return out
+
+
+def _stage_exprs(node: PhysicalPlan) -> List[Expression]:
+    from tidb_tpu.executor.fragment import _stage_exprs as chain_stage
+    if isinstance(node, PhysHashJoin):
+        out: List[Expression] = []
+        for l, r in node.equi:
+            out.append(l)
+            out.append(r)
+        out.extend(node.other_conditions or [])
+        return out
+    return chain_stage(node)
+
+
+def _walk_nodes(plan: PhysicalPlan) -> List[PhysicalPlan]:
+    """Deterministic DFS (children first, left-to-right) — the structural
+    order used for prep-value alignment across compile cache hits."""
+    out: List[PhysicalPlan] = []
+
+    def rec(n):
+        for c in n.children:
+            rec(c)
+        out.append(n)
+
+    rec(plan)
+    return out
+
+
+def tree_signature(plan: PhysicalPlan, caps: Dict[int, int],
+                   group_cap: int) -> str:
+    parts = [f"gcap={group_cap}"]
+    for node in _walk_nodes(plan):
+        if isinstance(node, PhysTableScan):
+            parts.append(
+                f"Scan(id={node.table.id}, cap={caps[id(node)]}, "
+                f"types={[str(ft) for ft in node.schema.field_types]}, "
+                f"filters={node.filters!r})")
+        elif isinstance(node, PhysHashJoin):
+            parts.append(f"Join({node.kind}, build_right={node.build_right},"
+                         f" equi={node.equi!r}, "
+                         f"other={node.other_conditions!r})")
+        elif isinstance(node, PhysSelection):
+            parts.append(f"Sel({node.conditions!r})")
+        elif isinstance(node, PhysProjection):
+            parts.append(f"Proj({node.exprs!r})")
+        elif isinstance(node, PhysHashAgg):
+            parts.append(
+                f"Agg(g={node.group_exprs!r}, "
+                f"a={[(d.name, repr(d.args), str(d.ftype)) for d in node.aggs]})")
+        elif isinstance(node, (PhysTopN, PhysSort)):
+            parts.append(f"{type(node).__name__}(by={node.by!r}, "
+                         f"descs={node.descs}, "
+                         f"k={getattr(node, 'count', None)}, "
+                         f"off={getattr(node, 'offset', 0)})")
+    return "|".join(parts)
+
+
+class TreeProgram:
+    """One jitted program for a join tree. Inputs: per-scan column dicts
+    (original column index → (values, validity)) + per-scan row counts +
+    positional prepared values."""
+
+    def __init__(self, plan: PhysicalPlan, caps: Dict[int, int],
+                 group_cap: int):
+        from tidb_tpu.ops.jax_env import jax
+        self.plan = plan
+        self.caps = caps           # id(scan-node) → slab capacity
+        self.group_cap = group_cap
+        self.scan_order = _scans(plan)
+        if isinstance(plan, PhysHashAgg):
+            self.aggs = [build_agg(d) for d in plan.aggs]
+        self.prep_nodes: List[Expression] = []
+        for node in _walk_nodes(plan):
+            for e in _stage_exprs(node):
+                for sub in e.walk():
+                    if type(sub).prepare is not Expression.prepare:
+                        self.prep_nodes.append(sub)
+        self.run = jax.jit(self._run)
+
+    def collect_preps(self, dict_flows: Dict[int, List]) -> List:
+        """Prepared values in structural order; dict_flows maps id(node) →
+        the dictionary list of that node's INPUT columns."""
+        vals = []
+        for node in _walk_nodes(self.plan):
+            dicts = dict_flows.get(id(node), [])
+            for e in _stage_exprs(node):
+                for sub in e.walk():
+                    if type(sub).prepare is not Expression.prepare:
+                        vals.append(sub.prepare(dicts))
+        return vals
+
+    # -- trace ---------------------------------------------------------------
+    def _run(self, scan_inputs, scan_rows, prep_vals):
+        from tidb_tpu.ops.jax_env import jnp
+        prepared = {id(n): v for n, v in zip(self.prep_nodes, prep_vals)
+                    if v is not None}
+        self._prepared = prepared
+        cols, live = self._emit(self.plan, scan_inputs, scan_rows, root=True)
+        return self._finish(cols, live)
+
+    def _ctx(self, cols):
+        from tidb_tpu.ops.jax_env import jnp
+        return EvalContext(jnp, cols, prepared=self._prepared,
+                           on_device=True)
+
+    def _emit(self, node: PhysicalPlan, scan_inputs, scan_rows,
+              root: bool = False):
+        """→ (cols [(v,m)...], live) for non-root nodes; root reductions
+        are handled in _finish."""
+        from tidb_tpu.ops.jax_env import jnp
+        if isinstance(node, PhysTableScan):
+            slot = next(i for i, s in enumerate(self.scan_order)
+                        if s is node)
+            in_cols = scan_inputs[slot]
+            cap = self.caps[id(node)]
+            live = jnp.arange(cap, dtype=jnp.int32) < scan_rows[slot]
+            max_idx = max(in_cols) if in_cols else -1
+            col_list = [in_cols.get(i) for i in range(max_idx + 1)]
+            ctx = self._ctx(col_list)
+            for f in node.filters:
+                v, m = f.eval(ctx)
+                live = live & (v != 0) & m
+            return col_list, live
+        if isinstance(node, PhysSelection):
+            cols, live = self._emit(node.children[0], scan_inputs, scan_rows)
+            ctx = self._ctx(cols)
+            for c in node.conditions:
+                v, m = c.eval(ctx)
+                live = live & (v != 0) & m
+            return cols, live
+        if isinstance(node, PhysProjection):
+            cols, live = self._emit(node.children[0], scan_inputs, scan_rows)
+            ctx = self._ctx(cols)
+            return [e.eval(ctx) for e in node.exprs], live
+        if isinstance(node, PhysHashJoin):
+            return self._emit_join(node, scan_inputs, scan_rows)
+        if isinstance(node, (PhysHashAgg, PhysTopN, PhysSort)):
+            return self._emit(node.children[0], scan_inputs, scan_rows)
+        raise AssertionError(f"unexpected node {type(node).__name__}")
+
+    def _emit_join(self, node: PhysHashJoin, scan_inputs, scan_rows):
+        from tidb_tpu.ops.jax_env import jnp
+        from tidb_tpu.ops import join as J
+        from tidb_tpu.executor.join import coerce_key_pair
+        lcols, llive = self._emit(node.children[0], scan_inputs, scan_rows)
+        rcols, rlive = self._emit(node.children[1], scan_inputs, scan_rows)
+        if node.build_right:
+            bcols, blive, pcols, plive = rcols, rlive, lcols, llive
+            bkeys = [coerce_key_pair(l, r)[1] for l, r in node.equi]
+            pkeys = [coerce_key_pair(l, r)[0] for l, r in node.equi]
+        else:
+            bcols, blive, pcols, plive = lcols, llive, rcols, rlive
+            bkeys = [coerce_key_pair(l, r)[0] for l, r in node.equi]
+            pkeys = [coerce_key_pair(l, r)[1] for l, r in node.equi]
+        bctx = self._ctx(bcols)
+        pctx = self._ctx(pcols)
+        bk = [e.eval(bctx) for e in bkeys]
+        pk = [e.eval(pctx) for e in pkeys]
+        nb = blive.shape[0]
+        # shared exact code space: factorize over build++probe concatenated
+        both = [(jnp.concatenate([jnp.asarray(bv), jnp.asarray(pv)]),
+                 jnp.concatenate([jnp.asarray(bm), jnp.asarray(pm)]))
+                for (bv, bm), (pv, pm) in zip(bk, pk)]
+        both_live = jnp.concatenate([blive, plive])
+        codes, cvalid = J.combine_keys(both, both_live)
+        match_idx, matched, unique = J.build_probe(
+            codes[:nb], cvalid[:nb], blive, codes[nb:], cvalid[nb:], plive)
+        self._join_unique_flags.append(unique)
+        bgathered = [(jnp.take(jnp.asarray(v), match_idx),
+                      jnp.take(jnp.asarray(m), match_idx) & matched)
+                     for v, m in bcols if v is not None] if None not in \
+            [c for c in bcols] else None
+        # build columns may contain None placeholders only at scan level;
+        # joins above projections/scans emit dense lists — fill safely:
+        bgathered = []
+        for c in bcols:
+            if c is None:
+                bgathered.append(None)
+                continue
+            v, m = c
+            bgathered.append((jnp.take(jnp.asarray(v), match_idx),
+                              jnp.take(jnp.asarray(m), match_idx) & matched))
+        if node.build_right:
+            joined = list(pcols) + bgathered
+        else:
+            joined = bgathered + list(pcols)
+        live = plive
+        if node.kind == "inner":
+            live = plive & matched
+        if node.other_conditions:
+            jctx = self._ctx(joined)
+            ok = jnp.ones_like(matched)
+            for cond in node.other_conditions:
+                v, m = cond.eval(jctx)
+                ok = ok & (v != 0) & m
+            if node.kind in ("left", "right"):
+                # failed condition → unmatched: null-extend, keep the row
+                matched = matched & ok
+                bgathered = [(v, m & matched) if c is not None else None
+                             for c, (v, m) in zip(bcols, bgathered)]
+                joined = (list(pcols) + bgathered if node.build_right
+                          else bgathered + list(pcols))
+            else:
+                matched = matched & ok
+                if node.kind == "inner":
+                    live = plive & matched
+        if node.kind == "semi":
+            return list(pcols), plive & matched
+        if node.kind == "anti":
+            return list(pcols), plive & jnp.logical_not(matched)
+        return joined, live
+
+    # -- root reductions ------------------------------------------------------
+    def _finish(self, cols, live):
+        from tidb_tpu.ops.jax_env import jax, jnp
+        from tidb_tpu.ops import factorize as F
+        root = self.plan
+        self_join_flags = self._join_unique_flags
+        uniq = jnp.stack(self_join_flags).all() if self_join_flags else \
+            jnp.bool_(True)
+        if isinstance(root, PhysHashAgg):
+            cap = self.group_cap
+            ctx = self._ctx(cols)
+            if root.group_exprs:
+                keys = [e.eval(ctx) for e in root.group_exprs]
+                gids, n_groups, rep = F.factorize(keys, live, cap)
+                gids = jnp.where(live, gids, jnp.int32(cap))
+                key_out = [(jnp.asarray(v)[rep], jnp.asarray(m)[rep] &
+                            (jnp.arange(cap) < n_groups)) for v, m in keys]
+            else:
+                gids = jnp.where(live, jnp.int32(0), jnp.int32(cap))
+                n_groups = jnp.int32(1)
+                key_out = []
+            states = []
+            n = live.shape[0]
+            for agg, desc in zip(self.aggs, root.aggs):
+                if desc.args:
+                    v, m = desc.args[0].eval(ctx)
+                    v = jnp.asarray(v)
+                    m = jnp.asarray(m) & live
+                else:
+                    v = jnp.zeros(n, dtype=jnp.int64)
+                    m = live
+                st = agg.init(jnp, cap)
+                states.append(agg.update(jnp, st, gids, cap, v, m))
+            return {"keys": key_out, "states": states, "n_groups": n_groups,
+                    "unique": uniq}
+        if isinstance(root, (PhysTopN, PhysSort)):
+            ctx = self._ctx(cols)
+            keys = [e.eval(ctx) for e in root.by]
+            n_out_cols = len(root.schema)
+            if isinstance(root, PhysTopN):
+                k = min(root.count + root.offset, live.shape[0])
+                idx, n_out = F.topn(keys, root.descs, live, k)
+            else:
+                idx, n_out = F.sort_perm(keys, root.descs, live)
+            gathered = [(jnp.take(jnp.asarray(v), idx),
+                         jnp.take(jnp.asarray(m), idx))
+                        for v, m in cols[:n_out_cols]]
+            return {"cols": gathered, "n_out": n_out, "unique": uniq}
+        return {"cols": [(jnp.asarray(v), jnp.asarray(m))
+                         for v, m in cols], "live": live, "unique": uniq}
+
+    def __call__(self, scan_inputs, scan_rows, prep_vals):
+        self._join_unique_flags = []
+        return self.run(scan_inputs, scan_rows, prep_vals)
+
+
+def dictionary_flows(plan: PhysicalPlan,
+                     scan_dicts: Dict[int, Dict[int, Optional[np.ndarray]]]
+                     ) -> Tuple[Dict[int, List], List]:
+    """Host-side mirror of the trace: per-node input dictionaries and the
+    root's output dictionary list. scan_dicts: id(scan) → {col_idx: dict}."""
+    flows: Dict[int, List] = {}
+
+    def rec(node: PhysicalPlan) -> List:
+        if isinstance(node, PhysTableScan):
+            d = scan_dicts.get(id(node), {})
+            n = max(d) + 1 if d else 0
+            out = [d.get(i) for i in range(n)]
+            flows[id(node)] = out
+            return out
+        child_flows = [rec(c) for c in node.children]
+        if isinstance(node, PhysHashJoin):
+            l, r = child_flows
+            nl = len(node.children[0].schema)
+            nr = len(node.children[1].schema)
+            l = (l + [None] * nl)[:nl]
+            r = (r + [None] * nr)[:nr]
+            if node.kind in ("semi", "anti"):
+                out = l
+            else:
+                out = l + r
+            flows[id(node)] = l + r
+            return out
+        inp = child_flows[0]
+        flows[id(node)] = inp
+        if isinstance(node, PhysProjection):
+            return [inp[e.index] if isinstance(e, ColumnRef)
+                    and e.index < len(inp) else None for e in node.exprs]
+        if isinstance(node, PhysHashAgg):
+            out = []
+            for e in node.group_exprs:
+                out.append(inp[e.index] if isinstance(e, ColumnRef)
+                           and e.index < len(inp) else None)
+            out.extend([None] * len(node.aggs))
+            return out
+        return inp
+
+    root_out = rec(plan)
+    return flows, root_out

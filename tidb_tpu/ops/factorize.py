@@ -1,0 +1,115 @@
+"""Device factorization & ordering primitives (the no-hash-table kernels).
+
+The reference's hash aggregation and hash join both revolve around an
+open-address hash table (executor/aggregate.go getGroupKey→HashGroupKey,
+executor/hash_table.go hashRowContainer). TPUs have no efficient random
+scatter, so the TPU-native formulation is sort-based (SURVEY §7 stage 4):
+
+  * `factorize` — dense group ids for multi-column keys via ONE variadic
+    `lax.sort` (XLA's bitonic sort vectorizes on the VPU), boundary
+    detection between sorted neighbors, and a cumsum. This is EXACT — the
+    actual typed key values are the sort operands, not a 64-bit hash — so
+    unlike a hash table there are no collisions to verify.
+  * `topn` / `sort_perm` — MySQL ORDER BY semantics (NULLs first ASC, last
+    DESC) as a single multi-operand sort returning a gather permutation.
+
+All group counts are static (`cap`): callers get `n_groups` back and must
+retry with a bigger cap (or fall back to host) when `n_groups > cap` —
+the padding/masking discipline of SURVEY §7 "dynamic shapes vs XLA".
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from tidb_tpu.ops.jax_env import jax, jnp, lax
+
+
+def _not(flag):
+    return jnp.logical_not(flag)
+
+
+def factorize(keys: Sequence[Tuple], live, cap: int):
+    """Dense group ids for rows under multi-column keys.
+
+    keys: list of (values, valid) pairs — `valid` False means SQL NULL,
+          which forms its own group (MySQL GROUP BY semantics, mirroring
+          host factorize_columns in executor/hash_agg.py).
+    live: (N,) bool — False rows (padding / filtered-out) join no group.
+    cap:  static maximum number of groups.
+
+    Returns (gids, n_groups, rep):
+      gids     (N,) int32 in [0, cap) — dead rows get an arbitrary in-range
+               id; callers must mask their contributions.
+      n_groups () int32 — may exceed cap, in which case results are invalid
+               and the caller must retry with a larger cap.
+      rep      (cap,) int32 — smallest original row index of each group
+               (clamped to N-1 for empty slots; gather-safe).
+    """
+    n = live.shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    operands: List = [_not(live)]  # live rows sort first
+    for v, m in keys:
+        operands.append(jnp.asarray(m))   # NULL group sorts before non-NULL
+        operands.append(jnp.asarray(v))
+    operands.append(iota)
+    out = lax.sort(tuple(operands), num_keys=len(operands) - 1)
+    sidx = out[-1]
+    dead_s = out[0]
+    live_s = _not(dead_s)
+    first = jnp.zeros(n, dtype=bool).at[0].set(True)
+    diff = first
+    for comp in out[1:-1]:
+        diff = diff | jnp.concatenate(
+            [jnp.ones(1, dtype=bool), comp[1:] != comp[:-1]])
+    boundary = diff & live_s
+    gid_s = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    n_groups = boundary.sum().astype(jnp.int32)
+    gid_s = jnp.clip(gid_s, 0, cap - 1)
+    gids = jnp.zeros(n, dtype=jnp.int32).at[sidx].set(gid_s)
+    rep = jax.ops.segment_min(jnp.where(live_s, sidx, n), gid_s,
+                              num_segments=cap)
+    rep = jnp.minimum(rep, n - 1).astype(jnp.int32)
+    return gids, n_groups, rep
+
+
+def _order_operands(keys: Sequence[Tuple], descs: Sequence[bool], live):
+    """Sort operands implementing MySQL ORDER BY over possibly-NULL keys."""
+    operands: List = [_not(live)]  # dead rows last
+    for (v, m), desc in zip(keys, descs):
+        v = jnp.asarray(v)
+        m = jnp.asarray(m)
+        if desc:
+            operands.append(_not(m))          # DESC: NULLs last
+            if v.dtype.kind == "f":
+                operands.append(-v)
+            elif v.dtype == jnp.bool_:
+                operands.append(_not(v))
+            else:
+                operands.append(~v)           # exact order flip, no overflow
+        else:
+            operands.append(m)                # ASC: NULLs first
+            operands.append(v)
+    return operands
+
+
+def sort_perm(keys: Sequence[Tuple], descs: Sequence[bool], live):
+    """Full-sort permutation → (perm (N,) int32, n_live () int32).
+
+    perm[0:n_live] are original row indices in output order; the tail is
+    the dead rows (stable, but callers trim via n_live).
+    """
+    n = live.shape[0]
+    operands = _order_operands(keys, descs, live)
+    operands.append(jnp.arange(n, dtype=jnp.int32))
+    out = lax.sort(tuple(operands), num_keys=len(operands) - 1,
+                   is_stable=True)
+    return out[-1], live.sum().astype(jnp.int32)
+
+
+def topn(keys: Sequence[Tuple], descs: Sequence[bool], live, k: int):
+    """Top-k row indices under ORDER BY semantics → (idx (k,), n_out)."""
+    perm, n_live = sort_perm(keys, descs, live)
+    return perm[:k], jnp.minimum(n_live, jnp.int32(k))

@@ -1,0 +1,76 @@
+"""Device equi-join primitives — sort + binary-search, no hash table.
+
+The reference's HashJoinExec builds an open-address table over the build
+side and probes it row-at-a-time (executor/hash_table.go:77-146). The
+TPU-native formulation (SURVEY A.5, §7 stage 4): sort the build side's
+(exact, typed) keys once, then every probe row finds its match with a
+vectorized binary search — `searchsorted` lowers to a handful of MXU-free
+gather rounds and the whole probe is one fused kernel.
+
+v1 scope: the build side's keys are UNIQUE (the PK-FK shape of every
+TPC-H join); each probe row then matches at most one build row, so the
+output shape equals the probe shape — static, no fanout expansion. The
+kernel reports a `unique` flag; non-unique builds fall back to the CPU
+hash join (executor/join.py) until the expansion kernel lands.
+
+Multi-column keys factorize to a single i64 code first (exact — see
+combine_keys): per-column dense ranks composed positionally.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+from tidb_tpu.ops.jax_env import jax, jnp, lax
+
+
+def combine_keys(keys: Sequence[Tuple], live):
+    """Collapse multi-column keys into one exact i64 code per row.
+
+    keys: [(values, valid), ...] — all rows of ONE array set (for joins,
+    concatenate build+probe first so codes share a space).
+    Returns (codes int64 (N,), code_valid (N,) bool) — code_valid False
+    when any key column is NULL (equi-join: NULL matches nothing).
+
+    Exactness: each column is reduced to dense ranks via sort+boundary
+    (ops/factorize.py mechanics); ranks compose as code*N + rank, which
+    cannot collide while N * product-of-ranks fits int64 — guaranteed by
+    re-densifying after every column.
+    """
+    from tidb_tpu.ops.factorize import factorize
+    n = live.shape[0]
+    codes = jnp.zeros(n, dtype=jnp.int64)
+    code_valid = jnp.ones(n, dtype=bool)
+    for v, m in keys:
+        m = jnp.asarray(m)
+        code_valid = code_valid & m
+        # dense rank of (codes, v) pairs — one sort per column, stays exact
+        gids, _, _ = factorize([(codes, jnp.ones(n, dtype=bool)),
+                                (jnp.asarray(v), m)], live, n)
+        codes = gids.astype(jnp.int64)
+    return codes, code_valid
+
+
+def build_probe(build_codes, build_valid, build_live,
+                probe_codes, probe_valid, probe_live):
+    """Unique-build equi-join core.
+
+    Returns (match_idx (P,) int32 — build row index per probe row (0 when
+    no match), matched (P,) bool, build_unique () bool).
+    """
+    nb = build_codes.shape[0]
+    ok_b = build_valid & build_live
+    # dense codes are < pool size << INT64_MAX, so the sentinel is
+    # out-of-band: dead/NULL build rows sort to a strictly-sorted tail
+    sentinel = jnp.iinfo(jnp.int64).max
+    sort_key = jnp.where(ok_b, build_codes, sentinel)
+    sorted_codes, sorted_idx = lax.sort(
+        (sort_key, jnp.arange(nb, dtype=jnp.int32)), num_keys=1)
+    dup = (sorted_codes[1:] == sorted_codes[:-1]) & \
+        (sorted_codes[1:] != sentinel)
+    unique = jnp.logical_not(dup.any())
+    pos = jnp.clip(jnp.searchsorted(sorted_codes, probe_codes), 0, nb - 1)
+    hit = jnp.take(sorted_codes, pos) == probe_codes
+    matched = hit & probe_valid & probe_live
+    match_idx = jnp.where(matched, jnp.take(sorted_idx, pos), 0)
+    return match_idx.astype(jnp.int32), matched, unique

@@ -1,0 +1,50 @@
+"""Multi-chip parallelism: the reference's MPP engine as XLA collectives.
+
+Mapping from the reference's parallelism inventory (SURVEY §2.4) to the
+TPU mesh (the data plane moves from gRPC exchange streams onto ICI):
+
+  reference mechanism                      TPU-native equivalent
+  ------------------------------------     ---------------------------------
+  region-parallel coprocessor scans        rows sharded over mesh axis
+    (buildCopTasks, copr/coprocessor.go)     'shard' (PartitionSpec sharding)
+  MPP hash-repartition exchange            all_to_all bucket exchange inside
+    (ExchangeType_Hash, mpp_exec.go)         shard_map (collective.exchange)
+  broadcast join small side                all_gather of the build side
+    (ExchangeType_Broadcast)                 (collective.broadcast_build)
+  two-phase partial/final aggregation      per-shard segment partials +
+    (AggFunc.MergePartialResult)             all_gather + owned-group merge
+  ShuffleExec intra-node pipelines         XLA fuses per-shard programs
+
+Everything here composes under ONE jit: a distributed query step traces to
+a single XLA program per shard with collectives riding ICI/DCN — the
+moral equivalent of a TiFlash MPP task DAG, but compiler-scheduled.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from tidb_tpu.ops.jax_env import jax, jnp
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = "shard"):
+    """1-D device mesh over the first n devices (the MPP task-group)."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return jax.sharding.Mesh(np.array(devs), (axis,))
+
+
+def shard_rows(mesh, arrays: Sequence, axis: str = "shard"):
+    """Place row-dim-sharded host arrays onto the mesh (region→shard map)."""
+    spec = jax.sharding.PartitionSpec(axis)
+    sharding = jax.sharding.NamedSharding(mesh, spec)
+    return [jax.device_put(a, sharding) for a in arrays]
+
+
+def replicated(mesh, arrays: Sequence):
+    spec = jax.sharding.PartitionSpec()
+    sharding = jax.sharding.NamedSharding(mesh, spec)
+    return [jax.device_put(a, sharding) for a in arrays]

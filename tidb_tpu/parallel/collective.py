@@ -1,0 +1,97 @@
+"""Collective building blocks used inside shard_map'd query steps.
+
+These are the data-plane primitives of the distributed engine — the ICI
+replacements for the reference's ExchangerTunnel channels
+(store/mockstore/unistore/cophandler/mpp_exec.go:109-206, which hash-
+partition chunks row-at-a-time into per-receiver gRPC streams).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+from tidb_tpu.ops.jax_env import jax, jnp, lax
+
+
+def _mix64(x):
+    """splitmix64 finalizer — spreads dense group codes across shards."""
+    x = jnp.asarray(x, dtype=jnp.uint64)
+    x = (x ^ (x >> 30)) * jnp.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> 27)) * jnp.uint64(0x94D049BB133111EB)
+    return x ^ (x >> 31)
+
+
+def shard_of(codes, n_shards: int):
+    """Owner shard of each key code (the hash-partition function — the
+    mod-N rule of mpp_exec.go:158-173, but over a mixed hash so dense
+    codes don't stripe)."""
+    return (_mix64(codes) % jnp.uint64(n_shards)).astype(jnp.int32)
+
+
+def exchange(arrays: Sequence, dest, live, n_shards: int, bucket_cap: int,
+             axis: str = "shard"):
+    """Hash-repartition rows across shards: all_to_all bucket exchange.
+
+    Per shard: scatter live rows into `n_shards` fixed-capacity buckets by
+    `dest`, then a single all_to_all swaps bucket i of shard j with bucket
+    j of shard i. Rows beyond bucket_cap are dropped and reported so
+    callers can retry with a bigger capacity (static-shape discipline).
+
+    arrays: per-row payload arrays (N,)...; dest (N,) int32; live (N,) bool.
+    Returns (received_arrays [(n_shards*bucket_cap,)...], received_live,
+             overflowed () bool).
+    """
+    n = dest.shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    d = jnp.where(live, dest, jnp.int32(n_shards))  # dead rows → no bucket
+    # rank of each row within its destination bucket: sort by (dest, row)
+    sorted_d, sorted_row = lax.sort((d, iota), num_keys=1)
+    first_of_d = jax.ops.segment_min(jnp.arange(n, dtype=jnp.int32),
+                                     sorted_d, num_segments=n_shards + 1)
+    rank_sorted = jnp.arange(n, dtype=jnp.int32) - \
+        jnp.take(first_of_d, jnp.clip(sorted_d, 0, n_shards))
+    rank = jnp.zeros(n, dtype=jnp.int32).at[sorted_row].set(rank_sorted)
+    counts = jax.ops.segment_sum(jnp.ones(n, dtype=jnp.int32), d,
+                                 num_segments=n_shards + 1)[:n_shards]
+    overflow_local = (counts > bucket_cap).any()
+    slot = d * bucket_cap + rank
+    ok = live & (rank < bucket_cap)
+    slot = jnp.where(ok, slot, n_shards * bucket_cap)  # OOB → dropped
+    total = n_shards * bucket_cap
+
+    sent_live = jnp.zeros(total, dtype=bool).at[slot].set(
+        ok, mode="drop")
+    out_arrays: List = []
+    for a in arrays:
+        buf = jnp.zeros(total, dtype=a.dtype).at[slot].set(
+            jnp.where(ok, a, jnp.zeros((), dtype=a.dtype)), mode="drop")
+        out_arrays.append(buf)
+
+    def swap(buf):
+        b = buf.reshape(n_shards, bucket_cap)
+        return lax.all_to_all(b, axis, split_axis=0, concat_axis=0,
+                              tiled=False).reshape(total)
+
+    recv = [swap(b) for b in out_arrays]
+    recv_live = swap(sent_live)
+    overflowed = lax.pmax(overflow_local.astype(jnp.int32), axis) > 0
+    return recv, recv_live, overflowed
+
+
+def broadcast_build(arrays: Sequence, live, axis: str = "shard"):
+    """Broadcast-join pattern: every shard receives the full build side
+    (ExchangeType_Broadcast) — one all_gather along the mesh axis."""
+    out = [lax.all_gather(a, axis, tiled=True) for a in arrays]
+    return out, lax.all_gather(live, axis, tiled=True)
+
+
+def gather_partials(key_cols: Sequence[Tuple], state_arrays: Sequence,
+                    slot_live, axis: str = "shard"):
+    """Two-phase aggregation exchange: all_gather per-shard partial states
+    so each shard can merge the groups it owns (MergePartialResult across
+    shards, SURVEY §2.4.6)."""
+    keys = [(lax.all_gather(v, axis, tiled=True),
+             lax.all_gather(m, axis, tiled=True)) for v, m in key_cols]
+    states = [tuple(lax.all_gather(a, axis, tiled=True) for a in st)
+              for st in state_arrays]
+    return keys, states, lax.all_gather(slot_live, axis, tiled=True)

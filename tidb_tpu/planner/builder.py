@@ -102,6 +102,7 @@ class ExpressionRewriter:
         if isinstance(node, ast.BinaryOp):
             left = self.rewrite(node.left)
             right = self.rewrite(node.right)
+            left, right = _coerce_temporal_cmp(node.op, left, right)
             return func(node.op, left, right)
         if isinstance(node, ast.IsNull):
             e = func("isnull", self.rewrite(node.expr))
@@ -110,7 +111,9 @@ class ExpressionRewriter:
             e = self.rewrite(node.expr)
             low = self.rewrite(node.low)
             high = self.rewrite(node.high)
-            out = func("and", func("ge", e, low), func("le", e, high))
+            e1, low = _coerce_temporal_cmp("ge", e, low)
+            e2, high = _coerce_temporal_cmp("le", e, high)
+            out = func("and", func("ge", e1, low), func("le", e2, high))
             return func("not", out) if node.negated else out
         if isinstance(node, ast.LikeExpr):
             e = func("like", self.rewrite(node.expr),
@@ -642,6 +645,29 @@ def classify_join_conditions(conds: List[Expression], left_width: int):
                     continue
         other.append(c)
     return equi, other
+
+
+_CMP_OPS = {"eq", "ne", "lt", "le", "gt", "ge"}
+
+
+def _coerce_temporal_cmp(op: str, left: Expression, right: Expression):
+    """`date_col <= '1998-09-02'`: fold the string literal into the
+    temporal column's physical encoding (MySQL implicit temporal cast;
+    ref: expression/builtin_compare.go refine of constant operands)."""
+    if op not in _CMP_OPS:
+        return left, right
+
+    def fold(e: Expression, target: Expression) -> Expression:
+        if (isinstance(e, Constant) and e.ftype.kind.is_string
+                and target.ftype.kind.is_temporal and e.value is not None):
+            try:
+                ft = target.ftype.with_nullable(False)
+                return Constant(ft.decode_value(ft.encode_value(e.value)), ft)
+            except (ValueError, TypeError):
+                return e
+        return e
+
+    return fold(left, right), fold(right, left)
 
 
 def _shift(e: Expression, delta: int) -> Expression:
