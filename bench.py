@@ -41,6 +41,56 @@ def log(msg: str):
     print(msg, file=sys.stderr, flush=True)
 
 
+def emit(value: float, vs: float, extra: dict | None = None):
+    row = {
+        "metric": "tpch_q1_hashagg_rows_per_sec",
+        "value": round(value, 1),
+        "unit": "rows/sec",
+        "vs_baseline": round(vs, 3),
+    }
+    if extra:
+        row.update(extra)
+    print(json.dumps(row), flush=True)
+
+
+def probe_backend(retries: int = 5) -> str:
+    """Initialize the JAX backend BEFORE any expensive work.
+
+    The TPU tunnel can report transient UNAVAILABLE at startup; retry with
+    backoff. On unrecoverable device failure, re-exec once onto the CPU
+    backend so a number still lands (flagged in the JSON) instead of dying
+    with no artifact at all.
+    """
+    last = None
+    for attempt in range(retries):
+        try:
+            import jax
+            devs = jax.devices()
+            # force real device initialization with a tiny computation
+            import jax.numpy as jnp
+            float(jnp.ones(8).sum())
+            log(f"jax backend ready: {jax.default_backend()} "
+                f"({len(devs)} device(s))")
+            return jax.default_backend()
+        except Exception as e:  # noqa: BLE001
+            last = e
+            msg = str(e)
+            log(f"backend probe attempt {attempt + 1}/{retries} failed: "
+                f"{msg[:300]}")
+            if "UNAVAILABLE" not in msg and "unavailable" not in msg \
+                    and attempt >= 1:
+                break
+            time.sleep(min(2 ** attempt, 30))
+    if os.environ.get("_TIDB_TPU_BENCH_CPU") == "1":
+        raise RuntimeError(f"backend init failed even on CPU: {last}")
+    log("device backend unrecoverable; re-exec on CPU backend")
+    env = dict(os.environ)
+    env["_TIDB_TPU_BENCH_CPU"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
+
 def make_lineitem(n: int):
     """Lineitem Q1 columns with TPC-H-like value distributions."""
     rng = np.random.default_rng(42)
@@ -96,11 +146,13 @@ def main():
     sf = float(os.environ.get("BENCH_SF", "1"))
     reps = int(os.environ.get("BENCH_REPS", "3"))
     n_rows = int(sf * 6_001_215)
+
+    # probe/initialize the backend FIRST — datagen takes a while and a dead
+    # backend must be discovered (and retried/re-execed) before spending it
+    backend_name = probe_backend()
+
     log(f"generating lineitem SF={sf} ({n_rows:,} rows)")
     eng, s = build_engine(n_rows)
-
-    from tidb_tpu.ops.jax_env import backend
-    log(f"jax backend: {backend()}")
 
     # CPU baseline (the reference-equivalent vectorized volcano engine)
     s.vars["tidb_tpu_engine"] = "off"
@@ -139,13 +191,17 @@ def main():
 
     value = n_rows / dev_t
     vs = cpu_t / dev_t
-    print(json.dumps({
-        "metric": "tpch_q1_hashagg_rows_per_sec",
-        "value": round(value, 1),
-        "unit": "rows/sec",
-        "vs_baseline": round(vs, 3),
-    }))
+    extra = {"backend": backend_name, "device_fragment": used_device,
+             "cpu_rows_per_sec": round(n_rows / cpu_t, 1)}
+    emit(value, vs, extra)
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # noqa: BLE001
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+        # still hand the driver a JSON line carrying the failure state
+        emit(0.0, 0.0, {"error": f"{type(e).__name__}: {e}"[:500]})
+        sys.exit(1)

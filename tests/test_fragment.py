@@ -163,3 +163,80 @@ def test_small_input_stays_on_cpu(session):
     finally:
         session.vars["tidb_tpu_engine"] = "off"
         session.vars["tidb_tpu_row_threshold"] = 1
+
+
+def test_multi_slab_per_slab_cap_overflow(session):
+    # Advisor r1 high-severity repro: per-slab distinct groups exceed the
+    # cap while the MERGED group count stays under it — the per-slab
+    # n_groups check must trigger retry, not silently conflate groups.
+    # Group by the DOUBLE column: floats have no cached bounds, so this
+    # exercises the sort-factorize path (perfect-hash grouping would route
+    # around the clipping bug this guards).
+    session.vars["tidb_tpu_group_cap"] = 64
+    try:
+        sql = "SELECT b, COUNT(*) FROM t WHERE b IS NOT NULL GROUP BY b"
+        dev = run_device(session, sql, max_slab=2048)
+        assert_same(dev, session.query(sql).rows)
+    finally:
+        session.vars.pop("tidb_tpu_group_cap", None)
+
+
+def test_device_table_cache_reuse_and_invalidation():
+    from tidb_tpu.executor import device_cache
+    eng = Engine()
+    s = eng.new_session()
+    s.execute("CREATE TABLE ct (a BIGINT, c VARCHAR(8))")
+    s.execute("INSERT INTO ct VALUES " + ",".join(
+        f"({i % 7}, 'v{i % 3}')" for i in range(4000)))
+    sql = "SELECT a, COUNT(*) FROM ct GROUP BY a"
+    r1 = run_device(s, sql)
+    ent1 = device_cache._CACHE.get(
+        eng.catalog.info_schema.table("ct").id)
+    assert ent1 is not None and 0 in ent1.dev
+    r2 = run_device(s, sql)
+    ent2 = device_cache._CACHE.get(
+        eng.catalog.info_schema.table("ct").id)
+    assert ent2 is ent1          # cache hit: same device payload object
+    assert_same(r1, r2)
+    # a write replaces TableData → identity check must rebuild
+    s.execute("INSERT INTO ct VALUES (99, 'new')")
+    r3 = run_device(s, sql)
+    ent3 = device_cache._CACHE.get(
+        eng.catalog.info_schema.table("ct").id)
+    assert ent3 is not ent1
+    assert sum(r[1] for r in r3) == 4001
+    assert_same(r3, s.query(sql).rows)
+
+
+def test_txn_reads_bypass_device_cache():
+    eng = Engine()
+    s = eng.new_session()
+    s.execute("CREATE TABLE tx (a BIGINT)")
+    s.execute("INSERT INTO tx VALUES " + ",".join(
+        f"({i % 5})" for i in range(3000)))
+    s.execute("BEGIN")
+    s.execute("INSERT INTO tx VALUES (77)")
+    sql = "SELECT a, COUNT(*) FROM tx GROUP BY a"
+    dev = run_device(s, sql)      # staged row must be visible
+    assert any(r[0] == 77 for r in dev)
+    s.execute("ROLLBACK")
+    dev2 = run_device(s, sql)
+    assert not any(r[0] == 77 for r in dev2)
+
+
+def test_strict_mode_and_fallback_reason():
+    from tidb_tpu.errors import ExecutionError
+    eng = Engine()
+    s = eng.new_session()
+    s.execute("CREATE TABLE st (a BIGINT)")  # empty table → FragmentFallback
+    s.vars["tidb_tpu_engine"] = "on"
+    s.vars["tidb_tpu_row_threshold"] = 0
+    s.vars["tidb_tpu_strict"] = True
+    try:
+        with pytest.raises(ExecutionError, match="fell back"):
+            s.query("SELECT a, COUNT(*) FROM st GROUP BY a")
+        s.vars["tidb_tpu_strict"] = False
+        rs = s.query("SELECT a, COUNT(*) FROM st GROUP BY a")
+        assert rs.rows == []
+    finally:
+        s.vars["tidb_tpu_engine"] = "off"

@@ -1,0 +1,189 @@
+"""HBM-resident table cache — the device engine's columnar replica.
+
+The reference never re-ships table data per query: TiFlash keeps a columnar
+replica synced from the row store and MPP queries read it in place. The TPU
+analog is this cache: the first device query against a table dictionary-
+encodes its string columns, pads rows into power-of-two slabs, and uploads
+each used column to HBM ONCE. Subsequent queries reuse the device arrays
+directly — the per-query host work drops to slicing prepared values, and the
+HBM copy is invalidated precisely when the table changes.
+
+Invalidation rides the storage engine's immutability discipline
+(tidb_tpu/storage): every committed write replaces the table's `TableData`
+tuple, so identity (`is`) of the snapshot's TableData is an exact freshness
+token — no version counters, no false sharing between tables. Reads inside
+an open transaction bypass the cache (staged rows are session-private, the
+UnionScan view).
+
+Ref: TiFlash replica selection (planner/core/find_best_task.go reads
+TiFlash availability per table); coprocessor cache
+(store/copr/coprocessor_cache.go) is the reference's other read-cache
+precedent.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+MAX_CACHED_TABLES = 4
+
+
+class CachedTable:
+    """Per-table device payload: per-column slab lists + dictionaries."""
+
+    __slots__ = ("td", "max_slab", "total", "slab_cap", "n_slabs",
+                 "parts", "dicts", "dev", "bounds")
+
+    def __init__(self, td, max_slab: int, total: int, slab_cap: int,
+                 n_slabs: int, parts):
+        self.td = td                    # TableData identity token (or None)
+        self.max_slab = max_slab
+        self.total = total
+        self.slab_cap = slab_cap
+        self.n_slabs = n_slabs
+        self.parts = parts              # [(aligned chunk, alive or None)]
+        self.dicts: Dict[int, Optional[np.ndarray]] = {}
+        self.dev: Dict[int, List[Tuple]] = {}  # col → [(vals, valid)] slabs
+        # col → (lo, hi) over valid values; None for floats/empty — feeds
+        # the perfect-hash group-by domain gate (fragment._agg_key_bounds)
+        self.bounds: Dict[int, Optional[Tuple[int, int]]] = {}
+
+    def slab_rows(self, s: int) -> int:
+        return min(self.slab_cap, self.total - s * self.slab_cap)
+
+
+_CACHE: "OrderedDict[int, CachedTable]" = OrderedDict()
+
+
+def clear():
+    _CACHE.clear()
+
+
+def invalidate(table_id: int):
+    _CACHE.pop(table_id, None)
+
+
+def _pow2(n: int, lo: int = 1024) -> int:
+    cap = lo
+    while cap < n:
+        cap <<= 1
+    return cap
+
+
+def _collect_parts(ctx, scan):
+    """Materialize the scan's region stream host-side (no column copies:
+    alignment reuses region arrays; only partially-deleted regions filter)."""
+    from tidb_tpu.executor.scan import align_chunk_to_schema
+    parts = []
+    total = 0
+    for _region, chunk, alive in ctx.scan_table(scan.table.id):
+        chunk = align_chunk_to_schema(chunk, scan.table)
+        mask = None if alive.all() else alive
+        n = chunk.num_rows if mask is None else int(mask.sum())
+        if n:
+            parts.append((chunk, mask))
+            total += n
+    return parts, total
+
+
+def _materialize_col(ent: CachedTable, col_idx: int):
+    vals_list, valid_list = [], []
+    for chunk, mask in ent.parts:
+        col = chunk.columns[col_idx]
+        v = col.values
+        m = col.valid_mask()
+        if mask is not None:
+            v = v[mask]
+            m = m[mask]
+        vals_list.append(v)
+        valid_list.append(m)
+    if len(vals_list) == 1:
+        return vals_list[0], valid_list[0]
+    return np.concatenate(vals_list), np.concatenate(valid_list)
+
+
+def _encode_col(ftype, vals: np.ndarray, valid: np.ndarray):
+    """→ (device-ready values, dictionary or None). Strings become sorted-
+    dictionary rank codes (order-preserving, so comparisons work on codes);
+    DOUBLE narrows to the device float dtype."""
+    from tidb_tpu.chunk import Column
+    from tidb_tpu.chunk.device import encode_strings
+    from tidb_tpu.ops.jax_env import device_float_dtype
+    if ftype.is_varlen:
+        return encode_strings(Column(ftype, vals, None))
+    if vals.dtype == np.dtype(np.float64):
+        vals = vals.astype(np.dtype(device_float_dtype()))
+    return vals, None
+
+
+def _col_bounds(vals: np.ndarray, valid: np.ndarray,
+                dictionary) -> Optional[Tuple[int, int]]:
+    if dictionary is not None:
+        return (0, len(dictionary) - 1) if len(dictionary) else None
+    if vals.dtype.kind not in "iu":
+        return None
+    vv = vals if valid.all() else vals[valid]
+    if not len(vv):
+        return None
+    return int(vv.min()), int(vv.max())
+
+
+def _upload_col(ent: CachedTable, col_idx: int, ftype):
+    from tidb_tpu.ops.jax_env import jnp
+    vals, valid = _materialize_col(ent, col_idx)
+    vals, dictionary = _encode_col(ftype, vals, valid)
+    ent.dicts[col_idx] = dictionary
+    ent.bounds[col_idx] = _col_bounds(vals, valid, dictionary)
+    slabs = []
+    for s in range(ent.n_slabs):
+        start = s * ent.slab_cap
+        stop = min(start + ent.slab_cap, ent.total)
+        n = stop - start
+        v = vals[start:stop]
+        m = valid[start:stop]
+        if n < ent.slab_cap:
+            pv = np.zeros(ent.slab_cap, dtype=v.dtype)
+            pv[:n] = v
+            pm = np.zeros(ent.slab_cap, dtype=bool)
+            pm[:n] = m
+            v, m = pv, pm
+        slabs.append((jnp.asarray(v), jnp.asarray(m)))
+    ent.dev[col_idx] = slabs
+
+
+def get_table(ctx, scan, used_cols, max_slab: int) -> CachedTable:
+    """→ CachedTable with every column in `used_cols` uploaded.
+
+    Cacheable only for snapshot reads (ctx.txn is None); transaction reads
+    build a transient entry so staged rows are visible without poisoning
+    the shared cache.
+    """
+    table_id = scan.table.id
+    cacheable = getattr(ctx, "txn", None) is None
+    td = ctx.snapshot.table_data(table_id) if cacheable else None
+
+    ent = _CACHE.get(table_id) if cacheable else None
+    if ent is not None and (ent.td is not td or ent.max_slab != max_slab):
+        _CACHE.pop(table_id, None)
+        ent = None
+    if ent is None:
+        parts, total = _collect_parts(ctx, scan)
+        slab_cap = _pow2(min(total, max_slab)) if total else 1024
+        n_slabs = (total + slab_cap - 1) // slab_cap
+        ent = CachedTable(td, max_slab, total, slab_cap, n_slabs, parts)
+        if cacheable:
+            _CACHE[table_id] = ent
+            while len(_CACHE) > MAX_CACHED_TABLES:
+                _CACHE.popitem(last=False)
+    elif cacheable:
+        _CACHE.move_to_end(table_id)
+
+    if ent.total:
+        ftypes = scan.schema.field_types
+        for i in used_cols:
+            if i not in ent.dev:
+                _upload_col(ent, i, ftypes[i])
+    return ent

@@ -15,9 +15,23 @@ from __future__ import annotations
 
 import numpy as np
 
+# Below this cap, grouped reductions use a masked broadcast-reduce instead of
+# a scatter: TPU scatter serializes updates (~70ms for 1M int64 rows on v4),
+# while `reduce(where(gid == iota_c, v, id))` stays a fused vector reduction
+# (~8ms at cap 16, ~14ms at cap 1024; measured on the target chip). Exact for
+# int64 — no float round trip.
+MASKED_REDUCE_CAP = 1024
+
 
 def _is_np(xp) -> bool:
     return xp is np
+
+
+def _masked_reduce(xp, data, segment_ids, num_segments, identity, reducer):
+    iota = xp.arange(num_segments, dtype=segment_ids.dtype)
+    m = segment_ids[:, None] == iota[None, :]
+    ident = xp.asarray(identity, dtype=data.dtype)
+    return reducer(xp.where(m, data[:, None], ident), axis=0)
 
 
 def segment_sum(xp, data, segment_ids, num_segments: int):
@@ -25,6 +39,9 @@ def segment_sum(xp, data, segment_ids, num_segments: int):
         out = np.zeros(num_segments, dtype=data.dtype)
         np.add.at(out, segment_ids, data)
         return out
+    if num_segments <= MASKED_REDUCE_CAP:
+        return _masked_reduce(xp, data, segment_ids, num_segments,
+                              data.dtype.type(0), xp.sum)
     from tidb_tpu.ops.jax_env import jax
     return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
 
@@ -35,9 +52,7 @@ def segment_count(xp, mask, segment_ids, num_segments: int):
         out = np.zeros(num_segments, dtype=np.int64)
         np.add.at(out, segment_ids, mask.astype(np.int64))
         return out
-    from tidb_tpu.ops.jax_env import jax, jnp
-    return jax.ops.segment_sum(mask.astype(jnp.int64), segment_ids,
-                               num_segments=num_segments)
+    return segment_sum(xp, mask.astype(xp.int64), segment_ids, num_segments)
 
 
 def segment_min(xp, data, segment_ids, num_segments: int):
@@ -46,6 +61,9 @@ def segment_min(xp, data, segment_ids, num_segments: int):
                       dtype=data.dtype)
         np.minimum.at(out, segment_ids, data)
         return out
+    if num_segments <= MASKED_REDUCE_CAP:
+        return _masked_reduce(xp, data, segment_ids, num_segments,
+                              _max_identity(data.dtype), xp.min)
     from tidb_tpu.ops.jax_env import jax
     return jax.ops.segment_min(data, segment_ids, num_segments=num_segments)
 
@@ -56,6 +74,9 @@ def segment_max(xp, data, segment_ids, num_segments: int):
                       dtype=data.dtype)
         np.maximum.at(out, segment_ids, data)
         return out
+    if num_segments <= MASKED_REDUCE_CAP:
+        return _masked_reduce(xp, data, segment_ids, num_segments,
+                              _min_identity(data.dtype), xp.max)
     from tidb_tpu.ops.jax_env import jax
     return jax.ops.segment_max(data, segment_ids, num_segments=num_segments)
 
@@ -66,9 +87,8 @@ def segment_any(xp, mask, segment_ids, num_segments: int):
         out = np.zeros(num_segments, dtype=bool)
         np.logical_or.at(out, segment_ids, mask)
         return out
-    from tidb_tpu.ops.jax_env import jax, jnp
-    return jax.ops.segment_max(mask.astype(jnp.int32), segment_ids,
-                               num_segments=num_segments) > 0
+    return segment_max(xp, mask.astype(xp.int32), segment_ids,
+                       num_segments) > 0
 
 
 def segment_first(xp, data, mask, segment_ids, num_segments: int):
@@ -81,9 +101,8 @@ def segment_first(xp, data, mask, segment_ids, num_segments: int):
         found = idx < n
         safe = np.where(found, idx, 0)
         return data[safe], found
-    from tidb_tpu.ops.jax_env import jax, jnp
     rows = xp.where(mask, xp.arange(n, dtype=xp.int64), n)
-    idx = jax.ops.segment_min(rows, segment_ids, num_segments=num_segments)
+    idx = segment_min(xp, rows, segment_ids, num_segments)
     found = idx < n
     safe = xp.where(found, idx, 0)
     return data[safe], found

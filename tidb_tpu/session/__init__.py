@@ -478,8 +478,15 @@ def _actual(exec_root, flat_index: int) -> str:
             walk(c)
     walk(exec_root)
     if flat_index < len(nodes):
-        s = nodes[flat_index].stats
-        return f"rows:{s.rows} time:{s.wall_ns / 1e6:.1f}ms"
+        node = nodes[flat_index]
+        s = node.stats
+        extra = ""
+        info_fn = getattr(node, "runtime_info", None)
+        if info_fn is not None:
+            ri = info_fn()
+            if ri:
+                extra = " " + ri
+        return f"rows:{s.rows} time:{s.wall_ns / 1e6:.1f}ms{extra}"
     return ""
 
 
