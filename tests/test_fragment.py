@@ -189,20 +189,18 @@ def test_device_table_cache_reuse_and_invalidation():
     s.execute("INSERT INTO ct VALUES " + ",".join(
         f"({i % 7}, 'v{i % 3}')" for i in range(4000)))
     sql = "SELECT a, COUNT(*) FROM ct GROUP BY a"
+    key = (id(eng.store), eng.catalog.info_schema.table("ct").id)
     r1 = run_device(s, sql)
-    ent1 = device_cache._CACHE.get(
-        eng.catalog.info_schema.table("ct").id)
+    ent1 = device_cache._CACHE.get(key)
     assert ent1 is not None and 0 in ent1.dev
     r2 = run_device(s, sql)
-    ent2 = device_cache._CACHE.get(
-        eng.catalog.info_schema.table("ct").id)
+    ent2 = device_cache._CACHE.get(key)
     assert ent2 is ent1          # cache hit: same device payload object
     assert_same(r1, r2)
     # a write replaces TableData → identity check must rebuild
     s.execute("INSERT INTO ct VALUES (99, 'new')")
     r3 = run_device(s, sql)
-    ent3 = device_cache._CACHE.get(
-        eng.catalog.info_schema.table("ct").id)
+    ent3 = device_cache._CACHE.get(key)
     assert ent3 is not ent1
     assert sum(r[1] for r in r3) == 4001
     assert_same(r3, s.query(sql).rows)

@@ -35,11 +35,12 @@ class CachedTable:
     """Per-table device payload: per-column slab lists + dictionaries."""
 
     __slots__ = ("td", "max_slab", "total", "slab_cap", "n_slabs",
-                 "parts", "dicts", "dev", "bounds")
+                 "parts", "dicts", "dev", "bounds", "n_cols")
 
     def __init__(self, td, max_slab: int, total: int, slab_cap: int,
-                 n_slabs: int, parts):
+                 n_slabs: int, parts, n_cols: int):
         self.td = td                    # TableData identity token (or None)
+        self.n_cols = n_cols            # schema width at build (DDL guard)
         self.max_slab = max_slab
         self.total = total
         self.slab_cap = slab_cap
@@ -63,7 +64,8 @@ def clear():
 
 
 def invalidate(table_id: int):
-    _CACHE.pop(table_id, None)
+    for key in [k for k in _CACHE if k[1] == table_id]:
+        _CACHE.pop(key, None)
 
 
 def _pow2(n: int, lo: int = 1024) -> int:
@@ -164,22 +166,28 @@ def get_table(ctx, scan, used_cols, max_slab: int) -> CachedTable:
     table_id = scan.table.id
     cacheable = getattr(ctx, "txn", None) is None
     td = ctx.snapshot.table_data(table_id) if cacheable else None
+    # key by owning store too: distinct engines may reuse table ids
+    key = (id(getattr(ctx.snapshot, "store", None)), table_id) \
+        if cacheable else None
 
-    ent = _CACHE.get(table_id) if cacheable else None
-    if ent is not None and (ent.td is not td or ent.max_slab != max_slab):
-        _CACHE.pop(table_id, None)
+    ent = _CACHE.get(key) if cacheable else None
+    if ent is not None and (ent.td is not td or ent.max_slab != max_slab
+                            or ent.n_cols != len(scan.schema)):
+        # td identity = data freshness; n_cols = DDL (ADD/DROP COLUMN) guard
+        _CACHE.pop(key, None)
         ent = None
     if ent is None:
         parts, total = _collect_parts(ctx, scan)
         slab_cap = _pow2(min(total, max_slab)) if total else 1024
         n_slabs = (total + slab_cap - 1) // slab_cap
-        ent = CachedTable(td, max_slab, total, slab_cap, n_slabs, parts)
+        ent = CachedTable(td, max_slab, total, slab_cap, n_slabs, parts,
+                          len(scan.schema))
         if cacheable:
-            _CACHE[table_id] = ent
+            _CACHE[key] = ent
             while len(_CACHE) > MAX_CACHED_TABLES:
                 _CACHE.popitem(last=False)
     elif cacheable:
-        _CACHE.move_to_end(table_id)
+        _CACHE.move_to_end(key)
 
     if ent.total:
         ftypes = scan.schema.field_types

@@ -133,8 +133,14 @@ def _fragment_ok(plan: PhysicalPlan, threshold: int) -> bool:
 def extract_fragments(plan: PhysicalPlan, threshold: int) -> PhysicalPlan:
     """Top-down maximal-chain extraction: try the largest fuse at each node
     first so HashAgg(Sel(Scan)) becomes one fragment, not a CPU agg over a
-    fragment filter."""
+    fragment filter. Join trees (the Q3/Q5 shape) fuse through
+    tree_fragment when statically eligible."""
     if _fragment_ok(plan, threshold):
+        frag = PhysTpuFragment(plan)
+        frag.est_rows = plan.est_rows
+        return frag
+    from tidb_tpu.executor.tree_fragment import tree_ok
+    if tree_ok(plan, threshold):
         frag = PhysTpuFragment(plan)
         frag.est_rows = plan.est_rows
         return frag
@@ -147,7 +153,26 @@ def extract_fragments(plan: PhysicalPlan, threshold: int) -> PhysicalPlan:
 # ---------------------------------------------------------------------------
 
 
-_COMPILE_CACHE: Dict[str, Tuple] = {}
+from collections import OrderedDict
+
+# LRU of compiled programs: bounded because signatures can embed
+# data-dependent key_bounds (moving min/max under writes would otherwise
+# accumulate executables forever)
+_COMPILE_CACHE: "OrderedDict[str, object]" = OrderedDict()
+MAX_COMPILED_PROGRAMS = 64
+
+
+def _cache_get(sig: str):
+    prog = _COMPILE_CACHE.get(sig)
+    if prog is not None:
+        _COMPILE_CACHE.move_to_end(sig)
+    return prog
+
+
+def _cache_put(sig: str, prog) -> None:
+    _COMPILE_CACHE[sig] = prog
+    while len(_COMPILE_CACHE) > MAX_COMPILED_PROGRAMS:
+        _COMPILE_CACHE.popitem(last=False)
 
 
 def _chain_signature(chain: List[PhysicalPlan], used_cols: Sequence[int],
@@ -460,11 +485,21 @@ def get_program(chain, used_cols, in_types, slab_cap, group_cap,
                 key_bounds=None) -> _FragmentProgram:
     sig = _chain_signature(chain, used_cols, in_types, slab_cap, group_cap,
                            key_bounds)
-    prog = _COMPILE_CACHE.get(sig)
+    prog = _cache_get(sig)
     if prog is None:
         prog = _FragmentProgram(chain, used_cols, in_types, slab_cap,
                                 group_cap, key_bounds)
-        _COMPILE_CACHE[sig] = prog
+        _cache_put(sig, prog)
+    return prog
+
+
+def get_tree_program(root, caps, group_cap):
+    from tidb_tpu.executor.tree_fragment import TreeProgram, tree_signature
+    sig = tree_signature(root, caps, group_cap)
+    prog = _cache_get(sig)
+    if prog is None:
+        prog = TreeProgram(root, caps, group_cap)
+        _cache_put(sig, prog)
     return prog
 
 
@@ -597,6 +632,9 @@ class TpuFragmentExec:
 
         chain = _linearize(self.plan.root)
         if chain is None:
+            from tidb_tpu.executor.tree_fragment import has_join
+            if has_join(self.plan.root):
+                return self._run_device_tree()
             raise FragmentFallback("not a chain")
         scan: PhysTableScan = chain[-1]
         vars_ = self.ctx.vars
@@ -639,9 +677,99 @@ class TpuFragmentExec:
                 continue
             return result
 
+    # ---- join-tree device pipeline -----------------------------------------
+    def _run_device_tree(self) -> Chunk:
+        """Q3/Q5-shaped join trees as ONE jitted program (tree_fragment)."""
+        from tidb_tpu.executor import device_cache
+        from tidb_tpu.executor import tree_fragment as TF
+        from tidb_tpu.ops.jax_env import jax, jnp
+
+        root = self.plan.root
+        vars_ = self.ctx.vars
+        max_slab = int(vars_.get("tidb_tpu_max_slab_rows",
+                                 DEFAULT_MAX_SLAB_ROWS))
+        group_cap = int(vars_.get("tidb_tpu_group_cap", DEFAULT_GROUP_CAP))
+
+        scans = TF._scans(root)
+        ents = []
+        for scan in scans:
+            used = scan.used_columns if scan.used_columns else \
+                list(range(len(scan.schema)))
+            ent = device_cache.get_table(self.ctx, scan, used, max_slab)
+            if ent.total == 0:
+                raise FragmentFallback("empty input")
+            if ent.n_slabs > 1:
+                raise FragmentFallback("multi-slab join input")
+            ents.append((ent, used))
+        caps = {id(s): e.slab_cap for s, (e, _) in zip(scans, ents)}
+        scan_dicts = {id(s): {i: e.dicts.get(i) for i in u}
+                      for s, (e, u) in zip(scans, ents)}
+        flows, root_dicts = TF.dictionary_flows(root, scan_dicts)
+        scan_inputs = tuple({i: e.dev[i][0] for i in u} for e, u in ents)
+        scan_rows = tuple(jnp.int32(e.total) for e, _ in ents)
+        max_cap = max(e.slab_cap for e, _ in ents)
+
+        flow_list = [flows.get(id(n), []) for n in TF._walk_nodes(root)]
+        is_agg = isinstance(root, PhysHashAgg)
+        gcap = group_cap if is_agg else 1
+        while True:
+            prog = get_tree_program(root, caps, gcap)
+            prep_vals = prog.collect_preps(flow_list)
+            out = prog(scan_inputs, scan_rows, prep_vals)
+            if is_agg:
+                uniq, ng = jax.device_get((out["unique"], out["n_groups"]))
+            else:
+                uniq = jax.device_get(out["unique"])
+                ng = 0
+            if not bool(uniq):
+                raise FragmentFallback("non-unique join build side")
+            if is_agg and int(ng) > gcap:
+                if gcap >= max_cap:
+                    raise FragmentFallback("group cap overflow")
+                gcap = min(gcap * 4, max_cap)
+                continue
+            break
+
+        dicts_root = {i: d for i, d in enumerate(root_dicts)}
+        if is_agg:
+            n_final = int(ng)
+            if root.group_exprs and n_final == 0:
+                from tidb_tpu.executor import _empty_chunk
+                return _empty_chunk(self.schema)
+            inp_dicts = {i: d for i, d in
+                         enumerate(flows.get(id(root), []))}
+            return self._agg_chunk(root, out, inp_dicts, max(n_final, 1))
+        if isinstance(root, (PhysTopN, PhysSort)):
+            n_out = int(jax.device_get(out["n_out"]))
+            dev_cols = [(v[:n_out], m[:n_out]) for v, m in out["cols"]]
+            host_cols = jax.device_get(dev_cols)
+            cols = [_decode_col(ft, np.asarray(v), np.asarray(m),
+                                dicts_root.get(ci))
+                    for ci, ((v, m), ft) in
+                    enumerate(zip(host_cols, root.schema.field_types))]
+            merged = Chunk(cols)
+            if isinstance(root, PhysTopN):
+                lo = min(root.offset, merged.num_rows)
+                hi = min(root.offset + root.count, merged.num_rows)
+                merged = merged.slice(lo, hi)
+            return merged
+        # join/selection/projection root: compact by live mask on host
+        host = jax.device_get(out)
+        live = np.asarray(host["live"])
+        idx = np.nonzero(live)[0]
+        cols = []
+        for ci, ((v, m), ft) in enumerate(zip(host["cols"],
+                                              root.schema.field_types)):
+            cols.append(_decode_col(ft, np.asarray(v)[idx],
+                                    np.asarray(m)[idx],
+                                    dicts_root.get(ci)))
+        return Chunk(cols)
+
     @staticmethod
-    def _slab(ent, slab_idx: int):
-        cols = {i: slabs[slab_idx] for i, slabs in ent.dev.items()}
+    def _slab(ent, slab_idx: int, used: Sequence[int]):
+        # restrict to the program's used columns: a superset (uploaded by a
+        # different query) would change the input pytree and force a retrace
+        cols = {i: ent.dev[i][slab_idx] for i in used}
         return cols, ent.slab_rows(slab_idx)
 
     def _execute(self, prog: "_FragmentProgram", chain, ent, dicts,
@@ -660,7 +788,7 @@ class TpuFragmentExec:
         n_slabs = ent.n_slabs
         partials = []
         for s in range(n_slabs):
-            cols, n = self._slab(ent, s)
+            cols, n = self._slab(ent, s, prog.used_cols)
             partials.append(prog.partial(cols, jnp.int32(n), prep_vals))
         # per-slab overflow check, fetched in ONE batched round trip (the
         # tunnel pays ~100ms latency per device_get, not per array): a slab
@@ -719,7 +847,7 @@ class TpuFragmentExec:
         from tidb_tpu.ops.jax_env import jax, jnp
         outs = []
         for s in range(ent.n_slabs):
-            cols, n = self._slab(ent, s)
+            cols, n = self._slab(ent, s, prog.used_cols)
             outs.append(prog.partial(cols, jnp.int32(n), prep_vals))
         n_outs = [int(n) for n in
                   jax.device_get([o["n_out"] for o in outs])]
@@ -754,7 +882,7 @@ class TpuFragmentExec:
         from tidb_tpu.ops.jax_env import jax, jnp
         outs = []
         for s in range(ent.n_slabs):
-            cols, n = self._slab(ent, s)
+            cols, n = self._slab(ent, s, prog.used_cols)
             outs.append(prog.partial(cols, jnp.int32(n), prep_vals))
         host_outs = jax.device_get(outs)   # one batched round trip
         pieces: List[Chunk] = []
@@ -806,6 +934,11 @@ def _decode_col(ft: FieldType, vals: np.ndarray, mask: np.ndarray,
                 dictionary: Optional[np.ndarray]) -> Column:
     if ft.is_varlen:
         if dictionary is None:
+            mask = np.asarray(mask, dtype=bool)
+            if not mask.any():
+                # unused placeholder column: all-NULL is fine
+                return Column(ft, np.full(len(vals), "", dtype=object),
+                              mask.copy())
             raise FragmentFallback("string column without dictionary")
         neg = vals < 0
         if neg.any():

@@ -3,16 +3,20 @@
 Extends the linear-chain fragments (executor/fragment.py) to plan subtrees
 containing equi hash joins — the TPC-H Q3/Q5 shape. The whole tree traces
 into a single jitted XLA program per query: every table is lifted to HBM
-once as a padded slab, joins run as sort + binary-search against unique
-build sides (ops/join.py; the reference's hashRowContainer probe,
-hash_table.go:110, without the hash table), and the root reduction reuses
-the factorize/segment machinery.
+once as a padded slab (executor/device_cache.py), joins run as sort +
+binary-search against unique build sides (ops/join.py; the reference's
+hashRowContainer probe, executor/hash_table.go:110, without the hash
+table), and the root reduction reuses the factorize/segment machinery.
 
 Restrictions (fall back to the CPU volcano path otherwise):
   * every table fits one slab (no multi-slab join builds yet);
   * equi keys are non-string (dictionary unification across sides TBD);
   * build sides are unique on the key (the PK-FK shape) — checked on
-    device, reported back, and non-unique builds fall back at runtime.
+    device, reported back, and non-unique builds fall back at runtime;
+  * outer joins must preserve the PROBE side (kind='left' requires
+    build_right, 'right' requires build-left): the unique-build probe
+    formulation emits probe-shaped output, so build rows that match
+    nothing cannot be null-extended.
 """
 
 from __future__ import annotations
@@ -21,7 +25,6 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from tidb_tpu.chunk import Chunk, Column
 from tidb_tpu.expression import ColumnRef, EvalContext, Expression
 from tidb_tpu.expression.aggfuncs import build_agg
 from tidb_tpu.planner.physical import (PhysHashAgg, PhysHashJoin,
@@ -56,6 +59,11 @@ def tree_ok(plan: PhysicalPlan, threshold: int) -> bool:
             return walk(node.children[0], False)
         if isinstance(node, PhysHashJoin):
             if node.kind not in JOIN_KINDS or not node.equi:
+                return False
+            # probe-shaped output ⇒ the preserved side must be the probe
+            if node.kind in ("left", "semi", "anti") and not node.build_right:
+                return False
+            if node.kind == "right" and node.build_right:
                 return False
             for le, re in node.equi:
                 if le.ftype.kind.is_string or re.ftype.kind.is_string:
@@ -123,7 +131,7 @@ def _walk_nodes(plan: PhysicalPlan) -> List[PhysicalPlan]:
 
 def tree_signature(plan: PhysicalPlan, caps: Dict[int, int],
                    group_cap: int) -> str:
-    parts = [f"gcap={group_cap}"]
+    parts = [f"tree", f"gcap={group_cap}"]
     for node in _walk_nodes(plan):
         if isinstance(node, PhysTableScan):
             parts.append(
@@ -153,7 +161,11 @@ def tree_signature(plan: PhysicalPlan, caps: Dict[int, int],
 class TreeProgram:
     """One jitted program for a join tree. Inputs: per-scan column dicts
     (original column index → (values, validity)) + per-scan row counts +
-    positional prepared values."""
+    positional prepared values.
+
+    Every join emits probe-shaped output: build rows are gathered through
+    the per-probe-row match index, so downstream shapes stay static — the
+    join itself never expands (guaranteed by the unique-build check)."""
 
     def __init__(self, plan: PhysicalPlan, caps: Dict[int, int],
                  group_cap: int):
@@ -172,12 +184,15 @@ class TreeProgram:
                         self.prep_nodes.append(sub)
         self.run = jax.jit(self._run)
 
-    def collect_preps(self, dict_flows: Dict[int, List]) -> List:
-        """Prepared values in structural order; dict_flows maps id(node) →
-        the dictionary list of that node's INPUT columns."""
+    def collect_preps(self, flow_list: List[List]) -> List:
+        """Prepared values in structural order.
+
+        flow_list: per-node input dictionary lists in _walk_nodes order of
+        the CALLER's (structurally identical) plan. Positional alignment —
+        not node identity — because compile-cache hits reuse this program
+        for fresh plan objects whose node ids differ."""
         vals = []
-        for node in _walk_nodes(self.plan):
-            dicts = dict_flows.get(id(node), [])
+        for node, dicts in zip(_walk_nodes(self.plan), flow_list):
             for e in _stage_exprs(node):
                 for sub in e.walk():
                     if type(sub).prepare is not Expression.prepare:
@@ -186,11 +201,11 @@ class TreeProgram:
 
     # -- trace ---------------------------------------------------------------
     def _run(self, scan_inputs, scan_rows, prep_vals):
-        from tidb_tpu.ops.jax_env import jnp
-        prepared = {id(n): v for n, v in zip(self.prep_nodes, prep_vals)
-                    if v is not None}
-        self._prepared = prepared
-        cols, live = self._emit(self.plan, scan_inputs, scan_rows, root=True)
+        self._prepared = {id(n): v
+                          for n, v in zip(self.prep_nodes, prep_vals)
+                          if v is not None}
+        self._join_unique_flags = []
+        cols, live = self._emit(self.plan, scan_inputs, scan_rows)
         return self._finish(cols, live)
 
     def _ctx(self, cols):
@@ -198,10 +213,11 @@ class TreeProgram:
         return EvalContext(jnp, cols, prepared=self._prepared,
                            on_device=True)
 
-    def _emit(self, node: PhysicalPlan, scan_inputs, scan_rows,
-              root: bool = False):
-        """→ (cols [(v,m)...], live) for non-root nodes; root reductions
-        are handled in _finish."""
+    def _emit(self, node: PhysicalPlan, scan_inputs, scan_rows):
+        """→ (cols [(v,m) or None per schema position], live) for non-root
+        nodes; root reductions are handled in _finish. The column list is
+        ALWAYS schema-length so join concatenation stays positionally
+        aligned (unused columns ride as None)."""
         from tidb_tpu.ops.jax_env import jnp
         if isinstance(node, PhysTableScan):
             slot = next(i for i, s in enumerate(self.scan_order)
@@ -209,8 +225,7 @@ class TreeProgram:
             in_cols = scan_inputs[slot]
             cap = self.caps[id(node)]
             live = jnp.arange(cap, dtype=jnp.int32) < scan_rows[slot]
-            max_idx = max(in_cols) if in_cols else -1
-            col_list = [in_cols.get(i) for i in range(max_idx + 1)]
+            col_list = [in_cols.get(i) for i in range(len(node.schema))]
             ctx = self._ctx(col_list)
             for f in node.filters:
                 v, m = f.eval(ctx)
@@ -261,58 +276,52 @@ class TreeProgram:
         match_idx, matched, unique = J.build_probe(
             codes[:nb], cvalid[:nb], blive, codes[nb:], cvalid[nb:], plive)
         self._join_unique_flags.append(unique)
-        bgathered = [(jnp.take(jnp.asarray(v), match_idx),
-                      jnp.take(jnp.asarray(m), match_idx) & matched)
-                     for v, m in bcols if v is not None] if None not in \
-            [c for c in bcols] else None
-        # build columns may contain None placeholders only at scan level;
-        # joins above projections/scans emit dense lists — fill safely:
-        bgathered = []
-        for c in bcols:
-            if c is None:
-                bgathered.append(None)
-                continue
-            v, m = c
-            bgathered.append((jnp.take(jnp.asarray(v), match_idx),
-                              jnp.take(jnp.asarray(m), match_idx) & matched))
+
+        def gather_build(keep):
+            out = []
+            for c in bcols:
+                if c is None:
+                    out.append(None)
+                    continue
+                v, m = c
+                out.append((jnp.take(jnp.asarray(v), match_idx),
+                            jnp.take(jnp.asarray(m), match_idx) & keep))
+            return out
+
+        bgathered = gather_build(matched)
         if node.build_right:
             joined = list(pcols) + bgathered
         else:
             joined = bgathered + list(pcols)
-        live = plive
-        if node.kind == "inner":
-            live = plive & matched
         if node.other_conditions:
             jctx = self._ctx(joined)
             ok = jnp.ones_like(matched)
             for cond in node.other_conditions:
                 v, m = cond.eval(jctx)
                 ok = ok & (v != 0) & m
+            matched = matched & ok
             if node.kind in ("left", "right"):
                 # failed condition → unmatched: null-extend, keep the row
-                matched = matched & ok
-                bgathered = [(v, m & matched) if c is not None else None
-                             for c, (v, m) in zip(bcols, bgathered)]
+                bgathered = gather_build(matched)
                 joined = (list(pcols) + bgathered if node.build_right
                           else bgathered + list(pcols))
-            else:
-                matched = matched & ok
-                if node.kind == "inner":
-                    live = plive & matched
         if node.kind == "semi":
             return list(pcols), plive & matched
         if node.kind == "anti":
             return list(pcols), plive & jnp.logical_not(matched)
-        return joined, live
+        if node.kind == "inner":
+            return joined, plive & matched
+        # left/right outer: tree_ok guarantees probe == preserved side, so
+        # every live probe row survives (null-extended when unmatched)
+        return joined, plive
 
     # -- root reductions ------------------------------------------------------
     def _finish(self, cols, live):
-        from tidb_tpu.ops.jax_env import jax, jnp
+        from tidb_tpu.ops.jax_env import jnp
         from tidb_tpu.ops import factorize as F
         root = self.plan
-        self_join_flags = self._join_unique_flags
-        uniq = jnp.stack(self_join_flags).all() if self_join_flags else \
-            jnp.bool_(True)
+        flags = self._join_unique_flags
+        uniq = jnp.stack(flags).all() if flags else jnp.bool_(True)
         if isinstance(root, PhysHashAgg):
             cap = self.group_cap
             ctx = self._ctx(cols)
@@ -340,6 +349,11 @@ class TreeProgram:
                 states.append(agg.update(jnp, st, gids, cap, v, m))
             return {"keys": key_out, "states": states, "n_groups": n_groups,
                     "unique": uniq}
+        # non-agg roots emit every schema column; unused (None) positions
+        # become all-NULL placeholders so output stays positionally aligned
+        n = live.shape[0]
+        cols = [(jnp.zeros(n, dtype=jnp.int64), jnp.zeros(n, dtype=bool))
+                if c is None else c for c in cols]
         if isinstance(root, (PhysTopN, PhysSort)):
             ctx = self._ctx(cols)
             keys = [e.eval(ctx) for e in root.by]
@@ -357,7 +371,6 @@ class TreeProgram:
                          for v, m in cols], "live": live, "unique": uniq}
 
     def __call__(self, scan_inputs, scan_rows, prep_vals):
-        self._join_unique_flags = []
         return self.run(scan_inputs, scan_rows, prep_vals)
 
 
@@ -365,14 +378,14 @@ def dictionary_flows(plan: PhysicalPlan,
                      scan_dicts: Dict[int, Dict[int, Optional[np.ndarray]]]
                      ) -> Tuple[Dict[int, List], List]:
     """Host-side mirror of the trace: per-node input dictionaries and the
-    root's output dictionary list. scan_dicts: id(scan) → {col_idx: dict}."""
+    root's output dictionary list. scan_dicts: id(scan) → {col_idx: dict}.
+    Lists are schema-length, mirroring _emit's positional alignment."""
     flows: Dict[int, List] = {}
 
     def rec(node: PhysicalPlan) -> List:
         if isinstance(node, PhysTableScan):
             d = scan_dicts.get(id(node), {})
-            n = max(d) + 1 if d else 0
-            out = [d.get(i) for i in range(n)]
+            out = [d.get(i) for i in range(len(node.schema))]
             flows[id(node)] = out
             return out
         child_flows = [rec(c) for c in node.children]
@@ -383,7 +396,7 @@ def dictionary_flows(plan: PhysicalPlan,
             l = (l + [None] * nl)[:nl]
             r = (r + [None] * nr)[:nr]
             if node.kind in ("semi", "anti"):
-                out = l
+                out = l       # semi/anti emit the left (probe) side
             else:
                 out = l + r
             flows[id(node)] = l + r

@@ -63,9 +63,11 @@ class TableData:
 class Snapshot:
     """Immutable point-in-time view (ref: kv.Snapshot, kv/kv.go:373)."""
 
-    def __init__(self, tables: Dict[int, TableData], version: int):
+    def __init__(self, tables: Dict[int, TableData], version: int,
+                 store: "Store" = None):
         self._tables = tables
         self.version = version
+        self.store = store        # owning engine's store (device-cache key)
 
     def table_data(self, table_id: int) -> TableData:
         td = self._tables.get(table_id)
@@ -112,7 +114,7 @@ class Store:
     # ---- reads -----------------------------------------------------------
     def snapshot(self) -> Snapshot:
         with self._lock:
-            return Snapshot(dict(self._tables), self._version)
+            return Snapshot(dict(self._tables), self._version, self)
 
     # ---- writes (autocommit fast path) -----------------------------------
     def append(self, table_id: int, chunk: Chunk) -> None:
