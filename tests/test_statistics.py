@@ -1,0 +1,138 @@
+"""Statistics: histogram/NDV/TopN build + selectivity + planner wiring
+(ref: statistics/histogram.go, statistics/selectivity.go,
+planner/core/find_best_task.go)."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.statistics import (ColumnStats, analyze_columns,
+                                 build_column_stats, expr_selectivity)
+from tidb_tpu.parser import parse
+from tidb_tpu.session import Engine
+
+
+def test_column_stats_exact_small():
+    vals = np.array([1, 2, 2, 3, 3, 3, 4, 4, 4, 4], dtype=np.int64)
+    valid = np.ones(10, dtype=bool)
+    cs = build_column_stats(vals, valid, 10)
+    assert cs.ndv == 4
+    assert cs.null_count == 0
+    assert cs.min_val == 1 and cs.max_val == 4
+    assert abs(cs.eq_selectivity(4) - 0.4) < 1e-9
+    assert abs(cs.eq_selectivity(1) - 0.1) < 1e-9
+    assert cs.eq_selectivity(99) <= 0.1
+    # range: values ≤ 2 are 3 of 10
+    assert abs(cs.range_selectivity(hi=2) - 0.3) < 0.05
+
+
+def test_column_stats_nulls():
+    vals = np.arange(100, dtype=np.int64)
+    valid = np.ones(100, dtype=bool)
+    valid[:25] = False
+    cs = build_column_stats(vals, valid, 100)
+    assert cs.null_count == 25
+    assert abs(cs.null_fraction() - 0.25) < 1e-9
+    assert cs.ndv == 75
+
+
+def test_column_stats_sampled_ndv():
+    rng = np.random.default_rng(3)
+    # 4M rows, 1000 distinct values → sampling path, NDV estimate close
+    vals = rng.integers(0, 1000, 4_000_000).astype(np.int64)
+    cs = build_column_stats(vals, np.ones(len(vals), bool), len(vals))
+    assert 900 <= cs.ndv <= 1100
+    sel = cs.eq_selectivity(5)
+    assert 0.0005 <= sel <= 0.002
+
+
+def test_string_stats():
+    vals = np.array(["ant", "bee", "ant", "cow", "ant"], dtype=object)
+    cs = build_column_stats(vals, np.ones(5, bool), 5)
+    assert cs.ndv == 3
+    assert abs(cs.eq_selectivity("ant") - 0.6) < 1e-9
+    # prefix range [a, b): the three 'ant's
+    assert abs(cs.range_selectivity(lo="a", hi="b", hi_incl=False) - 0.6) \
+        < 0.05
+
+
+@pytest.fixture()
+def session():
+    eng = Engine()
+    s = eng.new_session()
+    s.execute("CREATE TABLE st (a BIGINT, b BIGINT, c VARCHAR(8), "
+              "d DECIMAL(8,2))")
+    rng = np.random.default_rng(9)
+    rows = []
+    for i in range(20000):
+        a = int(rng.integers(0, 10))          # ndv 10
+        b = i                                 # ndv 20000 (unique)
+        c = ["x", "y"][int(rng.integers(0, 2))]
+        d = round(float(rng.uniform(0, 100)), 2)
+        rows.append(f"({a},{b},'{c}',{d})")
+    s.execute("INSERT INTO st VALUES " + ",".join(rows))
+    s.execute("ANALYZE TABLE st")
+    return s
+
+
+def _plan(s, sql):
+    return s._plan(parse(sql)[0])
+
+
+def _find(plan, name):
+    if type(plan).__name__ == name:
+        return plan
+    for c in plan.children:
+        hit = _find(c, name)
+        if hit is not None:
+            return hit
+    if hasattr(plan, "root"):
+        return _find(plan.root, name)
+    return None
+
+
+def test_scan_filter_selectivity(session):
+    p = _plan(session, "SELECT * FROM st WHERE a = 3")
+    scan = _find(p, "PhysTableScan")
+    assert 1200 <= scan.est_rows <= 2800     # ~1/10 of 20000
+
+    p = _plan(session, "SELECT * FROM st WHERE d < 25.0")
+    scan = _find(p, "PhysTableScan")
+    assert 3500 <= scan.est_rows <= 6500     # ~25%
+
+
+def test_agg_group_estimate(session):
+    p = _plan(session, "SELECT a, COUNT(*) FROM st GROUP BY a")
+    agg = _find(p, "PhysHashAgg")
+    assert agg.est_reliable
+    assert 8 <= agg.est_rows <= 13
+
+    p = _plan(session, "SELECT b, COUNT(*) FROM st GROUP BY b")
+    agg = _find(p, "PhysHashAgg")
+    assert agg.est_reliable
+    assert 15000 <= agg.est_rows <= 25000
+
+
+def test_join_estimate(session):
+    eng = session.engine
+    s2 = eng.new_session()
+    s2.execute("CREATE TABLE dim (k BIGINT, v BIGINT)")
+    s2.execute("INSERT INTO dim VALUES " +
+               ",".join(f"({i},{i * 2})" for i in range(100)))
+    s2.execute("ANALYZE TABLE dim")
+    # FK join: |st| rows survive ≈ |st| * |dim| / ndv(b)=20000 = 100
+    p = _plan(s2, "SELECT * FROM st JOIN dim ON b = k")
+    join = _find(p, "PhysHashJoin")
+    assert 50 <= join.est_rows <= 300
+
+
+def test_stats_feed_group_cap(session):
+    from tidb_tpu.executor.fragment import _initial_group_cap
+    p = _plan(session, "SELECT b, COUNT(*) FROM st GROUP BY b")
+    agg = _find(p, "PhysHashAgg")
+    cap = _initial_group_cap(agg, 1 << 16, 1 << 23)
+    assert cap >= 32768          # ≥ ndv(b)=20000 with headroom
+
+    p = _plan(session, "SELECT a, COUNT(*) FROM st GROUP BY a")
+    agg = _find(p, "PhysHashAgg")
+    cap = _initial_group_cap(agg, 1 << 16, 1 << 23)
+    assert cap == 1024           # small reliable estimate → floor

@@ -503,6 +503,19 @@ def get_tree_program(root, caps, group_cap):
     return prog
 
 
+def _initial_group_cap(root: PhysHashAgg, default_cap: int,
+                       max_cap: int) -> int:
+    """Stats-informed factorize capacity: when the planner's group estimate
+    came from real NDV stats (est_reliable, planner/physical.estimate), a
+    1.5× headroom start avoids the overflow→retry recompile ladder both for
+    high-cardinality keys (e.g. GROUP BY orderkey) and tiny ones."""
+    if not getattr(root, "est_reliable", False):
+        return default_cap
+    from tidb_tpu.executor.device_cache import _pow2
+    want = int(root.est_rows * 1.5) + 16
+    return min(_pow2(want), max_cap)
+
+
 DOMAIN_CAP = 1 << 20    # max packed group-key domain for perfect hashing
 
 
@@ -663,6 +676,8 @@ class TpuFragmentExec:
             group_cap = 1
             for lo, hi in key_bounds:
                 group_cap *= (hi - lo + 2)
+        elif isinstance(root, PhysHashAgg):
+            group_cap = _initial_group_cap(root, group_cap, slab_cap)
 
         while True:
             prog = get_program(chain, used, in_types, slab_cap, group_cap,
@@ -711,16 +726,21 @@ class TpuFragmentExec:
 
         flow_list = [flows.get(id(n), []) for n in TF._walk_nodes(root)]
         is_agg = isinstance(root, PhysHashAgg)
-        gcap = group_cap if is_agg else 1
+        gcap = _initial_group_cap(root, group_cap, max_cap) if is_agg else 1
+        # every device_get is a ~100ms tunnel round trip — batch fetches
         while True:
             prog = get_tree_program(root, caps, gcap)
             prep_vals = prog.collect_preps(flow_list)
             out = prog(scan_inputs, scan_rows, prep_vals)
             if is_agg:
                 uniq, ng = jax.device_get((out["unique"], out["n_groups"]))
-            else:
-                uniq = jax.device_get(out["unique"])
+            elif isinstance(root, (PhysTopN, PhysSort)):
+                uniq, n_out = jax.device_get((out["unique"], out["n_out"]))
                 ng = 0
+            else:
+                # padded cols + live + unique all come in ONE bulk fetch
+                host = jax.device_get(out)
+                uniq, ng = host["unique"], 0
             if not bool(uniq):
                 raise FragmentFallback("non-unique join build side")
             if is_agg and int(ng) > gcap:
@@ -740,7 +760,7 @@ class TpuFragmentExec:
                          enumerate(flows.get(id(root), []))}
             return self._agg_chunk(root, out, inp_dicts, max(n_final, 1))
         if isinstance(root, (PhysTopN, PhysSort)):
-            n_out = int(jax.device_get(out["n_out"]))
+            n_out = int(n_out)
             dev_cols = [(v[:n_out], m[:n_out]) for v, m in out["cols"]]
             host_cols = jax.device_get(dev_cols)
             cols = [_decode_col(ft, np.asarray(v), np.asarray(m),
@@ -754,7 +774,6 @@ class TpuFragmentExec:
                 merged = merged.slice(lo, hi)
             return merged
         # join/selection/projection root: compact by live mask on host
-        host = jax.device_get(out)
         live = np.asarray(host["live"])
         idx = np.nonzero(live)[0]
         cols = []
@@ -934,11 +953,9 @@ def _decode_col(ft: FieldType, vals: np.ndarray, mask: np.ndarray,
                 dictionary: Optional[np.ndarray]) -> Column:
     if ft.is_varlen:
         if dictionary is None:
-            mask = np.asarray(mask, dtype=bool)
-            if not mask.any():
+            if not np.asarray(mask, dtype=bool).any():
                 # unused placeholder column: all-NULL is fine
-                return Column(ft, np.full(len(vals), "", dtype=object),
-                              mask.copy())
+                return Column.all_null(ft, len(vals))
             raise FragmentFallback("string column without dictionary")
         neg = vals < 0
         if neg.any():

@@ -189,40 +189,134 @@ class PhysTpuFragment(PhysicalPlan):
 
 
 # ---------------------------------------------------------------------------
-# Cardinality estimation (crude; statistics-driven CBO arrives later)
+# Cardinality estimation (ref: planner/core/find_best_task.go +
+# statistics/selectivity.go; histogram/NDV stats from tidb_tpu.statistics)
 # ---------------------------------------------------------------------------
 
-SELECTIVITY = 0.25       # default filter selectivity (ref: selectionFactor 0.8
-                         # per condition; we fold to one factor)
-AGG_REDUCTION = 8.0
+SELECTIVITY = 0.25       # default filter selectivity (ref: selectionFactor)
+AGG_REDUCTION = 8.0      # fallback group reduction without stats
+
+
+def _table_stats(table, ctx):
+    fn = getattr(ctx, "table_stats", None)
+    return fn(table.id) if fn is not None else None
+
+
+def _scan_of(plan: PhysicalPlan, col_idx: int):
+    """Trace a column index down to (scan, scan_col_idx), or None if the
+    value is computed, crosses an aggregate, or the shape is unknown."""
+    node, idx = plan, col_idx
+    while True:
+        if isinstance(node, PhysTableScan):
+            return node, idx
+        if isinstance(node, (PhysSelection, PhysSort, PhysTopN, PhysLimit)):
+            node = node.children[0]
+            continue
+        if isinstance(node, PhysProjection):
+            e = node.exprs[idx] if idx < len(node.exprs) else None
+            if not isinstance(e, _ColumnRef()):
+                return None
+            idx = e.index
+            node = node.children[0]
+            continue
+        if isinstance(node, PhysHashJoin):
+            lw = len(node.children[0].schema)
+            if node.kind in ("semi", "anti") or idx < lw:
+                node = node.children[0]
+            else:
+                idx -= lw
+                node = node.children[1]
+            continue
+        return None
+
+
+def _ColumnRef():
+    from tidb_tpu.expression import ColumnRef
+    return ColumnRef
+
+
+def _expr_ndv(expr, plan: PhysicalPlan, ctx) -> Optional[float]:
+    """NDV of an expression over `plan`'s output, when it is a column
+    traceable to an ANALYZEd scan column."""
+    from tidb_tpu.statistics import column_ndv
+    if not isinstance(expr, _ColumnRef()):
+        return None
+    hit = _scan_of(plan, expr.index)
+    if hit is None:
+        return None
+    scan, idx = hit
+    stats = _table_stats(scan.table, ctx)
+    if stats is None or idx not in stats.columns:
+        return None
+    return column_ndv(stats, idx, -1.0)
 
 
 def estimate(plan: PhysicalPlan, ctx) -> float:
+    """Bottom-up cardinality; sets est_rows on every node. PhysHashAgg
+    additionally gets est_reliable=True when every group key had stats —
+    the device engine then trusts est_rows for its initial group cap."""
     if isinstance(plan, PhysTableScan):
         n = float(_table_rows(plan.table, ctx))
         if plan.filters:
-            n *= SELECTIVITY ** min(len(plan.filters), 2)
-        return max(n, 1.0)
+            from tidb_tpu.statistics import filters_selectivity
+            stats = _table_stats(plan.table, ctx)
+            n *= filters_selectivity(plan.filters, stats)
+        plan.est_rows = max(n, 1.0)
+        return plan.est_rows
     if isinstance(plan, PhysDual):
-        return float(plan.n_rows)
+        plan.est_rows = float(plan.n_rows)
+        return plan.est_rows
     kids = [estimate(c, ctx) for c in plan.children]
-    for c, k in zip(plan.children, kids):
-        c.est_rows = k
     if isinstance(plan, PhysSelection):
-        return max(kids[0] * SELECTIVITY, 1.0)
-    if isinstance(plan, PhysHashAgg):
+        child = plan.children[0]
+        n = kids[0]
+        if isinstance(child, PhysTableScan):
+            from tidb_tpu.statistics import filters_selectivity
+            stats = _table_stats(child.table, ctx)
+            n *= filters_selectivity(plan.conditions, stats)
+        else:
+            n *= SELECTIVITY ** min(len(plan.conditions), 2)
+        out = max(n, 1.0)
+    elif isinstance(plan, PhysHashAgg):
         if not plan.group_exprs:
-            return 1.0
-        return max(kids[0] / AGG_REDUCTION, 1.0)
-    if isinstance(plan, PhysHashJoin):
+            out = 1.0
+            plan.est_reliable = True
+        else:
+            child = plan.children[0]
+            ndvs = [_expr_ndv(e, child, ctx) for e in plan.group_exprs]
+            if all(v is not None and v > 0 for v in ndvs):
+                groups = 1.0
+                for v in ndvs:
+                    groups *= v
+                # group keys are rarely independent; cap by input rows
+                out = max(min(groups, kids[0]), 1.0)
+                plan.est_reliable = True
+            else:
+                out = max(kids[0] / AGG_REDUCTION, 1.0)
+                plan.est_reliable = False
+    elif isinstance(plan, PhysHashJoin):
+        l, r = kids
         if plan.kind in ("semi", "anti"):
-            return max(kids[0] * 0.5, 1.0)
-        return max(max(kids), 1.0)
-    if isinstance(plan, (PhysTopN, PhysLimit)):
-        return float(min(kids[0], plan.count + plan.offset))
-    if isinstance(plan, PhysUnionAll):
-        return float(sum(kids))
-    return kids[0] if kids else 1.0
+            out = max(l * 0.5, 1.0)
+        else:
+            # |L ⋈ R| ≈ |L||R| / max(ndv(keys)) (classic equi-join estimate)
+            denom = 1.0
+            for le, re in plan.equi or []:
+                nl = _expr_ndv(le, plan.children[0], ctx)
+                nr = _expr_ndv(re, plan.children[1], ctx)
+                cand = max(v for v in (nl, nr, 1.0) if v is not None)
+                denom = max(denom, cand)
+            out = max(l * r / denom if plan.equi else max(l, r), 1.0)
+            if plan.kind in ("left", "right"):
+                out = max(out, l if plan.kind == "left" else r)
+    elif isinstance(plan, (PhysTopN, PhysLimit)):
+        out = float(min(kids[0], plan.count + plan.offset))
+    elif isinstance(plan, PhysUnionAll):
+        out = float(sum(kids))
+    else:
+        out = kids[0] if kids else 1.0
+    plan.est_rows = out
+    return out
 
 
 def _table_rows(table, ctx) -> int:

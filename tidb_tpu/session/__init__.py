@@ -66,7 +66,9 @@ class Engine:
         self.catalog = Catalog()
         self.store = Store()
         self.stats_lock = threading.Lock()
-        self.table_stats: Dict[int, int] = {}  # table_id → analyzed row count
+        # table_id → statistics.TableStats (histograms/NDV/TopN; ref:
+        # statistics/handle — the Domain-owned stats cache)
+        self.table_stats: Dict[int, object] = {}
 
     def new_session(self) -> "Session":
         return Session(self)
@@ -80,14 +82,17 @@ class _PlanContext:
         self.subquery_evaluator = session._subquery_evaluator()
 
     def table_row_count(self, table_id: int) -> int:
-        eng = self.session.engine
-        with eng.stats_lock:
-            if table_id in eng.table_stats:
-                return eng.table_stats[table_id]
+        # exact live rows from the columnar store — cheap and fresher than
+        # any analyzed count (the reference must estimate; we needn't)
         snap = self.session._read_view_snapshot()
         if snap.has_table(table_id):
             return snap.table_data(table_id).live_rows
         return 1
+
+    def table_stats(self, table_id: int):
+        eng = self.session.engine
+        with eng.stats_lock:
+            return eng.table_stats.get(table_id)
 
     @property
     def use_tpu(self) -> bool:
@@ -459,13 +464,40 @@ class Session:
         raise PlanError(f"unsupported SHOW {stmt.kind}")
 
     def _analyze(self, stmt: ast.AnalyzeTable) -> ResultSet:
+        """Build per-column histogram/NDV/TopN stats (ref:
+        executor/analyze.go → statistics/histogram.go:49)."""
+        from tidb_tpu.executor.scan import align_chunk_to_schema
+        from tidb_tpu.statistics import analyze_columns
         snap = self._read_view_snapshot()
         for name in stmt.names:
             info = self.engine.catalog.info_schema.table(name)
-            if snap.has_table(info.id):
-                with self.engine.stats_lock:
-                    self.engine.table_stats[info.id] = \
-                        snap.table_data(info.id).live_rows
+            if not snap.has_table(info.id):
+                continue
+            parts = []
+            for region, alive in snap.scan(info.id):
+                chunk = align_chunk_to_schema(region.chunk, info)
+                mask = None if alive.all() else alive
+                parts.append((chunk, mask))
+            n_cols = len(info.columns)
+            cols = []
+            for ci in range(n_cols):
+                vs, ms = [], []
+                for chunk, mask in parts:
+                    col = chunk.columns[ci]
+                    v, m = col.values, col.valid_mask()
+                    if mask is not None:
+                        v, m = v[mask], m[mask]
+                    vs.append(v)
+                    ms.append(m)
+                if vs:
+                    cols.append((np.concatenate(vs), np.concatenate(ms)))
+                else:
+                    cols.append((np.empty(0), np.empty(0, dtype=bool)))
+            total = len(cols[0][0]) if cols else 0
+            ts = analyze_columns(cols, total)
+            with self.engine.stats_lock:
+                ts.version = snap.version   # version of the analyzed data
+                self.engine.table_stats[info.id] = ts
         return ok()
 
 
