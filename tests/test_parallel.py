@@ -20,7 +20,7 @@ def mesh(eight_devices):
 
 def test_exchange_round_trip(mesh):
     """Hash exchange delivers every live row exactly once, to its owner."""
-    from jax.experimental.shard_map import shard_map
+    from tidb_tpu.ops.jax_env import shard_map
     import jax
 
     N = 512
@@ -52,7 +52,7 @@ def test_exchange_round_trip(mesh):
 
 
 def test_exchange_overflow_detected(mesh):
-    from jax.experimental.shard_map import shard_map
+    from tidb_tpu.ops.jax_env import shard_map
     import jax
 
     N = 256
@@ -100,7 +100,7 @@ def test_distributed_agg_join_matches_oracle(mesh):
 
 
 def test_broadcast_build(mesh):
-    from jax.experimental.shard_map import shard_map
+    from tidb_tpu.ops.jax_env import shard_map
     import jax
 
     N = 64

@@ -68,6 +68,15 @@ def invalidate(table_id: int):
         _CACHE.pop(key, None)
 
 
+_STORE_FINALIZERS: Dict[int, object] = {}
+
+
+def _evict_store(store_id: int):
+    for key in [k for k in _CACHE if k[0] == store_id]:
+        _CACHE.pop(key, None)
+    _STORE_FINALIZERS.pop(store_id, None)
+
+
 def _pow2(n: int, lo: int = 1024) -> int:
     cap = lo
     while cap < n:
@@ -166,9 +175,14 @@ def get_table(ctx, scan, used_cols, max_slab: int) -> CachedTable:
     table_id = scan.table.id
     cacheable = getattr(ctx, "txn", None) is None
     td = ctx.snapshot.table_data(table_id) if cacheable else None
-    # key by owning store too: distinct engines may reuse table ids
-    key = (id(getattr(ctx.snapshot, "store", None)), table_id) \
-        if cacheable else None
+    # key by owning store too: distinct engines may reuse table ids; a
+    # finalizer evicts a dead engine's entries so its HBM isn't pinned
+    store = getattr(ctx.snapshot, "store", None) if cacheable else None
+    key = (id(store), table_id) if cacheable else None
+    if store is not None and id(store) not in _STORE_FINALIZERS:
+        import weakref
+        _STORE_FINALIZERS[id(store)] = weakref.finalize(
+            store, _evict_store, id(store))
 
     ent = _CACHE.get(key) if cacheable else None
     if ent is not None and (ent.td is not td or ent.max_slab != max_slab

@@ -1,0 +1,228 @@
+"""Distributed device fragments: one shard_map program per SQL fragment.
+
+The planner inserts PhysExchange boundaries (planner/physical.py
+insert_exchanges — the fragmentation pass of planner/core/fragment.go:64);
+this module compiles the WHOLE annotated fragment tree into a single
+jitted shard_map program over a 1-D device mesh:
+
+  * scans arrive row-sharded (the region→coprocessor-task parallelism of
+    store/copr/coprocessor.go:178 becomes a PartitionSpec);
+  * Exchange[hash] is collective.exchange — an all_to_all bucket swap on
+    ICI (the ExchangeType_Hash tunnels of cophandler/mpp_exec.go:158-173);
+  * Exchange[broadcast] is an all_gather (ExchangeType_Broadcast);
+  * an agg root runs per-shard partials, all_gathers partial states, and
+    each shard merges the groups it owns (AggFunc.MergePartialResult
+    across MPP tasks, SURVEY §2.4.6);
+  * a TopN/Sort root emits per-shard candidates; the host does the final
+    k-way merge (the MPPGather role, executor/mpp_gather.go:42).
+
+XLA schedules the collectives and overlaps them with per-shard compute —
+the compiler replaces the reference's goroutine/gRPC exchange plumbing.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tidb_tpu.executor.tree_fragment import (TreeProgram, _scans,
+                                             _walk_nodes, tree_signature)
+from tidb_tpu.planner.physical import (PhysExchange, PhysHashAgg, PhysSort,
+                                       PhysTableScan, PhysTopN, PhysicalPlan)
+
+AXIS = "shard"
+
+
+class DistTreeProgram(TreeProgram):
+    """Shard_map-compiled fragment: per-shard emission is TreeProgram's,
+    plus Exchange nodes and a distributed root reduction."""
+
+    def __init__(self, plan: PhysicalPlan, caps: Dict[int, int],
+                 group_cap: int, mesh, bucket_caps: Dict[int, int]):
+        from tidb_tpu.ops.jax_env import jax, shard_map
+        self.mesh = mesh
+        self.n_shards = mesh.devices.size
+        self.bucket_caps = bucket_caps    # id(exchange-node) → bucket cap
+        # TreeProgram.__init__ builds prep_nodes and jits self._run; we
+        # re-wrap with shard_map afterwards.
+        super().__init__(plan, caps, group_cap)
+        P = jax.sharding.PartitionSpec
+        root = plan
+        flags = {"unique": P(), "over_groups": P(), "over_exchange": P()}
+        if isinstance(root, PhysHashAgg):
+            out_specs = {"keys": P(AXIS), "states": P(AXIS),
+                         "out_live": P(AXIS), **flags}
+        else:                      # dist_ok guarantees a TopN/Sort root
+            assert isinstance(root, (PhysTopN, PhysSort)), root
+            out_specs = {"cols": P(AXIS), "n_out": P(AXIS), **flags}
+        self.run = jax.jit(shard_map(
+            self._run, mesh=mesh,
+            in_specs=(P(AXIS), P(AXIS), P()),
+            out_specs=out_specs,
+            check_rep=False))
+
+    # -- traced per-shard body ----------------------------------------------
+    def _run(self, scan_inputs, scan_rows, prep_vals):
+        from tidb_tpu.ops.jax_env import jnp, lax
+        self._prepared = {id(n): v
+                          for n, v in zip(self.prep_nodes, prep_vals)
+                          if v is not None}
+        self._join_unique_flags = []
+        self._overflow_flags = []
+        cols, live = self._emit(self.plan, scan_inputs, scan_rows)
+        out = self._finish_dist(cols, live)
+        flags = self._join_unique_flags
+        uniq_local = jnp.stack(flags).all() if flags else jnp.bool_(True)
+        out["unique"] = lax.pmin(uniq_local.astype(jnp.int32), AXIS) > 0
+        over_g = out.pop("_over_local", jnp.bool_(False))
+        out["over_groups"] = lax.pmax(over_g.astype(jnp.int32), AXIS) > 0
+        over_x = jnp.bool_(False)
+        for f in self._overflow_flags:       # already pmax'd by exchange()
+            over_x = over_x | f
+        out["over_exchange"] = over_x
+        return out
+
+    def _emit(self, node: PhysicalPlan, scan_inputs, scan_rows):
+        from tidb_tpu.ops.jax_env import jnp
+        from tidb_tpu.parallel import collective as C
+        if isinstance(node, PhysTableScan):
+            slot = next(i for i, s in enumerate(self.scan_order)
+                        if s is node)
+            in_cols = scan_inputs[slot]
+            cap = self.caps[id(node)]
+            # per-shard row count arrives as a (1,) slice of (n_shards,)
+            n_local = scan_rows[slot][0]
+            live = jnp.arange(cap, dtype=jnp.int32) < n_local
+            col_list = [in_cols.get(i) for i in range(len(node.schema))]
+            ctx = self._ctx(col_list)
+            for f in node.filters:
+                v, m = f.eval(ctx)
+                live = live & (v != 0) & m
+            return col_list, live
+        if isinstance(node, PhysExchange):
+            cols, live = self._emit(node.children[0], scan_inputs,
+                                    scan_rows)
+            if node.kind == "broadcast":
+                flat, meta = _flatten_cols(cols)
+                out_flat, out_live = C.broadcast_build(flat, live, AXIS)
+                return _unflatten_cols(out_flat, meta), out_live
+            # hash: repartition rows so equal keys co-locate
+            ctx = self._ctx(cols)
+            keys = [e.eval(ctx) for e in node.keys]
+            code = C.mix_key_code(keys)
+            dest = C.shard_of(code, self.n_shards)
+            flat, meta = _flatten_cols(cols)
+            cap = self.bucket_caps[id(node)]
+            recv, recv_live, over = C.exchange(flat, dest, live,
+                                               self.n_shards, cap, AXIS)
+            self._overflow_flags.append(over)
+            return _unflatten_cols(recv, meta), recv_live
+        return super()._emit(node, scan_inputs, scan_rows)
+
+    # -- distributed root reductions -----------------------------------------
+    def _finish_dist(self, cols, live):
+        from tidb_tpu.ops.jax_env import jnp, lax
+        from tidb_tpu.ops import factorize as F
+        from tidb_tpu.parallel import collective as C
+        root = self.plan
+        if isinstance(root, PhysHashAgg):
+            cap = self.group_cap
+            ctx = self._ctx(cols)
+            n = live.shape[0]
+            # ---- per-shard partial (the MPP task's partial agg) ----
+            if root.group_exprs:
+                keys = [e.eval(ctx) for e in root.group_exprs]
+                gids, n_groups, rep = F.factorize(keys, live, cap)
+                gids = jnp.where(live, gids, jnp.int32(cap))
+                slot_live = jnp.arange(cap, dtype=jnp.int32) < n_groups
+                key_out = [(jnp.asarray(v)[rep], jnp.asarray(m)[rep] &
+                            slot_live) for v, m in keys]
+                over = n_groups > cap
+            else:
+                gids = jnp.where(live, jnp.int32(0), jnp.int32(cap))
+                slot_live = jnp.arange(cap, dtype=jnp.int32) < 1
+                key_out = []
+                over = jnp.bool_(False)
+            states = []
+            for agg, desc in zip(self.aggs, root.aggs):
+                if desc.args:
+                    v, m = desc.args[0].eval(ctx)
+                    v = jnp.asarray(v)
+                    m = jnp.asarray(m) & live
+                else:
+                    v = jnp.zeros(n, dtype=jnp.int64)
+                    m = live
+                st = agg.init(jnp, cap)
+                states.append(agg.update(jnp, st, gids, cap, v, m))
+            # ---- gather partials, merge owned groups ----
+            gkeys, gstates, gslot = C.gather_partials(
+                key_out, [tuple(st) for st in states], slot_live, AXIS)
+            rank = lax.axis_index(AXIS)
+            if root.group_exprs:
+                code = C.mix_key_code(gkeys)
+                owner = C.shard_of(code, self.n_shards)
+            else:
+                owner = jnp.zeros(gslot.shape[0], dtype=jnp.int32)
+            own = gslot & (owner == rank)
+            if root.group_exprs:
+                fgids, n_own, frep = F.factorize(gkeys, own, cap)
+                fgids = jnp.where(own, fgids, jnp.int32(cap))
+                out_live = jnp.arange(cap, dtype=jnp.int32) < n_own
+                f_keys = [(jnp.asarray(v)[frep],
+                           jnp.asarray(m)[frep] & out_live)
+                          for v, m in gkeys]
+                over = over | (n_own > cap)
+            else:
+                fgids = jnp.where(own, jnp.int32(0), jnp.int32(cap))
+                out_live = (jnp.arange(cap, dtype=jnp.int32) < 1) & \
+                    (rank == 0)
+                f_keys = []
+            f_states = []
+            for agg, gstate in zip(self.aggs, gstates):
+                clean = tuple(jnp.where(own, a, jnp.zeros_like(a))
+                              for a in gstate)
+                st = agg.init(jnp, cap)
+                f_states.append(agg.merge(jnp, st, fgids, cap, clean))
+            return {"keys": f_keys, "states": f_states,
+                    "out_live": out_live, "_over_local": over}
+        # ---- TopN / Sort: per-shard candidates, host merges ----
+        assert isinstance(root, (PhysTopN, PhysSort)), root
+        n = live.shape[0]
+        cols = [(jnp.zeros(n, dtype=jnp.int64), jnp.zeros(n, dtype=bool))
+                if c is None else c for c in cols]
+        ctx = self._ctx(cols)
+        keys = [e.eval(ctx) for e in root.by]
+        n_out_cols = len(root.schema)
+        if isinstance(root, PhysTopN):
+            k = min(root.count + root.offset, n)
+            idx, n_out = F.topn(keys, root.descs, live, k)
+        else:
+            idx, n_out = F.sort_perm(keys, root.descs, live)
+        gathered = [(jnp.take(jnp.asarray(v), idx),
+                     jnp.take(jnp.asarray(m), idx))
+                    for v, m in cols[:n_out_cols]]
+        return {"cols": gathered,
+                "n_out": jnp.reshape(n_out, (1,)),
+                "_over_local": jnp.bool_(False)}
+
+
+def _flatten_cols(cols):
+    """[(v,m) or None...] → (flat arrays for the collective, meta)."""
+    flat: List = []
+    meta: List[Optional[int]] = []
+    for c in cols:
+        if c is None:
+            meta.append(None)
+        else:
+            meta.append(len(flat))
+            flat.append(c[0])
+            flat.append(c[1])
+    return flat, meta
+
+
+def _unflatten_cols(flat, meta):
+    out = []
+    for m in meta:
+        out.append(None if m is None else (flat[m], flat[m + 1]))
+    return out

@@ -493,6 +493,23 @@ def get_program(chain, used_cols, in_types, slab_cap, group_cap,
     return prog
 
 
+def _get_dist_program(root, caps, group_cap, mesh, bucket_caps):
+    from tidb_tpu.executor.dist_fragment import DistTreeProgram
+    from tidb_tpu.executor.tree_fragment import (_walk_nodes,
+                                                 tree_signature)
+    from tidb_tpu.planner.physical import PhysExchange
+    bux = ",".join(str(bucket_caps[id(n)]) for n in _walk_nodes(root)
+                   if isinstance(n, PhysExchange) and n.kind == "hash")
+    sig = (f"dist={mesh.devices.size}|bux={bux}|" +
+           tree_signature(root, caps, group_cap))
+    prog = _cache_get(sig)
+    if prog is None:
+        prog = DistTreeProgram(root, caps, group_cap, mesh,
+                               dict(bucket_caps))
+        _cache_put(sig, prog)
+    return prog
+
+
 def get_tree_program(root, caps, group_cap):
     from tidb_tpu.executor.tree_fragment import TreeProgram, tree_signature
     sig = tree_signature(root, caps, group_cap)
@@ -643,6 +660,8 @@ class TpuFragmentExec:
     def _run_device(self) -> Chunk:
         from tidb_tpu.executor import device_cache
 
+        if getattr(self.plan, "dist", 0) > 1:
+            return self._run_device_dist()
         chain = _linearize(self.plan.root)
         if chain is None:
             from tidb_tpu.executor.tree_fragment import has_join
@@ -783,6 +802,149 @@ class TpuFragmentExec:
                                     np.asarray(m)[idx],
                                     dicts_root.get(ci)))
         return Chunk(cols)
+
+    # ---- distributed (multi-shard) pipeline --------------------------------
+    def _run_device_dist(self) -> Chunk:
+        """Planner-fragmented tree as one shard_map program over the mesh
+        (executor/dist_fragment.py; the MPPGather role of
+        executor/mpp_gather.go:42 lives in this method)."""
+        import types as pytypes
+
+        from tidb_tpu.executor import device_cache, tree_fragment as TF
+        from tidb_tpu.executor.device_cache import (_collect_parts,
+                                                    _encode_col,
+                                                    _materialize_col, _pow2)
+        from tidb_tpu.executor.dist_fragment import DistTreeProgram
+        from tidb_tpu.ops.jax_env import jax, jnp
+        from tidb_tpu.parallel import make_mesh
+        from tidb_tpu.planner.physical import PhysExchange
+
+        root = self.plan.root
+        nd = self.plan.dist
+        import jax as _jax
+        if len(_jax.devices()) < nd:
+            raise FragmentFallback(f"mesh wants {nd} devices, "
+                                   f"{len(_jax.devices())} available")
+        mesh = make_mesh(nd)
+        P = jax.sharding.PartitionSpec
+        sharding = jax.sharding.NamedSharding(mesh, P("shard"))
+
+        scans = TF._scans(root)
+        caps: Dict[int, int] = {}
+        scan_inputs = []
+        scan_rows = []
+        scan_dicts = {}
+        for scan in scans:
+            used = scan.used_columns if scan.used_columns else \
+                list(range(len(scan.schema)))
+            parts, total = _collect_parts(self.ctx, scan)
+            if total == 0:
+                raise FragmentFallback("empty input")
+            shim = pytypes.SimpleNamespace(parts=parts)
+            cap = _pow2((total + nd - 1) // nd, lo=8)
+            caps[id(scan)] = cap
+            cols = {}
+            dicts = {}
+            ftypes = scan.schema.field_types
+            for i in used:
+                vals, valid = _materialize_col(shim, i)
+                vals, dictionary = _encode_col(ftypes[i], vals, valid)
+                dicts[i] = dictionary
+                pv = np.zeros(nd * cap, dtype=vals.dtype)
+                pv[:total] = vals
+                pm = np.zeros(nd * cap, dtype=bool)
+                pm[:total] = valid
+                cols[i] = (jax.device_put(pv, sharding),
+                           jax.device_put(pm, sharding))
+            rows = np.clip(total - np.arange(nd) * cap, 0,
+                           cap).astype(np.int32)
+            scan_inputs.append(cols)
+            scan_rows.append(jax.device_put(rows, sharding))
+            scan_dicts[id(scan)] = dicts
+        scan_inputs = tuple(scan_inputs)
+        scan_rows = tuple(scan_rows)
+
+        flows, root_dicts = TF.dictionary_flows(root, scan_dicts)
+        flow_list = [flows.get(id(n), []) for n in TF._walk_nodes(root)]
+
+        # initial bucket cap per hash exchange: 4× the balanced share
+        bucket_caps: Dict[int, int] = {}
+        for node in TF._walk_nodes(root):
+            if isinstance(node, PhysExchange) and node.kind == "hash":
+                est = max(int(node.est_rows), 1)
+                bucket_caps[id(node)] = _pow2(4 * ((est + nd - 1) // nd),
+                                              lo=64)
+
+        vars_ = self.ctx.vars
+        group_cap = int(vars_.get("tidb_tpu_group_cap", DEFAULT_GROUP_CAP))
+        is_agg = isinstance(root, PhysHashAgg)
+        max_cap = max(caps.values())
+        gcap = _initial_group_cap(root, group_cap, max_cap * nd) \
+            if is_agg else 1
+
+        while True:
+            prog = _get_dist_program(root, caps, gcap, mesh, bucket_caps)
+            prep_vals = prog.collect_preps(flow_list)
+            out = jax.device_get(prog(scan_inputs, scan_rows, prep_vals))
+            if not bool(out["unique"]):
+                raise FragmentFallback("non-unique join build side")
+            retry = False
+            if bool(out["over_exchange"]):
+                for k in bucket_caps:
+                    bucket_caps[k] *= 2
+                retry = True
+            if bool(out["over_groups"]):
+                if gcap >= max_cap * nd:
+                    raise FragmentFallback("group cap overflow")
+                gcap = min(gcap * 4, max_cap * nd)
+                retry = True
+            if not retry:
+                break
+
+        dicts_root = {i: d for i, d in enumerate(root_dicts)}
+        if is_agg:
+            out_live = np.asarray(out["out_live"])
+            idx = np.nonzero(out_live)[0]
+            inp = flows.get(id(root), [])
+            cols: List[Column] = []
+            for kc, e in enumerate(root.group_exprs):
+                ft = self.schema[kc]
+                v, m = out["keys"][kc]
+                d = inp[e.index] if isinstance(e, ColumnRef) and \
+                    e.index < len(inp) else None
+                cols.append(_decode_col(ft, np.asarray(v)[idx],
+                                        np.asarray(m)[idx], d))
+            for agg, st in zip([build_agg(d) for d in root.aggs],
+                               out["states"]):
+                v, m = agg.final(np, tuple(np.asarray(a) for a in st))
+                cols.append(_decode_col(agg.ftype, np.asarray(v)[idx],
+                                        np.asarray(m)[idx], None))
+            if root.group_exprs and not len(idx):
+                from tidb_tpu.executor import _empty_chunk
+                return _empty_chunk(self.schema)
+            return Chunk(cols)
+        # dist_ok guarantees the remaining root is TopN/Sort: per-shard
+        # candidates arrive concatenated; host does the final k-way merge
+        n_outs = np.asarray(out["n_out"])
+        per_shard = out["cols"][0][0].shape[0] // nd if out["cols"] else 0
+        pieces = []
+        for s in range(nd):
+            lo = s * per_shard
+            n = int(n_outs[s])
+            piece = []
+            for ci, ((v, m), ft) in enumerate(
+                    zip(out["cols"], root.schema.field_types)):
+                piece.append(_decode_col(
+                    ft, np.asarray(v)[lo:lo + n],
+                    np.asarray(m)[lo:lo + n], dicts_root.get(ci)))
+            pieces.append(Chunk(piece))
+        merged = Chunk.concat(pieces) if len(pieces) > 1 else pieces[0]
+        merged = _host_order(merged, root, root.schema)
+        if isinstance(root, PhysTopN):
+            lo = min(root.offset, merged.num_rows)
+            hi = min(root.offset + root.count, merged.num_rows)
+            merged = merged.slice(lo, hi)
+        return merged
 
     @staticmethod
     def _slab(ent, slab_idx: int, used: Sequence[int]):

@@ -94,6 +94,27 @@ def tree_ok(plan: PhysicalPlan, threshold: int) -> bool:
     return walk(plan, True) and has_join(plan) and max_scan[0] >= threshold
 
 
+def dist_ok(plan: PhysicalPlan, threshold: int) -> bool:
+    """Eligibility for the multi-shard (shard_map) compilation: the same
+    operator allowlist as tree_ok, but joins are optional (a linear Q1
+    chain distributes as shard-partials + owned final merge) and agg/topN
+    roots are required (a distributed result needs a shard-reducible
+    root)."""
+    from tidb_tpu.planner.physical import PhysExchange
+    if isinstance(plan, PhysExchange):
+        return False               # already fragmented
+    if not isinstance(plan, (PhysHashAgg, PhysTopN, PhysSort)):
+        return False
+    if has_join(plan):
+        return tree_ok(plan, threshold)
+    return _chain_shape_ok(plan, threshold)
+
+
+def _chain_shape_ok(plan: PhysicalPlan, threshold: int) -> bool:
+    from tidb_tpu.executor.fragment import _fragment_ok
+    return _fragment_ok(plan, threshold)
+
+
 def _scans(plan: PhysicalPlan) -> List[PhysTableScan]:
     if isinstance(plan, PhysTableScan):
         return [plan]
@@ -105,6 +126,7 @@ def _scans(plan: PhysicalPlan) -> List[PhysTableScan]:
 
 def _stage_exprs(node: PhysicalPlan) -> List[Expression]:
     from tidb_tpu.executor.fragment import _stage_exprs as chain_stage
+    from tidb_tpu.planner.physical import PhysExchange
     if isinstance(node, PhysHashJoin):
         out: List[Expression] = []
         for l, r in node.equi:
@@ -112,6 +134,8 @@ def _stage_exprs(node: PhysicalPlan) -> List[Expression]:
             out.append(r)
         out.extend(node.other_conditions or [])
         return out
+    if isinstance(node, PhysExchange):
+        return list(node.keys)
     return chain_stage(node)
 
 
@@ -155,6 +179,8 @@ def tree_signature(plan: PhysicalPlan, caps: Dict[int, int],
                          f"descs={node.descs}, "
                          f"k={getattr(node, 'count', None)}, "
                          f"off={getattr(node, 'offset', 0)})")
+        elif type(node).__name__ == "PhysExchange":
+            parts.append(f"Exch({node.kind}, keys={node.keys!r})")
     return "|".join(parts)
 
 
@@ -403,6 +429,7 @@ def dictionary_flows(plan: PhysicalPlan,
             return out
         inp = child_flows[0]
         flows[id(node)] = inp
+        # PhysExchange: pure redistribution, dictionaries pass through
         if isinstance(node, PhysProjection):
             return [inp[e.index] if isinstance(e, ColumnRef)
                     and e.index < len(inp) else None for e in node.exprs]

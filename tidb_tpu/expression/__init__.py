@@ -64,7 +64,10 @@ class EvalContext:
     def num_rows(self):
         if self._n_rows is not None:
             return self._n_rows
-        return self._columns[0][0].shape[0] if self._columns else 0
+        for c in self._columns:
+            if c is not None:       # unused positions ride as None
+                return c[0].shape[0]
+        return 0
 
 
 # ---------------------------------------------------------------------------

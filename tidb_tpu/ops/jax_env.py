@@ -18,6 +18,16 @@ import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 from jax import lax  # noqa: E402
 
+def shard_map(f, *, mesh, in_specs, out_specs, check_rep=False):
+    """Version shim: jax>=0.8 renamed check_rep → check_vma and moved
+    shard_map out of experimental."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_rep)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_rep)
+
 jax.config.update("jax_enable_x64", True)
 
 

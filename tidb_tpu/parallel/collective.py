@@ -28,6 +28,21 @@ def shard_of(codes, n_shards: int):
     return (_mix64(codes) % jnp.uint64(n_shards)).astype(jnp.int32)
 
 
+def mix_key_code(keys):
+    """Collapse key columns [(values, validity), ...] into one int64 hash
+    input for shard_of. Equal values map equally (correctness); collisions
+    only affect balance. Validity is mixed in so NULL keys — whose slot
+    values can differ across shards — co-locate deterministically."""
+    code = None
+    for v, m in keys:
+        v = jnp.asarray(v)
+        m = jnp.asarray(m)
+        canon = jnp.where(m, v.astype(jnp.int64), jnp.int64(0))
+        part = canon * jnp.int64(2) + m.astype(jnp.int64)
+        code = part if code is None else code * jnp.int64(1000003) + part
+    return code
+
+
 def exchange(arrays: Sequence, dest, live, n_shards: int, bucket_cap: int,
              axis: str = "shard"):
     """Hash-repartition rows across shards: all_to_all bucket exchange.

@@ -49,17 +49,7 @@ def _owned_final_merge(gkeys, gsums, gcounts, gslot_live, cap: int,
     VALUE, comparable across shards — local factorize ids are not)."""
     from tidb_tpu.ops import factorize as F
     rank = lax.axis_index(AXIS)
-    # ownership must hash the full key INCLUDING validity: a NULL slot's
-    # residual value differs across shards, so canonicalize NULLs to a
-    # fixed value and mix every key column (else the same group could be
-    # claimed by several shards → duplicate rows)
-    code = jnp.zeros_like(gkeys[0][0], dtype=jnp.int64)
-    for v, m in gkeys:
-        m = jnp.asarray(m)
-        canon = jnp.where(m, jnp.asarray(v).astype(jnp.int64), jnp.int64(0))
-        code = code * jnp.int64(1000003) + canon * jnp.int64(2) + \
-            m.astype(jnp.int64)
-    owner = C.shard_of(code, n_shards)
+    owner = C.shard_of(C.mix_key_code(gkeys), n_shards)
     own = gslot_live & (owner == rank)
     gids, n_own, rep = F.factorize(gkeys, own, cap)
     gids = jnp.where(own, gids, jnp.int32(cap))
@@ -92,7 +82,7 @@ def build_agg_join_step(mesh, bucket_cap: int, group_cap: int,
     hash exchange of BOTH sides (ExchangeType_Hash), per-shard sort-probe
     join (no hash table), two-phase aggregate with value-owned final merge.
     """
-    from jax.experimental.shard_map import shard_map
+    from tidb_tpu.ops.jax_env import shard_map
     from tidb_tpu.ops import join as J
 
     n_shards = mesh.devices.size

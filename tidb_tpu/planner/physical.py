@@ -18,7 +18,7 @@ from __future__ import annotations
 
 from typing import List, Optional, Tuple
 
-from tidb_tpu.expression import Expression
+from tidb_tpu.expression import ColumnRef, Expression
 from tidb_tpu.expression.aggfuncs import AggDesc, build_agg
 from tidb_tpu.planner.logical import (LogicalAggregation, LogicalDataSource,
                                       LogicalDual, LogicalJoin, LogicalLimit,
@@ -164,6 +164,53 @@ class PhysUnionAll(PhysicalPlan):
         super().__init__(schema, children)
 
 
+class PhysExchange(PhysicalPlan):
+    """Data redistribution boundary inside a distributed fragment.
+
+    The analog of PhysicalExchangeSender/Receiver with tipb.ExchangeType
+    (planner/core/physical_plans.go:895-923): kind='hash' repartitions rows
+    by key hash (all_to_all over ICI), kind='broadcast' replicates the
+    child to every shard (all_gather). Inserted by insert_exchanges, the
+    fragmentation pass (planner/core/fragment.go:64 analog); consumed by
+    the shard_map compiler in executor/dist_fragment.py."""
+
+    def __init__(self, child: PhysicalPlan, kind: str, keys=()):
+        super().__init__(child.schema, [child])
+        self.kind = kind           # hash | broadcast
+        self.keys = list(keys)     # hash keys (exprs over child schema)
+        self.est_rows = child.est_rows
+
+    @property
+    def name(self) -> str:
+        return f"Exchange[{self.kind}]"
+
+    def describe(self):
+        return f"keys:{self.keys}" if self.kind == "hash" else ""
+
+
+def insert_exchanges(node: PhysicalPlan, n_shards: int) -> PhysicalPlan:
+    """Fragmentation pass for a device fragment subtree: choose and insert
+    exchange boundaries under every join (the planner-side MPP decision —
+    broadcast when replicating the build side is cheaper than
+    repartitioning both sides, else hash on the equi keys)."""
+    node.children = [insert_exchanges(c, n_shards) for c in node.children]
+    if not isinstance(node, PhysHashJoin) or not node.equi:
+        return node
+    from tidb_tpu.executor.join import coerce_key_pair
+    coerced = [coerce_key_pair(l, r) for l, r in node.equi]
+    lkeys = [c[0] for c in coerced]
+    rkeys = [c[1] for c in coerced]
+    bi = 1 if node.build_right else 0
+    build, probe = node.children[bi], node.children[1 - bi]
+    # broadcast moves build_est*(n-1) rows; hash moves ~build+probe rows
+    if build.est_rows * (n_shards - 1) <= build.est_rows + probe.est_rows:
+        node.children[bi] = PhysExchange(build, "broadcast")
+    else:
+        node.children[0] = PhysExchange(node.children[0], "hash", lkeys)
+        node.children[1] = PhysExchange(node.children[1], "hash", rkeys)
+    return node
+
+
 class PhysTpuFragment(PhysicalPlan):
     """A fused subtree executed as one jitted device program.
 
@@ -177,13 +224,16 @@ class PhysTpuFragment(PhysicalPlan):
     def __init__(self, root: PhysicalPlan):
         super().__init__(root.schema)
         self.root = root
+        self.dist = 0        # >1 → compiled as an n-shard shard_map program
 
     def describe(self):
         return f"fused:[{self.root.name}]"
 
     def explain_lines(self, indent: int = 0):
+        info = "engine:tpu" + (f", shards:{self.dist}" if self.dist > 1
+                               else "")
         rows = [("  " * indent + ("└─" if indent else "") + "TpuFragment",
-                 f"{self.est_rows:.0f}", "engine:tpu")]
+                 f"{self.est_rows:.0f}", info)]
         rows.extend(self.root.explain_lines(indent + 1))
         return rows
 
@@ -214,7 +264,7 @@ def _scan_of(plan: PhysicalPlan, col_idx: int):
             continue
         if isinstance(node, PhysProjection):
             e = node.exprs[idx] if idx < len(node.exprs) else None
-            if not isinstance(e, _ColumnRef()):
+            if not isinstance(e, ColumnRef):
                 return None
             idx = e.index
             node = node.children[0]
@@ -230,16 +280,11 @@ def _scan_of(plan: PhysicalPlan, col_idx: int):
         return None
 
 
-def _ColumnRef():
-    from tidb_tpu.expression import ColumnRef
-    return ColumnRef
-
-
 def _expr_ndv(expr, plan: PhysicalPlan, ctx) -> Optional[float]:
     """NDV of an expression over `plan`'s output, when it is a column
     traceable to an ANALYZEd scan column."""
     from tidb_tpu.statistics import column_ndv
-    if not isinstance(expr, _ColumnRef()):
+    if not isinstance(expr, ColumnRef):
         return None
     hit = _scan_of(plan, expr.index)
     if hit is None:
@@ -340,7 +385,25 @@ def physical_optimize(plan: LogicalPlan, ctx) -> PhysicalPlan:
         threshold = int(getattr(ctx, "tpu_row_threshold",
                                 DEFAULT_TPU_ROW_THRESHOLD))
         phys = extract_fragments(phys, threshold)
+        n_shards = int(getattr(ctx, "dist_devices", 0) or 0)
+        if n_shards > 1:
+            _distribute_fragments(phys, n_shards, threshold)
     return phys
+
+
+def _distribute_fragments(plan: PhysicalPlan, n_shards: int,
+                          threshold: int) -> None:
+    """Turn eligible device fragments into n-shard distributed fragments:
+    insert exchange boundaries (the fragmentation pass) and mark them for
+    shard_map compilation."""
+    if isinstance(plan, PhysTpuFragment):
+        from tidb_tpu.executor.tree_fragment import dist_ok
+        if dist_ok(plan.root, threshold):
+            plan.root = insert_exchanges(plan.root, n_shards)
+            plan.dist = n_shards
+        return
+    for c in plan.children:
+        _distribute_fragments(c, n_shards, threshold)
 
 
 def _to_physical(plan: LogicalPlan, ctx) -> PhysicalPlan:

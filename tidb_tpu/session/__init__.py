@@ -108,6 +108,20 @@ class _PlanContext:
     def tpu_row_threshold(self) -> int:
         return int(self.session.vars.get("tidb_tpu_row_threshold", 32768))
 
+    @property
+    def dist_devices(self) -> int:
+        """Shards for distributed fragments: tidb_tpu_dist_devices=N pins
+        an N-way mesh; 'auto' uses every visible device (>1 ⇒ MPP-style
+        distribution; the tidb_allow_mpp analog)."""
+        v = self.session.vars.get("tidb_tpu_dist_devices", 0)
+        if str(v) == "auto":
+            import jax
+            return len(jax.devices())
+        try:
+            return int(v)
+        except (TypeError, ValueError):
+            return 0
+
 
 class Session:
     def __init__(self, engine: Optional[Engine] = None):

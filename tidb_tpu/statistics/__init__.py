@@ -180,15 +180,25 @@ def _col_and_const(func):
 def expr_selectivity(expr, stats: Optional[TableStats]) -> float:
     """Selectivity of one predicate against scan-schema stats. Column refs
     must be scan-level (callers pass filters already pushed to the scan)."""
+    s = informed_selectivity(expr, stats)
+    return DEFAULT_SELECTIVITY if s is None else s
+
+
+def informed_selectivity(expr, stats: Optional[TableStats]
+                         ) -> Optional[float]:
+    """Like expr_selectivity but returns None when there is genuinely no
+    information (no stats / opaque expression shape) — callers that cap
+    opaque compounding must distinguish 'no info' from 'the estimate
+    happens to be 0.25'."""
     from tidb_tpu.expression import ColumnRef, Constant, ScalarFunc
     if stats is None:
-        return DEFAULT_SELECTIVITY
+        return None
     if isinstance(expr, Constant):
         if expr.value is None:
             return 0.0
         return 1.0 if expr.value else 0.0
     if not isinstance(expr, ScalarFunc):
-        return DEFAULT_SELECTIVITY
+        return None
     op = expr.op
     if op == "logical_and":
         s = 1.0
@@ -225,17 +235,17 @@ def expr_selectivity(expr, stats: Optional[TableStats]) -> float:
                     if isinstance(a, Constant) and a.value is not None:
                         s += cs.eq_selectivity(_raw(col, a))
                 return min(s, 1.0)
-        return DEFAULT_SELECTIVITY
+        return None
     if op in _CMP_OPS:
         col, const, flipped = _col_and_const(expr)
         if col is None or const is None or const.value is None:
-            return DEFAULT_SELECTIVITY
+            return None
         cs = stats.columns.get(col.index)
         if cs is None:
-            return DEFAULT_SELECTIVITY
+            return None
         raw = _raw(col, const)
         if raw is None:
-            return DEFAULT_SELECTIVITY
+            return None
         o = op
         if flipped and o in ("lt", "le", "gt", "ge"):
             o = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}[o]
@@ -269,8 +279,8 @@ def expr_selectivity(expr, stats: Optional[TableStats]) -> float:
                 hi = prefix[:-1] + chr(ord(prefix[-1]) + 1)
                 return cs.range_selectivity(lo=prefix, hi=hi, lo_incl=True,
                                             hi_incl=False)
-        return DEFAULT_SELECTIVITY
-    return DEFAULT_SELECTIVITY
+        return None
+    return None
 
 
 def filters_selectivity(filters, stats: Optional[TableStats]) -> float:
@@ -282,8 +292,8 @@ def filters_selectivity(filters, stats: Optional[TableStats]) -> float:
     combined = 1.0
     opaque = 0
     for f in filters:
-        s = expr_selectivity(f, stats)
-        if s == DEFAULT_SELECTIVITY:
+        s = informed_selectivity(f, stats)
+        if s is None:
             opaque += 1
         else:
             combined *= s
