@@ -91,6 +91,17 @@ def _string_exprs_are_refs(exprs: Sequence[Expression]) -> bool:
                for e in exprs)
 
 
+def _exprs_device_ok(exprs: Sequence[Expression]) -> bool:
+    """Reject host-only builtins at plan time (quiet CPU routing instead
+    of a traced failure + warning per query)."""
+    from tidb_tpu.expression import HOST_ONLY_OPS, ScalarFunc
+    for e in exprs:
+        for sub in e.walk():
+            if isinstance(sub, ScalarFunc) and sub.op in HOST_ONLY_OPS:
+                return False
+    return True
+
+
 def _fragment_ok(plan: PhysicalPlan, threshold: int) -> bool:
     chain = _linearize(plan)
     if chain is None:
@@ -103,10 +114,12 @@ def _fragment_ok(plan: PhysicalPlan, threshold: int) -> bool:
     reduction = isinstance(plan, (PhysHashAgg, PhysTopN, PhysSort))
     worthwhile = reduction or bool(scan.filters)
     for node in chain:
+        if not _exprs_device_ok(_stage_exprs(node)):
+            return False
         if isinstance(node, PhysHashAgg):
             for desc in node.aggs:
-                if desc.distinct:
-                    return False
+                if desc.distinct and len(desc.args) != 1:
+                    return False    # COUNT(DISTINCT a,b): CPU only
                 try:
                     if not build_agg(desc).device_capable:
                         return False
@@ -191,7 +204,7 @@ def _chain_signature(chain: List[PhysicalPlan], used_cols: Sequence[int],
         elif isinstance(node, PhysHashAgg):
             parts.append(
                 f"Agg(g={node.group_exprs!r}, "
-                f"a={[(d.name, repr(d.args), str(d.ftype)) for d in node.aggs]})")
+                f"a={[(d.name, repr(d.args), str(d.ftype), d.distinct) for d in node.aggs]})")
         elif isinstance(node, (PhysTopN, PhysSort)):
             k = getattr(node, "count", None)
             off = getattr(node, "offset", 0)
@@ -364,6 +377,7 @@ class _FragmentProgram:
         O(n log n) multi-operand bitonic sort.
         """
         from tidb_tpu.ops.jax_env import jnp
+        from tidb_tpu.ops import factorize as F
         cap = self.group_cap           # == the packed key domain size
         keys = [e.eval(ctx) for e in root.group_exprs]
         # packed code: per-key code 0 = NULL (its own group), else 1+v-lo
@@ -407,6 +421,9 @@ class _FragmentProgram:
             else:
                 v = jnp.zeros(self.slab_cap, dtype=jnp.int64)
                 m = live
+            if desc.distinct and desc.args:
+                # keep only the first (group, value) occurrence
+                m = m & F.distinct_mask(gids, v, m, live)
             st = agg.init(jnp, cap)
             states.append(agg.update(jnp, st, gids, cap, v, m))
         return {"keys": key_out, "states": states, "n_groups": n_groups,
@@ -439,6 +456,9 @@ class _FragmentProgram:
             else:
                 v = jnp.zeros(self.slab_cap, dtype=jnp.int64)
                 m = live
+            if desc.distinct and desc.args:
+                # keep only the first (group, value) occurrence
+                m = m & F.distinct_mask(gids, v, m, live)
             st = agg.init(jnp, cap)
             states.append(agg.update(jnp, st, gids, cap, v, m))
         slot_live = jnp.arange(cap, dtype=jnp.int32) < n_groups
@@ -967,6 +987,9 @@ class TpuFragmentExec:
                      prep_vals) -> Chunk:
         from tidb_tpu.ops.jax_env import jax, jnp
         n_slabs = ent.n_slabs
+        if n_slabs > 1 and any(d.distinct for d in root.aggs):
+            # distinct partials would double-count across slab merges
+            raise FragmentFallback("multi-slab distinct aggregate")
         partials = []
         for s in range(n_slabs):
             cols, n = self._slab(ent, s, prog.used_cols)

@@ -48,6 +48,9 @@ def tree_ok(plan: PhysicalPlan, threshold: int) -> bool:
     max_scan = [0.0]
 
     def walk(node: PhysicalPlan, is_root: bool) -> bool:
+        from tidb_tpu.executor.fragment import _exprs_device_ok
+        if not _exprs_device_ok(_stage_exprs(node)):
+            return False
         if isinstance(node, PhysTableScan):
             max_scan[0] = max(max_scan[0], getattr(node, "est_rows", 0.0))
             return True
@@ -72,8 +75,8 @@ def tree_ok(plan: PhysicalPlan, threshold: int) -> bool:
                 walk(node.children[1], False)
         if is_root and isinstance(node, PhysHashAgg):
             for desc in node.aggs:
-                if desc.distinct:
-                    return False
+                if desc.distinct and len(desc.args) != 1:
+                    return False    # COUNT(DISTINCT a,b): CPU only
                 try:
                     if not build_agg(desc).device_capable:
                         return False
@@ -105,6 +108,8 @@ def dist_ok(plan: PhysicalPlan, threshold: int) -> bool:
         return False               # already fragmented
     if not isinstance(plan, (PhysHashAgg, PhysTopN, PhysSort)):
         return False
+    if isinstance(plan, PhysHashAgg) and any(d.distinct for d in plan.aggs):
+        return False     # distinct partials don't merge across shards
     if has_join(plan):
         return tree_ok(plan, threshold)
     return _chain_shape_ok(plan, threshold)
@@ -173,7 +178,7 @@ def tree_signature(plan: PhysicalPlan, caps: Dict[int, int],
         elif isinstance(node, PhysHashAgg):
             parts.append(
                 f"Agg(g={node.group_exprs!r}, "
-                f"a={[(d.name, repr(d.args), str(d.ftype)) for d in node.aggs]})")
+                f"a={[(d.name, repr(d.args), str(d.ftype), d.distinct) for d in node.aggs]})")
         elif isinstance(node, (PhysTopN, PhysSort)):
             parts.append(f"{type(node).__name__}(by={node.by!r}, "
                          f"descs={node.descs}, "
@@ -371,6 +376,8 @@ class TreeProgram:
                 else:
                     v = jnp.zeros(n, dtype=jnp.int64)
                     m = live
+                if desc.distinct and desc.args:
+                    m = m & F.distinct_mask(gids, v, m, live)
                 st = agg.init(jnp, cap)
                 states.append(agg.update(jnp, st, gids, cap, v, m))
             return {"keys": key_out, "states": states, "n_groups": n_groups,

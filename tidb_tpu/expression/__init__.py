@@ -709,6 +709,212 @@ for _n in _HOST_STRING_FNS:
     _make_string_fn_kernel(_n)
 
 
+# -- multi-arg string builtins ------------------------------------------
+# host path evaluates row-wise; the device path precomputes a dictionary
+# lookup table when the single string column's co-arguments are constants
+# (same trick as the unary functions above).
+
+
+def _mysql_substr(s: str, pos: int, ln=None) -> str:
+    if pos == 0:
+        return ""
+    start = pos - 1 if pos > 0 else len(s) + pos
+    if start < 0:
+        return ""
+    end = len(s) if ln is None else start + max(int(ln), 0)
+    return s[start:end]
+
+
+def _mysql_locate(sub: str, s: str, pos: int = 1) -> int:
+    if pos < 1:
+        return 0
+    return s.find(sub, pos - 1) + 1
+
+
+_STRING_FNS_EXTRA = {
+    # name: (host_fn(str, *co_args), string-col arg index, result kind)
+    "substr": (lambda s, pos, ln=None: _mysql_substr(s, int(pos), ln),
+               0, "str"),
+    "left": (lambda s, n: s[:max(int(n), 0)], 0, "str"),
+    "right": (lambda s, n: s[-int(n):] if int(n) > 0 else "", 0, "str"),
+    "repeat": (lambda s, n: s * max(int(n), 0), 0, "str"),
+    "replace": (lambda s, a, b: s.replace(str(a), str(b)), 0, "str"),
+    "lpad": (lambda s, n, p: "" if int(n) < 0 else
+             (s[:int(n)] if len(s) >= int(n) else
+              ((str(p) * int(n))[:int(n) - len(s)] + s if p else s)),
+             0, "str"),
+    "rpad": (lambda s, n, p: "" if int(n) < 0 else
+             (s[:int(n)] if len(s) >= int(n) else
+              (s + (str(p) * int(n))[:int(n) - len(s)] if p else s)),
+             0, "str"),
+    "instr": (lambda s, sub: s.find(str(sub)) + 1, 0, "int"),
+    "locate": (lambda s, sub, pos=1: _mysql_locate(str(sub), s, int(pos)),
+               1, "int"),
+    "substring_index": (
+        lambda s, delim, cnt:
+            str(delim).join(s.split(str(delim))[:int(cnt)])
+            if int(cnt) > 0 else
+            (str(delim).join(s.split(str(delim))[int(cnt):])
+             if int(cnt) < 0 else ""),
+        0, "str"),
+    # col is the SET string (arg 1); the needle arrives as the co-arg
+    "find_in_set": (
+        lambda setstr, needle: (setstr.split(",").index(str(needle)) + 1
+                                if str(needle) in setstr.split(",")
+                                else 0), 1, "int"),
+}
+
+
+def _make_string_extra_kernel(name):
+    host, col_idx, rkind = _STRING_FNS_EXTRA[name]
+
+    def k(func: ScalarFunc, ctx: EvalContext):
+        xp = ctx.xp
+        if ctx.on_device:
+            # the prepared LUT folds constant co-args; only the string
+            # column's codes are evaluated (string constants cannot trace)
+            table = ctx.prepared.get(id(func))
+            if table is None:
+                raise TypeError_(f"{name}: device path needs constant "
+                                 f"co-arguments")
+            codes, m = func.args[col_idx].eval(ctx)
+            return xp.take(table, codes.astype(xp.int32), mode="clip"), m
+        evals = [a.eval(ctx) for a in func.args]
+        m = evals[0][1]
+        for _, am in evals[1:]:
+            m = m & am
+        n = ctx.num_rows
+        out = []
+        for i in range(n):
+            row = [np.asarray(v)[i] if np.ndim(v) else v
+                   for v, _ in evals]
+            s = str(row[col_idx])
+            co = [row[j] for j in range(len(row)) if j != col_idx]
+            out.append(host(s, *co))
+        dtype = np.int64 if rkind == "int" else object
+        return np.array(out, dtype=dtype), m
+
+    def prep(func: ScalarFunc, dictionaries):
+        col = func.args[col_idx]
+        if not isinstance(col, ColumnRef):
+            return None
+        co = [a for j, a in enumerate(func.args) if j != col_idx]
+        if not all(isinstance(a, Constant) and a.value is not None
+                   for a in co):
+            return None
+        d = dictionaries[col.index] if col.index < len(dictionaries) \
+            else None
+        if d is None:
+            return None
+        co_vals = [a.ftype.encode_value(a.value) for a in co]
+        out = [host(str(s), *co_vals) for s in d]
+        if rkind == "int":
+            return np.array(out, dtype=np.int64)
+        newdict, codes = np.unique(np.array(out, dtype=object),
+                                   return_inverse=True)
+        func._derived_dict = newdict  # noqa: SLF001
+        return codes.astype(np.int32)
+
+    kernel(name)(k)
+    preparer(name)(prep)
+
+
+for _n in _STRING_FNS_EXTRA:
+    _make_string_extra_kernel(_n)
+
+
+@kernel("concat")
+def _concat(func, ctx):
+    """CONCAT(a, b, …): NULL if any arg NULL. Host-only for multi-column
+    inputs; single string column + constants goes through the dictionary
+    preparation (prepared table of result codes)."""
+    xp = ctx.xp
+    if ctx.on_device:
+        table = ctx.prepared.get(id(func))
+        if table is None:
+            raise TypeError_("concat: device path needs a prepared table")
+        col_idx = next(i for i, a in enumerate(func.args)
+                       if isinstance(a, ColumnRef) and
+                       a.ftype.kind.is_string)
+        codes, m = func.args[col_idx].eval(ctx)
+        return xp.take(table, codes.astype(xp.int32), mode="clip"), m
+    evals = [a.eval(ctx) for a in func.args]
+    m = evals[0][1]
+    for _, am in evals[1:]:
+        m = m & am
+    n = ctx.num_rows
+    out = []
+    for i in range(n):
+        parts = []
+        for (v, _), a in zip(evals, func.args):
+            x = np.asarray(v)[i] if np.ndim(v) else v
+            parts.append(_concat_str(x, a.ftype))
+        out.append("".join(parts))
+    return np.array(out, dtype=object), m
+
+
+def _concat_str(x, ft: FieldType) -> str:
+    if ft.kind.is_string:
+        return str(x)
+    v = ft.decode_value(x)
+    return str(v)
+
+
+@preparer("concat")
+def _prepare_concat(func: ScalarFunc, dictionaries):
+    scols = [(i, a) for i, a in enumerate(func.args)
+             if isinstance(a, ColumnRef) and a.ftype.kind.is_string]
+    others = [a for i, a in enumerate(func.args)
+              if not (isinstance(a, ColumnRef) and a.ftype.kind.is_string)]
+    if len(scols) != 1 or not all(isinstance(a, Constant) for a in others):
+        return None
+    ci, col = scols[0]
+    d = dictionaries[col.index] if col.index < len(dictionaries) else None
+    if d is None:
+        return None
+    out = []
+    for s in d:
+        parts = []
+        for i, a in enumerate(func.args):
+            if i == ci:
+                parts.append(str(s))
+            else:
+                parts.append(_concat_str(a.ftype.encode_value(a.value),
+                                         a.ftype))
+        out.append("".join(parts))
+    newdict, codes = np.unique(np.array(out, dtype=object),
+                               return_inverse=True)
+    func._derived_dict = newdict  # noqa: SLF001
+    return codes.astype(np.int32)
+
+
+@kernel("strcmp")
+def _strcmp(func, ctx):
+    xp = ctx.xp
+    a, am = func.args[0].eval(ctx)
+    b, bm = func.args[1].eval(ctx)
+    m = am & bm
+    if ctx.on_device:
+        raise TypeError_("strcmp: host-only")
+    # MySQL coerces both sides to strings (STRCMP(3, '3') = 0)
+    sa = np.array([_concat_str(x, func.args[0].ftype)
+                   for x in np.asarray(a)], dtype=object)
+    sb = np.array([_concat_str(x, func.args[1].ftype)
+                   for x in np.asarray(b)], dtype=object)
+    out = np.where(sa < sb, -1, np.where(sa > sb, 1, 0)).astype(np.int64)
+    return out, m
+
+
+@kernel("space")
+def _space(func, ctx):
+    xp = ctx.xp
+    v, m = func.args[0].eval(ctx)
+    if ctx.on_device:
+        raise TypeError_("space: host-only")
+    return np.array([" " * max(int(x), 0) for x in np.asarray(v)],
+                    dtype=object), m
+
+
 def _like_to_regex(pattern: str) -> str:
     out = []
     i = 0
@@ -851,8 +1057,367 @@ def _date_fn(func, ctx):
 
 
 # ---------------------------------------------------------------------------
+# Math builtins (ref: expression/builtin_math.go + _vec twins)
+# ---------------------------------------------------------------------------
+
+
+def _float_unary(name, fn, domain=None):
+    """Register a float→float elementwise builtin; NULL (and out-of-domain,
+    MySQL-style) yields NULL."""
+
+    def k(func: ScalarFunc, ctx: EvalContext):
+        xp = ctx.xp
+        v, m = func.args[0].eval(ctx)
+        fdt = _xp_dtype(xp, T.double(), ctx.on_device)
+        x = _to_float(xp, v, func.args[0].ftype, fdt)
+        if domain is not None:
+            ok = domain(xp, x)
+            m = m & ok
+            x = xp.where(ok, x, xp.ones_like(x))
+        return fn(xp, x), m
+
+    kernel(name)(k)
+
+
+_float_unary("exp", lambda xp, x: xp.exp(x))
+_float_unary("ln", lambda xp, x: xp.log(x), domain=lambda xp, x: x > 0)
+_float_unary("log2", lambda xp, x: xp.log2(x), domain=lambda xp, x: x > 0)
+_float_unary("log10", lambda xp, x: xp.log10(x), domain=lambda xp, x: x > 0)
+_float_unary("sin", lambda xp, x: xp.sin(x))
+_float_unary("cos", lambda xp, x: xp.cos(x))
+_float_unary("tan", lambda xp, x: xp.tan(x))
+_float_unary("cot", lambda xp, x: 1.0 / xp.tan(x))
+_float_unary("asin", lambda xp, x: xp.arcsin(x),
+             domain=lambda xp, x: (x >= -1) & (x <= 1))
+_float_unary("acos", lambda xp, x: xp.arccos(x),
+             domain=lambda xp, x: (x >= -1) & (x <= 1))
+_float_unary("atan", lambda xp, x: xp.arctan(x))
+_float_unary("degrees", lambda xp, x: x * (180.0 / np.pi))
+_float_unary("radians", lambda xp, x: x * (np.pi / 180.0))
+
+
+@kernel("log")
+def _log(func, ctx):
+    """LOG(x) = ln x; LOG(b, x) = log_b x."""
+    xp = ctx.xp
+    fdt = _xp_dtype(xp, T.double(), ctx.on_device)
+    if len(func.args) == 1:
+        v, m = func.args[0].eval(ctx)
+        x = _to_float(xp, v, func.args[0].ftype, fdt)
+        ok = x > 0
+        return xp.log(xp.where(ok, x, xp.ones_like(x))), m & ok
+    bv, bm = func.args[0].eval(ctx)
+    xv, xm = func.args[1].eval(ctx)
+    b = _to_float(xp, bv, func.args[0].ftype, fdt)
+    x = _to_float(xp, xv, func.args[1].ftype, fdt)
+    ok = (x > 0) & (b > 0) & (b != 1)
+    b = xp.where(ok, b, xp.full_like(b, 2.0))
+    x = xp.where(ok, x, xp.ones_like(x))
+    return xp.log(x) / xp.log(b), bm & xm & ok
+
+
+@kernel("pi")
+def _pi(func, ctx):
+    xp = ctx.xp
+    n = ctx.num_rows
+    fdt = _xp_dtype(xp, T.double(), ctx.on_device)
+    return (xp.full(n, np.pi, dtype=fdt), xp.ones(n, dtype=bool))
+
+
+@kernel("sign")
+def _sign(func, ctx):
+    xp = ctx.xp
+    v, m = func.args[0].eval(ctx)
+    return xp.sign(v).astype(xp.int64), m
+
+
+@kernel("truncate")
+def _truncate(func, ctx):
+    """TRUNCATE(x, d): toward zero at d decimal places. DECIMAL args stay
+    exact (integer arithmetic on the scaled representation)."""
+    xp = ctx.xp
+    v, m = func.args[0].eval(ctx)
+    dv, dm = func.args[1].eval(ctx)
+    ft = func.args[0].ftype
+    m = m & dm
+    if ft.kind is TypeKind.DECIMAL:
+        # d clamps to [ -precision, scale ]; scaled int math is exact
+        s = ft.scale
+        d = xp.clip(dv.astype(xp.int64), -18, s)
+        p = xp.asarray(10 ** xp.clip(s - d, 0, 18)).astype(xp.int64) \
+            if not ctx.on_device else 10 ** xp.clip(s - d, 0, 18)
+        q = xp.abs(v) // p * p
+        return xp.where(v < 0, -q, q), m
+    fdt = _xp_dtype(xp, T.double(), ctx.on_device)
+    x = _to_float(xp, v, ft, fdt)
+    p = xp.power(xp.asarray(10.0, dtype=fdt), dv.astype(fdt))
+    return _trunc(xp, x * p) / p, m
+
+
+def _nary_minmax(name, pick):
+    def k(func: ScalarFunc, ctx: EvalContext):
+        # MySQL GREATEST/LEAST: NULL if ANY argument is NULL
+        xp = ctx.xp
+        target = func.ftype
+        if target.kind.is_string:
+            if ctx.on_device:
+                raise TypeError_(f"{name}: host-only for strings")
+            out_v = out_m = None
+            for a in func.args:
+                v, m = a.eval(ctx)
+                sv = np.array([_concat_str(x, a.ftype)
+                               for x in np.asarray(v)], dtype=object)
+                if out_v is None:
+                    out_v, out_m = sv, m
+                else:
+                    cond = sv > out_v if name == "greatest" else sv < out_v
+                    out_v = np.where(cond, sv, out_v)
+                    out_m = out_m & m
+            return out_v, out_m
+        out_v = out_m = None
+        for a in func.args:
+            v, m = _coerced(a, target, ctx)
+            if out_v is None:
+                out_v, out_m = v, m
+            else:
+                out_v = pick(xp, out_v, v)
+                out_m = out_m & m
+        return out_v, out_m
+
+    kernel(name)(k)
+
+
+_nary_minmax("greatest", lambda xp, a, b: xp.maximum(a, b))
+_nary_minmax("least", lambda xp, a, b: xp.minimum(a, b))
+
+
+# ---------------------------------------------------------------------------
+# Date/time builtins (ref: expression/builtin_time.go)
+# ---------------------------------------------------------------------------
+
+
+def _civil_from_days(xp, days):
+    """days-since-epoch → (year, month, day) — Hinnant algorithm, pure
+    integer ops (device-traceable)."""
+    z = days.astype(xp.int64) + 719468
+    era = _floor_div_neg(xp, z, 146097)
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    mth = xp.where(mp < 10, mp + 3, mp - 9)
+    y = xp.where(mp >= 10, y + 1, y)
+    return y, mth, d
+
+
+def _days_from_civil(xp, y, mth, d):
+    """(year, month, day) → days-since-epoch; inverse of _civil_from_days."""
+    y = y - (mth <= 2)
+    era = _floor_div_neg(xp, y, 400)
+    yoe = y - era * 400
+    mp = xp.where(mth > 2, mth - 3, mth + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def _as_days(xp, v, ft):
+    if ft.kind in (TypeKind.DATETIME, TypeKind.TIMESTAMP):
+        return _floor_div_neg(xp, v, 86_400_000_000)
+    return v.astype(xp.int64)
+
+
+@kernel("datediff")
+def _datediff(func, ctx):
+    xp = ctx.xp
+    a, am = func.args[0].eval(ctx)
+    b, bm = func.args[1].eval(ctx)
+    da = _as_days(xp, a, func.args[0].ftype)
+    db = _as_days(xp, b, func.args[1].ftype)
+    return (da - db).astype(xp.int64), am & bm
+
+
+def _date_add_interval(func, ctx):
+    """DATE_ADD/SUB lowered by the planner to `date_add_<unit>(date, n)` —
+    the unit rides in the op name so plan signatures stay faithful;
+    DATE_SUB negates n at build time."""
+    xp = ctx.xp
+    v, m = func.args[0].eval(ctx)
+    nv, nm = func.args[1].eval(ctx)
+    ft = func.args[0].ftype
+    unit = func.op[len("date_add_"):]
+    n = nv.astype(xp.int64)
+    is_dt = ft.kind in (TypeKind.DATETIME, TypeKind.TIMESTAMP)
+    usec = v.astype(xp.int64) if is_dt else None
+    days = _as_days(xp, v, ft)
+    if unit in ("day", "week"):
+        delta = n * (7 if unit == "week" else 1)
+        out_days = days + delta
+        tod = usec - days * 86_400_000_000 if is_dt else None
+    elif unit in ("month", "quarter", "year"):
+        months = n * {"month": 1, "quarter": 3, "year": 12}[unit]
+        y, mth, d = _civil_from_days(xp, days)
+        tot = y * 12 + (mth - 1) + months
+        ny = _floor_div_neg(xp, tot, 12)
+        nm_ = tot - ny * 12 + 1
+        # clamp day to the target month's length (MySQL semantics)
+        nxt = _days_from_civil(xp, xp.where(nm_ == 12, ny + 1, ny),
+                               xp.where(nm_ == 12, 1, nm_ + 1),
+                               xp.ones_like(d))
+        first = _days_from_civil(xp, ny, nm_, xp.ones_like(d))
+        dim = nxt - first
+        nd = xp.minimum(d, dim)
+        out_days = _days_from_civil(xp, ny, nm_, nd)
+        tod = usec - days * 86_400_000_000 if is_dt else None
+    elif unit in ("hour", "minute", "second", "microsecond"):
+        mult = {"hour": 3_600_000_000, "minute": 60_000_000,
+                "second": 1_000_000, "microsecond": 1}[unit]
+        base = usec if is_dt else days * 86_400_000_000
+        return (base + n * mult), m & nm
+    else:
+        raise TypeError_(f"unsupported INTERVAL unit: {unit}")
+    if is_dt:
+        return out_days * 86_400_000_000 + tod, m & nm
+    return out_days.astype(xp.int32), m & nm
+
+
+INTERVAL_UNITS = ("day", "week", "month", "quarter", "year", "hour",
+                  "minute", "second", "microsecond")
+for _u in INTERVAL_UNITS:
+    kernel(f"date_add_{_u}")(_date_add_interval)
+
+
+@kernel("dayofweek")
+def _dayofweek(func, ctx):
+    # 1 = Sunday … 7 = Saturday; epoch 1970-01-01 was a Thursday
+    xp = ctx.xp
+    v, m = func.args[0].eval(ctx)
+    days = _as_days(xp, v, func.args[0].ftype)
+    return (_floor_mod(xp, days + 4, 7) + 1).astype(xp.int64), m
+
+
+@kernel("weekday")
+def _weekday(func, ctx):
+    # 0 = Monday … 6 = Sunday
+    xp = ctx.xp
+    v, m = func.args[0].eval(ctx)
+    days = _as_days(xp, v, func.args[0].ftype)
+    return _floor_mod(xp, days + 3, 7).astype(xp.int64), m
+
+
+@kernel("dayofyear")
+def _dayofyear(func, ctx):
+    xp = ctx.xp
+    v, m = func.args[0].eval(ctx)
+    days = _as_days(xp, v, func.args[0].ftype)
+    y, _, _ = _civil_from_days(xp, days)
+    jan1 = _days_from_civil(xp, y, xp.ones_like(y), xp.ones_like(y))
+    return (days - jan1 + 1).astype(xp.int64), m
+
+
+@kernel("quarter")
+def _quarter(func, ctx):
+    xp = ctx.xp
+    v, m = func.args[0].eval(ctx)
+    _, mth, _ = _civil_from_days(xp, _as_days(xp, v, func.args[0].ftype))
+    return ((mth + 2) // 3).astype(xp.int64), m
+
+
+@kernel("week")
+def _week(func, ctx):
+    """WEEK(d) mode 0: week 0..53, weeks start Sunday; week 1 is the first
+    week containing a Sunday of the year."""
+    xp = ctx.xp
+    v, m = func.args[0].eval(ctx)
+    days = _as_days(xp, v, func.args[0].ftype)
+    y, _, _ = _civil_from_days(xp, days)
+    jan1 = _days_from_civil(xp, y, xp.ones_like(y), xp.ones_like(y))
+    jan1_dow = _floor_mod(xp, jan1 + 4, 7)        # 0 = Sunday
+    first_sunday = jan1 + _floor_mod(xp, -jan1_dow, 7)
+    return xp.where(days < first_sunday, 0,
+                    (days - first_sunday) // 7 + 1).astype(xp.int64), m
+
+
+@kernel("last_day")
+def _last_day(func, ctx):
+    xp = ctx.xp
+    v, m = func.args[0].eval(ctx)
+    days = _as_days(xp, v, func.args[0].ftype)
+    y, mth, _ = _civil_from_days(xp, days)
+    ny = xp.where(mth == 12, y + 1, y)
+    nm_ = xp.where(mth == 12, xp.ones_like(mth), mth + 1)
+    nxt = _days_from_civil(xp, ny, nm_, xp.ones_like(mth))
+    return (nxt - 1).astype(xp.int32), m
+
+
+@kernel("hour")
+def _hour(func, ctx):
+    return _time_part(func, ctx, 3_600_000_000, 24)
+
+
+@kernel("minute")
+def _minute(func, ctx):
+    return _time_part(func, ctx, 60_000_000, 60)
+
+
+@kernel("second")
+def _second(func, ctx):
+    return _time_part(func, ctx, 1_000_000, 60)
+
+
+def _time_part(func, ctx, unit_usec, modulo):
+    xp = ctx.xp
+    v, m = func.args[0].eval(ctx)
+    ft = func.args[0].ftype
+    if ft.kind is TypeKind.DATE:
+        return xp.zeros(v.shape[0], dtype=xp.int64), m
+    usec = v.astype(xp.int64)
+    return _floor_mod(xp, _floor_div_neg(xp, usec, unit_usec),
+                      modulo).astype(xp.int64), m
+
+
+_DAY_NAMES = np.array(["Monday", "Tuesday", "Wednesday", "Thursday",
+                       "Friday", "Saturday", "Sunday"], dtype=object)
+_MONTH_NAMES = np.array(
+    ["January", "February", "March", "April", "May", "June", "July",
+     "August", "September", "October", "November", "December"], dtype=object)
+
+
+@kernel("dayname")
+def _dayname(func, ctx):
+    xp = ctx.xp
+    v, m = func.args[0].eval(ctx)
+    days = _as_days(xp, v, func.args[0].ftype)
+    idx = _floor_mod(xp, days + 3, 7)        # 0 = Monday
+    if ctx.on_device:
+        raise TypeError_("dayname: host-only (string result)")
+    return _DAY_NAMES[np.asarray(idx)], m
+
+
+@kernel("monthname")
+def _monthname(func, ctx):
+    xp = ctx.xp
+    v, m = func.args[0].eval(ctx)
+    _, mth, _ = _civil_from_days(xp, _as_days(xp, v, func.args[0].ftype))
+    if ctx.on_device:
+        raise TypeError_("monthname: host-only (string result)")
+    return _MONTH_NAMES[np.asarray(mth) - 1], m
+
+
+def _floor_mod(xp, a, n):
+    return a - _floor_div_neg(xp, a, n) * n
+
+
+# ---------------------------------------------------------------------------
 # Type inference / construction helpers (used by the planner)
 # ---------------------------------------------------------------------------
+
+# ops whose kernels can only run host-side (string results with no
+# dictionary precompute, or object-array machinery) — the device gate
+# (_fragment_ok/tree_ok) rejects fragments containing them up front
+HOST_ONLY_OPS = {"strcmp", "space", "dayname", "monthname"}
 
 _BOOL_OPS = {"eq", "ne", "lt", "le", "gt", "ge", "nulleq", "and", "or", "xor",
              "not", "isnull", "like", "in"}
@@ -905,13 +1470,37 @@ def infer_type(op: str, args: Sequence[Expression]) -> FieldType:
         if args[0].ftype.kind is TypeKind.DECIMAL:
             return T.decimal(args[0].ftype.precision, 0, nullable)
         return T.bigint(nullable)
-    if op in ("sqrt", "pow"):
+    if op in ("sqrt", "pow", "exp", "ln", "log", "log2", "log10", "sin",
+              "cos", "tan", "cot", "asin", "acos", "atan", "degrees",
+              "radians", "pi"):
         return T.double(True)
-    if op in _STRING_INT_RESULT or op in ("year", "month", "dayofmonth"):
+    if op == "sign":
         return T.bigint(nullable)
-    if op in _HOST_STRING_FNS:
+    if op == "truncate":
+        if args[0].ftype.kind is TypeKind.DECIMAL:
+            return args[0].ftype.with_nullable(nullable)
+        return T.double(nullable)
+    if op in ("greatest", "least"):
+        if any(a.ftype.kind.is_string for a in args):
+            return T.varchar(nullable=nullable)
+        out = args[0].ftype
+        for a in args[1:]:
+            out = T.merge_numeric(out, a.ftype)
+        return out.with_nullable(nullable)
+    if op in _STRING_INT_RESULT or op in ("year", "month", "dayofmonth",
+                                          "datediff", "dayofweek",
+                                          "weekday", "dayofyear", "quarter",
+                                          "week", "hour", "minute",
+                                          "second", "strcmp"):
+        return T.bigint(nullable)
+    if op in _STRING_FNS_EXTRA:
+        _, _, rkind = _STRING_FNS_EXTRA[op]
+        return T.bigint(nullable) if rkind == "int" else \
+            T.varchar(nullable=nullable)
+    if op in _HOST_STRING_FNS or op in ("concat", "space", "dayname",
+                                        "monthname"):
         return T.varchar(nullable=nullable)
-    if op == "date":
+    if op in ("date", "last_day"):
         return T.date(nullable)
     if op == "cast":
         raise AssertionError("cast requires explicit target type")

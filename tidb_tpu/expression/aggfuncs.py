@@ -155,10 +155,23 @@ class SumAgg(AggFunc):
         return v
 
     def init(self, xp, n):
-        return (xp.zeros(n, dtype=self._acc_dtype(xp)),
-                xp.zeros(n, dtype=xp.int64))
+        if self._float:
+            # two-float (hi, lo) accumulator: f64-quality SUM(double) on an
+            # f32-only device (ops/segment.segment_sum_accurate)
+            dt = self._acc_dtype(xp)
+            return (xp.zeros(n, dtype=dt), xp.zeros(n, dtype=dt),
+                    xp.zeros(n, dtype=xp.int64))
+        return (xp.zeros(n, dtype=xp.int64), xp.zeros(n, dtype=xp.int64))
 
     def update(self, xp, state, gid, n, values, validity):
+        if self._float:
+            hi, lo, counts = state
+            v = self._cast_in(xp, values)
+            v = xp.where(validity, v, xp.zeros_like(v))
+            nh, nl = seg.segment_sum_accurate(xp, v, gid, n)
+            hi, lo = seg.two_float_add(xp, hi, lo, nh.astype(hi.dtype),
+                                       nl.astype(hi.dtype))
+            return (hi, lo, counts + seg.segment_count(xp, validity, gid, n))
         sums, counts = state
         v = self._cast_in(xp, values)
         v = xp.where(validity, v, xp.zeros_like(v))
@@ -166,13 +179,29 @@ class SumAgg(AggFunc):
                 counts + seg.segment_count(xp, validity, gid, n))
 
     def merge(self, xp, state, gid, n, partial):
+        if self._float:
+            hi, lo, counts = state
+            phi, plo, pcounts = partial
+            mh1, ml1 = seg.segment_sum_accurate(xp, phi.astype(hi.dtype),
+                                                gid, n)
+            mh2, ml2 = seg.segment_sum_accurate(xp, plo.astype(hi.dtype),
+                                                gid, n)
+            ah, al = seg.two_float_add(xp, mh1, ml1, mh2, ml2)
+            hi, lo = seg.two_float_add(xp, hi, lo, ah, al)
+            return (hi, lo, counts + seg.segment_sum(xp, pcounts, gid, n))
         sums, counts = state
         psums, pcounts = partial
         return (sums + seg.segment_sum(xp, psums.astype(sums.dtype), gid, n),
                 counts + seg.segment_sum(xp, pcounts, gid, n))
 
+    def _sum_of(self, xp, state):
+        if self._float:
+            hi, lo, counts = state
+            return hi.astype(np.float64) + lo.astype(np.float64), counts
+        return state
+
     def final(self, xp, state):
-        sums, counts = state
+        sums, counts = self._sum_of(xp, state)
         return sums, counts > 0
 
 
@@ -185,7 +214,7 @@ class AvgAgg(SumAgg):
     """Same state as SUM; final divides. Decimal result rounds half-away."""
 
     def final(self, xp, state):
-        sums, counts = state
+        sums, counts = self._sum_of(xp, state)
         valid = counts > 0
         safe = xp.where(valid, counts, xp.ones_like(counts))
         if self.ftype.kind.is_float:

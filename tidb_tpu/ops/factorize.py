@@ -113,3 +113,18 @@ def topn(keys: Sequence[Tuple], descs: Sequence[bool], live, k: int):
     """Top-k row indices under ORDER BY semantics → (idx (k,), n_out)."""
     perm, n_live = sort_perm(keys, descs, live)
     return perm[:k], jnp.minimum(n_live, jnp.int32(k))
+
+
+def distinct_mask(gids, values, validity, live):
+    """True at the first live+valid occurrence of each (group, value) pair —
+    the device half of DISTINCT aggregation (the reference keeps a per-group
+    hash set, aggfuncs/func_count_distinct.go; here one extra sort dedups
+    the whole column). Rows where validity/live is False return garbage;
+    callers keep masking with validity & live as usual."""
+    n = live.shape[0]
+    ones = jnp.ones(n, dtype=bool)
+    pair_live = live & jnp.asarray(validity)
+    pg, _, rep = factorize([(jnp.asarray(gids), ones),
+                            (jnp.asarray(values), ones)], pair_live, n)
+    iota = jnp.arange(n, dtype=jnp.int32)
+    return jnp.take(rep, pg) == iota

@@ -41,8 +41,9 @@ def on_tpu() -> bool:
 
 
 # Device float dtype policy: TPU has no native f64. DOUBLE columns compute in
-# f32 on TPU (sums use compensated accumulation in ops/segment.py); exact
-# aggregates ride DECIMAL/int64 which is unaffected.
+# f32 on TPU; SUM/AVG accumulate through the exact fixed-point two-float
+# path (ops/segment.segment_sum_accurate — ~48-bit sums, ~1e-12 relative at
+# SF=10); exact aggregates ride DECIMAL/int64 which is unaffected.
 def device_float_dtype():
     return jnp.float32 if on_tpu() else jnp.float64
 

@@ -46,6 +46,59 @@ def segment_sum(xp, data, segment_ids, num_segments: int):
     return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
 
 
+def segment_sum_accurate(xp, data, segment_ids, num_segments: int):
+    """Float segment sum with f64-quality accuracy on an f32-only device.
+
+    → (hi, lo) per segment with hi + lo ≈ the exact sum (~48 significant
+    bits). TPU has no native f64, and a plain f32 scatter-add absorbs low
+    bits once the running sum outgrows individual addends (rel. error up
+    to O(n·ε) ≈ 1e-2 at 60M rows). Instead: scale every value by a traced
+    power of two (exponent shift — exact in f32), round to int64, and
+    accumulate with EXACT integer segment adds; the int result splits back
+    into a two-float (hi, lo) pair. Error bound: |err| ≤ n·2⁻ᵏ⁻¹ absolute,
+    with 2ᵏ ≈ 2⁶¹/(n·max|x|) — ~1e-12 relative at SF=10 scales.
+    Non-finite inputs bypass the int path and propagate (inf/nan) through
+    a plain float side-sum. CPU/np accumulates f64 directly (hi, lo=0).
+    """
+    if _is_np(xp):
+        out = np.zeros(num_segments, dtype=np.float64)
+        np.add.at(out, segment_ids, data.astype(np.float64))
+        return out, np.zeros_like(out)
+    if data.dtype == xp.float64:      # CPU jax backend: f64 is native
+        s = segment_sum(xp, data, segment_ids, num_segments)
+        return s, xp.zeros_like(s)
+    finite = xp.isfinite(data)
+    x = xp.where(finite, data, xp.zeros_like(data)).astype(xp.float32)
+    n_rows = data.shape[0]
+    absmax = xp.max(xp.abs(x)) if n_rows else xp.float32(0)
+    k = xp.floor(61.0 - xp.log2(xp.maximum(absmax, xp.float32(1e-30)) *
+                                (n_rows + 1)))
+    k = xp.clip(k, -96.0, 61.0).astype(xp.float32)
+    scale = xp.exp2(k)                # power of two ⇒ x*scale is EXACT
+    scaled = xp.round(x * scale).astype(xp.int64)
+    ints = segment_sum(xp, scaled, segment_ids, num_segments)
+    inv = xp.exp2(-k)
+    hi = ints.astype(xp.float32) * inv
+    resid = ints - xp.round(hi * scale).astype(xp.int64)
+    lo = resid.astype(xp.float32) * inv
+    nonfin = segment_sum(xp, xp.where(finite, xp.zeros_like(data), data),
+                         segment_ids, num_segments)
+    hi = hi + nonfin                  # 0 normally; propagates inf/nan
+    return hi, lo
+
+
+def two_float_add(xp, ahi, alo, bhi, blo):
+    """(ahi+alo) + (bhi+blo) as a renormalized two-float pair (Knuth
+    two-sum; XLA preserves IEEE ordering so the trick survives jit)."""
+    s = ahi + bhi
+    bb = s - ahi
+    err = (ahi - (s - bb)) + (bhi - bb)
+    e = err + alo + blo
+    hi = s + e
+    lo = e - (hi - s)
+    return hi, lo
+
+
 def segment_count(xp, mask, segment_ids, num_segments: int):
     """Count of True rows per segment → int64."""
     if _is_np(xp):

@@ -719,7 +719,8 @@ class Parser:
             kw = self.advance().value
             s = self.advance().value
             return ast.FuncCall(f"{kw}_literal", [ast.Literal(s, "str")])
-        if t.is_kw("replace", "left", "right", "database"):
+        if t.is_kw("replace", "left", "right", "database",
+                   "truncate", "mod"):
             # keywords that double as function names
             if self.toks[self.i + 1].kind == "op" and \
                     self.toks[self.i + 1].value == "(":

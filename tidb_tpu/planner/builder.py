@@ -32,14 +32,25 @@ from tidb_tpu.types import FieldType, TypeKind
 # scalar function names accepted from SQL (normalized spellings)
 _SCALAR_FUNCS = {
     "abs", "ceil", "ceiling", "floor", "round", "sqrt", "pow", "power",
+    "exp", "ln", "log", "log2", "log10", "sin", "cos", "tan", "cot",
+    "asin", "acos", "atan", "degrees", "radians", "pi", "sign", "truncate",
+    "greatest", "least", "mod",
     "length", "char_length", "character_length", "upper", "ucase", "lower",
     "lcase", "reverse", "ltrim", "rtrim", "trim", "ascii", "hex",
-    "year", "month", "dayofmonth", "day", "date",
+    "substr", "substring", "mid", "left", "right", "repeat", "replace",
+    "lpad", "rpad", "instr", "locate", "position", "substring_index",
+    "find_in_set", "concat", "strcmp", "space",
+    "year", "month", "dayofmonth", "day", "date", "datediff",
+    "date_add", "date_sub", "adddate", "subdate", "dayofweek", "weekday",
+    "dayofyear", "quarter", "week", "hour", "minute", "second",
+    "last_day", "dayname", "monthname",
     "if", "ifnull", "coalesce", "nullif", "isnull",
 }
 _CANON = {"ceiling": "ceil", "power": "pow", "ucase": "upper",
           "lcase": "lower", "character_length": "char_length",
-          "day": "dayofmonth"}
+          "day": "dayofmonth", "substring": "substr", "mid": "substr",
+          "position": "locate", "adddate": "date_add",
+          "subdate": "date_sub"}
 
 
 class SubqueryEvaluator:
@@ -100,6 +111,16 @@ class ExpressionRewriter:
                 return func("not", arg)
             raise PlanError(f"unknown unary op {node.op}")
         if isinstance(node, ast.BinaryOp):
+            # temporal arithmetic: d + INTERVAL n unit / d - INTERVAL n unit
+            if isinstance(node.right, ast.IntervalExpr) and \
+                    node.op in ("plus", "minus"):
+                return self._date_interval(
+                    _as_temporal(self.rewrite(node.left)), node.right,
+                    -1 if node.op == "minus" else 1)
+            if isinstance(node.left, ast.IntervalExpr) and \
+                    node.op == "plus":
+                return self._date_interval(
+                    _as_temporal(self.rewrite(node.right)), node.left, 1)
             left = self.rewrite(node.left)
             right = self.rewrite(node.right)
             left, right = _coerce_temporal_cmp(node.op, left, right)
@@ -147,13 +168,48 @@ class ExpressionRewriter:
                 f"aggregate function {name}() in a non-aggregate context")
         if name not in _SCALAR_FUNCS:
             raise PlanError(f"unsupported function: {node.name}")
+        if name in ("date_add", "date_sub"):
+            if len(node.args) != 2 or \
+                    not isinstance(node.args[1], ast.IntervalExpr):
+                raise PlanError(f"{name} expects (date, INTERVAL n unit)")
+            return self._date_interval(
+                _as_temporal(self.rewrite(node.args[0])), node.args[1],
+                -1 if name == "date_sub" else 1)
         args = [self.rewrite(a) for a in node.args]
+        if name in _DATE_ARG_FUNCS:
+            # implicit string→DATE cast of literal args (MySQL temporal
+            # coercion; ref: expression/builtin_time.go arg casting)
+            args = [_as_temporal(a) for a in args]
         if name == "nullif":
             # NULLIF(a,b) ≡ CASE WHEN a=b THEN NULL ELSE a
             a, b = args
             return ScalarFunc("if", [func("eq", a, b),
                                      Constant(None, a.ftype), a], a.ftype)
         return func(name, *args)
+
+    def _date_interval(self, d: Expression, iv: ast.IntervalExpr,
+                       sign: int) -> Expression:
+        """DATE_ADD/SUB → date_add_<unit>(date, n) (the unit rides in the
+        op name; DATE_SUB negates n). Time-unit arithmetic on a DATE
+        promotes to DATETIME (MySQL semantics)."""
+        from tidb_tpu.expression import INTERVAL_UNITS
+        from tidb_tpu.types import TypeKind
+        unit = iv.unit.lower()
+        if unit not in INTERVAL_UNITS:
+            raise PlanError(f"unsupported INTERVAL unit: {iv.unit}")
+        n = self.rewrite(iv.value)
+        if sign < 0:
+            if isinstance(n, Constant) and n.value is not None:
+                n = lit(-n.value, n.ftype)
+            else:
+                n = func("unary_minus", n)
+        ft = d.ftype
+        if unit in ("hour", "minute", "second", "microsecond") and \
+                ft.kind is TypeKind.DATE:
+            from tidb_tpu import types as _T
+            ft = _T.datetime(ft.nullable or n.ftype.nullable)
+        return ScalarFunc(f"date_add_{unit}", [d, n],
+                          ft.with_nullable(ft.nullable or n.ftype.nullable))
 
     # -- subqueries (eager) -------------------------------------------------
     def _require_subq(self):
@@ -648,6 +704,27 @@ def classify_join_conditions(conds: List[Expression], left_width: int):
 
 
 _CMP_OPS = {"eq", "ne", "lt", "le", "gt", "ge"}
+
+
+_DATE_ARG_FUNCS = {"datediff", "dayofweek", "weekday", "dayofyear",
+                   "quarter", "week", "last_day", "dayname", "monthname",
+                   "year", "month", "dayofmonth", "date", "hour", "minute",
+                   "second"}
+
+
+def _as_temporal(e: Expression) -> Expression:
+    """Fold a string literal into its DATE/DATETIME physical encoding."""
+    from tidb_tpu import types as _T
+    if isinstance(e, Constant) and e.ftype.kind.is_string \
+            and e.value is not None:
+        s = str(e.value)
+        try:
+            ft = (_T.datetime(False) if (" " in s or "T" in s)
+                  else _T.date(False))
+            return Constant(ft.decode_value(ft.encode_value(s)), ft)
+        except (ValueError, TypeError):
+            return e
+    return e
 
 
 def _coerce_temporal_cmp(op: str, left: Expression, right: Expression):
