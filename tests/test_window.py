@@ -1,0 +1,213 @@
+"""Window functions vs a row-at-a-time python oracle
+(ref: executor/window.go semantics; default RANGE frame with ties)."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.session import Engine
+
+
+@pytest.fixture(scope="module")
+def session():
+    eng = Engine()
+    s = eng.new_session()
+    s.execute("CREATE TABLE w (id BIGINT, g VARCHAR(4), o BIGINT, "
+              "x DOUBLE, d DECIMAL(8,2))")
+    rng = np.random.default_rng(17)
+    rows = []
+    for i in range(800):
+        g = "NULL" if rng.random() < 0.05 else \
+            f"'g{int(rng.integers(0, 6))}'"
+        o = "NULL" if rng.random() < 0.05 else str(int(rng.integers(0, 20)))
+        x = round(float(rng.normal(0, 10)), 3)
+        d = round(float(rng.uniform(0, 50)), 2)
+        rows.append(f"({i},{g},{o},{x},{d})")
+    s.execute("INSERT INTO w VALUES " + ",".join(rows))
+    return s
+
+
+def fetch(session, sql):
+    return session.query(sql).rows
+
+
+def _partitions(rows, gi):
+    parts = {}
+    for r in rows:
+        parts.setdefault(r[gi], []).append(r)
+    return parts
+
+
+def _okey(o):
+    # MySQL ASC NULLS FIRST total order for the oracle
+    return (0, 0) if o is None else (1, o)
+
+
+def test_row_number_rank_dense(session):
+    rows = fetch(session,
+                 "SELECT id, g, o, "
+                 "ROW_NUMBER() OVER (PARTITION BY g ORDER BY o), "
+                 "RANK() OVER (PARTITION BY g ORDER BY o), "
+                 "DENSE_RANK() OVER (PARTITION BY g ORDER BY o) FROM w")
+    for part in _partitions(rows, 1).values():
+        part.sort(key=lambda r: _okey(r[2]))
+        seen_orders = []
+        rank_of = {}
+        for i, r in enumerate(part):
+            if r[2] not in rank_of:
+                rank_of[r[2]] = i + 1
+                seen_orders.append(r[2])
+        rns = sorted(r[3] for r in part)
+        assert rns == list(range(1, len(part) + 1))
+        for r in part:
+            assert r[4] == rank_of[r[2]], r
+            assert r[5] == seen_orders.index(r[2]) + 1, r
+
+
+def test_full_partition_aggregates(session):
+    rows = fetch(session,
+                 "SELECT g, x, SUM(x) OVER (PARTITION BY g), "
+                 "COUNT(*) OVER (PARTITION BY g), "
+                 "MIN(x) OVER (PARTITION BY g), "
+                 "MAX(x) OVER (PARTITION BY g), "
+                 "AVG(d) OVER (PARTITION BY g) FROM w")
+    for part in _partitions(rows, 0).values():
+        xs = [r[1] for r in part]
+        for r in part:
+            assert r[2] == pytest.approx(sum(xs), rel=1e-9)
+            assert r[3] == len(part)
+            assert r[4] == pytest.approx(min(xs))
+            assert r[5] == pytest.approx(max(xs))
+
+
+def test_running_sum_with_ties(session):
+    rows = fetch(session,
+                 "SELECT g, o, x, SUM(x) OVER (PARTITION BY g ORDER BY o) "
+                 "FROM w")
+    for part in _partitions(rows, 0).values():
+        part.sort(key=lambda r: _okey(r[1]))
+        for r in part:
+            # RANGE frame: all rows with o <= current o (peers included)
+            expect = sum(p[2] for p in part
+                         if _okey(p[1]) <= _okey(r[1]))
+            assert r[3] == pytest.approx(expect, rel=1e-9), (r, expect)
+
+
+def test_lag_lead(session):
+    rows = fetch(session,
+                 "SELECT id, g, o, x, "
+                 "LAG(x) OVER (PARTITION BY g ORDER BY o, id), "
+                 "LEAD(x, 2, 0.5) OVER (PARTITION BY g ORDER BY o, id) "
+                 "FROM w")
+    for part in _partitions(rows, 1).values():
+        part.sort(key=lambda r: (_okey(r[2]), r[0]))
+        for i, r in enumerate(part):
+            expect_lag = part[i - 1][3] if i >= 1 else None
+            assert r[4] == (pytest.approx(expect_lag)
+                            if expect_lag is not None else None), r
+            expect_lead = part[i + 2][3] if i + 2 < len(part) else 0.5
+            assert r[5] == pytest.approx(expect_lead), r
+
+
+def test_running_min_max(session):
+    rows = fetch(session,
+                 "SELECT g, o, x, MIN(x) OVER (PARTITION BY g ORDER BY o), "
+                 "MAX(x) OVER (PARTITION BY g ORDER BY o) FROM w")
+    for part in _partitions(rows, 0).values():
+        part.sort(key=lambda r: _okey(r[1]))
+        for r in part:
+            frame = [p[2] for p in part if _okey(p[1]) <= _okey(r[1])]
+            assert r[3] == pytest.approx(min(frame)), r
+            assert r[4] == pytest.approx(max(frame)), r
+
+
+def test_window_desc_order(session):
+    rows = fetch(session,
+                 "SELECT g, o, ROW_NUMBER() OVER "
+                 "(PARTITION BY g ORDER BY o DESC) FROM w "
+                 "WHERE o IS NOT NULL")
+    for part in _partitions(rows, 0).values():
+        part.sort(key=lambda r: -r[1])
+        by_rn = sorted(part, key=lambda r: r[2])
+        os = [r[1] for r in by_rn]
+        assert os == sorted(os, reverse=True)
+
+
+def test_no_partition(session):
+    rows = fetch(session, "SELECT id, ROW_NUMBER() OVER (ORDER BY id) "
+                          "FROM w")
+    rows.sort(key=lambda r: r[0])
+    for i, r in enumerate(rows):
+        assert r[1] == i + 1
+
+
+def test_window_with_arithmetic_and_alias(session):
+    rows = fetch(session,
+                 "SELECT g, RANK() OVER (PARTITION BY g ORDER BY o) + 100 "
+                 "AS r100 FROM w")
+    assert all(r[1] >= 101 for r in rows)
+
+
+def test_window_in_where_rejected(session):
+    from tidb_tpu.errors import TiDBTPUError
+    with pytest.raises(TiDBTPUError):
+        session.query("SELECT id FROM w "
+                      "WHERE ROW_NUMBER() OVER (ORDER BY id) < 5")
+
+
+def test_empty_input(session):
+    rows = fetch(session, "SELECT g, ROW_NUMBER() OVER (ORDER BY o) "
+                          "FROM w WHERE id < 0")
+    assert rows == []
+
+
+# ---- device differential (fragment engine window root) ---------------------
+
+DEVICE_WINDOW_QUERIES = [
+    "SELECT g, o, id, ROW_NUMBER() OVER (PARTITION BY g ORDER BY o, id) "
+    "FROM w",
+    "SELECT g, o, RANK() OVER (PARTITION BY g ORDER BY o), "
+    "DENSE_RANK() OVER (PARTITION BY g ORDER BY o) FROM w",
+    "SELECT g, SUM(x) OVER (PARTITION BY g), "
+    "COUNT(*) OVER (PARTITION BY g), MIN(x) OVER (PARTITION BY g) FROM w",
+    "SELECT g, o, SUM(x) OVER (PARTITION BY g ORDER BY o) FROM w",
+    "SELECT g, o, MIN(x) OVER (PARTITION BY g ORDER BY o), "
+    "MAX(x) OVER (PARTITION BY g ORDER BY o) FROM w",
+    "SELECT g, o, id, LAG(x) OVER (PARTITION BY g ORDER BY o, id), "
+    "LEAD(x, 2, 0.25) OVER (PARTITION BY g ORDER BY o, id) FROM w",
+]
+
+
+@pytest.mark.parametrize("sql", DEVICE_WINDOW_QUERIES)
+def test_device_window_matches_cpu(session, sql):
+    from tidb_tpu.executor import build, run_to_completion
+    from tidb_tpu.executor.fragment import TpuFragmentExec
+    from tidb_tpu.parser import parse
+    s = session
+    cpu = s.query(sql).rows
+    s.vars["tidb_tpu_engine"] = "on"
+    s.vars["tidb_tpu_row_threshold"] = 1
+    try:
+        plan = s._plan(parse(sql)[0])
+        root = build(plan)
+        chunks = run_to_completion(root, s._exec_ctx())
+        frags = []
+
+        def walk(e):
+            if isinstance(e, TpuFragmentExec):
+                frags.append(e)
+            for c in getattr(e, "children", []):
+                walk(c)
+
+        walk(root)
+        assert frags and all(f.used_device for f in frags), \
+            [f.fallback_reason for f in frags]
+        dev = [r for ch in chunks for r in ch.rows()]
+    finally:
+        s.vars["tidb_tpu_engine"] = "off"
+    assert len(dev) == len(cpu)
+    for a, b in zip(sorted(cpu, key=str), sorted(dev, key=str)):
+        for x, y in zip(a, b):
+            if isinstance(x, float) and y is not None:
+                assert abs(x - y) <= 1e-4 * max(1.0, abs(x)), (a, b)
+            else:
+                assert x == y, (a, b)

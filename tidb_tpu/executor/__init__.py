@@ -27,7 +27,8 @@ from tidb_tpu.planner.physical import (PhysDual, PhysHashAgg, PhysHashJoin,
                                        PhysLimit, PhysProjection,
                                        PhysSelection, PhysSort, PhysTableScan,
                                        PhysTopN, PhysTpuFragment,
-                                       PhysUnionAll, PhysicalPlan)
+                                       PhysUnionAll, PhysWindow,
+                                       PhysicalPlan)
 from tidb_tpu.types import FieldType
 
 
@@ -294,6 +295,9 @@ def build(plan: PhysicalPlan) -> Executor:
         return HashAggExec(plan, kids[0])
     if isinstance(plan, PhysHashJoin):
         return HashJoinExec(plan, kids[0], kids[1])
+    if isinstance(plan, PhysWindow):
+        from tidb_tpu.executor.window import WindowExec
+        return WindowExec(plan, kids[0])
     if isinstance(plan, PhysSort):
         return SortExec(plan.by, plan.descs, kids[0])
     if isinstance(plan, PhysTopN):

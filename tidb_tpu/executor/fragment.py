@@ -46,7 +46,7 @@ from tidb_tpu.expression import EvalContext, Expression, ColumnRef
 from tidb_tpu.expression.aggfuncs import AggFunc, build_agg
 from tidb_tpu.planner.physical import (PhysHashAgg, PhysProjection,
                                        PhysSelection, PhysSort, PhysTableScan,
-                                       PhysTopN, PhysTpuFragment,
+                                       PhysTopN, PhysTpuFragment, PhysWindow,
                                        PhysicalPlan)
 from tidb_tpu.types import FieldType
 
@@ -80,7 +80,7 @@ def _linearize(root: PhysicalPlan) -> Optional[List[PhysicalPlan]]:
             return nodes
         mid_ok = isinstance(cur, (PhysSelection, PhysProjection))
         root_ok = cur is root and isinstance(cur, (PhysHashAgg, PhysTopN,
-                                                   PhysSort))
+                                                   PhysSort, PhysWindow))
         if not (mid_ok or root_ok) or len(cur.children) != 1:
             return None
         cur = cur.children[0]
@@ -133,6 +133,10 @@ def _fragment_ok(plan: PhysicalPlan, threshold: int) -> bool:
         elif isinstance(node, (PhysTopN, PhysSort)):
             if not _string_exprs_are_refs(node.by):
                 return False
+        elif isinstance(node, PhysWindow):
+            if not _window_device_ok(node):
+                return False
+            worthwhile = True
         elif isinstance(node, PhysSelection):
             worthwhile = True
         elif isinstance(node, PhysProjection):
@@ -141,6 +145,21 @@ def _fragment_ok(plan: PhysicalPlan, threshold: int) -> bool:
             if any(not isinstance(e, ColumnRef) for e in node.exprs):
                 worthwhile = True
     return worthwhile
+
+
+_DEVICE_WINDOW_FUNCS = ("row_number", "rank", "dense_rank", "sum",
+                        "count", "avg", "min", "max", "lag", "lead")
+
+
+def _window_device_ok(node: PhysWindow) -> bool:
+    for d in node.wdescs:
+        if d.name not in _DEVICE_WINDOW_FUNCS:
+            return False
+        if d.args and d.args[0].ftype.kind.is_string:
+            return False            # string lag/lead needs dict passthrough
+        if not _string_exprs_are_refs(list(d.partition) + list(d.order)):
+            return False
+    return True
 
 
 def extract_fragments(plan: PhysicalPlan, threshold: int) -> PhysicalPlan:
@@ -210,6 +229,8 @@ def _chain_signature(chain: List[PhysicalPlan], used_cols: Sequence[int],
             off = getattr(node, "offset", 0)
             parts.append(f"{type(node).__name__}(by={node.by!r}, "
                          f"descs={node.descs}, k={k}, off={off})")
+        elif isinstance(node, PhysWindow):
+            parts.append(f"Window({node.wdescs!r})")
     return "|".join(parts)
 
 
@@ -247,6 +268,12 @@ def _used_column_indices(chain: List[PhysicalPlan]) -> List[int]:
             # sort/topn emit every child column
             n_cols = len(node.schema)
             used.update(range(n_cols))
+        elif isinstance(node, PhysWindow):
+            n_child = len(node.children[0].schema)
+            used.update(range(n_child))   # window emits every child column
+            for d in node.wdescs:
+                for e in list(d.args) + list(d.partition) + list(d.order):
+                    used.update(e.references())
     return sorted(used)
 
 
@@ -265,6 +292,13 @@ def _stage_exprs(node: PhysicalPlan) -> List[Expression]:
         return out
     if isinstance(node, (PhysTopN, PhysSort)):
         return list(node.by)
+    if isinstance(node, PhysWindow):
+        out: List[Expression] = []
+        for d in node.wdescs:
+            out.extend(d.args)
+            out.extend(d.partition)
+            out.extend(d.order)
+        return out
     return []
 
 
@@ -363,10 +397,92 @@ class _FragmentProgram:
             gathered = [(jnp.asarray(v)[idx], jnp.asarray(m)[idx])
                         for v, m in out_cols]
             return {"cols": gathered, "n_out": n_out}
+        if isinstance(root, PhysWindow):
+            return self._window_partial(ctx, live, root)
         # Selection/Projection root: columns + live mask, host compacts
         out_cols = [ctx.column(i) for i in range(len(root.schema))]
         return {"cols": [(jnp.asarray(v), jnp.asarray(m))
                          for v, m in out_cols], "live": live}
+
+    def _window_partial(self, ctx, live, root: PhysWindow):
+        """Window root on device: one lax.sort per distinct spec, then the
+        cumulative/segment primitives of ops/window.py traced with jnp
+        (the whole-column reformulation of executor/window.go)."""
+        from tidb_tpu.ops.jax_env import jnp
+        from tidb_tpu.ops import factorize as F
+        from tidb_tpu.ops import window as W
+        from tidb_tpu.types import TypeKind
+        n = self.slab_cap
+        n_child = len(root.children[0].schema)
+        out_cols = [ctx.column(i) for i in range(n_child)]
+        layouts = {}
+        for d in root.wdescs:
+            lkey = repr((d.partition, d.order, d.descs))
+            layout = layouts.get(lkey)
+            if layout is None:
+                pkeys = [e.eval(ctx) for e in d.partition]
+                okeys = [e.eval(ctx) for e in d.order]
+                perm, _ = F.sort_perm(pkeys + okeys,
+                                      [False] * len(pkeys) + list(d.descs),
+                                      live)
+                lives_s = jnp.take(live, perm)
+                first = jnp.zeros(n, dtype=bool).at[0].set(True)
+
+                def flags(cols):
+                    out = first | jnp.concatenate(
+                        [jnp.zeros(1, dtype=bool),
+                         lives_s[1:] != lives_s[:-1]])
+                    for v, m in cols:
+                        vs = jnp.take(jnp.asarray(v), perm)
+                        ms = jnp.take(jnp.asarray(m), perm)
+                        # NULL slots hold garbage values: neutralize so all
+                        # NULLs form ONE group (SQL GROUP/PARTITION NULLs)
+                        vs = jnp.where(ms, vs, jnp.zeros_like(vs))
+                        out = out | jnp.concatenate(
+                            [jnp.zeros(1, dtype=bool),
+                             (vs[1:] != vs[:-1]) | (ms[1:] != ms[:-1])])
+                    return out
+
+                pstart = flags(pkeys)
+                peerstart = flags(pkeys + okeys) if okeys else pstart
+                layout = (perm, pstart, peerstart)
+                layouts[lkey] = layout
+            perm, pstart, peerstart = layout
+            v, m = self._window_value(ctx, live, d, n, perm, pstart,
+                                      peerstart)
+            back_v = jnp.zeros(n, dtype=v.dtype).at[perm].set(v)
+            back_m = jnp.zeros(n, dtype=bool).at[perm].set(m)
+            out_cols.append((back_v, back_m & live))
+        return {"cols": [(jnp.asarray(v), jnp.asarray(m))
+                         for v, m in out_cols], "live": live}
+
+    def _window_value(self, ctx, live, d, n, perm, pstart, peerstart):
+        from tidb_tpu.ops.jax_env import jnp
+        from tidb_tpu.ops import window as W
+        from tidb_tpu.types import TypeKind
+        vals = valid = fill = None
+        if d.args:
+            v, m = d.args[0].eval(ctx)
+            vals = jnp.take(jnp.asarray(v), perm)
+            valid = jnp.take(jnp.asarray(m) & live, perm)
+        elif d.name not in ("row_number", "rank", "dense_rank"):
+            vals = jnp.zeros(n, dtype=jnp.int64)        # COUNT(*)
+            valid = jnp.take(live, perm)
+        if d.name in ("lag", "lead"):
+            if d.default is not None and d.default.value is not None:
+                fv = d.args[0].ftype.encode_value(d.default.value)
+                fill = (jnp.full(n, fv, dtype=vals.dtype),
+                        jnp.ones(n, dtype=bool))
+            else:
+                fill = (jnp.zeros(n, dtype=vals.dtype),
+                        jnp.zeros(n, dtype=bool))
+        if d.name == "avg" and d.args and \
+                d.args[0].ftype.kind is TypeKind.DECIMAL:
+            from tidb_tpu.ops.jax_env import device_float_dtype
+            vals = vals.astype(device_float_dtype()) / \
+                d.args[0].ftype.decimal_multiplier
+        return W.compute(jnp, d.name, vals, valid, pstart, peerstart,
+                         bool(d.order), d.offset, fill)
 
     def _agg_partial_perfect(self, ctx, live, root: PhysHashAgg):
         """Stats-informed grouping without sorting: group-key domains are
@@ -708,6 +824,9 @@ class TpuFragmentExec:
         root = chain[0]
         if isinstance(root, PhysSort) and n_slabs > 1:
             raise FragmentFallback("multi-slab global sort")
+        if isinstance(root, PhysWindow) and n_slabs > 1:
+            # partitions span slabs; no cross-slab merge for windows yet
+            raise FragmentFallback("multi-slab window")
 
         # stats-informed grouping: small known key domains skip the sort
         key_bounds = _agg_key_bounds(chain, ent)

@@ -1,0 +1,140 @@
+"""Window-function executor (ref: executor/window.go:31).
+
+Blocking operator: drains the child, sorts once per distinct window spec
+by (partition, order) keys with MySQL NULL ordering, computes every
+window column via the whole-column primitives in ops/window.py, and
+scatters results back to the original row order. The reference streams
+partition groups through per-function slide states (pipelined_window.go);
+the columnar formulation is one sort + cumulative ops — the same code
+path the device engine traces.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tidb_tpu.chunk import Chunk, Column
+from tidb_tpu.executor import Executor, _empty_chunk
+from tidb_tpu.expression import EvalContext
+from tidb_tpu.expression.runner import host_context
+from tidb_tpu.ops import window as W
+from tidb_tpu.planner.physical import PhysWindow
+from tidb_tpu.types import TypeKind
+
+
+class WindowExec(Executor):
+    def __init__(self, plan: PhysWindow, child: Executor):
+        super().__init__(plan.schema.field_types, [child])
+        self.plan = plan
+        self._result: Optional[Chunk] = None
+        self._offset = 0
+
+    def open(self, ctx):
+        super().open(ctx)
+        self._result = None
+        self._offset = 0
+
+    def next(self) -> Optional[Chunk]:
+        if self._result is None:
+            self._result = self._compute()
+        if self._offset >= self._result.num_rows:
+            return None
+        size = self.ctx.chunk_size
+        out = self._result.slice(
+            self._offset, min(self._offset + size, self._result.num_rows))
+        self._offset += out.num_rows
+        return out
+
+    # ------------------------------------------------------------------
+    def _compute(self) -> Chunk:
+        chunks = []
+        while True:
+            ch = self.child_next()
+            if ch is None:
+                break
+            if ch.num_rows:
+                chunks.append(ch)
+        if not chunks:
+            return _empty_chunk(self.schema)
+        inp = Chunk.concat(chunks) if len(chunks) > 1 else chunks[0]
+        ctx = host_context(inp)
+        n = inp.num_rows
+
+        sort_cache: Dict[str, Tuple] = {}
+        out_cols = list(inp.columns)
+        for d in self.plan.wdescs:
+            key = repr((d.partition, d.order, d.descs))
+            layout = sort_cache.get(key)
+            if layout is None:
+                layout = _sorted_layout(inp, n, d)
+                sort_cache[key] = layout
+            sidx, pstart, peerstart = layout
+            v, m = self._one(d, ctx, n, sidx, pstart, peerstart)
+            back_v = np.empty_like(v)
+            back_v[sidx] = v
+            back_m = np.empty(n, dtype=bool)
+            back_m[sidx] = m
+            if d.ftype.is_varlen:
+                back_v = np.asarray(back_v, dtype=object)
+            elif back_v.dtype != d.ftype.np_dtype:
+                back_v = back_v.astype(d.ftype.np_dtype)
+            out_cols.append(Column(d.ftype, back_v,
+                                   None if back_m.all() else back_m))
+        return Chunk(out_cols)
+
+    def _one(self, d, ctx, n, sidx, pstart, peerstart):
+        vals = valid = fill = None
+        if d.args:
+            v, m = d.args[0].eval(ctx)
+            vals = np.asarray(v)[sidx]
+            valid = np.asarray(m, dtype=bool)[sidx]
+        elif d.name not in ("row_number", "rank", "dense_rank"):
+            vals = np.zeros(n, dtype=np.int64)      # COUNT(*)
+            valid = np.ones(n, dtype=bool)
+        if d.name in ("lag", "lead"):
+            if d.default is not None and d.default.value is not None:
+                fv = d.args[0].ftype.encode_value(d.default.value)
+                fill = (np.full(n, fv,
+                                dtype=object if vals.dtype == object
+                                else vals.dtype),
+                        np.ones(n, dtype=bool))
+            else:
+                fill = (np.zeros(n, dtype=vals.dtype)
+                        if vals.dtype != object
+                        else np.full(n, "", dtype=object),
+                        np.zeros(n, dtype=bool))
+        if d.name == "avg" and d.args and \
+                d.args[0].ftype.kind is TypeKind.DECIMAL:
+            vals = vals.astype(np.float64) / \
+                d.args[0].ftype.decimal_multiplier
+        return W.compute(np, d.name, vals, valid, pstart, peerstart,
+                         bool(d.order), d.offset, fill)
+
+
+def _sorted_layout(chunk: Chunk, n: int, d):
+    """→ (sidx, pstart, peerstart) for one window spec. Rank-encoded keys
+    (executor/sort.rank_keys) bake in direction and MySQL NULL ordering,
+    so boundary detection is a plain code comparison."""
+    from tidb_tpu.executor.sort import rank_keys
+    pkeys = rank_keys(list(d.partition), [False] * len(d.partition), chunk)
+    okeys = rank_keys(list(d.order), list(d.descs), chunk)
+    all_keys = pkeys + okeys
+    if all_keys:
+        sidx = np.lexsort(tuple(reversed(all_keys)))
+    else:
+        sidx = np.arange(n, dtype=np.int64)
+
+    def changes(keys) -> np.ndarray:
+        out = np.zeros(n, dtype=bool)
+        if n:
+            out[0] = True
+        for k in keys:
+            ks = k[sidx]
+            out[1:] |= ks[1:] != ks[:-1]
+        return out
+
+    pstart = changes(pkeys)
+    peerstart = changes(all_keys) if okeys else pstart
+    return sidx, pstart, peerstart

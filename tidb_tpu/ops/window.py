@@ -1,0 +1,198 @@
+"""Window-function computation over sorted partitions.
+
+Ref: executor/window.go:31 + executor/aggfuncs window functions. The
+reference streams partition groups through per-function slide/accumulate
+state machines; the TPU-first formulation is whole-column: ONE sort by
+(partition, order) keys, then every window function is a composition of
+cumulative/segment primitives over the sorted layout — no per-row state,
+no Python loop, and the same code traces under jit for the device path
+(`xp` is numpy or jax.numpy).
+
+All helpers take the SORTED layout:
+  pstart (n,) bool — True at the first row of each partition;
+  peerstart (n,) bool — True at the first row of each peer group (rows
+  equal on partition + order keys); pstart ⊆ peerstart.
+Results are aligned to the sorted layout; callers scatter back through
+the sort permutation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _iota(xp, n):
+    return xp.arange(n, dtype=xp.int64)
+
+
+def _cummax(xp, a):
+    if xp is np:
+        return np.maximum.accumulate(a)
+    from tidb_tpu.ops.jax_env import lax
+    return lax.associative_scan(lax.max, a)
+
+
+def _pstart_pos(xp, pstart):
+    """Index of the owning partition's first row, per row."""
+    n = pstart.shape[0]
+    return _cummax(xp, xp.where(pstart, _iota(xp, n), xp.int64(0)))
+
+
+def row_number(xp, pstart):
+    n = pstart.shape[0]
+    return _iota(xp, n) - _pstart_pos(xp, pstart) + 1
+
+
+def rank(xp, pstart, peerstart):
+    n = pstart.shape[0]
+    peer_pos = _cummax(xp, xp.where(peerstart, _iota(xp, n), xp.int64(0)))
+    return peer_pos - _pstart_pos(xp, pstart) + 1
+
+
+def dense_rank(xp, pstart, peerstart):
+    cp = xp.cumsum(peerstart.astype(xp.int64))
+    pp = _pstart_pos(xp, pstart)
+    cp_at_pstart = xp.take(cp, pp)
+    return cp - cp_at_pstart + 1
+
+
+def partition_ids(xp, pstart):
+    return xp.cumsum(pstart.astype(xp.int64)) - 1
+
+
+def full_frame_agg(xp, name, vals, valid, pstart, num_partitions: int):
+    """Whole-partition aggregate broadcast back to every row
+    (OVER (PARTITION BY …) without ORDER BY)."""
+    from tidb_tpu.ops import segment as seg
+    pid = partition_ids(xp, pstart)
+    if name == "count":
+        per = seg.segment_count(xp, valid, pid, num_partitions)
+        return xp.take(per, pid), xp.ones_like(valid)
+    z = xp.where(valid, vals, xp.zeros_like(vals))
+    if name in ("sum", "avg"):
+        s = seg.segment_sum(xp, z, pid, num_partitions)
+        c = seg.segment_count(xp, valid, pid, num_partitions)
+        sv = xp.take(s, pid)
+        cv = xp.take(c, pid)
+        if name == "sum":
+            return sv, cv > 0
+        safe = xp.where(cv > 0, cv, xp.ones_like(cv))
+        return sv / safe.astype(sv.dtype) if sv.dtype.kind == "f" \
+            else sv / safe, cv > 0
+    if name in ("min", "max"):
+        fn = seg.segment_min if name == "min" else seg.segment_max
+        ident = seg._max_identity(vals.dtype) if name == "min" \
+            else seg._min_identity(vals.dtype)
+        masked = xp.where(valid, vals, xp.asarray(ident, dtype=vals.dtype))
+        per = fn(xp, masked, pid, num_partitions)
+        c = seg.segment_count(xp, valid, pid, num_partitions)
+        return xp.take(per, pid), xp.take(c, pid) > 0
+    raise AssertionError(f"unsupported window aggregate {name}")
+
+
+def _segmented_scan(xp, vals, pstart, op):
+    """Inclusive per-partition prefix scan (Hillis–Steele with a segment
+    guard): log₂(n) vectorized passes, identical host/device."""
+    n = vals.shape[0]
+    pos = _pstart_pos(xp, pstart)
+    iota = _iota(xp, n)
+    out = vals
+    k = 1
+    while k < n:
+        idx = iota - k
+        ok = idx >= pos
+        prev = xp.take(out, xp.clip(idx, 0, n - 1))
+        out = xp.where(ok, op(out, prev), out)
+        k <<= 1
+    return out
+
+
+def running_agg(xp, name, vals, valid, pstart, peerstart):
+    """Default frame with ORDER BY: RANGE UNBOUNDED PRECEDING..CURRENT ROW
+    — cumulative including the whole current peer group (ties share)."""
+    from tidb_tpu.ops import segment as seg
+    n = vals.shape[0]
+    ccnt = xp.cumsum(valid.astype(xp.int64))
+    pp = _pstart_pos(xp, pstart)
+    base_c = xp.where(pp > 0, xp.take(ccnt, xp.maximum(pp - 1, 0)),
+                      xp.int64(0))
+    # frame end = last row of the current peer group
+    nxt = _next_peerstart_pos(xp, peerstart)
+    c = xp.take(ccnt, nxt) - base_c
+    if name == "count":
+        return c, xp.ones(n, dtype=bool)
+    if name in ("min", "max"):
+        ident = seg._max_identity(vals.dtype) if name == "min" \
+            else seg._min_identity(vals.dtype)
+        masked = xp.where(valid, vals, xp.asarray(ident, dtype=vals.dtype))
+        op = xp.minimum if name == "min" else xp.maximum
+        scan = _segmented_scan(xp, masked, pstart, op)
+        return xp.take(scan, nxt), c > 0
+    z = xp.where(valid, vals, xp.zeros_like(vals))
+    # host promotes float cumsum to f64; the device keeps its float dtype
+    # (TPU has no native f64 — error stays bounded by partition size)
+    acc_dt = (xp.float64 if xp is np else z.dtype) \
+        if z.dtype.kind == "f" else xp.int64
+    cum = xp.cumsum(z.astype(acc_dt))
+    # exclusive prefix before the partition start
+    base = xp.where(pp > 0, xp.take(cum, xp.maximum(pp - 1, 0)),
+                    xp.zeros((), dtype=cum.dtype))
+    s = xp.take(cum, nxt) - base
+    if name == "sum":
+        return s, c > 0
+    if name == "avg":
+        safe = xp.where(c > 0, c, xp.ones_like(c))
+        return s / safe.astype(s.dtype) if s.dtype.kind == "f" else s / safe, \
+            c > 0
+    raise AssertionError(f"running {name} is not supported")
+
+
+def compute(xp, name, vals, valid, pstart, peerstart, has_order: bool,
+            offset: int = 1, fill=None):
+    """Shared dispatch for host (numpy) and device (jnp) window columns.
+    vals/valid are the function argument in SORTED layout (None for the
+    rank family); fill = (fill_vals, fill_valid) for lag/lead."""
+    n = pstart.shape[0]
+    ones = xp.ones(n, dtype=bool)
+    if name == "row_number":
+        return row_number(xp, pstart), ones
+    if name == "rank":
+        return rank(xp, pstart, peerstart), ones
+    if name == "dense_rank":
+        return dense_rank(xp, pstart, peerstart), ones
+    if name in ("lag", "lead"):
+        off = offset if name == "lag" else -offset
+        return shifted(xp, vals, valid, pstart, off, fill[0], fill[1])
+    if has_order:
+        return running_agg(xp, name, vals, valid, pstart, peerstart)
+    return full_frame_agg(xp, name, vals, valid, pstart, n)
+
+
+def _next_peerstart_pos(xp, peerstart):
+    """Index of the LAST row of each row's peer group."""
+    from tidb_tpu.ops import segment as seg
+    n = peerstart.shape[0]
+    iota = _iota(xp, n)
+    peer_id = xp.cumsum(peerstart.astype(xp.int64)) - 1
+    last = seg.segment_max(xp, iota, peer_id.astype(xp.int32)
+                           if xp is not np else peer_id, n)
+    return xp.take(last, peer_id)
+
+
+def shifted(xp, vals, valid, pstart, offset: int, fill_vals, fill_valid):
+    """LAG (offset>0) / LEAD (offset<0) within partitions, sorted layout."""
+    from tidb_tpu.ops import segment as seg
+    n = vals.shape[0]
+    iota = _iota(xp, n)
+    src = iota - offset
+    if offset > 0:
+        ok = src >= _pstart_pos(xp, pstart)    # same partition, in range
+    else:
+        pid = partition_ids(xp, pstart)
+        last = seg.segment_max(xp, iota, pid.astype(xp.int32)
+                               if xp is not np else pid, n)
+        ok = src <= xp.take(last, pid)
+    safe = xp.clip(src, 0, n - 1)
+    out_v = xp.where(ok, xp.take(vals, safe), fill_vals)
+    out_m = xp.where(ok, xp.take(valid, safe), fill_valid)
+    return out_v, out_m

@@ -70,6 +70,14 @@ class FuncCall(ExprNode):
     name: str
     args: List[ExprNode]
     distinct: bool = False
+    window: Optional["WindowSpec"] = None
+
+
+@dataclass
+class WindowSpec(ExprNode):
+    """OVER (PARTITION BY … ORDER BY …) — ref: parser/ast WindowSpec."""
+    partition_by: List[ExprNode]
+    order_by: List[Tuple[ExprNode, bool]]   # (expr, desc)
 
 
 @dataclass

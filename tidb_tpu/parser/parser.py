@@ -744,15 +744,43 @@ class Parser:
         self.expect_op("(")
         if self.try_op("*"):
             self.expect_op(")")
-            return ast.FuncCall(name, [ast.Star()])
+            return self._maybe_window(ast.FuncCall(name, [ast.Star()]))
         if self.try_op(")"):
-            return ast.FuncCall(name, [])
+            return self._maybe_window(ast.FuncCall(name, []))
         distinct = bool(self.try_kw("distinct"))
         args = [self.expr()]
         while self.try_op(","):
             args.append(self.expr())
         self.expect_op(")")
-        return ast.FuncCall(name, args, distinct)
+        return self._maybe_window(ast.FuncCall(name, args, distinct))
+
+    def _maybe_window(self, call: ast.FuncCall) -> ast.FuncCall:
+        """OVER (PARTITION BY … ORDER BY …) window attachment."""
+        if not self.try_kw("over"):
+            return call
+        self.expect_op("(")
+        partition: list = []
+        order: list = []
+        if self.try_kw("partition"):
+            self.expect_kw("by")
+            partition.append(self.expr())
+            while self.try_op(","):
+                partition.append(self.expr())
+        if self.try_kw("order"):
+            self.expect_kw("by")
+            while True:
+                e = self.expr()
+                desc = False
+                if self.try_kw("desc"):
+                    desc = True
+                elif self.try_kw("asc"):
+                    pass
+                order.append((e, desc))
+                if not self.try_op(","):
+                    break
+        self.expect_op(")")
+        call.window = ast.WindowSpec(partition, order)
+        return call
 
     def case_expr(self) -> ast.CaseExpr:
         self.expect_kw("case")

@@ -72,10 +72,12 @@ class ExpressionRewriter:
     def __init__(self, schema: Schema,
                  subq: Optional[SubqueryEvaluator] = None,
                  agg_ctx: Optional["AggContext"] = None,
-                 outer: Optional["ExpressionRewriter"] = None):
+                 outer: Optional["ExpressionRewriter"] = None,
+                 window_map: Optional[Dict[int, Expression]] = None):
         self.schema = schema
         self.subq = subq
         self.agg_ctx = agg_ctx
+        self.window_map = window_map or {}
 
     # -- entry -------------------------------------------------------------
     def rewrite(self, node: ast.ExprNode) -> Expression:
@@ -96,6 +98,12 @@ class ExpressionRewriter:
 
     # -- dispatch ----------------------------------------------------------
     def _dispatch(self, node: ast.ExprNode) -> Expression:
+        if isinstance(node, ast.FuncCall) and node.window is not None:
+            hit = self.window_map.get(id(node))
+            if hit is None:
+                raise PlanError(
+                    "window function not allowed in this context")
+            return hit
         if isinstance(node, ast.Literal):
             return self._literal(node)
         if isinstance(node, ast.Name):
@@ -340,9 +348,42 @@ class AggContext:
                                   self.group_names)
 
 
+_WINDOW_FUNCS = {"row_number", "rank", "dense_rank", "sum", "count", "avg",
+                 "min", "max", "lag", "lead"}
+
+
+def _collect_windows(node: ast.Node, out: List) -> None:
+    """Gather windowed FuncCall nodes (DFS; a window call's own args are
+    not searched — nested windows are invalid anyway)."""
+    if isinstance(node, ast.FuncCall):
+        if node.window is not None:
+            out.append(node)
+            return
+        for a in node.args:
+            _collect_windows(a, out)
+        return
+    for attr in ("operand", "expr", "left", "right", "low", "high",
+                 "pattern", "else_"):
+        v = getattr(node, attr, None)
+        if isinstance(v, ast.Node):
+            _collect_windows(v, out)
+    for attr in ("whens", "items"):
+        v = getattr(node, attr, None)
+        if isinstance(v, list):
+            for x in v:
+                if isinstance(x, tuple):
+                    for y in x:
+                        if isinstance(y, ast.Node):
+                            _collect_windows(y, out)
+                elif isinstance(x, ast.Node):
+                    _collect_windows(x, out)
+
+
 def _has_agg(node: ast.Node) -> bool:
     """Does this expression subtree contain an aggregate call?"""
     if isinstance(node, ast.FuncCall):
+        if node.window is not None:
+            return False             # windowed call: not an aggregate
         if node.name.lower() in AGG_NAMES:
             return True
         return any(_has_agg(a) for a in node.args)
@@ -432,11 +473,22 @@ class PlanBuilder:
             (sel.having is not None and _has_agg(sel.having)) or \
             any(_has_agg(e) for e, _ in sel.order_by)
 
+        win_calls = []
+        for it in items:
+            _collect_windows(it.expr, win_calls)
+        if win_calls and needs_agg:
+            raise PlanError("window functions over aggregated queries "
+                            "are not supported yet")
+
         if needs_agg:
             plan, proj_exprs, names, pre_rw = self._build_aggregation(
                 sel, items, plan)
         else:
-            pre_rw = ExpressionRewriter(plan.schema, self.subq)
+            window_map: Dict[int, Expression] = {}
+            if win_calls:
+                plan = self._build_window(win_calls, plan, window_map)
+            pre_rw = ExpressionRewriter(plan.schema, self.subq,
+                                        window_map=window_map)
             proj_exprs = [pre_rw.rewrite(it.expr) for it in items]
             names = [self._item_name(it) for it in items]
             if sel.having is not None:
@@ -484,6 +536,71 @@ class PlanBuilder:
                 refs, names, out,
                 self._item_qualifiers(items, plan.schema))
         return out
+
+    def _build_window(self, win_calls, plan: LogicalPlan,
+                      window_map: Dict[int, Expression]) -> LogicalPlan:
+        """Windowed calls → one LogicalWindow appending a column per call
+        (ref: planner/core/logical_plan_builder.go buildWindowFunctions)."""
+        from tidb_tpu.expression.aggfuncs import infer_agg_type
+        from tidb_tpu.planner.logical import LogicalWindow, WinDesc
+        rw = ExpressionRewriter(plan.schema, self.subq)
+        base = len(plan.schema)
+        wdescs: List[WinDesc] = []
+        names: List[str] = []
+        for i, call in enumerate(win_calls):
+            name = call.name.lower()
+            if name not in _WINDOW_FUNCS:
+                raise PlanError(f"unsupported window function: {call.name}")
+            spec = call.window
+            partition = [rw.rewrite(e) for e in spec.partition_by]
+            order = [rw.rewrite(e) for e, _ in spec.order_by]
+            descs = [d for _, d in spec.order_by]
+            offset, default = 1, None
+            if name in ("lag", "lead"):
+                if not call.args:
+                    raise PlanError(f"{name}() needs an argument")
+                args = [rw.rewrite(call.args[0])]
+                if len(call.args) >= 2:
+                    off = rw.rewrite(call.args[1])
+                    if not isinstance(off, Constant) or \
+                            not isinstance(off.value, int):
+                        raise PlanError(
+                            f"{name}() offset must be an integer literal")
+                    offset = off.value
+                if len(call.args) >= 3:
+                    dflt = rw.rewrite(call.args[2])
+                    if not isinstance(dflt, Constant):
+                        raise PlanError(
+                            f"{name}() default must be a literal")
+                    default = dflt
+                ftype = args[0].ftype.with_nullable(True)
+            elif name in ("row_number", "rank", "dense_rank"):
+                if call.args and not isinstance(call.args[0], ast.Star):
+                    raise PlanError(f"{name}() takes no arguments")
+                args = []
+                ftype = T.bigint(False)
+            else:   # sum/count/avg/min/max over the window
+                args = [rw.rewrite(a) for a in call.args
+                        if not isinstance(a, ast.Star)]
+                if name != "count" and not args:
+                    raise PlanError(f"{name}() needs an argument")
+                if args and args[0].ftype.kind.is_string:
+                    if name in ("sum", "avg"):
+                        # MySQL coerces string operands to double
+                        args[0] = cast(args[0], T.double(True))
+                    elif name in ("min", "max"):
+                        raise PlanError(
+                            f"windowed {name.upper()}() over strings is "
+                            f"not supported")
+                ftype = infer_agg_type(name, args, False)
+                if name == "avg":
+                    ftype = T.double(True)   # windowed AVG computes double
+            wdescs.append(WinDesc(name, args, partition, order, descs,
+                                  ftype, offset, default))
+            names.append(f"_win_{i}")
+            window_map[id(call)] = ColumnRef(base + i, ftype,
+                                             f"_win_{i}")
+        return LogicalWindow(wdescs, names, plan)
 
     def _resolve_order(self, sel: ast.SelectStmt, items, names,
                        proj_exprs: List[Expression],

@@ -206,6 +206,42 @@ class LogicalJoin(LogicalPlan):
         return f"{self.kind} equi:{self.equi} other:{self.other_conditions}"
 
 
+class WinDesc:
+    """One window-function column (ref: planner/core WindowFuncDesc)."""
+
+    def __init__(self, name, args, partition, order, descs, ftype,
+                 offset: int = 1, default=None):
+        self.name = name              # row_number|rank|dense_rank|sum|...
+        self.args = args              # List[Expression]
+        self.partition = partition    # List[Expression]
+        self.order = order            # List[Expression]
+        self.descs = descs            # List[bool]
+        self.ftype = ftype
+        self.offset = offset          # lag/lead shift
+        self.default = default        # lag/lead default Constant or None
+
+    def __repr__(self):
+        return (f"{self.name}({self.args!r}) over(p={self.partition!r}, "
+                f"o={list(zip(self.order, self.descs))!r}, "
+                f"off={self.offset}, dflt={self.default!r})")
+
+
+class LogicalWindow(LogicalPlan):
+    """Appends one output column per window function
+    (ref: planner/core/logical_plans.go LogicalWindow)."""
+
+    def __init__(self, wdescs: List["WinDesc"], names: List[str],
+                 child: LogicalPlan):
+        cols = list(child.schema.columns) + [
+            SchemaColumn(n, d.ftype, None)
+            for d, n in zip(wdescs, names)]
+        super().__init__(Schema(cols), [child])
+        self.wdescs = wdescs
+
+    def describe(self):
+        return f"{self.wdescs!r}"
+
+
 class LogicalSort(LogicalPlan):
     def __init__(self, by: List[Expression], descs: List[bool],
                  child: LogicalPlan):

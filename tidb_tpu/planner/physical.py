@@ -24,7 +24,8 @@ from tidb_tpu.planner.logical import (LogicalAggregation, LogicalDataSource,
                                       LogicalDual, LogicalJoin, LogicalLimit,
                                       LogicalPlan, LogicalProjection,
                                       LogicalSelection, LogicalSort,
-                                      LogicalTopN, LogicalUnionAll, Schema)
+                                      LogicalTopN, LogicalUnionAll,
+                                      LogicalWindow, Schema)
 
 DEFAULT_TPU_ROW_THRESHOLD = 32768
 
@@ -124,6 +125,18 @@ class PhysHashJoin(PhysicalPlan):
                 f"equi:{self.equi}" +
                 (f", other:{self.other_conditions}"
                  if self.other_conditions else ""))
+
+
+class PhysWindow(PhysicalPlan):
+    """Window functions over sorted partitions (ref: executor/window.go:31;
+    computed whole-column via ops/window.py instead of streamed frames)."""
+
+    def __init__(self, wdescs, schema, child):
+        super().__init__(schema, [child])
+        self.wdescs = wdescs
+
+    def describe(self):
+        return f"{self.wdescs!r}"
 
 
 class PhysSort(PhysicalPlan):
@@ -430,6 +443,8 @@ def _to_physical(plan: LogicalPlan, ctx) -> PhysicalPlan:
             build_right = rrows <= lrows
         return PhysHashJoin(plan.kind, left, right, plan.equi,
                             plan.other_conditions, plan.schema, build_right)
+    if isinstance(plan, LogicalWindow):
+        return PhysWindow(plan.wdescs, plan.schema, kids[0])
     if isinstance(plan, LogicalSort):
         return PhysSort(plan.by, plan.descs, kids[0])
     if isinstance(plan, LogicalTopN):

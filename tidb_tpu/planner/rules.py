@@ -25,7 +25,8 @@ from tidb_tpu.planner.logical import (LogicalAggregation, LogicalDataSource,
                                       LogicalDual, LogicalJoin, LogicalLimit,
                                       LogicalPlan, LogicalProjection,
                                       LogicalSelection, LogicalSort,
-                                      LogicalTopN, LogicalUnionAll)
+                                      LogicalTopN, LogicalUnionAll,
+                                      LogicalWindow)
 
 
 def logical_optimize(plan: LogicalPlan) -> LogicalPlan:
@@ -312,6 +313,16 @@ def mark_used_columns(plan: LogicalPlan,
         for e in plan.by:
             req.update(e.references())
         mark_used_columns(plan.children[0], req)
+        return
+    if isinstance(plan, LogicalWindow):
+        nchild = len(plan.children[0].schema)
+        req = set(required) if required is not None else set(
+            range(len(plan.schema)))
+        child_req = {i for i in req if i < nchild}
+        for d in plan.wdescs:
+            for e in list(d.args) + list(d.partition) + list(d.order):
+                child_req.update(e.references())
+        mark_used_columns(plan.children[0], child_req)
         return
     if isinstance(plan, LogicalJoin):
         lw = len(plan.children[0].schema)
