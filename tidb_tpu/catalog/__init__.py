@@ -16,8 +16,8 @@ import threading
 from dataclasses import dataclass, field, replace
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from tidb_tpu.errors import (TableExistsError, UnknownColumnError,
-                             UnknownTableError)
+from tidb_tpu.errors import (DDLError, TableExistsError,
+                             UnknownColumnError, UnknownTableError)
 from tidb_tpu.types import FieldType
 
 
@@ -142,6 +142,46 @@ class Catalog:
             tables[key] = info
             self._bump(tables, f"create table {name}")
             return info
+
+    def add_index(self, table: str, index: IndexInfo) -> TableInfo:
+        """CREATE INDEX (ref: ddl/ddl_api.go CreateIndex; synchronous —
+        backfill is lazy because indexes are sorted snapshot views)."""
+        with self._lock:
+            key = table.lower()
+            info = self._snapshot._tables.get(key)
+            if info is None:
+                raise UnknownTableError(f"Unknown table '{table}'")
+            if any(ix.name.lower() == index.name.lower()
+                   for ix in info.indexes):
+                raise DDLError(f"Duplicate key name '{index.name}'",
+                               code=1061)  # ER_DUP_KEYNAME
+            for c in index.columns:
+                info.column(c)        # raises on unknown column
+            new = replace(info, indexes=info.indexes + (index,))
+            tables = dict(self._snapshot._tables)
+            tables[key] = new
+            self._bump(tables, f"create index {index.name} on {table}")
+            return new
+
+    def drop_index(self, table: str, index_name: str,
+                   if_exists: bool = False) -> Optional[TableInfo]:
+        with self._lock:
+            key = table.lower()
+            info = self._snapshot._tables.get(key)
+            if info is None:
+                raise UnknownTableError(f"Unknown table '{table}'")
+            keep = tuple(ix for ix in info.indexes
+                         if ix.name.lower() != index_name.lower())
+            if len(keep) == len(info.indexes):
+                if if_exists:
+                    return None
+                raise DDLError(f"Can't DROP '{index_name}'; check that "
+                               f"column/key exists")
+            new = replace(info, indexes=keep)
+            tables = dict(self._snapshot._tables)
+            tables[key] = new
+            self._bump(tables, f"drop index {index_name} on {table}")
+            return new
 
     def drop_table(self, name: str, if_exists: bool = False) -> Optional[TableInfo]:
         with self._lock:

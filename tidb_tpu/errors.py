@@ -57,3 +57,14 @@ class DivisionByZero(TiDBTPUError):
 
 class TxnError(TiDBTPUError):
     code = 1205
+
+
+class DDLError(TiDBTPUError):
+    """Schema-change failure (ref: ddl/ddl error codes)."""
+
+    code = 1091  # ER_CANT_DROP_FIELD_OR_KEY (default; override per raise)
+
+    def __init__(self, msg, code=None):
+        super().__init__(msg)
+        if code is not None:
+            self.code = code

@@ -24,11 +24,11 @@ from tidb_tpu.errors import ExecutionError, QueryKilledError
 from tidb_tpu.expression import Expression
 from tidb_tpu.expression.runner import eval_on_chunk, filter_mask
 from tidb_tpu.planner.physical import (PhysDual, PhysHashAgg, PhysHashJoin,
-                                       PhysLimit, PhysProjection,
-                                       PhysSelection, PhysSort, PhysTableScan,
-                                       PhysTopN, PhysTpuFragment,
-                                       PhysUnionAll, PhysWindow,
-                                       PhysicalPlan)
+                                       PhysIndexScan, PhysLimit,
+                                       PhysProjection, PhysSelection,
+                                       PhysSort, PhysTableScan, PhysTopN,
+                                       PhysTpuFragment, PhysUnionAll,
+                                       PhysWindow, PhysicalPlan)
 from tidb_tpu.types import FieldType
 
 
@@ -118,6 +118,30 @@ class Executor:
         if not chunks:
             return _empty_chunk(self.schema)
         return Chunk.concat(chunks) if len(chunks) > 1 else chunks[0]
+
+
+class MaterializingExec(Executor):
+    """Blocking-operator base: materialize the whole result once, then
+    paginate by ctx.chunk_size (shared by window/index/sort executors)."""
+
+    def open(self, ctx: ExecContext) -> None:
+        super().open(ctx)
+        self._result: Optional[Chunk] = None
+        self._offset = 0
+
+    def _materialize(self) -> Chunk:
+        raise NotImplementedError
+
+    def next(self) -> Optional[Chunk]:
+        if self._result is None:
+            self._result = self._materialize()
+        if self._offset >= self._result.num_rows:
+            return None
+        size = self.ctx.chunk_size
+        out = self._result.slice(
+            self._offset, min(self._offset + size, self._result.num_rows))
+        self._offset += out.num_rows
+        return out
 
 
 def _empty_chunk(schema: List[FieldType]) -> Chunk:
@@ -284,6 +308,9 @@ def build(plan: PhysicalPlan) -> Executor:
         return TpuFragmentExec(plan)
     if isinstance(plan, PhysTableScan):
         return TableScanExec(plan)
+    if isinstance(plan, PhysIndexScan):
+        from tidb_tpu.executor.index_scan import IndexScanExec
+        return IndexScanExec(plan)
     if isinstance(plan, PhysDual):
         return DualExec(plan.schema.field_types, plan.n_rows)
     kids = [build(c) for c in plan.children]

@@ -16,7 +16,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from tidb_tpu.chunk import Chunk, Column
-from tidb_tpu.executor import Executor, _empty_chunk
+from tidb_tpu.executor import Executor, MaterializingExec, _empty_chunk
 from tidb_tpu.expression import EvalContext
 from tidb_tpu.expression.runner import host_context
 from tidb_tpu.ops import window as W
@@ -24,31 +24,13 @@ from tidb_tpu.planner.physical import PhysWindow
 from tidb_tpu.types import TypeKind
 
 
-class WindowExec(Executor):
+class WindowExec(MaterializingExec):
     def __init__(self, plan: PhysWindow, child: Executor):
         super().__init__(plan.schema.field_types, [child])
         self.plan = plan
-        self._result: Optional[Chunk] = None
-        self._offset = 0
-
-    def open(self, ctx):
-        super().open(ctx)
-        self._result = None
-        self._offset = 0
-
-    def next(self) -> Optional[Chunk]:
-        if self._result is None:
-            self._result = self._compute()
-        if self._offset >= self._result.num_rows:
-            return None
-        size = self.ctx.chunk_size
-        out = self._result.slice(
-            self._offset, min(self._offset + size, self._result.num_rows))
-        self._offset += out.num_rows
-        return out
 
     # ------------------------------------------------------------------
-    def _compute(self) -> Chunk:
+    def _materialize(self) -> Chunk:
         chunks = []
         while True:
             ch = self.child_next()

@@ -976,10 +976,14 @@ def _in(func, ctx):
         for c in codeset:
             hit = hit | (v == c)
         return hit, m
+    # each membership test goes through the eq kernel so mixed-type items
+    # coerce like `col = item` would (a DECIMAL 5.5 must NOT compare its
+    # scaled encoding 55 against raw BIGINT values)
     hit = None
+    eqfn = _KERNELS["eq"]
     for cexpr in func.args[1:]:
-        cv, cm = cexpr.eval(ctx)
-        h = (v == cv) & cm
+        h, hm = eqfn(ScalarFunc("eq", [arg, cexpr], T.bigint(False)), ctx)
+        h = h & hm
         hit = h if hit is None else (hit | h)
     return np.asarray(hit, dtype=bool) if not ctx.on_device else hit, m
 

@@ -241,6 +241,20 @@ class CreateTable(StmtNode):
 
 
 @dataclass
+class CreateIndex(StmtNode):
+    name: str
+    table: str
+    columns: List[str]
+    unique: bool = False
+
+
+@dataclass
+class DropIndex(StmtNode):
+    name: str
+    table: str
+
+
+@dataclass
 class DropTable(StmtNode):
     names: List[str]
     if_exists: bool = False

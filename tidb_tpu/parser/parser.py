@@ -315,8 +315,20 @@ class Parser:
         return ast.TableName(name, alias)
 
     # ---- DDL -------------------------------------------------------------
-    def create_table(self) -> ast.CreateTable:
+    def create_table(self):
         self.expect_kw("create")
+        unique = bool(self.try_kw("unique"))
+        if unique or self.at_kw("index", "key"):
+            self.advance()                 # INDEX | KEY
+            iname = self.ident()
+            self.expect_kw("on")
+            tname = self.ident()
+            self.expect_op("(")
+            cols = [self.ident()]
+            while self.try_op(","):
+                cols.append(self.ident())
+            self.expect_op(")")
+            return ast.CreateIndex(iname, tname, cols, unique)
         self.expect_kw("table")
         if_not_exists = False
         if self.try_kw("if"):
@@ -425,8 +437,12 @@ class Parser:
         scale = args[1] if len(args) > 1 else 0
         return FieldType(kind, True, precision, scale, unsigned)
 
-    def drop_table(self) -> ast.DropTable:
+    def drop_table(self):
         self.expect_kw("drop")
+        if self.try_kw("index"):
+            iname = self.ident()
+            self.expect_kw("on")
+            return ast.DropIndex(iname, self.ident())
         self.expect_kw("table")
         if_exists = False
         if self.try_kw("if"):

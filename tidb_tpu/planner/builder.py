@@ -563,9 +563,11 @@ class PlanBuilder:
                 if len(call.args) >= 2:
                     off = rw.rewrite(call.args[1])
                     if not isinstance(off, Constant) or \
-                            not isinstance(off.value, int):
+                            not isinstance(off.value, int) or \
+                            off.value < 0:
                         raise PlanError(
-                            f"{name}() offset must be an integer literal")
+                            f"Incorrect arguments to {name}: offset must "
+                            f"be a non-negative integer literal")
                     offset = off.value
                 if len(call.args) >= 3:
                     dflt = rw.rewrite(call.args[2])

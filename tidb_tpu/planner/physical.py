@@ -72,6 +72,32 @@ class PhysTableScan(PhysicalPlan):
         return s
 
 
+class PhysIndexScan(PhysicalPlan):
+    """Point/range access through a sorted index view (ref:
+    planner/core/point_get_plan.go + PhysicalIndexReader). Chosen over a
+    full scan when ranger-derived ranges are selective; residual filters
+    run after the gather."""
+
+    def __init__(self, ds: LogicalDataSource, key_col: int,
+                 index_name: str, ranges, residual):
+        super().__init__(ds.schema)
+        self.table = ds.table
+        self.alias = ds.alias
+        self.key_col = key_col
+        self.index_name = index_name
+        self.ranges = ranges
+        self.residual = residual
+        self.used_columns = ds.used_columns
+        self.filters = []          # scan-compat (fragment gate reads this)
+
+    def describe(self):
+        s = (f"table:{self.table.name}, index:{self.index_name}, "
+             f"ranges:{self.ranges!r}")
+        if self.residual:
+            s += f", residual:{self.residual!r}"
+        return s
+
+
 class PhysDual(PhysicalPlan):
     def __init__(self, schema: Schema, n_rows: int):
         super().__init__(schema)
@@ -313,6 +339,14 @@ def estimate(plan: PhysicalPlan, ctx) -> float:
     """Bottom-up cardinality; sets est_rows on every node. PhysHashAgg
     additionally gets est_reliable=True when every group key had stats —
     the device engine then trusts est_rows for its initial group cap."""
+    if isinstance(plan, PhysIndexScan):
+        n = plan.est_rows        # set by _try_index_access from ranges
+        if plan.residual:
+            from tidb_tpu.statistics import filters_selectivity
+            stats = _table_stats(plan.table, ctx)
+            n *= filters_selectivity(plan.residual, stats)
+        plan.est_rows = max(n, 1.0)
+        return plan.est_rows
     if isinstance(plan, PhysTableScan):
         n = float(_table_rows(plan.table, ctx))
         if plan.filters:
@@ -419,8 +453,76 @@ def _distribute_fragments(plan: PhysicalPlan, n_shards: int,
         _distribute_fragments(c, n_shards, threshold)
 
 
+INDEX_SELECTIVITY_GATE = 0.15     # index path only below this fraction
+
+
+def _index_candidates(table) -> List:
+    """(col_name, index_name, unique) — PK first, then index prefixes."""
+    out = []
+    if table.primary_key:
+        out.append((table.primary_key[0], "PRIMARY",
+                    len(table.primary_key) == 1))
+    for ix in table.indexes:
+        out.append((ix.columns[0], ix.name,
+                    ix.unique and len(ix.columns) == 1))
+    return out
+
+
+def _try_index_access(ds: LogicalDataSource, ctx) -> Optional[PhysIndexScan]:
+    """Cost gate (find_best_task.go skyline-lite): point access on a
+    unique key always wins; range access needs stats showing the ranges
+    select under INDEX_SELECTIVITY_GATE of the table."""
+    if not ds.filters:
+        return None
+    from tidb_tpu.planner.ranger import detach_ranges
+    stats = _table_stats(ds.table, ctx)
+    total = max(_table_rows(ds.table, ctx), 1)
+    best = None
+    for col_name, index_name, unique in _index_candidates(ds.table):
+        try:
+            col_idx = next(i for i, c in enumerate(ds.table.columns)
+                           if c.name.lower() == col_name.lower())
+        except StopIteration:
+            continue
+        ranges, residual = detach_ranges(ds.filters, col_idx)
+        if ranges is None:
+            continue
+        if not ranges:
+            est = 0.0              # unsatisfiable → empty
+        elif unique and all(r.lo == r.hi and r.lo is not None
+                            for r in ranges):
+            est = float(len(ranges))
+        else:
+            cs = stats.columns.get(col_idx) if stats is not None else None
+            if cs is None:
+                continue           # no stats → can't justify a range scan
+            frac = 0.0
+            for r in ranges:
+                if r.include_null:
+                    frac += cs.null_fraction()
+                elif r.lo == r.hi and r.lo is not None:
+                    frac += cs.eq_selectivity(r.lo)
+                else:
+                    frac += cs.range_selectivity(r.lo, r.hi, r.lo_incl,
+                                                 r.hi_incl)
+            if frac > INDEX_SELECTIVITY_GATE:
+                continue
+            est = frac * total
+        if best is None or est < best[0]:
+            best = (est, col_idx, index_name, ranges, residual)
+    if best is None:
+        return None
+    est, col_idx, index_name, ranges, residual = best
+    scan = PhysIndexScan(ds, col_idx, index_name, ranges, residual)
+    scan.est_rows = max(est, 1.0)
+    return scan
+
+
 def _to_physical(plan: LogicalPlan, ctx) -> PhysicalPlan:
     if isinstance(plan, LogicalDataSource):
+        idx = _try_index_access(plan, ctx)
+        if idx is not None:
+            return idx
         return PhysTableScan(plan)
     if isinstance(plan, LogicalDual):
         return PhysDual(plan.schema, plan.n_rows)

@@ -1,0 +1,199 @@
+"""Predicate → index range derivation ("ranger-lite").
+
+Ref: util/ranger/points.go:864 + detacher.go — the reference turns a
+conjunction into disjoint [lo, hi] ranges over an index prefix and
+detaches the conditions it consumed. This subset handles single-column
+indexes with the operators that matter for point/range access:
+
+    col = c | col <|<=|>|>= c | col BETWEEN a AND b (as two cmps) |
+    col IN (c1..cn) | col IS NULL
+
+Anything else stays a residual filter evaluated after the index gather.
+Values are in the column's RAW encoded domain (DECIMAL scaled ints,
+DATE days, raw strings) so the executor compares against stored arrays
+directly.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+from tidb_tpu.expression import (ColumnRef, Constant, Expression,
+                                 ScalarFunc)
+
+_CMP = {"eq", "lt", "le", "gt", "ge"}
+_FLIP = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le", "eq": "eq"}
+
+
+@dataclass
+class Range:
+    """One contiguous key range; None bound = unbounded. include_null
+    covers `IS NULL` point access (NULLs live outside value ranges)."""
+
+    lo: object = None
+    hi: object = None
+    lo_incl: bool = True
+    hi_incl: bool = True
+    include_null: bool = False
+
+    def __repr__(self):
+        if self.include_null:
+            return "[NULL]"
+        lb = "[" if self.lo_incl else "("
+        rb = "]" if self.hi_incl else ")"
+        lo = "-inf" if self.lo is None else self.lo
+        hi = "+inf" if self.hi is None else self.hi
+        return f"{lb}{lo},{hi}{rb}"
+
+
+def _col_const(e: ScalarFunc, col_idx: int):
+    a, b = (e.args + [None, None])[:2]
+    if isinstance(a, ColumnRef) and a.index == col_idx and \
+            isinstance(b, Constant):
+        return a, b, False
+    if isinstance(b, ColumnRef) and b.index == col_idx and \
+            isinstance(a, Constant):
+        return b, a, True
+    return None, None, False
+
+
+def _raw(col: ColumnRef, const: Constant):
+    """Constant → the column's raw encoded domain, ONLY when the coercion
+    is lossless: `id = 500.5` must not become `id = 500` (the full-scan
+    comparator would match nothing). Lossy/ambiguous constants stay
+    residual filters."""
+    try:
+        if col.ftype.kind.is_string:
+            # numeric-vs-string compares numerically in MySQL; only
+            # string literals are safe to probe lexically
+            return str(const.value) if isinstance(const.value, str) \
+                else None
+        raw = col.ftype.encode_value(const.value)
+        back = col.ftype.decode_value(raw)
+        return raw if _lossless(back, const.value) else None
+    except Exception:
+        return None
+
+
+def _lossless(back, orig) -> bool:
+    import datetime as _dt
+    from decimal import Decimal, InvalidOperation
+    try:
+        if isinstance(orig, (_dt.date, _dt.datetime, _dt.timedelta)) or \
+                isinstance(back, (_dt.date, _dt.datetime, _dt.timedelta)):
+            return back == orig
+        return Decimal(str(back)) == Decimal(str(orig))
+    except (InvalidOperation, ValueError, TypeError):
+        return False
+
+
+def _intersect(r: Range, lo=None, hi=None, lo_incl=True, hi_incl=True
+               ) -> Optional[Range]:
+    out = Range(r.lo, r.hi, r.lo_incl, r.hi_incl)
+    if lo is not None:
+        if out.lo is None or lo > out.lo or (lo == out.lo and not lo_incl):
+            out.lo, out.lo_incl = lo, lo_incl
+    if hi is not None:
+        if out.hi is None or hi < out.hi or (hi == out.hi and not hi_incl):
+            out.hi, out.hi_incl = hi, hi_incl
+    if out.lo is not None and out.hi is not None:
+        if out.lo > out.hi:
+            return None
+        if out.lo == out.hi and not (out.lo_incl and out.hi_incl):
+            return None
+    return out
+
+
+def detach_ranges(filters: Sequence[Expression], col_idx: int
+                  ) -> Tuple[Optional[List[Range]], List[Expression]]:
+    """→ (ranges or None if the column is unconstrained, residual filters).
+
+    Consumed conditions are removed from the residual list; multiple
+    consumed conditions intersect (AND semantics). IN produces multiple
+    point ranges intersected with any bounds."""
+    bounds = Range()              # running intersection of cmp conditions
+    points: Optional[List] = None  # from eq / IN
+    null_point = False
+    residual: List[Expression] = []
+    consumed_any = False
+
+    for f in filters:
+        if not isinstance(f, ScalarFunc):
+            residual.append(f)
+            continue
+        op = f.op
+        if op in _CMP:
+            col, const, flipped = _col_const(f, col_idx)
+            raw = _raw(col, const) if col is not None and \
+                const is not None and const.value is not None else None
+            if raw is None:
+                residual.append(f)
+                continue
+            o = _FLIP[op] if flipped else op
+            if o == "eq":
+                points = [raw] if points is None else \
+                    [p for p in points if p == raw]
+            elif o == "lt":
+                nb = _intersect(bounds, hi=raw, hi_incl=False)
+                if nb is None:
+                    return [], residual
+                bounds = nb
+            elif o == "le":
+                nb = _intersect(bounds, hi=raw, hi_incl=True)
+                if nb is None:
+                    return [], residual
+                bounds = nb
+            elif o == "gt":
+                nb = _intersect(bounds, lo=raw, lo_incl=False)
+                if nb is None:
+                    return [], residual
+                bounds = nb
+            else:  # ge
+                nb = _intersect(bounds, lo=raw, lo_incl=True)
+                if nb is None:
+                    return [], residual
+                bounds = nb
+            consumed_any = True
+            continue
+        if op == "in":
+            col = f.args[0]
+            if isinstance(col, ColumnRef) and col.index == col_idx and \
+                    all(isinstance(a, Constant) for a in f.args[1:]):
+                raws = [_raw(col, a) for a in f.args[1:]
+                        if a.value is not None]
+                if all(r is not None for r in raws):
+                    raws = sorted(set(raws))
+                    points = raws if points is None else \
+                        [p for p in points if p in raws]
+                    consumed_any = True
+                    continue
+            residual.append(f)
+            continue
+        if op == "isnull" and len(f.args) == 1:
+            a = f.args[0]
+            if isinstance(a, ColumnRef) and a.index == col_idx:
+                null_point = True
+                consumed_any = True
+                continue
+            residual.append(f)
+            continue
+        residual.append(f)
+
+    if not consumed_any:
+        return None, list(filters)
+    if null_point:
+        # col IS NULL AND col cmp … is unsatisfiable unless only IS NULL
+        if points is not None or bounds.lo is not None or \
+                bounds.hi is not None:
+            return [], residual
+        return [Range(include_null=True)], residual
+    if points is not None:
+        out = []
+        for p in points:
+            r = _intersect(Range(p, p, True, True), bounds.lo, bounds.hi,
+                           bounds.lo_incl, bounds.hi_incl)
+            if r is not None:
+                out.append(r)
+        return out, residual
+    return [bounds], residual

@@ -163,6 +163,15 @@ class Session:
             return self._run_query(stmt)
         if isinstance(stmt, ast.CreateTable):
             return self._create_table(stmt)
+        if isinstance(stmt, ast.CreateIndex):
+            from tidb_tpu.catalog import IndexInfo as _IdxInfo
+            self.engine.catalog.add_index(
+                stmt.table, _IdxInfo(stmt.name, tuple(stmt.columns),
+                                     stmt.unique))
+            return ok()
+        if isinstance(stmt, ast.DropIndex):
+            self.engine.catalog.drop_index(stmt.table, stmt.name)
+            return ok()
         if isinstance(stmt, ast.DropTable):
             for name in stmt.names:
                 info = self.engine.catalog.drop_table(name, stmt.if_exists)
