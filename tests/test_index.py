@@ -147,3 +147,44 @@ def test_create_drop_index_ddl(session):
     from tidb_tpu.errors import DDLError
     with pytest.raises(DDLError):
         s.execute("DROP INDEX is2 ON it")
+
+
+# ---- unique-key enforcement (write path) ----------------------------------
+
+def test_unique_enforcement():
+    from tidb_tpu.errors import DuplicateKeyError
+    eng = Engine()
+    s = eng.new_session()
+    s.execute("CREATE TABLE u (id BIGINT, v BIGINT, PRIMARY KEY (id))")
+    s.execute("INSERT INTO u VALUES (1,10),(2,10)")
+    with pytest.raises(DuplicateKeyError):
+        s.execute("INSERT INTO u VALUES (1,99)")
+    with pytest.raises(DuplicateKeyError):      # in-batch dup
+        s.execute("INSERT INTO u VALUES (5,1),(5,2)")
+    with pytest.raises(DuplicateKeyError):      # backfill over dup data
+        s.execute("CREATE UNIQUE INDEX uv ON u (v)")
+    s.execute("INSERT IGNORE INTO u VALUES (1,99),(3,30)")
+    assert sorted(s.query("SELECT id FROM u").rows) == [(1,), (2,), (3,)]
+    s.execute("REPLACE INTO u VALUES (1,111)")
+    assert sorted(s.query("SELECT id, v FROM u").rows) == \
+        [(1, 111), (2, 10), (3, 30)]
+    # NULLs never conflict in unique secondary indexes
+    s.execute("CREATE TABLE un (a BIGINT, b BIGINT)")
+    s.execute("CREATE UNIQUE INDEX ub ON un (b)")
+    s.execute("INSERT INTO un VALUES (1,NULL),(2,NULL)")
+    assert len(s.query("SELECT * FROM un").rows) == 2
+    # txn-staged conflicts are seen
+    s.execute("BEGIN")
+    s.execute("INSERT INTO u VALUES (7,700)")
+    with pytest.raises(DuplicateKeyError):
+        s.execute("INSERT INTO u VALUES (7,701)")
+    s.execute("ROLLBACK")
+
+
+def test_invalid_create_index_syntax_rejected():
+    from tidb_tpu.errors import ParseError
+    eng = Engine()
+    s = eng.new_session()
+    s.execute("CREATE TABLE z (a BIGINT)")
+    with pytest.raises(ParseError):
+        s.execute("CREATE UNIQUE FROB zz ON z (a)")

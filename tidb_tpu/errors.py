@@ -59,6 +59,10 @@ class TxnError(TiDBTPUError):
     code = 1205
 
 
+class DuplicateKeyError(TiDBTPUError):
+    code = 1062  # ER_DUP_ENTRY
+
+
 class DDLError(TiDBTPUError):
     """Schema-change failure (ref: ddl/ddl error codes)."""
 

@@ -17,10 +17,9 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-from tidb_tpu.chunk import Chunk, Column
+from tidb_tpu.chunk import Chunk
 from tidb_tpu.executor import MaterializingExec, _empty_chunk
-from tidb_tpu.expression.runner import eval_on_chunk, filter_mask, \
-    host_context
+from tidb_tpu.expression.runner import filter_mask
 from tidb_tpu.planner.ranger import Range
 
 MAX_CACHED_INDEXES = 16
@@ -70,10 +69,14 @@ class SortedIndex:
 
 
 _CACHE: "OrderedDict[Tuple, SortedIndex]" = OrderedDict()
+# live view shared across every index of one table snapshot (a wide table
+# with 3 indexes must not hold 3 copies of its rows)
+_VIEW_CACHE: "OrderedDict[Tuple, Tuple]" = OrderedDict()
 
 
 def clear():
     _CACHE.clear()
+    _VIEW_CACHE.clear()
 
 
 def get_index(ctx, table_id: int, col_idx: int, table_info) -> SortedIndex:
@@ -91,19 +94,33 @@ def get_index(ctx, table_id: int, col_idx: int, table_info) -> SortedIndex:
         _CACHE.move_to_end(key)
         return ent
 
-    live_chunks: List[Chunk] = []
-    for _region, chunk, alive in ctx.scan_table(table_id):
-        chunk = align_chunk_to_schema(chunk, table_info)
-        if alive.all():
-            live_chunks.append(chunk)
+    vkey = (id(store), table_id) if cacheable else None
+    view = None
+    if cacheable:
+        hit = _VIEW_CACHE.get(vkey)
+        if hit is not None and hit[0] is td and \
+                len(hit[1].columns) == len(table_info.columns):
+            _VIEW_CACHE.move_to_end(vkey)
+            view = hit[1]
+    if view is None:
+        live_chunks: List[Chunk] = []
+        for _region, chunk, alive in ctx.scan_table(table_id):
+            ctx.check_killed()
+            chunk = align_chunk_to_schema(chunk, table_info)
+            if alive.all():
+                live_chunks.append(chunk)
+            else:
+                live_chunks.append(chunk.take(np.nonzero(alive)[0]))
+        if live_chunks:
+            view = Chunk.concat(live_chunks) if len(live_chunks) > 1 \
+                else live_chunks[0]
         else:
-            live_chunks.append(chunk.take(np.nonzero(alive)[0]))
-    if live_chunks:
-        view = Chunk.concat(live_chunks) if len(live_chunks) > 1 \
-            else live_chunks[0]
-    else:
-        from tidb_tpu.executor import _empty_chunk
-        view = _empty_chunk([c.ftype for c in table_info.columns])
+            view = _empty_chunk([c.ftype for c in table_info.columns])
+        if cacheable:
+            _VIEW_CACHE[vkey] = (td, view)
+            while len(_VIEW_CACHE) > MAX_CACHED_INDEXES:
+                _VIEW_CACHE.popitem(last=False)
+    ctx.check_killed()
     col = view.columns[col_idx]
     vals, valid = col.values, col.valid_mask()
     n = len(vals)

@@ -13,7 +13,7 @@ from typing import List, Optional
 import numpy as np
 
 from tidb_tpu.chunk import Chunk
-from tidb_tpu.executor import Executor, _empty_chunk
+from tidb_tpu.executor import Executor, MaterializingExec, _empty_chunk
 from tidb_tpu.expression import Expression
 from tidb_tpu.expression.runner import host_context
 
@@ -45,39 +45,21 @@ def sort_indices(by, descs, chunk: Chunk) -> np.ndarray:
     return np.lexsort(tuple(reversed(keys)))
 
 
-class SortExec(Executor):
+class SortExec(MaterializingExec):
     def __init__(self, by: List[Expression], descs: List[bool],
                  child: Executor):
         super().__init__(child.schema, [child])
         self.by = by
         self.descs = descs
-        self._sorted: Optional[Chunk] = None
-        self._offset = 0
 
-    def open(self, ctx):
-        super().open(ctx)
-        self._sorted = None
-        self._offset = 0
-
-    def next(self) -> Optional[Chunk]:
-        if self._sorted is None:
-            data = self.children[0].drain()
-            if data.num_rows:
-                self._sorted = data.take(sort_indices(self.by, self.descs,
-                                                      data))
-            else:
-                self._sorted = data
-        if self._offset >= self._sorted.num_rows:
-            return None
-        size = self.ctx.chunk_size
-        out = self._sorted.slice(self._offset,
-                                 min(self._offset + size,
-                                     self._sorted.num_rows))
-        self._offset += out.num_rows
-        return out
+    def _materialize(self) -> Chunk:
+        data = self.children[0].drain()
+        if not data.num_rows:
+            return data
+        return data.take(sort_indices(self.by, self.descs, data))
 
 
-class TopNExec(Executor):
+class TopNExec(MaterializingExec):
     """Heap-free TopN: keep a bounded candidate set per batch — argpartition
     against the (offset+count) bound, full sort only at the end
     (ref: executor/sort.go TopNExec's heap, reformulated batch-wise)."""
@@ -88,15 +70,8 @@ class TopNExec(Executor):
         self.descs = descs
         self.offset = offset
         self.count = count
-        self._result: Optional[Chunk] = None
-        self._emitted = 0
 
-    def open(self, ctx):
-        super().open(ctx)
-        self._result = None
-        self._emitted = 0
-
-    def _compute(self) -> Chunk:
+    def _materialize(self) -> Chunk:
         bound = self.offset + self.count
         candidate: Optional[Chunk] = None
         while True:
@@ -118,15 +93,3 @@ class TopNExec(Executor):
         idx = sort_indices(self.by, self.descs, candidate)
         idx = idx[self.offset:bound]
         return candidate.take(idx)
-
-    def next(self) -> Optional[Chunk]:
-        if self._result is None:
-            self._result = self._compute()
-        if self._emitted >= self._result.num_rows:
-            return None
-        size = self.ctx.chunk_size
-        out = self._result.slice(self._emitted,
-                                 min(self._emitted + size,
-                                     self._result.num_rows))
-        self._emitted += out.num_rows
-        return out

@@ -978,14 +978,29 @@ def _in(func, ctx):
         return hit, m
     # each membership test goes through the eq kernel so mixed-type items
     # coerce like `col = item` would (a DECIMAL 5.5 must NOT compare its
-    # scaled encoding 55 against raw BIGINT values)
+    # scaled encoding 55 against raw BIGINT values); the probe expression
+    # evaluates ONCE and rides as a precomputed leaf
     hit = None
     eqfn = _KERNELS["eq"]
+    pre = _Precomputed(v, m, arg.ftype)
     for cexpr in func.args[1:]:
-        h, hm = eqfn(ScalarFunc("eq", [arg, cexpr], T.bigint(False)), ctx)
+        h, hm = eqfn(ScalarFunc("eq", [pre, cexpr], T.bigint(False)), ctx)
         h = h & hm
         hit = h if hit is None else (hit | h)
     return np.asarray(hit, dtype=bool) if not ctx.on_device else hit, m
+
+
+class _Precomputed(Expression):
+    """Leaf wrapping already-evaluated (values, validity) arrays so a
+    kernel can reuse another kernel without re-evaluating subtrees."""
+
+    def __init__(self, v, m, ftype):
+        self._v = v
+        self._m = m
+        self.ftype = ftype
+
+    def eval(self, ctx: EvalContext):
+        return self._v, self._m
 
 
 @preparer("in")

@@ -74,10 +74,12 @@ def segment_sum_accurate(xp, data, segment_ids, num_segments: int):
     k = xp.floor(61.0 - xp.log2(xp.maximum(absmax, xp.float32(1e-30)) *
                                 (n_rows + 1)))
     k = xp.clip(k, -96.0, 61.0).astype(xp.float32)
-    scale = xp.exp2(k)                # power of two ⇒ x*scale is EXACT
+    # exp2 is a polynomial approximation on TPU (exp2(30) ≠ 2^30!);
+    # ldexp builds the exponent bits exactly, keeping x*scale lossless
+    scale = xp.ldexp(xp.float32(1.0), k.astype(xp.int32))
     scaled = xp.round(x * scale).astype(xp.int64)
     ints = segment_sum(xp, scaled, segment_ids, num_segments)
-    inv = xp.exp2(-k)
+    inv = xp.ldexp(xp.float32(1.0), (-k).astype(xp.int32))
     hi = ints.astype(xp.float32) * inv
     resid = ints - xp.round(hi * scale).astype(xp.int64)
     lo = resid.astype(xp.float32) * inv

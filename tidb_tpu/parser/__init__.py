@@ -7,5 +7,6 @@ UPDATE/DELETE, EXPLAIN [ANALYZE], SET, SHOW. The AST mirrors parser/ast/
 in spirit: plain dataclasses the planner walks.
 """
 
-from tidb_tpu.parser.parser import parse, parse_one  # noqa: F401
+from tidb_tpu.parser.parser import (parse, parse_one,  # noqa: F401
+                                    parse_with_text)
 from tidb_tpu.parser import ast  # noqa: F401

@@ -29,6 +29,24 @@ def parse(sql: str) -> List[ast.StmtNode]:
     return stmts
 
 
+def parse_with_text(sql: str) -> List[Tuple[ast.StmtNode, str]]:
+    """Like parse(), but pairs each statement with its own source slice
+    (for per-statement logging/digests in multi-statement scripts)."""
+    toks = tokenize(sql)
+    p = Parser(toks)
+    out = []
+    while not p.at("eof"):
+        if p.try_op(";"):
+            continue
+        start = p.cur.pos
+        stmt = p.statement()
+        end = p.cur.pos if not p.at("eof") else len(sql)
+        out.append((stmt, sql[start:end].strip().rstrip(";").strip()))
+        if not p.at("eof"):
+            p.expect_op(";")
+    return out
+
+
 def parse_one(sql: str) -> ast.StmtNode:
     stmts = parse(sql)
     if len(stmts) != 1:
@@ -319,6 +337,8 @@ class Parser:
         self.expect_kw("create")
         unique = bool(self.try_kw("unique"))
         if unique or self.at_kw("index", "key"):
+            if not self.at_kw("index", "key"):
+                raise ParseError(f"expected INDEX near {self._near()}")
             self.advance()                 # INDEX | KEY
             iname = self.ident()
             self.expect_kw("on")
@@ -564,6 +584,26 @@ class Parser:
         if self.try_kw("create"):
             self.expect_kw("table")
             return ast.ShowStmt("create_table", target=self.ident())
+        if self.at("ident") or self.at("kw"):
+            word = str(self.cur.value).lower()
+            if word == "metrics":
+                self.advance()
+                return ast.ShowStmt("metrics")
+            if word == "slow":
+                self.advance()
+                self.ident()       # QUERIES
+                return ast.ShowStmt("slow_queries")
+            if word == "statement":
+                self.advance()
+                self.ident()       # SUMMARY
+                return ast.ShowStmt("statement_summary")
+            if word == "processlist":
+                self.advance()
+                return ast.ShowStmt("processlist")
+            if word == "indexes" or word == "index" or word == "keys":
+                self.advance()
+                self.expect_kw("from")
+                return ast.ShowStmt("indexes", target=self.ident())
         raise ParseError(f"unsupported SHOW near {self._near()}")
 
     # ---- expressions -----------------------------------------------------

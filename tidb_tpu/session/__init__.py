@@ -11,6 +11,7 @@ visible to the writing session, invisible to others until commit).
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from dataclasses import dataclass
@@ -124,15 +125,48 @@ class _PlanContext:
 
 
 class Session:
+    _next_conn_id = itertools.count(1)
+
     def __init__(self, engine: Optional[Engine] = None):
         self.engine = engine or Engine()
         self.vars: Dict[str, object] = dict(DEFAULT_VARS)
         self.txn: Optional[Transaction] = None
         self.last_plan = None
+        self.conn_id = next(Session._next_conn_id)
+        self.last_engine = "cpu"   # cpu | tpu — set by the fragment path
 
     # ---- public API --------------------------------------------------------
     def execute(self, sql: str) -> List[ResultSet]:
-        return [self._execute_stmt(s) for s in parse(sql)]
+        """Parse + run every statement, recording per-statement metrics,
+        slow-log entries and the processlist (ref: session.ExecuteStmt's
+        observability hooks, session/session.go:1614)."""
+        import time as _time
+
+        from tidb_tpu.parser import parse_with_text
+        from tidb_tpu.util.observability import REGISTRY
+        out = []
+        for s, one in parse_with_text(sql):
+            kind = type(s).__name__
+            self.last_engine = "cpu"
+            REGISTRY.stmt_begin(self.conn_id, one[:256])
+            t0 = _time.perf_counter()
+            try:
+                rs = self._execute_stmt(s)
+            except Exception:
+                REGISTRY.inc("tidb_tpu_stmt_errors_total",
+                             {"stmt": kind})
+                REGISTRY.stmt_end(self.conn_id)
+                raise
+            dt = _time.perf_counter() - t0
+            REGISTRY.stmt_end(self.conn_id)
+            REGISTRY.inc("tidb_tpu_stmt_total", {"stmt": kind})
+            REGISTRY.observe("tidb_tpu_stmt_seconds", dt, {"stmt": kind})
+            n_rows = len(rs.rows) if rs.is_query else rs.affected_rows
+            threshold = float(self.vars.get("long_query_time", 0.3))
+            REGISTRY.record_stmt(one, dt, n_rows, self.last_engine,
+                                 threshold)
+            out.append(rs)
+        return out
 
     def query(self, sql: str) -> ResultSet:
         results = self.execute(sql)
@@ -165,6 +199,10 @@ class Session:
             return self._create_table(stmt)
         if isinstance(stmt, ast.CreateIndex):
             from tidb_tpu.catalog import IndexInfo as _IdxInfo
+            info = self.engine.catalog.info_schema.table(stmt.table)
+            if stmt.unique:
+                self._validate_unique_backfill(info, stmt.columns,
+                                               stmt.name)
             self.engine.catalog.add_index(
                 stmt.table, _IdxInfo(stmt.name, tuple(stmt.columns),
                                      stmt.unique))
@@ -228,18 +266,25 @@ class Session:
         ctx = _PlanContext(self)
         return optimize(stmt, self.engine.catalog.info_schema, ctx)
 
-    def _run_query_chunks(self, stmt):
+    def _run_query_chunks(self, stmt, want_root: bool = False):
         plan = self._plan(stmt)
         self.last_plan = plan
         exec_root = build(plan)
         chunks = run_to_completion(exec_root, self._exec_ctx())
+        if want_root:
+            return plan, chunks, exec_root
         return plan, chunks
 
     def _run_query(self, stmt) -> ResultSet:
-        plan, chunks = self._run_query_chunks(stmt)
+        plan, chunks, exec_root = self._run_query_chunks(stmt,
+                                                        want_root=True)
         rows: List[tuple] = []
         for ch in chunks:
             rows.extend(ch.rows())
+        self.last_engine = "tpu" if _used_device(exec_root) else "cpu"
+        if self.last_engine == "tpu":
+            from tidb_tpu.util.observability import REGISTRY
+            REGISTRY.inc("tidb_tpu_device_queries_total")
         return ResultSet(plan.schema.names, plan.schema.field_types, rows)
 
     # ---- DDL ---------------------------------------------------------------
@@ -280,10 +325,115 @@ class Session:
         else:
             chunk = self._rows_chunk(stmt, info, names)
         txn, auto = self._write_txn()
-        txn.append(info.id, chunk)
-        if auto:
-            txn.commit()
+        try:
+            chunk = self._enforce_unique(info, chunk, txn,
+                                         ignore=stmt.ignore,
+                                         replace=stmt.replace)
+            txn.append(info.id, chunk)
+            if auto:
+                txn.commit()
+        except TiDBTPUError:
+            if auto:
+                txn.rollback()
+            raise
         return ok(chunk.num_rows)
+
+    def _validate_unique_backfill(self, info: TableInfo, cols, name):
+        """CREATE UNIQUE INDEX must fail when existing rows collide (the
+        reference's write-reorg backfill checks, ddl/backfilling.go)."""
+        from tidb_tpu.errors import DuplicateKeyError
+        col_of = {c.name.lower(): i for i, c in enumerate(info.columns)}
+        idxs = [col_of[c.lower()] for c in cols]
+        snap = self._read_view_snapshot()
+        if not snap.has_table(info.id):
+            return
+        seen = set()
+        for region, alive in snap.scan(info.id):
+            from tidb_tpu.executor.scan import align_chunk_to_schema
+            ch = align_chunk_to_schema(region.chunk, info)
+            keys = _key_tuples(ch, idxs)
+            for ri in range(ch.num_rows):
+                if alive[ri] and keys[ri] is not None:
+                    if keys[ri] in seen:
+                        raise DuplicateKeyError(
+                            f"Duplicate entry {keys[ri]!r} for key "
+                            f"'{name}'")
+                    seen.add(keys[ri])
+
+    def _unique_constraints(self, info: TableInfo):
+        out = []
+        if info.primary_key:
+            out.append(("PRIMARY", tuple(info.primary_key)))
+        for ix in info.indexes:
+            if ix.unique:
+                out.append((ix.name, tuple(ix.columns)))
+        return out
+
+    def _enforce_unique(self, info: TableInfo, chunk: Chunk, txn,
+                        ignore: bool = False, replace: bool = False):
+        """PK / unique-key enforcement on the write path (ref:
+        table/tables/tables.go AddRecord dup-key checks). MySQL semantics:
+        NULL never conflicts; INSERT IGNORE drops conflicting rows;
+        REPLACE deletes the existing conflicting rows first."""
+        from tidb_tpu.errors import DuplicateKeyError
+        constraints = self._unique_constraints(info)
+        if not constraints or chunk.num_rows == 0:
+            return chunk
+        col_of = {c.name.lower(): i for i, c in enumerate(info.columns)}
+        keep = np.ones(chunk.num_rows, dtype=bool)
+        for cname, cols in constraints:
+            idxs = [col_of[c.lower()] for c in cols]
+            new_keys = _key_tuples(chunk, idxs)
+            # in-batch duplicates (first row wins under IGNORE/REPLACE)
+            seen = {}
+            for ri, k in enumerate(new_keys):
+                if k is None or not keep[ri]:
+                    continue
+                if k in seen:
+                    if ignore or replace:
+                        keep[ri] = False
+                        continue
+                    raise DuplicateKeyError(
+                        f"Duplicate entry {k!r} for key '{cname}'")
+                seen[k] = ri
+            if not seen:
+                continue
+            # conflicts against the (staged-visible) current table
+            conflict_masks: Dict[int, np.ndarray] = {}
+            staged_keep: List[np.ndarray] = []
+            for region, ch, alive in txn.scan(info.id):
+                ex_keys = _key_tuples(ch, idxs)
+                hit = np.zeros(ch.num_rows, dtype=bool)
+                for ri in range(ch.num_rows):
+                    if alive[ri] and ex_keys[ri] is not None and \
+                            ex_keys[ri] in seen:
+                        hit[ri] = True
+                if not hit.any():
+                    if region is None:
+                        staged_keep.append(np.ones(ch.num_rows,
+                                                   dtype=bool))
+                    continue
+                if replace:
+                    if region is None:
+                        staged_keep.append(~hit)
+                    else:
+                        conflict_masks[region.id] = hit
+                elif ignore:
+                    for ri in np.nonzero(hit)[0]:
+                        keep[seen[ex_keys[ri]]] = False
+                else:
+                    k = ex_keys[int(np.nonzero(hit)[0][0])]
+                    raise DuplicateKeyError(
+                        f"Duplicate entry {k!r} for key '{cname}'")
+            if replace:
+                if conflict_masks:
+                    txn.delete(info.id, conflict_masks)
+                if staged_keep and not all(m.all() for m in staged_keep):
+                    txn.delete_staged(info.id,
+                                      np.concatenate(staged_keep))
+        if keep.all():
+            return chunk
+        return chunk.take(np.nonzero(keep)[0])
 
     def _rows_chunk(self, stmt: ast.Insert, info: TableInfo,
                     names: List[str]) -> Chunk:
@@ -484,6 +634,34 @@ class Session:
             ddl = f"CREATE TABLE `{t.name}` (\n  {body}\n)"
             return ResultSet(["Table", "Create Table"],
                              [T.varchar(), T.varchar()], [(t.name, ddl)])
+        if stmt.kind == "indexes":
+            t = info_schema.table(stmt.target)
+            rows = [(t.name, ix.name, ",".join(ix.columns),
+                     "YES" if ix.unique else "NO") for ix in t.indexes]
+            if t.primary_key:
+                rows.insert(0, (t.name, "PRIMARY",
+                                ",".join(t.primary_key), "YES"))
+            return ResultSet(["Table", "Key_name", "Columns", "Unique"],
+                             [T.varchar()] * 4, rows)
+        from tidb_tpu.util.observability import REGISTRY
+        if stmt.kind == "metrics":
+            return ResultSet(["Metric", "Labels", "Value"],
+                             [T.varchar(), T.varchar(), T.double()],
+                             REGISTRY.metric_rows())
+        if stmt.kind == "slow_queries":
+            return ResultSet(
+                ["Time", "Duration_s", "Rows", "Engine", "Query"],
+                [T.varchar(), T.double(), T.bigint(), T.varchar(),
+                 T.varchar()], REGISTRY.slow_rows())
+        if stmt.kind == "statement_summary":
+            return ResultSet(
+                ["Digest", "Count", "Sum_s", "Avg_s", "Max_s", "Rows"],
+                [T.varchar(), T.bigint(), T.double(), T.double(),
+                 T.double(), T.bigint()], REGISTRY.summary_rows())
+        if stmt.kind == "processlist":
+            return ResultSet(["Id", "Time_s", "Info"],
+                             [T.bigint(), T.double(), T.varchar()],
+                             REGISTRY.process_rows())
         raise PlanError(f"unsupported SHOW {stmt.kind}")
 
     def _analyze(self, stmt: ast.AnalyzeTable) -> ResultSet:
@@ -593,3 +771,32 @@ def _assemble_rows(rows: List[List], info: TableInfo,
                     f"Field '{c.name}' doesn't have a default value")
         out_rows.append(row)
     return out_rows
+
+
+def _key_tuples(chunk: Chunk, idxs: List[int]):
+    """Per-row unique-key tuples; None when any component is NULL (NULL
+    never participates in unique conflicts, MySQL semantics)."""
+    cols = [(chunk.columns[i].values, chunk.columns[i].valid_mask())
+            for i in idxs]
+    out = []
+    for ri in range(chunk.num_rows):
+        parts = []
+        null = False
+        for v, m in cols:
+            if not m[ri]:
+                null = True
+                break
+            parts.append(v[ri])
+        out.append(None if null else tuple(parts))
+    return out
+
+
+def _used_device(exec_root) -> bool:
+    from tidb_tpu.executor.fragment import TpuFragmentExec
+
+    def walk(e):
+        if isinstance(e, TpuFragmentExec) and e.used_device:
+            return True
+        return any(walk(c) for c in getattr(e, "children", []))
+
+    return walk(exec_root)

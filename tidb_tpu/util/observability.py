@@ -1,0 +1,171 @@
+"""Metrics, slow-query log, statement summary (ref: metrics/metrics.go:68,
+executor/slow_query.go:59, util/stmtsummary/statement_summary.go:66).
+
+The reference registers ~17 Prometheus collectors and exposes them over
+HTTP; queries can also read the slow log and statement summaries as SQL
+tables. Here one process-wide registry backs all three surfaces:
+
+  * counters + histograms, rendered in Prometheus text format
+    (`render_prometheus`) and served by util/status_server.py;
+  * a slow-query ring buffer (threshold: `long_query_time` sysvar);
+  * per-SQL-digest statement summaries (count/total/max latency, rows).
+
+SQL surfaces: SHOW METRICS / SHOW SLOW QUERIES / SHOW STATEMENT SUMMARY
+/ SHOW PROCESSLIST (session/__init__.py wires them)."""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Dict, List, Optional, Tuple
+
+_BUCKETS = (0.001, 0.005, 0.02, 0.1, 0.5, 2.0, 10.0)
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters: Dict[Tuple[str, Tuple], float] = {}
+        self.hists: Dict[Tuple[str, Tuple], List] = {}
+        self.slow_log: deque = deque(maxlen=256)
+        self.stmt_summary: "OrderedDict[str, dict]" = OrderedDict()
+        self.processlist: Dict[int, dict] = {}
+
+    # -- metrics -----------------------------------------------------------
+    def inc(self, name: str, labels: Dict[str, str] = None, by: float = 1):
+        key = (name, tuple(sorted((labels or {}).items())))
+        with self._lock:
+            self.counters[key] = self.counters.get(key, 0) + by
+
+    def observe(self, name: str, value: float,
+                labels: Dict[str, str] = None):
+        key = (name, tuple(sorted((labels or {}).items())))
+        with self._lock:
+            h = self.hists.get(key)
+            if h is None:
+                h = [[0] * (len(_BUCKETS) + 1), 0.0, 0]   # buckets, sum, n
+                self.hists[key] = h
+            i = 0
+            while i < len(_BUCKETS) and value > _BUCKETS[i]:
+                i += 1
+            h[0][i] += 1
+            h[1] += value
+            h[2] += 1
+
+    def metric_rows(self) -> List[tuple]:
+        with self._lock:
+            out = []
+            for (name, labels), v in sorted(self.counters.items()):
+                lbl = ",".join(f"{k}={val}" for k, val in labels)
+                out.append((name, lbl, float(v)))
+            for (name, labels), (bk, s, n) in sorted(self.hists.items()):
+                lbl = ",".join(f"{k}={val}" for k, val in labels)
+                out.append((name + "_count", lbl, float(n)))
+                out.append((name + "_sum", lbl, round(s, 6)))
+            return out
+
+    def render_prometheus(self) -> str:
+        lines = []
+        with self._lock:
+            for (name, labels), v in sorted(self.counters.items()):
+                lines.append(f"{name}{_fmt_labels(labels)} {v}")
+            for (name, labels), (bk, s, n) in sorted(self.hists.items()):
+                acc = 0
+                for b, cnt in zip(_BUCKETS, bk):
+                    acc += cnt
+                    lines.append(
+                        f'{name}_bucket{_fmt_labels(labels, ("le", b))} '
+                        f"{acc}")
+                lines.append(
+                    f'{name}_bucket{_fmt_labels(labels, ("le", "+Inf"))} '
+                    f"{n}")
+                lines.append(f"{name}_sum{_fmt_labels(labels)} {s}")
+                lines.append(f"{name}_count{_fmt_labels(labels)} {n}")
+        return "\n".join(lines) + "\n"
+
+    # -- statement-level records -------------------------------------------
+    def record_stmt(self, sql: str, seconds: float, rows: int,
+                    engine: str, threshold: float):
+        digest = normalize_sql(sql)
+        now = time.time()
+        with self._lock:
+            s = self.stmt_summary.get(digest)
+            if s is None:
+                s = {"digest": digest, "count": 0, "sum_s": 0.0,
+                     "max_s": 0.0, "rows": 0, "last_seen": 0.0}
+                self.stmt_summary[digest] = s
+                while len(self.stmt_summary) > 512:
+                    self.stmt_summary.popitem(last=False)
+            s["count"] += 1
+            s["sum_s"] += seconds
+            s["max_s"] = max(s["max_s"], seconds)
+            s["rows"] += rows
+            s["last_seen"] = now
+            if seconds >= threshold:
+                self.slow_log.append({
+                    "time": now, "query": sql[:2048],
+                    "duration_s": round(seconds, 6), "rows": rows,
+                    "engine": engine})
+
+    def slow_rows(self) -> List[tuple]:
+        with self._lock:
+            return [(time.strftime("%Y-%m-%d %H:%M:%S",
+                                   time.localtime(e["time"])),
+                     e["duration_s"], e["rows"], e["engine"], e["query"])
+                    for e in reversed(self.slow_log)]
+
+    def summary_rows(self) -> List[tuple]:
+        with self._lock:
+            out = [(s["digest"], s["count"], round(s["sum_s"], 6),
+                    round(s["sum_s"] / max(s["count"], 1), 6),
+                    round(s["max_s"], 6), s["rows"])
+                   for s in self.stmt_summary.values()]
+        out.sort(key=lambda r: -r[2])
+        return out
+
+    # -- processlist --------------------------------------------------------
+    def stmt_begin(self, conn_id: int, sql: str):
+        with self._lock:
+            self.processlist[conn_id] = {"sql": sql[:256],
+                                         "start": time.time()}
+
+    def stmt_end(self, conn_id: int):
+        with self._lock:
+            self.processlist.pop(conn_id, None)
+
+    def process_rows(self) -> List[tuple]:
+        now = time.time()
+        with self._lock:
+            return [(cid, round(now - e["start"], 3), e["sql"])
+                    for cid, e in sorted(self.processlist.items())]
+
+
+def _fmt_labels(labels: Tuple, extra: Optional[Tuple] = None) -> str:
+    items = list(labels)
+    if extra is not None:
+        items.append(extra)
+    if not items:
+        return ""
+    return "{" + ",".join(f'{k}="{v}"' for k, v in items) + "}"
+
+
+_NORM_NUM = re.compile(r"\b\d+(\.\d+)?\b")
+_NORM_STR = re.compile(r"'(?:[^'\\]|\\.)*'")
+_NORM_WS = re.compile(r"\s+")
+
+
+def normalize_sql(sql: str) -> str:
+    """SQL digest: literals → ?, collapsed whitespace (the reference's
+    parser.Normalize)."""
+    s = _NORM_STR.sub("?", sql)
+    s = _NORM_NUM.sub("?", s)
+    s = _NORM_WS.sub(" ", s).strip()
+    # collapse IN/VALUES lists so bulk inserts share one digest
+    s = re.sub(r"\((\s*\?\s*,)+\s*\?\s*\)", "(?)", s)
+    s = re.sub(r"(\(\?\)\s*,\s*)+\(\?\)", "(?)", s)
+    return s[:512]
+
+
+REGISTRY = Registry()

@@ -1,0 +1,62 @@
+"""HTTP status/metrics endpoint (ref: server/http_status.go:193).
+
+Serves the reference's two load-bearing routes:
+  /metrics  — Prometheus text format from util/observability.REGISTRY;
+  /status   — JSON liveness blob (version, connections, ddl history).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+class StatusServer:
+    def __init__(self, engine=None, host: str = "127.0.0.1",
+                 port: int = 10080):
+        from tidb_tpu.util.observability import REGISTRY
+        eng = engine
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):       # quiet
+                pass
+
+            def do_GET(self):
+                if self.path == "/metrics":
+                    body = REGISTRY.render_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                elif self.path == "/status":
+                    payload = {"version": "tidb-tpu", "status": "ok"}
+                    if eng is not None:
+                        payload["ddl_history"] = \
+                            eng.catalog.ddl_history()[-20:]
+                        payload["schema_version"] = \
+                            eng.catalog.info_schema.version
+                    body = json.dumps(payload).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                else:
+                    body = b"not found"
+                    self.send_response(404)
+                    self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._srv = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._srv.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "StatusServer":
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
