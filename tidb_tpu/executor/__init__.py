@@ -36,11 +36,16 @@ class ExecContext:
     """Per-statement execution context (ref: sessionctx.Context subset)."""
 
     def __init__(self, txn=None, snapshot=None, vars: Optional[Dict] = None):
+        from tidb_tpu.util.memory import Tracker
         self.txn = txn              # storage.Transaction (reads merge staged)
         self.snapshot = snapshot    # storage.Snapshot (autocommit reads)
         self.vars = vars or {}
         self.killed = False
         self.runtime_stats: Dict[int, "OperatorStats"] = {}
+        # per-statement quota root (ref: memory.Tracker attached to the
+        # session; tidb_mem_quota_query, 0 = unlimited)
+        quota = int(self.vars.get("tidb_mem_quota_query", 0) or 0)
+        self.mem_tracker = Tracker("query", quota)
 
     @property
     def chunk_size(self) -> int:

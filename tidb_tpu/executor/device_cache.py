@@ -29,6 +29,10 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 MAX_CACHED_TABLES = 4
+# HBM budget for the table cache (v5e has 16 GiB; leave headroom for the
+# programs' working set). Exceeding it evicts LRU tables — the memory
+# Tracker analog for device residency (util/memory/tracker.go).
+DEFAULT_HBM_BUDGET_BYTES = 8 << 30
 
 
 class CachedTable:
@@ -54,6 +58,13 @@ class CachedTable:
 
     def slab_rows(self, s: int) -> int:
         return min(self.slab_cap, self.total - s * self.slab_cap)
+
+    def hbm_bytes(self) -> int:
+        total = 0
+        for slabs in self.dev.values():
+            for v, m in slabs:
+                total += v.nbytes + m.nbytes
+        return total
 
 
 _CACHE: "OrderedDict[int, CachedTable]" = OrderedDict()
@@ -205,7 +216,24 @@ def get_table(ctx, scan, used_cols, max_slab: int) -> CachedTable:
 
     if ent.total:
         ftypes = scan.schema.field_types
+        uploaded = False
         for i in used_cols:
             if i not in ent.dev:
                 _upload_col(ent, i, ftypes[i])
+                uploaded = True
+        if uploaded and cacheable:
+            budget = int(ctx.vars.get("tidb_tpu_hbm_budget",
+                                      DEFAULT_HBM_BUDGET_BYTES))
+            _evict_to_budget(budget, keep=key)
     return ent
+
+
+def _evict_to_budget(budget: int, keep) -> None:
+    """Drop LRU cached tables until resident bytes fit the HBM budget
+    (never the entry in active use)."""
+    total = sum(e.hbm_bytes() for e in _CACHE.values())
+    while total > budget and len(_CACHE) > 1:
+        victim = next((k for k in _CACHE if k != keep), None)
+        if victim is None:
+            return
+        total -= _CACHE.pop(victim).hbm_bytes()

@@ -822,8 +822,10 @@ class TpuFragmentExec:
         total, slab_cap, n_slabs = ent.total, ent.slab_cap, ent.n_slabs
 
         root = chain[0]
-        if isinstance(root, PhysSort) and n_slabs > 1:
-            raise FragmentFallback("multi-slab global sort")
+        # multi-slab Sort: each slab sorts on device; the host performs the
+        # k-way run merge in _execute_order via rank-key lexsort (numpy's
+        # stable sort is a merge sort — presorted runs merge cheaply), the
+        # disk-spill multiWayMerge analog of executor/sort.go:56-58
         if isinstance(root, PhysWindow) and n_slabs > 1:
             # partitions span slabs; no cross-slab merge for windows yet
             raise FragmentFallback("multi-slab window")

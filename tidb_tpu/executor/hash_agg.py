@@ -31,6 +31,13 @@ from tidb_tpu.planner.physical import PhysHashAgg
 _OVERFLOW_GUARD = 1 << 61
 
 
+def _iter_batches(distinct_rows, n_batches):
+    """Transpose per-agg distinct lists into per-batch rows for spilling."""
+    for b in range(n_batches):
+        yield [rows[b] if b < len(rows) else None
+               for rows in distinct_rows]
+
+
 def factorize_columns(cols: Sequence[Tuple[np.ndarray, np.ndarray]]
                       ) -> Tuple[np.ndarray, int, np.ndarray]:
     """Dense group ids for multi-column keys, NULLs forming their own group.
@@ -83,57 +90,154 @@ class HashAggExec(Executor):
         self._offset = 0
 
     # ---- core -------------------------------------------------------------
+    N_SPILL_PARTITIONS = 16
+
     def _aggregate(self) -> Chunk:
+        from tidb_tpu.util import memory as M
         partial_keys: List[List[Tuple[np.ndarray, np.ndarray]]] = []
         partial_states: List[List[Tuple]] = []
         distinct_rows: List[List[Tuple[np.ndarray, np.ndarray, np.ndarray]]] = \
             [[] for _ in self.aggs]
         saw_rows = False
+        spill = None                # PartitionedPickleSpill once engaged
+        tracker = self.ctx.mem_tracker.child("HashAgg")
+        tracked = 0
 
-        while True:
-            ch = self.child_next()
-            if ch is None:
-                break
-            if ch.num_rows == 0:
+        def engage_spill() -> bool:
+            # AggSpillDiskAction analog: partition accumulated partials by
+            # group-key hash onto disk; later batches write through
+            nonlocal spill, tracked, partial_keys, partial_states
+            nonlocal distinct_rows
+            if self.scalar or spill is not None:
+                return False     # single group: nothing to partition
+            spill = M.PartitionedPickleSpill(self.N_SPILL_PARTITIONS)
+            for pk, st, dr in zip(partial_keys, partial_states,
+                                  _iter_batches(distinct_rows,
+                                                len(partial_keys))):
+                self._spill_batch(spill, pk, st, dr)
+            partial_keys, partial_states = [], []
+            distinct_rows = [[] for _ in self.aggs]
+            tracker.release(tracked)
+            tracked = 0
+            return True
+
+        tracker.add_handler(engage_spill)
+
+        try:
+            while True:
+                ch = self.child_next()
+                if ch is None:
+                    break
+                if ch.num_rows == 0:
+                    continue
+                saw_rows = True
+                ctx = host_context(ch)
+                key_cols = [e.eval(ctx) for e in self.group_exprs]
+                gids, n_groups, reps = factorize_columns(key_cols)
+                if self.scalar:
+                    gids = np.zeros(ch.num_rows, dtype=np.int64)
+                    n_groups, reps = 1, np.zeros(1, dtype=np.int64)
+                states = []
+                batch_distinct = [None] * len(self.aggs)
+                for i, (agg, desc) in enumerate(zip(self.aggs, self.descs)):
+                    if desc.args:
+                        # multi-arg only for COUNT(DISTINCT a, b): row counts
+                        # iff every arg is non-NULL (MySQL semantics)
+                        vs, ms = [], []
+                        for a in desc.args:
+                            v, m = a.eval(ctx)
+                            vs.append(np.asarray(v))
+                            ms.append(np.asarray(m, dtype=bool))
+                        m = ms[0]
+                        for extra in ms[1:]:
+                            m = m & extra
+                        v = vs[0]
+                    else:  # COUNT(*)
+                        vs = [np.zeros(ch.num_rows, dtype=np.int64)]
+                        v = vs[0]
+                        m = np.ones(ch.num_rows, dtype=bool)
+                    if desc.distinct:
+                        batch_distinct[i] = (gids, vs, m)
+                        states.append(None)
+                    else:
+                        st = agg.init(np, n_groups)
+                        states.append(agg.update(np, st, gids, n_groups, v, m))
+                pk = [(np.asarray(v)[reps], np.asarray(m, dtype=bool)[reps])
+                      for v, m in key_cols]
+                if spill is not None:
+                    self._spill_batch(spill, pk, states, batch_distinct)
+                    continue
+                partial_keys.append(pk)
+                partial_states.append(states)
+                for i, bd in enumerate(batch_distinct):
+                    if bd is not None:
+                        distinct_rows[i].append(bd)
+                batch_bytes = sum(M.array_bytes(v, m) for v, m in pk)
+                for st in states:
+                    if st is not None:
+                        batch_bytes += M.array_bytes(*st)
+                for bd in batch_distinct:
+                    if bd is not None:
+                        batch_bytes += M.array_bytes(bd[0], bd[2], *bd[1])
+                tracked += batch_bytes
+                tracker.consume(batch_bytes)
+
+            if spill is None:
+                return self._merge_partials(partial_keys, partial_states,
+                                            distinct_rows, saw_rows)
+            return self._merge_spilled(spill, saw_rows)
+        finally:
+            tracker.remove_handler(engage_spill)
+            tracker.release(tracked)
+            if spill is not None:
+                spill.close()
+
+    def _spill_batch(self, spill, pk, states, batch_distinct) -> None:
+        """Split one batch's partial groups by key hash into partitions."""
+        from tidb_tpu.util.memory import hash_partition
+        n_groups = len(pk[0][0]) if pk else 0
+        buckets = hash_partition(pk, spill.n)
+        for p in np.unique(buckets):
+            gsel = buckets == p
+            keymap = np.full(n_groups, -1, dtype=np.int64)
+            keymap[np.nonzero(gsel)[0]] = np.arange(int(gsel.sum()))
+            pk_p = [(v[gsel], m[gsel]) for v, m in pk]
+            st_p = [None if st is None else tuple(a[gsel] for a in st)
+                    for st in states]
+            dr_p = []
+            for bd in batch_distinct:
+                if bd is None:
+                    dr_p.append(None)
+                    continue
+                gids, vs, m = bd
+                rsel = gsel[gids]
+                dr_p.append((keymap[gids[rsel]],
+                             [v[rsel] for v in vs], m[rsel]))
+            spill.add(int(p), (pk_p, st_p, dr_p))
+
+    def _merge_spilled(self, spill, saw_rows: bool) -> Chunk:
+        """Partition-at-a-time final merge: peak memory ≈ one partition."""
+        pieces = []
+        for p in range(spill.n):
+            partial_keys, partial_states = [], []
+            distinct_rows = [[] for _ in self.aggs]
+            any_batch = False
+            for pk_p, st_p, dr_p in spill.read(p):
+                any_batch = True
+                partial_keys.append(pk_p)
+                partial_states.append(st_p)
+                for i, d in enumerate(dr_p):
+                    if d is not None:
+                        distinct_rows[i].append(d)
+            if not any_batch:
                 continue
-            saw_rows = True
-            ctx = host_context(ch)
-            key_cols = [e.eval(ctx) for e in self.group_exprs]
-            gids, n_groups, reps = factorize_columns(key_cols)
-            if self.scalar:
-                gids = np.zeros(ch.num_rows, dtype=np.int64)
-                n_groups, reps = 1, np.zeros(1, dtype=np.int64)
-            states = []
-            for i, (agg, desc) in enumerate(zip(self.aggs, self.descs)):
-                if desc.args:
-                    # multi-arg only for COUNT(DISTINCT a, b): row counts
-                    # iff every arg is non-NULL (MySQL semantics)
-                    vs, ms = [], []
-                    for a in desc.args:
-                        v, m = a.eval(ctx)
-                        vs.append(np.asarray(v))
-                        ms.append(np.asarray(m, dtype=bool))
-                    m = ms[0]
-                    for extra in ms[1:]:
-                        m = m & extra
-                    v = vs[0]
-                else:  # COUNT(*)
-                    vs = [np.zeros(ch.num_rows, dtype=np.int64)]
-                    v = vs[0]
-                    m = np.ones(ch.num_rows, dtype=bool)
-                if desc.distinct:
-                    distinct_rows[i].append((gids, vs, m))
-                    states.append(None)
-                else:
-                    st = agg.init(np, n_groups)
-                    states.append(agg.update(np, st, gids, n_groups, v, m))
-            partial_keys.append([(np.asarray(v)[reps],
-                                  np.asarray(m, dtype=bool)[reps])
-                                 for v, m in key_cols])
-            partial_states.append(states)
-
-        return self._merge_partials(partial_keys, partial_states,
-                                    distinct_rows, saw_rows)
+            piece = self._merge_partials(partial_keys, partial_states,
+                                         distinct_rows, True)
+            if piece.num_rows:
+                pieces.append(piece)
+        if not pieces:
+            return _empty_chunk(self.schema)
+        return Chunk.concat(pieces) if len(pieces) > 1 else pieces[0]
 
     def _merge_partials(self, partial_keys, partial_states, distinct_rows,
                         saw_rows: bool) -> Chunk:

@@ -32,6 +32,11 @@ from tidb_tpu.types import TypeKind
 _CODE_GUARD = 1 << 61
 
 
+def _empty_like(ftypes) -> Chunk:
+    from tidb_tpu.executor import _empty_chunk
+    return _empty_chunk(list(ftypes))
+
+
 def _key_arrays(exprs: List[Expression], chunk: Chunk):
     ctx = host_context(chunk)
     out = []
@@ -149,6 +154,8 @@ class _BuildTable:
 
 
 class HashJoinExec(Executor):
+    N_SPILL_PARTITIONS = 16
+
     def __init__(self, plan: PhysHashJoin, left: Executor, right: Executor):
         super().__init__(plan.schema.field_types, [left, right])
         self.plan = plan
@@ -157,11 +164,29 @@ class HashJoinExec(Executor):
         self.equi = [coerce_key_pair(l, r) for l, r in plan.equi]
         self._table: Optional[_BuildTable] = None
         self._build_chunk: Optional[Chunk] = None
+        self._grace = None            # (build_spill, probe_spill) if spilled
+        self._grace_iter = None
+        self._tracker = None
+        self._tracked = 0
 
     def open(self, ctx):
         super().open(ctx)
         self._table = None
         self._build_chunk = None
+        self._grace = None
+        self._grace_iter = None
+        self._tracker = None
+        self._tracked = 0
+
+    def close(self):
+        super().close()
+        if self._grace is not None:
+            for sp in self._grace:
+                sp.close()
+            self._grace = None
+        if self._tracker is not None and self._tracked:
+            self._tracker.release(self._tracked)
+            self._tracked = 0
 
     # ---- sides -------------------------------------------------------------
     @property
@@ -180,17 +205,102 @@ class HashJoinExec(Executor):
         return left_keys, right_keys
 
     def _ensure_built(self):
-        if self._table is not None:
+        if self._table is not None or self._grace is not None:
             return
+        from tidb_tpu.util import memory as M
         build_exec = self.children[self._build_idx]
-        self._build_chunk = build_exec.drain()
+        build_fts = build_exec.schema
+        self._tracker = self.ctx.mem_tracker.child("HashJoin")
+        chunks: List[Chunk] = []
+        state = {"spill": None}
+
+        def engage() -> bool:
+            # grace hash join (the hashRowContainer spill,
+            # executor/hash_table.go:77): partition the build side to disk
+            if not self.equi or state["spill"] is not None:
+                return False       # cross join cannot partition
+            state["spill"] = M.PartitionedChunkSpill(
+                self.N_SPILL_PARTITIONS, build_fts)
+            for ch in chunks:
+                self._spill_side(state["spill"], ch, build=True)
+            chunks.clear()
+            self._tracker.release(self._tracked)
+            self._tracked = 0
+            return True
+
+        self._tracker.add_handler(engage)
+        try:
+            while True:
+                ch = self.child_next(self._build_idx)
+                if ch is None:
+                    break
+                if ch.num_rows == 0:
+                    continue
+                if state["spill"] is not None:
+                    self._spill_side(state["spill"], ch, build=True)
+                    continue
+                chunks.append(ch)
+                b = M.chunk_bytes(ch)
+                self._tracked += b
+                self._tracker.consume(b)
+        finally:
+            self._tracker.remove_handler(engage)
+        if state["spill"] is not None:
+            probe_fts = self.children[self._probe_idx].schema
+            self._grace = (state["spill"],
+                           M.PartitionedChunkSpill(self.N_SPILL_PARTITIONS,
+                                                   probe_fts))
+            return
+        self._build_chunk = (Chunk.concat(chunks) if len(chunks) > 1
+                             else chunks[0] if chunks
+                             else _empty_like(build_fts))
         build_key_exprs, _ = self._keys()
         bkeys = _key_arrays(build_key_exprs, self._build_chunk)
         self._table = _BuildTable(bkeys)
 
+    def _spill_side(self, spill, chunk: Chunk, build: bool) -> None:
+        from tidb_tpu.util.memory import hash_partition
+        build_key_exprs, probe_key_exprs = self._keys()
+        exprs = build_key_exprs if build else probe_key_exprs
+        keys = _key_arrays(exprs, chunk)
+        keys = [(_normalize(v), m) for v, m in keys]
+        spill.add_partitioned(chunk, hash_partition(keys, spill.n))
+
+    def _grace_results(self):
+        """Partition-at-a-time join: per partition, an in-memory build over
+        ~1/P of the build side, probing that partition's probe chunks."""
+        build_spill, probe_spill = self._grace
+        build_key_exprs, _ = self._keys()
+        for p in range(build_spill.n):
+            self.ctx.check_killed()
+            bchunks = list(build_spill.read(p))
+            self._build_chunk = (Chunk.concat(bchunks)
+                                 if len(bchunks) > 1 else bchunks[0]
+                                 if bchunks else
+                                 _empty_like(self.children[
+                                     self._build_idx].schema))
+            self._table = _BuildTable(
+                _key_arrays(build_key_exprs, self._build_chunk))
+            for probe in probe_spill.read(p):
+                out = self._join_chunk(probe)
+                if out is not None and out.num_rows:
+                    yield out
+
     # ---- volcano -----------------------------------------------------------
     def next(self) -> Optional[Chunk]:
         self._ensure_built()
+        if self._grace is not None:
+            if self._grace_iter is None:
+                # drain + partition the probe side, then join per partition
+                while True:
+                    probe = self.child_next(self._probe_idx)
+                    if probe is None:
+                        break
+                    if probe.num_rows:
+                        self._spill_side(self._grace[1], probe,
+                                         build=False)
+                self._grace_iter = self._grace_results()
+            return next(self._grace_iter, None)
         while True:
             probe = self.child_next(self._probe_idx)
             if probe is None:

@@ -976,14 +976,43 @@ def _in(func, ctx):
         for c in codeset:
             hit = hit | (v == c)
         return hit, m
-    # each membership test goes through the eq kernel so mixed-type items
-    # coerce like `col = item` would (a DECIMAL 5.5 must NOT compare its
-    # scaled encoding 55 against raw BIGINT values); the probe expression
-    # evaluates ONCE and rides as a precomputed leaf
+    # fast path: integer probe + constant items → ONE sorted-table binary
+    # search instead of per-item compares (IN-subqueries expand to
+    # thousands of constants). Non-integral items can never equal an
+    # integer value (MySQL numeric compare), so they drop out exactly.
+    items = func.args[1:]
+    if arg.ftype.kind.is_integer and all(isinstance(c, Constant)
+                                         for c in items):
+        import decimal as _dec
+        ints = set()
+        for c in items:
+            cv = c.value
+            if cv is None:
+                continue
+            if isinstance(cv, bool):
+                cv = int(cv)
+            if isinstance(cv, (int, np.integer)):
+                cv = int(cv)
+            elif isinstance(cv, (float, _dec.Decimal)) and cv == int(cv):
+                cv = int(cv)
+            else:
+                continue
+            if -(1 << 63) <= cv < (1 << 63):   # out-of-range never matches
+                ints.add(cv)
+        table = xp.asarray(np.array(sorted(ints), dtype=np.int64))
+        if len(ints) == 0:
+            return xp.zeros(v.shape[0], dtype=bool), m
+        pos = xp.clip(xp.searchsorted(table, v), 0, len(ints) - 1)
+        hit = xp.take(table, pos) == v
+        return hit, m
+    # general path: each membership test goes through the eq kernel so
+    # mixed-type items coerce like `col = item` would (a DECIMAL 5.5 must
+    # NOT compare its scaled encoding 55 against raw BIGINT values); the
+    # probe expression evaluates ONCE and rides as a precomputed leaf
     hit = None
     eqfn = _KERNELS["eq"]
     pre = _Precomputed(v, m, arg.ftype)
-    for cexpr in func.args[1:]:
+    for cexpr in items:
         h, hm = eqfn(ScalarFunc("eq", [pre, cexpr], T.bigint(False)), ctx)
         h = h & hm
         hit = h if hit is None else (hit | h)

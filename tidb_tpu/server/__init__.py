@@ -39,12 +39,17 @@ CLIENT_PROTOCOL_41 = 1 << 9
 CLIENT_TRANSACTIONS = 1 << 13
 CLIENT_SECURE_CONNECTION = 1 << 15
 CLIENT_PLUGIN_AUTH = 1 << 19
+CLIENT_MULTI_STATEMENTS = 1 << 16
+CLIENT_MULTI_RESULTS = 1 << 17
 CLIENT_DEPRECATE_EOF = 1 << 24
 
 SERVER_CAPS = (CLIENT_LONG_PASSWORD | CLIENT_FOUND_ROWS | CLIENT_LONG_FLAG
                | CLIENT_CONNECT_WITH_DB | CLIENT_PROTOCOL_41
                | CLIENT_TRANSACTIONS | CLIENT_SECURE_CONNECTION
-               | CLIENT_PLUGIN_AUTH)
+               | CLIENT_PLUGIN_AUTH | CLIENT_MULTI_STATEMENTS
+               | CLIENT_MULTI_RESULTS)
+
+SERVER_MORE_RESULTS_EXISTS = 0x0008
 
 COM_QUIT = 0x01
 COM_INIT_DB = 0x02
@@ -176,7 +181,7 @@ class _Conn:
                 + bytes([ft.scale & 0xFF]) + b"\x00\x00")
 
     def write_resultset(self, names: List[str], ftypes: List[FieldType],
-                        rows: List[tuple]) -> None:
+                        rows: List[tuple], status: int = 0x0002) -> None:
         self.write_packet(_lenenc_int(len(names)))
         for nm, ft in zip(names, ftypes):
             self.write_packet(self._coldef(nm, ft))
@@ -189,7 +194,7 @@ class _Conn:
                 else:
                     out += _lenenc_str(_text_value(v))
             self.write_packet(out)
-        self.write_eof()
+        self.write_eof(status)
 
     # -- command loop --------------------------------------------------------
     def run(self) -> None:
@@ -224,11 +229,16 @@ class _Conn:
                 self.write_err(1105, f"{type(e).__name__}: {e}")
 
     def _query(self, sql: str) -> None:
-        for rs in self.session.execute(sql):
+        results = self.session.execute(sql)
+        for i, rs in enumerate(results):
+            # non-final resultsets carry SERVER_MORE_RESULTS_EXISTS so
+            # drivers keep reading (multi-statement COM_QUERY)
+            status = 0x0002 | (SERVER_MORE_RESULTS_EXISTS
+                               if i + 1 < len(results) else 0)
             if rs.is_query:
-                self.write_resultset(rs.names, rs.ftypes, rs.rows)
+                self.write_resultset(rs.names, rs.ftypes, rs.rows, status)
             else:
-                self.write_ok(affected=rs.affected_rows)
+                self.write_ok(affected=rs.affected_rows, status=status)
 
 
 def _text_value(v) -> bytes:

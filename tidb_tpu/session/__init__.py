@@ -384,13 +384,18 @@ class Session:
         for cname, cols in constraints:
             idxs = [col_of[c.lower()] for c in cols]
             new_keys = _key_tuples(chunk, idxs)
-            # in-batch duplicates (first row wins under IGNORE/REPLACE)
+            # in-batch duplicates: IGNORE keeps the FIRST occurrence,
+            # REPLACE keeps the LAST (MySQL: later rows replace earlier)
             seen = {}
             for ri, k in enumerate(new_keys):
                 if k is None or not keep[ri]:
                     continue
                 if k in seen:
-                    if ignore or replace:
+                    if replace:
+                        keep[seen[k]] = False
+                        seen[k] = ri
+                        continue
+                    if ignore:
                         keep[ri] = False
                         continue
                     raise DuplicateKeyError(
@@ -401,13 +406,21 @@ class Session:
             # conflicts against the (staged-visible) current table
             conflict_masks: Dict[int, np.ndarray] = {}
             staged_keep: List[np.ndarray] = []
+            first_vals = np.array([k[0] for k in seen], dtype=object)
             for region, ch, alive in txn.scan(info.id):
-                ex_keys = _key_tuples(ch, idxs)
+                # vectorized prefilter on the first key column narrows the
+                # python tuple check to near-candidates (O(batch) not O(n))
+                c0 = ch.columns[idxs[0]]
+                cand = np.isin(c0.values.astype(object), first_vals) & \
+                    c0.valid_mask() & alive
                 hit = np.zeros(ch.num_rows, dtype=bool)
-                for ri in range(ch.num_rows):
-                    if alive[ri] and ex_keys[ri] is not None and \
-                            ex_keys[ri] in seen:
-                        hit[ri] = True
+                if cand.any():
+                    ex_keys = _key_tuples(ch.take(np.nonzero(cand)[0]),
+                                          idxs)
+                    ci = np.nonzero(cand)[0]
+                    for j, k in enumerate(ex_keys):
+                        if k is not None and k in seen:
+                            hit[ci[j]] = True
                 if not hit.any():
                     if region is None:
                         staged_keep.append(np.ones(ch.num_rows,

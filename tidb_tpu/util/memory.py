@@ -1,0 +1,208 @@
+"""Memory accounting + spill containers (ref: util/memory/tracker.go:54,
+util/chunk/row_container.go).
+
+The reference threads a hierarchical byte Tracker through every blocking
+operator; crossing the root quota fires an ActionOnExceed chain — spill
+for operators that can, cancel otherwise. Same contract here:
+
+  * Tracker — consume/release walk up to the root; on quota excess the
+    nearest handler (registered by a spillable operator) gets a chance
+    to shed memory before MemoryQuotaExceeded cancels the query;
+  * PartitionedChunkSpill — grace-hash partition files of wire-codec
+    chunks on disk (the RowContainer analog for join sides);
+  * PartitionedPickleSpill — partition files of arbitrary picklable
+    records (aggregation partial states).
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+import tempfile
+from typing import Callable, Iterator, List, Optional
+
+import numpy as np
+
+from tidb_tpu.chunk import Chunk
+from tidb_tpu.chunk.codec import decode_chunk, encode_chunk
+from tidb_tpu.errors import MemoryQuotaExceeded
+
+
+class Tracker:
+    """Hierarchical byte accounting (ref: memory.Tracker)."""
+
+    def __init__(self, label: str = "root", quota: int = 0,
+                 parent: Optional["Tracker"] = None):
+        self.label = label
+        self.quota = int(quota)          # 0 = unlimited
+        self.parent = parent
+        self.consumed = 0
+        self.peak = 0
+        # ActionOnExceed chain (ref: memory/action.go:29): spillable
+        # operators push a handler; on quota excess handlers run LIFO
+        # until one returns True (memory shed/diverted), else fatal
+        self.handlers: List[Callable[[], bool]] = []
+
+    def add_handler(self, fn: Callable[[], bool]) -> None:
+        self._root().handlers.append(fn)
+
+    def remove_handler(self, fn: Callable[[], bool]) -> None:
+        root = self._root()
+        if fn in root.handlers:
+            root.handlers.remove(fn)
+
+    def _root(self) -> "Tracker":
+        t = self
+        while t.parent is not None:
+            t = t.parent
+        return t
+
+    def consume(self, n: int) -> None:
+        t = self
+        while t is not None:
+            t.consumed += n
+            t.peak = max(t.peak, t.consumed)
+            if t.quota and t.consumed > t.quota:
+                handled = False
+                for fn in reversed(list(t.handlers)):
+                    if fn():
+                        handled = True
+                        break
+                if not handled and t.consumed > t.quota:
+                    raise MemoryQuotaExceeded(
+                        f"Out Of Memory Quota! quota={t.quota} "
+                        f"consumed={t.consumed} tracker={t.label}")
+            t = t.parent
+
+    def release(self, n: int) -> None:
+        t = self
+        while t is not None:
+            t.consumed -= n
+            t = t.parent
+
+    def child(self, label: str) -> "Tracker":
+        return Tracker(label, 0, self)
+
+
+def chunk_bytes(chunk: Chunk) -> int:
+    total = 0
+    for c in chunk.columns:
+        v = c.values
+        if v.dtype == object:
+            # strings: pointer + rough payload estimate
+            total += v.size * 8
+            if v.size:
+                sample = v[: min(v.size, 64)]
+                avg = sum(len(str(x)) for x in sample) / len(sample)
+                total += int(avg * v.size)
+        else:
+            total += v.nbytes
+        total += v.size // 8 + 8          # validity
+    return total
+
+
+def array_bytes(*arrays) -> int:
+    total = 0
+    for a in arrays:
+        a = np.asarray(a)
+        total += a.size * 8 if a.dtype == object else a.nbytes
+    return total
+
+
+def hash_partition(keys, n_partitions: int) -> np.ndarray:
+    """Row → spill partition from key columns [(values, valid)...].
+    NULL keys land deterministically in partition 0 (they never match,
+    but outer/anti joins must still see the rows)."""
+    n = len(keys[0][0]) if keys else 0
+    acc = np.zeros(n, dtype=np.uint64)
+    for v, m in keys:
+        v = np.asarray(v)
+        if v.dtype == object:
+            h = np.fromiter((hash(str(x)) & 0xFFFFFFFFFFFFFFFF
+                             for x in v), dtype=np.uint64, count=len(v))
+        elif v.dtype.kind == "f":
+            f = v.astype(np.float64)
+            f = np.where(f == 0.0, 0.0, f)     # -0.0 joins equal to 0.0
+            h = f.view(np.uint64)
+        else:
+            h = v.astype(np.int64).view(np.uint64)
+        h = np.where(np.asarray(m, dtype=bool), h, np.uint64(0))
+        acc = acc * np.uint64(1000003) + h
+    # splitmix-ish finalizer so dense keys don't stripe
+    acc ^= acc >> np.uint64(30)
+    acc *= np.uint64(0xBF58476D1CE4E5B9)
+    acc ^= acc >> np.uint64(27)
+    return (acc % np.uint64(n_partitions)).astype(np.int64)
+
+
+class PartitionedChunkSpill:
+    """N temp files of length-prefixed wire-codec chunks
+    (ListInDisk / RowContainer.SpillToDisk analog)."""
+
+    def __init__(self, n_partitions: int, ftypes):
+        self.n = n_partitions
+        self.ftypes = list(ftypes)
+        self._files = [tempfile.TemporaryFile(prefix="tidbtpu-spill-")
+                       for _ in range(n_partitions)]
+        self.rows = [0] * n_partitions
+        self.bytes_written = 0
+
+    def add(self, p: int, chunk: Chunk) -> None:
+        if chunk.num_rows == 0:
+            return
+        buf = encode_chunk(chunk)
+        f = self._files[p]
+        f.write(struct.pack("<Q", len(buf)))
+        f.write(buf)
+        self.rows[p] += chunk.num_rows
+        self.bytes_written += len(buf)
+
+    def add_partitioned(self, chunk: Chunk, parts: np.ndarray) -> None:
+        for p in np.unique(parts):
+            sel = np.nonzero(parts == p)[0]
+            self.add(int(p), chunk.take(sel))
+
+    def read(self, p: int) -> Iterator[Chunk]:
+        f = self._files[p]
+        f.seek(0)
+        while True:
+            header = f.read(8)
+            if len(header) < 8:
+                break
+            (ln,) = struct.unpack("<Q", header)
+            yield decode_chunk(f.read(ln), self.ftypes)
+
+    def close(self) -> None:
+        for f in self._files:
+            f.close()
+        self._files = []
+
+
+class PartitionedPickleSpill:
+    """N temp files of pickled records (partial agg states)."""
+
+    def __init__(self, n_partitions: int):
+        self.n = n_partitions
+        self._files = [tempfile.TemporaryFile(prefix="tidbtpu-aggspill-")
+                       for _ in range(n_partitions)]
+        self.bytes_written = 0
+
+    def add(self, p: int, record) -> None:
+        f = self._files[p]
+        before = f.tell()
+        pickle.dump(record, f, protocol=pickle.HIGHEST_PROTOCOL)
+        self.bytes_written += f.tell() - before
+
+    def read(self, p: int) -> Iterator:
+        f = self._files[p]
+        f.seek(0)
+        while True:
+            try:
+                yield pickle.load(f)
+            except EOFError:
+                return
+
+    def close(self) -> None:
+        for f in self._files:
+            f.close()
+        self._files = []
