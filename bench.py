@@ -91,6 +91,13 @@ def probe_backend(retries: int = 5) -> str:
     os.execve(sys.executable, [sys.executable] + sys.argv, env)
 
 
+Q3 = """SELECT o_orderpriority, COUNT(*),
+ SUM(l_extendedprice * (1 - l_discount))
+ FROM lineitem JOIN orders ON l_orderkey = o_orderkey
+ WHERE l_shipdate <= '1998-09-02' AND o_orderdate < '1998-01-01'
+ GROUP BY o_orderpriority ORDER BY o_orderpriority"""
+
+
 def make_lineitem(n: int):
     """Lineitem Q1 columns with TPC-H-like value distributions."""
     rng = np.random.default_rng(42)
@@ -115,30 +122,50 @@ def build_engine(n_rows: int):
         "CREATE TABLE lineitem (l_quantity DECIMAL(15,2), "
         "l_extendedprice DECIMAL(15,2), l_discount DECIMAL(15,2), "
         "l_tax DECIMAL(15,2), l_returnflag CHAR(1), l_linestatus CHAR(1), "
-        "l_shipdate DATE)")
+        "l_shipdate DATE, l_orderkey BIGINT)")
+    s.execute(
+        "CREATE TABLE orders (o_orderkey BIGINT, o_orderdate DATE, "
+        "o_orderpriority CHAR(1))")
     info = eng.catalog.info_schema.table("lineitem")
     qty, price, disc, tax, rflag, lstatus, shipdate = make_lineitem(n_rows)
+    rng = np.random.default_rng(7)
+    n_orders = max(n_rows // 4, 1)
+    okey = rng.integers(0, n_orders, n_rows).astype(np.int64)
     fts = [c.ftype for c in info.columns]
     chunk = Chunk([
         Column(fts[0], qty, None), Column(fts[1], price, None),
         Column(fts[2], disc, None), Column(fts[3], tax, None),
         Column(fts[4], rflag, None), Column(fts[5], lstatus, None),
-        Column(fts[6], shipdate, None)])
+        Column(fts[6], shipdate, None), Column(fts[7], okey, None)])
     txn = eng.store.begin()
     txn.append(info.id, chunk)
     txn.commit()
+    oinfo = eng.catalog.info_schema.table("orders")
+    ofts = [c.ftype for c in oinfo.columns]
+    ochunk = Chunk([
+        Column(ofts[0], np.arange(n_orders, dtype=np.int64), None),
+        Column(ofts[1], rng.integers(8036, 10590,
+                                     n_orders).astype(np.int32), None),
+        Column(ofts[2], np.array(["1", "2", "3", "4", "5"],
+                                 dtype=object)[rng.integers(0, 5,
+                                                            n_orders)],
+               None)])
+    txn = eng.store.begin()
+    txn.append(oinfo.id, ochunk)
+    txn.commit()
     s.execute("ANALYZE TABLE lineitem")
+    s.execute("ANALYZE TABLE orders")
     return eng, s
 
 
-def time_query(s, reps: int) -> float:
+def time_query(s, reps: int, sql: str = Q1) -> float:
     best = float("inf")
     for _ in range(reps):
         t0 = time.perf_counter()
-        rs = s.query(Q1)
+        rs = s.query(sql)
         dt = time.perf_counter() - t0
         best = min(best, dt)
-        assert rs.rows, "Q1 returned no rows"
+        assert rs.rows, "query returned no rows"
     return best
 
 
@@ -189,10 +216,26 @@ def main():
     dev_t = time_query(s, reps)
     log(f"TPU engine: {dev_t:.3f}s ({n_rows / dev_t / 1e6:.1f}M rows/s)")
 
+    # secondary metric: Q3-shaped join+aggregate (BASELINE config #3)
+    q3 = {}
+    try:
+        s.vars["tidb_tpu_engine"] = "off"
+        q3_cpu = time_query(s, 1, Q3)
+        s.vars["tidb_tpu_engine"] = "on"
+        time_query(s, 1, Q3)          # compile warmup
+        q3_dev = time_query(s, reps, Q3)
+        log(f"Q3 join: CPU {q3_cpu:.3f}s, TPU {q3_dev:.3f}s "
+            f"({q3_cpu / q3_dev:.1f}x)")
+        q3 = {"q3_join_rows_per_sec": round(n_rows / q3_dev, 1),
+              "q3_vs_cpu": round(q3_cpu / q3_dev, 3)}
+    except Exception as e:  # noqa: BLE001 — Q3 must not sink the headline
+        log(f"Q3 bench failed (headline unaffected): {e}")
+        q3 = {"q3_error": str(e)[:200]}
+
     value = n_rows / dev_t
     vs = cpu_t / dev_t
     extra = {"backend": backend_name, "device_fragment": used_device,
-             "cpu_rows_per_sec": round(n_rows / cpu_t, 1)}
+             "cpu_rows_per_sec": round(n_rows / cpu_t, 1), **q3}
     emit(value, vs, extra)
 
 

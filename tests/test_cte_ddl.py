@@ -1,0 +1,130 @@
+"""CTEs (WITH / WITH RECURSIVE, ref: executor/cte.go) and online schema
+changes (ALTER TABLE, ref: ddl/column.go)."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.errors import ExecutionError, TiDBTPUError
+from tidb_tpu.session import Engine
+
+
+@pytest.fixture()
+def session():
+    eng = Engine()
+    s = eng.new_session()
+    s.execute("CREATE TABLE t (a BIGINT, b BIGINT)")
+    s.execute("INSERT INTO t VALUES (1,10),(2,20),(3,30),(4,40)")
+    return s
+
+
+def test_basic_cte(session):
+    r = session.query("WITH big AS (SELECT a, b FROM t WHERE b > 15) "
+                      "SELECT COUNT(*), SUM(b) FROM big").rows
+    assert r == [(3, 90)]
+
+
+def test_chained_ctes_and_multiple_references(session):
+    r = session.query(
+        "WITH x AS (SELECT a FROM t), "
+        "y AS (SELECT a FROM x WHERE a > 1) "
+        "SELECT COUNT(*) FROM y JOIN x ON x.a = y.a").rows
+    assert r == [(3,)]
+
+
+def test_cte_column_aliases(session):
+    r = session.query("WITH c (n, m) AS (SELECT a, b FROM t) "
+                      "SELECT SUM(n), MAX(m) FROM c").rows
+    assert r == [(10, 40)]
+
+
+def test_cte_name_shadows_table(session):
+    # a CTE named like a real table wins inside the statement
+    r = session.query("WITH t AS (SELECT 1 AS a) SELECT COUNT(*) FROM t")
+    assert r.rows == [(1,)]
+    # and the real table is untouched afterwards
+    assert session.query("SELECT COUNT(*) FROM t").rows == [(4,)]
+
+
+def test_recursive_sequence(session):
+    r = session.query(
+        "WITH RECURSIVE seq (n) AS (SELECT 1 UNION ALL "
+        "SELECT n + 1 FROM seq WHERE n < 100) "
+        "SELECT COUNT(*), SUM(n) FROM seq").rows
+    assert r == [(100, 5050)]
+
+
+def test_recursive_union_distinct_fixpoint(session):
+    # UNION (distinct) terminates on fixpoint even though the recursive
+    # term always produces a row
+    r = session.query(
+        "WITH RECURSIVE r (n) AS (SELECT 1 UNION SELECT 1 FROM r) "
+        "SELECT COUNT(*) FROM r").rows
+    assert r == [(1,)]
+
+
+def test_recursive_depth_limit(session):
+    with pytest.raises(ExecutionError):
+        session.query(
+            "WITH RECURSIVE r (n) AS (SELECT 1 UNION ALL "
+            "SELECT n + 1 FROM r) SELECT COUNT(*) FROM r")
+
+
+def test_cte_temp_tables_cleaned_up(session):
+    session.query("WITH c AS (SELECT a FROM t) SELECT * FROM c")
+    names = [t.name for t in
+             session.engine.catalog.info_schema.list_tables()]
+    assert all(not n.startswith("#cte") for n in names)
+
+
+# ---- ALTER TABLE -----------------------------------------------------------
+
+def test_add_column_lazy_default(session):
+    s = session
+    s.execute("ALTER TABLE t ADD COLUMN c BIGINT DEFAULT 7")
+    assert s.query("SELECT SUM(c) FROM t").rows == [(28,)]
+    s.execute("INSERT INTO t VALUES (5, 50, 9)")
+    rows = dict((r[0], r[2]) for r in s.query("SELECT a, b, c FROM t").rows)
+    assert rows[5] == 9 and rows[1] == 7
+
+
+def test_drop_column_rewrites_storage(session):
+    s = session
+    s.execute("ALTER TABLE t ADD COLUMN c BIGINT DEFAULT 7")
+    s.execute("INSERT INTO t VALUES (5, 50, 9)")
+    s.execute("ALTER TABLE t DROP COLUMN b")
+    rows = sorted(s.query("SELECT a, c FROM t").rows)
+    assert rows == [(1, 7), (2, 7), (3, 7), (4, 7), (5, 9)]
+    with pytest.raises(TiDBTPUError):
+        s.query("SELECT b FROM t")
+
+
+def test_rename_table(session):
+    s = session
+    s.execute("ALTER TABLE t RENAME TO t_new")
+    assert s.query("SELECT COUNT(*) FROM t_new").rows == [(4,)]
+    with pytest.raises(TiDBTPUError):
+        s.query("SELECT COUNT(*) FROM t")
+
+
+def test_drop_pk_column_rejected(session):
+    s = session
+    s.execute("CREATE TABLE pkt (id BIGINT, v BIGINT, PRIMARY KEY (id))")
+    with pytest.raises(TiDBTPUError):
+        s.execute("ALTER TABLE pkt DROP COLUMN id")
+
+
+def test_device_cache_sees_new_column(session):
+    # device queries after ADD COLUMN must not read stale layouts
+    s = session
+    s.execute("INSERT INTO t VALUES " + ",".join(
+        f"({i},{i * 10})" for i in range(10, 2000)))
+    s.execute("ANALYZE TABLE t")
+    s.vars.update(tidb_tpu_engine="on", tidb_tpu_row_threshold=1)
+    try:
+        before = s.query("SELECT COUNT(*) FROM t WHERE b > 100").rows
+        s.execute("ALTER TABLE t ADD COLUMN d BIGINT DEFAULT 1")
+        after = s.query("SELECT COUNT(*), SUM(d) FROM t WHERE b > 100").rows
+        assert after[0][0] == before[0][0]
+        assert after[0][1] == after[0][0]     # every row d = 1
+    finally:
+        s.vars.pop("tidb_tpu_engine", None)

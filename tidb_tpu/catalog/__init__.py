@@ -183,6 +183,32 @@ class Catalog:
             self._bump(tables, f"drop index {index_name} on {table}")
             return new
 
+    def drop_column(self, table: str, col_name: str) -> TableInfo:
+        """DROP COLUMN (ref: ddl/column.go onDropColumn); the session
+        rewrites storage eagerly (regions hold positional layouts)."""
+        with self._lock:
+            info = self._snapshot.table(table)
+            keep = [c for c in info.columns
+                    if c.name.lower() != col_name.lower()]
+            if len(keep) == len(info.columns):
+                raise UnknownColumnError(
+                    f"Unknown column '{col_name}' in '{table}'")
+            if not keep:
+                raise DDLError("cannot drop the only column")
+            if any(c.lower() == col_name.lower() for c in info.primary_key):
+                raise DDLError(
+                    f"cannot drop primary-key column '{col_name}'")
+            keep = tuple(replace(c, offset=i) for i, c in enumerate(keep))
+            idxs = tuple(ix for ix in info.indexes
+                         if col_name.lower() not in
+                         (c.lower() for c in ix.columns))
+            updated = replace(info, columns=keep, indexes=idxs)
+            tables = dict(self._snapshot._tables)
+            tables[table.lower()] = updated
+            self._bump(tables,
+                       f"alter table {table} drop column {col_name}")
+            return updated
+
     def drop_table(self, name: str, if_exists: bool = False) -> Optional[TableInfo]:
         with self._lock:
             key = name.lower()

@@ -171,6 +171,8 @@ class Chunk:
     def concat(chunks: Sequence["Chunk"]) -> "Chunk":
         assert chunks
         ncol = chunks[0].num_cols
+        assert all(ch.num_cols == ncol for ch in chunks), \
+            "cannot concat chunks of different widths"
         return Chunk([Column.concat([ch.columns[j] for ch in chunks])
                       for j in range(ncol)])
 

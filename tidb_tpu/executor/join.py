@@ -268,12 +268,21 @@ class HashJoinExec(Executor):
 
     def _grace_results(self):
         """Partition-at-a-time join: per partition, an in-memory build over
-        ~1/P of the build side, probing that partition's probe chunks."""
+        ~1/P of the build side, probing that partition's probe chunks.
+        A skewed partition that alone exceeds the quota cancels honestly
+        (tracked consume raises) instead of silently re-inflating."""
+        from tidb_tpu.util import memory as M
         build_spill, probe_spill = self._grace
         build_key_exprs, _ = self._keys()
         for p in range(build_spill.n):
             self.ctx.check_killed()
+            if self._tracked:
+                self._tracker.release(self._tracked)
+                self._tracked = 0
             bchunks = list(build_spill.read(p))
+            part_bytes = sum(M.chunk_bytes(c) for c in bchunks)
+            self._tracked = part_bytes
+            self._tracker.consume(part_bytes)
             self._build_chunk = (Chunk.concat(bchunks)
                                  if len(bchunks) > 1 else bchunks[0]
                                  if bchunks else

@@ -1002,7 +1002,11 @@ def _in(func, ctx):
         table = xp.asarray(np.array(sorted(ints), dtype=np.int64))
         if len(ints) == 0:
             return xp.zeros(v.shape[0], dtype=bool), m
-        pos = xp.clip(xp.searchsorted(table, v), 0, len(ints) - 1)
+        if ctx.on_device:
+            pos = xp.clip(xp.searchsorted(table, v, method='sort'),
+                          0, len(ints) - 1)
+        else:
+            pos = xp.clip(xp.searchsorted(table, v), 0, len(ints) - 1)
         hit = xp.take(table, pos) == v
         return hit, m
     # general path: each membership test goes through the eq kernel so

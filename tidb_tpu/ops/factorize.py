@@ -115,6 +115,28 @@ def topn(keys: Sequence[Tuple], descs: Sequence[bool], live, k: int):
     return perm[:k], jnp.minimum(n_live, jnp.int32(k))
 
 
+def dense_codes(keys: Sequence[Tuple], live):
+    """Dense group codes ONLY — factorize without the representative-row
+    segment_min (a num_segments=N scatter the join's key-combining never
+    uses)."""
+    n = live.shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    operands: List = [_not(live)]
+    for v, m in keys:
+        operands.append(jnp.asarray(m))
+        operands.append(jnp.asarray(v))
+    operands.append(iota)
+    out = lax.sort(tuple(operands), num_keys=len(operands) - 1)
+    sidx = out[-1]
+    first = jnp.zeros(n, dtype=bool).at[0].set(True)
+    diff = first
+    for comp in out[1:-1]:
+        diff = diff | jnp.concatenate(
+            [jnp.ones(1, dtype=bool), comp[1:] != comp[:-1]])
+    gid_s = jnp.cumsum(diff.astype(jnp.int32)) - 1
+    return jnp.zeros(n, dtype=jnp.int32).at[sidx].set(gid_s)
+
+
 def distinct_mask(gids, values, validity, live):
     """True at the first live+valid occurrence of each (group, value) pair —
     the device half of DISTINCT aggregation (the reference keeps a per-group

@@ -37,7 +37,7 @@ def combine_keys(keys: Sequence[Tuple], live):
     cannot collide while N * product-of-ranks fits int64 — guaranteed by
     re-densifying after every column.
     """
-    from tidb_tpu.ops.factorize import factorize
+    from tidb_tpu.ops.factorize import dense_codes
     n = live.shape[0]
     codes = jnp.zeros(n, dtype=jnp.int64)
     code_valid = jnp.ones(n, dtype=bool)
@@ -45,8 +45,8 @@ def combine_keys(keys: Sequence[Tuple], live):
         m = jnp.asarray(m)
         code_valid = code_valid & m
         # dense rank of (codes, v) pairs — one sort per column, stays exact
-        gids, _, _ = factorize([(codes, jnp.ones(n, dtype=bool)),
-                                (jnp.asarray(v), m)], live, n)
+        gids = dense_codes([(codes, jnp.ones(n, dtype=bool)),
+                            (jnp.asarray(v), m)], live)
         codes = gids.astype(jnp.int64)
     return codes, code_valid
 
@@ -69,7 +69,10 @@ def build_probe(build_codes, build_valid, build_live,
     dup = (sorted_codes[1:] == sorted_codes[:-1]) & \
         (sorted_codes[1:] != sentinel)
     unique = jnp.logical_not(dup.any())
-    pos = jnp.clip(jnp.searchsorted(sorted_codes, probe_codes), 0, nb - 1)
+    # method='sort' lowers to one concat+sort+scatter — the TPU-friendly
+    # sort-merge; the default 'scan' binary search is ~4x slower at 1M rows
+    pos = jnp.clip(jnp.searchsorted(sorted_codes, probe_codes,
+                                    method='sort'), 0, nb - 1)
     hit = jnp.take(sorted_codes, pos) == probe_codes
     matched = hit & probe_valid & probe_live
     match_idx = jnp.where(matched, jnp.take(sorted_idx, pos), 0)

@@ -19,8 +19,15 @@ import numpy as np
 # a scatter: TPU scatter serializes updates (~70ms for 1M int64 rows on v4),
 # while `reduce(where(gid == iota_c, v, id))` stays a fused vector reduction
 # (~8ms at cap 16, ~14ms at cap 1024; measured on the target chip). Exact for
-# int64 — no float round trip.
+# int64 — no float round trip. The broadcast materializes n×cap work, so it
+# must ALSO clear a total-work budget or big inputs at cap ~1k regress.
 MASKED_REDUCE_CAP = 1024
+MASKED_REDUCE_WORK = 1 << 27
+
+
+def _masked_ok(data, num_segments: int) -> bool:
+    return (num_segments <= MASKED_REDUCE_CAP and
+            int(data.shape[0]) * num_segments <= MASKED_REDUCE_WORK)
 
 
 def _is_np(xp) -> bool:
@@ -39,7 +46,7 @@ def segment_sum(xp, data, segment_ids, num_segments: int):
         out = np.zeros(num_segments, dtype=data.dtype)
         np.add.at(out, segment_ids, data)
         return out
-    if num_segments <= MASKED_REDUCE_CAP:
+    if _masked_ok(data, num_segments):
         return _masked_reduce(xp, data, segment_ids, num_segments,
                               data.dtype.type(0), xp.sum)
     from tidb_tpu.ops.jax_env import jax
@@ -116,7 +123,7 @@ def segment_min(xp, data, segment_ids, num_segments: int):
                       dtype=data.dtype)
         np.minimum.at(out, segment_ids, data)
         return out
-    if num_segments <= MASKED_REDUCE_CAP:
+    if _masked_ok(data, num_segments):
         return _masked_reduce(xp, data, segment_ids, num_segments,
                               _max_identity(data.dtype), xp.min)
     from tidb_tpu.ops.jax_env import jax
@@ -129,7 +136,7 @@ def segment_max(xp, data, segment_ids, num_segments: int):
                       dtype=data.dtype)
         np.maximum.at(out, segment_ids, data)
         return out
-    if num_segments <= MASKED_REDUCE_CAP:
+    if _masked_ok(data, num_segments):
         return _masked_reduce(xp, data, segment_ids, num_segments,
                               _min_identity(data.dtype), xp.max)
     from tidb_tpu.ops.jax_env import jax

@@ -207,6 +207,21 @@ class SelectStmt(StmtNode):
 
 
 @dataclass
+class CteDef(Node):
+    name: str
+    columns: Optional[List[str]]
+    select: "StmtNode"
+
+
+@dataclass
+class WithStmt(StmtNode):
+    """WITH [RECURSIVE] name [(cols)] AS (select), ... <select>."""
+    recursive: bool
+    ctes: List[CteDef]
+    stmt: "StmtNode"
+
+
+@dataclass
 class SetOpStmt(StmtNode):
     op: str                # union | except | intersect
     all: bool
@@ -252,6 +267,15 @@ class CreateIndex(StmtNode):
 class DropIndex(StmtNode):
     name: str
     table: str
+
+
+@dataclass
+class AlterTable(StmtNode):
+    table: str
+    action: str                     # add_column | drop_column | rename
+    column: Optional[ColumnDef] = None
+    column_name: Optional[str] = None
+    new_name: Optional[str] = None
 
 
 @dataclass

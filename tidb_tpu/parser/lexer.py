@@ -23,7 +23,7 @@ KEYWORDS = {
     "decimal", "numeric", "char", "varchar", "text", "date", "datetime",
     "timestamp", "time", "unsigned", "signed", "auto_increment", "engine",
     "charset", "collate", "comment", "replace", "ignore", "start",
-    "transaction", "over", "partition",
+    "transaction", "over", "partition", "with", "recursive", "alter", "add", "rename", "to", "column",
 }
 
 

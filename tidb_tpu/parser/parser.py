@@ -106,7 +106,9 @@ class Parser:
         if self.cur.kind == "kw" and self.cur.value in (
                 "date", "time", "timestamp", "key", "tables", "columns",
                 "comment", "engine", "charset", "begin", "analyze", "offset",
-                "set", "values", "variables", "if"):
+                "set", "values", "variables", "if",
+                "add", "to", "column", "rename", "over", "partition",
+                "alter", "mod"):
             return self.advance().value
         raise ParseError(f"expected identifier near {self._near()}")
 
@@ -116,12 +118,16 @@ class Parser:
 
     # ---- statements ------------------------------------------------------
     def statement(self) -> ast.StmtNode:
+        if self.at_kw("with"):
+            return self.with_stmt()
         if self.at_kw("select") or self.at_op("("):
             return self.select_with_setops()
         if self.at_kw("create"):
             return self.create_table()
         if self.at_kw("drop"):
             return self.drop_table()
+        if self.at_kw("alter"):
+            return self.alter_table()
         if self.at_kw("truncate"):
             self.advance()
             self.try_kw("table")
@@ -166,6 +172,27 @@ class Parser:
         raise ParseError(f"unsupported statement near {self._near()}")
 
     # ---- SELECT ----------------------------------------------------------
+    def with_stmt(self) -> ast.StmtNode:
+        self.expect_kw("with")
+        recursive = bool(self.try_kw("recursive"))
+        ctes = []
+        while True:
+            name = self.ident()
+            cols = None
+            if self.try_op("("):
+                cols = [self.ident()]
+                while self.try_op(","):
+                    cols.append(self.ident())
+                self.expect_op(")")
+            self.expect_kw("as")
+            self.expect_op("(")
+            sel = self.select_with_setops()
+            self.expect_op(")")
+            ctes.append(ast.CteDef(name, cols, sel))
+            if not self.try_op(","):
+                break
+        return ast.WithStmt(recursive, ctes, self.select_with_setops())
+
     def select_with_setops(self) -> ast.StmtNode:
         left = self.select_core()
         while self.at_kw("union", "except", "intersect"):
@@ -395,6 +422,24 @@ class Parser:
                 if c.name in pk:
                     c.ftype = c.ftype.with_nullable(False)
         return ast.CreateTable(name, columns, pk, indexes, if_not_exists)
+
+    def alter_table(self) -> ast.AlterTable:
+        self.expect_kw("alter")
+        self.expect_kw("table")
+        name = self.ident()
+        if self.try_kw("add"):
+            self.try_kw("column")
+            return ast.AlterTable(name, "add_column",
+                                  column=self.column_def())
+        if self.try_kw("drop"):
+            self.try_kw("column")
+            return ast.AlterTable(name, "drop_column",
+                                  column_name=self.ident())
+        if self.try_kw("rename"):
+            self.try_kw("to")
+            return ast.AlterTable(name, "rename",
+                                  new_name=self.ident())
+        raise ParseError(f"unsupported ALTER TABLE near {self._near()}")
 
     def column_def(self) -> ast.ColumnDef:
         name = self.ident()
@@ -783,7 +828,8 @@ class Parser:
                 name = self.advance().value
                 return self._call(name)
         if t.kind == "ident" or (t.kind == "kw" and t.value in (
-                "date", "time", "timestamp", "values", "if")):
+                "date", "time", "timestamp", "values", "if",
+                "add", "to", "column", "rename", "partition")):
             name = self.advance().value
             if self.at_op("("):
                 return self._call(name.lower())

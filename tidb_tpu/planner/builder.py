@@ -403,10 +403,14 @@ class PlanBuilder:
     """Ref: planner/core/planbuilder.go PlanBuilder."""
 
     def __init__(self, info_schema, ctx=None,
-                 subq: Optional[SubqueryEvaluator] = None):
+                 subq: Optional[SubqueryEvaluator] = None,
+                 cte_map: Optional[Dict[str, str]] = None):
         self.info_schema = info_schema
         self.ctx = ctx
         self.subq = subq or getattr(ctx, "subquery_evaluator", None)
+        # CTE name (lower) → materialized temp table (session-provided;
+        # ref: executor/cte.go materializes into cteutil storage)
+        self.cte_map = cte_map or getattr(ctx, "cte_map", None) or {}
 
     # -- statements ---------------------------------------------------------
     def build(self, stmt: ast.StmtNode) -> LogicalPlan:
@@ -419,6 +423,10 @@ class PlanBuilder:
     # -- FROM ---------------------------------------------------------------
     def build_table_ref(self, ref: ast.TableRef) -> LogicalPlan:
         if isinstance(ref, ast.TableName):
+            mapped = self.cte_map.get(ref.name.lower())
+            if mapped is not None:
+                info = self.info_schema.table(mapped)
+                return LogicalDataSource(info, ref.alias or ref.name)
             info = self.info_schema.table(ref.name)
             return LogicalDataSource(info, ref.alias)
         if isinstance(ref, ast.SubqueryTable):

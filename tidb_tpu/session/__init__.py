@@ -81,6 +81,7 @@ class _PlanContext:
     def __init__(self, session: "Session"):
         self.session = session
         self.subquery_evaluator = session._subquery_evaluator()
+        self.cte_map = dict(getattr(session, "_cte_map", {}) or {})
 
     def table_row_count(self, table_id: int) -> int:
         # exact live rows from the columnar store — cheap and fresher than
@@ -134,6 +135,7 @@ class Session:
         self.last_plan = None
         self.conn_id = next(Session._next_conn_id)
         self.last_engine = "cpu"   # cpu | tpu — set by the fragment path
+        self._cte_map: Dict[str, str] = {}
 
     # ---- public API --------------------------------------------------------
     def execute(self, sql: str) -> List[ResultSet]:
@@ -191,12 +193,29 @@ class Session:
             return self.txn, False
         return self.engine.store.begin(), True
 
+    _DDL_STMTS = (ast.CreateTable, ast.DropTable, ast.TruncateTable,
+                  ast.AlterTable, ast.CreateIndex, ast.DropIndex)
+
+    def _implicit_commit(self) -> None:
+        """DDL causes an implicit COMMIT of any open transaction (MySQL
+        semantics) — staged rows must land under the pre-DDL schema, not
+        be silently re-interpreted against the new layout."""
+        if self.txn is not None:
+            self.txn.commit()
+            self.txn = None
+
     # ---- dispatch ----------------------------------------------------------
     def _execute_stmt(self, stmt: ast.StmtNode) -> ResultSet:
+        if isinstance(stmt, self._DDL_STMTS):
+            self._implicit_commit()
         if isinstance(stmt, (ast.SelectStmt, ast.SetOpStmt)):
             return self._run_query(stmt)
+        if isinstance(stmt, ast.WithStmt):
+            return self._run_with(stmt)
         if isinstance(stmt, ast.CreateTable):
             return self._create_table(stmt)
+        if isinstance(stmt, ast.AlterTable):
+            return self._alter_table(stmt)
         if isinstance(stmt, ast.CreateIndex):
             from tidb_tpu.catalog import IndexInfo as _IdxInfo
             info = self.engine.catalog.info_schema.table(stmt.table)
@@ -626,7 +645,8 @@ class Session:
     def _show(self, stmt: ast.ShowStmt) -> ResultSet:
         info_schema = self.engine.catalog.info_schema
         if stmt.kind == "tables":
-            rows = [(t.name,) for t in info_schema.list_tables()]
+            rows = [(t.name,) for t in info_schema.list_tables()
+                    if not t.name.startswith("#")]   # hide CTE temps
             return ResultSet(["Tables"], [T.varchar()], rows)
         if stmt.kind == "columns":
             t = info_schema.table(stmt.target)
@@ -676,6 +696,163 @@ class Session:
                              [T.bigint(), T.double(), T.varchar()],
                              REGISTRY.process_rows())
         raise PlanError(f"unsupported SHOW {stmt.kind}")
+
+    def _alter_table(self, stmt: ast.AlterTable) -> ResultSet:
+        """Online-ish schema change (ref: ddl/column.go): ADD COLUMN is
+        lazy (regions surface the default at read time via
+        align_chunk_to_schema); DROP COLUMN rewrites storage eagerly
+        because regions hold positional layouts."""
+        cat = self.engine.catalog
+        if stmt.action == "add_column":
+            c = stmt.column
+            default = None
+            has_default = False
+            if c.default is not None:
+                from tidb_tpu.expression import Constant
+                from tidb_tpu.planner.rules import fold_expr
+                rw = ExpressionRewriter(Schema([]))
+                folded = fold_expr(rw.rewrite(c.default))
+                if not isinstance(folded, Constant):
+                    raise PlanError("DEFAULT must fold to a constant")
+                default = folded.value
+                has_default = True
+            cat.add_column(stmt.table, ColumnInfo(
+                c.name, c.ftype.with_nullable(True), default=default,
+                has_default=has_default))
+            return ok()
+        if stmt.action == "drop_column":
+            info = cat.info_schema.table(stmt.table)
+            drop_idx = next(i for i, c in enumerate(info.columns)
+                            if c.name.lower() == stmt.column_name.lower())
+            cat.drop_column(stmt.table, stmt.column_name)
+            # eager storage rewrite minus the dropped column
+            from tidb_tpu.executor.scan import align_chunk_to_schema
+            snap = self.engine.store.snapshot()
+            if snap.has_table(info.id):
+                keep_cols = [i for i in range(len(info.columns))
+                             if i != drop_idx]
+                chunks = []
+                for region, alive in snap.scan(info.id):
+                    ch = align_chunk_to_schema(region.chunk, info)
+                    if not alive.all():
+                        ch = ch.take(np.nonzero(alive)[0])
+                    chunks.append(Chunk([ch.columns[i]
+                                         for i in keep_cols]))
+                self.engine.store.truncate_table(info.id)
+                for ch in chunks:
+                    if ch.num_rows:
+                        self.engine.store.append(info.id, ch)
+            return ok()
+        if stmt.action == "rename":
+            cat.rename_table(stmt.table, stmt.new_name)
+            return ok()
+        raise PlanError(f"unsupported ALTER action {stmt.action}")
+
+    # ---- WITH / CTE (ref: executor/cte.go — materialized CTE storage) ----
+    _cte_seq = itertools.count(1)
+    MAX_CTE_RECURSION = 1000     # cte_max_recursion_depth default
+
+    def _run_with(self, stmt: ast.WithStmt) -> ResultSet:
+        """Materialize each CTE into a hidden temp table (multiple
+        references share one materialization, the reference's cteutil
+        storage reuse), then run the main statement with references
+        remapped. Recursive CTEs iterate seed + recursive term over the
+        delta until fixpoint (MySQL WITH RECURSIVE semantics)."""
+        outer_map = dict(getattr(self, "_cte_map", {}) or {})
+        created: List[str] = []
+        try:
+            for cte in stmt.ctes:
+                tmp = f"#cte_{next(Session._cte_seq)}"
+                if stmt.recursive and _references_table(cte.select,
+                                                        cte.name):
+                    self._materialize_recursive(cte, tmp, created)
+                else:
+                    rows, ftypes, names = self._run_cte_select(cte.select)
+                    cnames = cte.columns or names
+                    self._create_temp(tmp, cnames, ftypes, rows, created)
+                self._cte_map = dict(self._cte_map or {})
+                self._cte_map[cte.name.lower()] = tmp
+            return self._execute_stmt(stmt.stmt)
+        finally:
+            self._cte_map = outer_map
+            for name in created:
+                info = self.engine.catalog.drop_table(name, if_exists=True)
+                if info is not None:
+                    self.engine.store.drop_table(info.id)
+
+    def _run_cte_select(self, sel):
+        plan, chunks = self._run_query_chunks(sel)
+        rows: List[tuple] = []
+        for ch in chunks:
+            rows.extend(ch.rows())
+        return rows, plan.schema.field_types, plan.schema.names
+
+    def _create_temp(self, name, cnames, ftypes, rows, created):
+        cols = [ColumnInfo(n or f"c{i}", ft.with_nullable(True))
+                for i, (n, ft) in enumerate(zip(cnames, ftypes))]
+        self.engine.catalog.create_table(name, cols)
+        info = self.engine.catalog.info_schema.table(name)
+        self.engine.store.create_table(info.id)
+        created.append(name)
+        if rows:
+            self._append_rows(info, rows)
+        return info
+
+    def _append_rows(self, info, rows):
+        from tidb_tpu.chunk import Chunk
+        encoded = []
+        for r in rows:
+            encoded.append(tuple(
+                c.ftype.encode_value(v) if v is not None else None
+                for c, v in zip(info.columns, r)))
+        chunk = Chunk.from_rows(info.field_types, encoded)
+        txn = self.engine.store.begin()
+        txn.append(info.id, chunk)
+        txn.commit()
+
+    def _materialize_recursive(self, cte, tmp, created):
+        if not isinstance(cte.select, ast.SetOpStmt) or \
+                cte.select.op != "union":
+            raise PlanError(
+                "recursive CTE must be <seed> UNION [ALL] <recursive>")
+        seed_stmt, rec_stmt = cte.select.left, cte.select.right
+        distinct = not cte.select.all
+        rows, ftypes, names = self._run_cte_select(seed_stmt)
+        cnames = cte.columns or names
+        if distinct:
+            rows = list(dict.fromkeys(map(tuple, rows)))
+        info = self._create_temp(tmp, cnames, ftypes, rows, created)
+        seen = set(map(tuple, rows)) if distinct else None
+        delta = rows
+        delta_tmp = f"#cte_delta_{next(Session._cte_seq)}"
+        self._create_temp(delta_tmp, cnames, ftypes, delta, created)
+        dinfo = self.engine.catalog.info_schema.table(delta_tmp)
+        it = 0
+        saved = dict(self._cte_map or {})
+        try:
+            while delta:
+                it += 1
+                if it > self.MAX_CTE_RECURSION:
+                    raise ExecutionError(
+                        "Recursive query aborted after "
+                        f"{self.MAX_CTE_RECURSION} iterations")
+                # the recursive term sees only the previous delta (MySQL)
+                self._cte_map = dict(saved)
+                self._cte_map[cte.name.lower()] = delta_tmp
+                new_rows, _, _ = self._run_cte_select(rec_stmt)
+                new_rows = [tuple(r) for r in new_rows]
+                if distinct:
+                    new_rows = [r for r in dict.fromkeys(new_rows)
+                                if r not in seen]
+                    seen.update(new_rows)
+                if not new_rows:
+                    break
+                self._append_rows(info, new_rows)
+                self.engine.store.truncate_table(dinfo.id)
+                self._append_rows(dinfo, new_rows)
+                delta = new_rows
+        finally:
+            self._cte_map = saved
 
     def _analyze(self, stmt: ast.AnalyzeTable) -> ResultSet:
         """Build per-column histogram/NDV/TopN stats (ref:
@@ -784,6 +961,28 @@ def _assemble_rows(rows: List[List], info: TableInfo,
                     f"Field '{c.name}' doesn't have a default value")
         out_rows.append(row)
     return out_rows
+
+
+def _references_table(node, name: str) -> bool:
+    lname = name.lower()
+
+    def walk(n) -> bool:
+        if isinstance(n, ast.TableName):
+            return n.name.lower() == lname
+        for attr in ("from_", "left", "right", "stmt", "select",
+                     "subquery", "expr"):
+            v = getattr(n, attr, None)
+            if isinstance(v, (ast.Node,)) and walk(v):
+                return True
+        for attr in ("items", "ctes"):
+            v = getattr(n, attr, None)
+            if isinstance(v, list):
+                for x in v:
+                    if isinstance(x, ast.Node) and walk(x):
+                        return True
+        return False
+
+    return walk(node)
 
 
 def _key_tuples(chunk: Chunk, idxs: List[int]):

@@ -132,7 +132,11 @@ class Store:
         for start in range(0, chunk.num_rows, REGION_ROWS):
             part = chunk.slice(start, min(start + REGION_ROWS, chunk.num_rows))
             if (regions and regions[-1].num_rows + part.num_rows <= REGION_ROWS
-                    and not regions[-1].deleted.any()):
+                    and not regions[-1].deleted.any()
+                    and regions[-1].chunk.num_cols == part.num_cols):
+                # layouts must match: a region written before ADD COLUMN
+                # keeps its narrow layout (padded at read); new rows with
+                # the wider layout start a fresh region
                 last = regions[-1]
                 merged = Chunk.concat([last.chunk, part])
                 regions[-1] = Region(last.id, merged,
