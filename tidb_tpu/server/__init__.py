@@ -181,11 +181,31 @@ class _Conn:
                 + bytes([ft.scale & 0xFF]) + b"\x00\x00")
 
     def write_resultset(self, names: List[str], ftypes: List[FieldType],
-                        rows: List[tuple], status: int = 0x0002) -> None:
+                        rows: List[tuple], status: int = 0x0002,
+                        chunks=None) -> None:
         self.write_packet(_lenenc_int(len(names)))
         for nm, ft in zip(names, ftypes):
             self.write_packet(self._coldef(nm, ft))
         self.write_eof()
+        if chunks is not None:
+            # columnar fast path: the whole batch encodes to framed row
+            # packets in C++ (tidb_tpu/native/rowcodec.cpp — the native
+            # dumpTextRow of server/util.go:390); one sendall per chunk
+            from tidb_tpu import native
+            for ch in chunks:
+                if ch.num_rows == 0:
+                    continue
+                enc = native.encode_text_rows(ch, ftypes, self.seq)
+                if enc is None:
+                    self._write_rows_python(ch.rows())
+                    continue
+                payload, self.seq = enc
+                self.sock.sendall(payload)
+        else:
+            self._write_rows_python(rows)
+        self.write_eof(status)
+
+    def _write_rows_python(self, rows) -> None:
         for row in rows:
             out = b""
             for v in row:
@@ -194,7 +214,6 @@ class _Conn:
                 else:
                     out += _lenenc_str(_text_value(v))
             self.write_packet(out)
-        self.write_eof(status)
 
     # -- command loop --------------------------------------------------------
     def run(self) -> None:
@@ -236,7 +255,8 @@ class _Conn:
             status = 0x0002 | (SERVER_MORE_RESULTS_EXISTS
                                if i + 1 < len(results) else 0)
             if rs.is_query:
-                self.write_resultset(rs.names, rs.ftypes, rs.rows, status)
+                self.write_resultset(rs.names, rs.ftypes, rs.rows, status,
+                                     chunks=rs.chunks)
             else:
                 self.write_ok(affected=rs.affected_rows, status=status)
 

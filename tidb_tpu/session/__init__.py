@@ -22,7 +22,8 @@ import numpy as np
 from tidb_tpu import types as T
 from tidb_tpu.catalog import Catalog, ColumnInfo, IndexInfo, TableInfo
 from tidb_tpu.chunk import Chunk, Column
-from tidb_tpu.errors import (ExecutionError, PlanError, TiDBTPUError)
+from tidb_tpu.errors import (ExecutionError, PlanError, TiDBTPUError,
+                             TxnError, UnknownColumnError)
 from tidb_tpu.executor import ExecContext, build, run_to_completion
 from tidb_tpu.expression import Expression
 from tidb_tpu.expression.runner import eval_on_chunk, filter_mask
@@ -44,13 +45,34 @@ DEFAULT_VARS: Dict[str, object] = {
 }
 
 
-@dataclass
 class ResultSet:
-    names: List[str]
-    ftypes: List[FieldType]
-    rows: List[tuple]
-    affected_rows: int = 0
-    is_query: bool = True
+    """Query result. `rows` (python tuples) materialize lazily from the
+    columnar `chunks` payload, so sinks that consume chunks directly (the
+    wire server's native text encoder) never pay the per-row decode."""
+
+    def __init__(self, names: List[str], ftypes: List[FieldType],
+                 rows: Optional[List[tuple]] = None,
+                 affected_rows: int = 0, is_query: bool = True,
+                 chunks: Optional[List[Chunk]] = None):
+        self.names = names
+        self.ftypes = ftypes
+        self._rows = rows
+        self.affected_rows = affected_rows
+        self.is_query = is_query
+        self.chunks = chunks
+
+    @property
+    def rows(self) -> List[tuple]:
+        if self._rows is None:
+            self._rows = [r for ch in (self.chunks or [])
+                          for r in ch.rows()]
+        return self._rows
+
+    @property
+    def row_count(self) -> int:
+        if self.chunks is not None:
+            return sum(ch.num_rows for ch in self.chunks)
+        return len(self._rows or ())
 
     def scalar(self):
         return self.rows[0][0] if self.rows else None
@@ -163,7 +185,7 @@ class Session:
             REGISTRY.stmt_end(self.conn_id)
             REGISTRY.inc("tidb_tpu_stmt_total", {"stmt": kind})
             REGISTRY.observe("tidb_tpu_stmt_seconds", dt, {"stmt": kind})
-            n_rows = len(rs.rows) if rs.is_query else rs.affected_rows
+            n_rows = rs.row_count if rs.is_query else rs.affected_rows
             threshold = float(self.vars.get("long_query_time", 0.3))
             REGISTRY.record_stmt(one, dt, n_rows, self.last_engine,
                                  threshold)
@@ -257,10 +279,22 @@ class Session:
             if self.txn is not None:
                 self.txn.commit()  # implicit commit (MySQL semantics)
             self.txn = self.engine.store.begin()
+            self._txn_schema_version = \
+                self.engine.catalog.info_schema.version
             return ok()
         if isinstance(stmt, ast.CommitStmt):
             if self.txn is not None:
                 try:
+                    # schema lease check (domain/schema_validator.go): a
+                    # concurrent DDL may have changed layouts the staged
+                    # chunks were built against — abort, don't corrupt
+                    if self.engine.catalog.info_schema.version != \
+                            getattr(self, "_txn_schema_version", None) \
+                            and self.txn.has_staged_writes():
+                        self.txn.rollback()
+                        raise TxnError(
+                            "Information schema is changed during the "
+                            "execution of the statement; please retry")
                     self.txn.commit()
                 finally:
                     self.txn = None
@@ -297,14 +331,12 @@ class Session:
     def _run_query(self, stmt) -> ResultSet:
         plan, chunks, exec_root = self._run_query_chunks(stmt,
                                                         want_root=True)
-        rows: List[tuple] = []
-        for ch in chunks:
-            rows.extend(ch.rows())
         self.last_engine = "tpu" if _used_device(exec_root) else "cpu"
         if self.last_engine == "tpu":
             from tidb_tpu.util.observability import REGISTRY
             REGISTRY.inc("tidb_tpu_device_queries_total")
-        return ResultSet(plan.schema.names, plan.schema.field_types, rows)
+        return ResultSet(plan.schema.names, plan.schema.field_types,
+                         chunks=chunks)
 
     # ---- DDL ---------------------------------------------------------------
     def _create_table(self, stmt: ast.CreateTable) -> ResultSet:
@@ -722,8 +754,13 @@ class Session:
             return ok()
         if stmt.action == "drop_column":
             info = cat.info_schema.table(stmt.table)
-            drop_idx = next(i for i, c in enumerate(info.columns)
-                            if c.name.lower() == stmt.column_name.lower())
+            drop_idx = next((i for i, c in enumerate(info.columns)
+                             if c.name.lower() ==
+                             stmt.column_name.lower()), None)
+            if drop_idx is None:
+                raise UnknownColumnError(
+                    f"Unknown column '{stmt.column_name}' in "
+                    f"'{stmt.table}'")
             cat.drop_column(stmt.table, stmt.column_name)
             # eager storage rewrite minus the dropped column
             from tidb_tpu.executor.scan import align_chunk_to_schema
@@ -767,9 +804,11 @@ class Session:
                                                         cte.name):
                     self._materialize_recursive(cte, tmp, created)
                 else:
-                    rows, ftypes, names = self._run_cte_select(cte.select)
-                    cnames = cte.columns or names
-                    self._create_temp(tmp, cnames, ftypes, rows, created)
+                    plan, chunks = self._run_query_chunks(cte.select)
+                    cnames = cte.columns or plan.schema.names
+                    self._create_temp(tmp, cnames,
+                                      plan.schema.field_types, None,
+                                      created, chunks=chunks)
                 self._cte_map = dict(self._cte_map or {})
                 self._cte_map[cte.name.lower()] = tmp
             return self._execute_stmt(stmt.stmt)
@@ -787,14 +826,21 @@ class Session:
             rows.extend(ch.rows())
         return rows, plan.schema.field_types, plan.schema.names
 
-    def _create_temp(self, name, cnames, ftypes, rows, created):
+    def _create_temp(self, name, cnames, ftypes, rows, created,
+                     chunks=None):
         cols = [ColumnInfo(n or f"c{i}", ft.with_nullable(True))
                 for i, (n, ft) in enumerate(zip(cnames, ftypes))]
         self.engine.catalog.create_table(name, cols)
         info = self.engine.catalog.info_schema.table(name)
         self.engine.store.create_table(info.id)
         created.append(name)
-        if rows:
+        if chunks is not None:
+            # columnar handoff: result chunks append directly, no per-row
+            # python round trip (the cteutil storage-reuse spirit)
+            for ch in chunks:
+                if ch.num_rows:
+                    self.engine.store.append(info.id, ch)
+        elif rows:
             self._append_rows(info, rows)
         return info
 

@@ -241,6 +241,9 @@ class Transaction:
         self.staged_deletes: Dict[int, Dict[int, np.ndarray]] = {}
         self.active = True
 
+    def has_staged_writes(self) -> bool:
+        return bool(self.staged_inserts) or bool(self.staged_deletes)
+
     # ---- writes ----------------------------------------------------------
     def append(self, table_id: int, chunk: Chunk) -> None:
         self.staged_inserts.setdefault(table_id, []).append(chunk)
