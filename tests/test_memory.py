@@ -154,3 +154,52 @@ def test_multi_slab_device_sort(session):
     assert len(dev) == len(base)
     for a, b in zip(base, dev):
         assert a[0] == b[0] and a[1] == b[1], (a, b)
+
+
+# ---- failpoints + GC -------------------------------------------------------
+
+def test_failpoint_commit_error():
+    from tidb_tpu.errors import TxnError
+    from tidb_tpu.util import failpoint
+    eng = Engine()
+    s = eng.new_session()
+    s.execute("CREATE TABLE fp (a BIGINT)")
+    with failpoint.enabled("store-commit", raise_=TxnError("injected")):
+        with pytest.raises(TxnError):
+            s.execute("INSERT INTO fp VALUES (1)")
+        assert failpoint.hits("store-commit") == 1
+    # recovered after disable
+    s.execute("INSERT INTO fp VALUES (2)")
+    assert s.query("SELECT COUNT(*) FROM fp").rows == [(1,)]
+
+
+def test_failpoint_device_fallback():
+    from tidb_tpu.util import failpoint
+    eng = Engine()
+    s = eng.new_session()
+    s.execute("CREATE TABLE fd (a BIGINT)")
+    s.execute("INSERT INTO fd VALUES " +
+              ",".join(f"({i})" for i in range(5000)))
+    s.vars.update(tidb_tpu_engine="on", tidb_tpu_row_threshold=1)
+    with failpoint.enabled("device-fragment",
+                           raise_=RuntimeError("injected device loss")):
+        # device dies → CPU fallback still answers correctly
+        assert s.query("SELECT SUM(a) FROM fd").rows == [(12497500,)]
+        assert failpoint.hits("device-fragment") >= 1
+
+
+def test_gc_compaction_reclaims_tombstones():
+    eng = Engine()
+    s = eng.new_session()
+    s.execute("CREATE TABLE gc (a BIGINT)")
+    s.execute("INSERT INTO gc VALUES " +
+              ",".join(f"({i})" for i in range(10000)))
+    info = eng.catalog.info_schema.table("gc")
+    s.execute("DELETE FROM gc WHERE a < 8000")   # 80% dead → compaction
+    live, dead, regions = eng.store.gc_stats(info.id)
+    assert dead == 0, "tombstones not reclaimed"
+    assert live == 2000
+    assert s.query("SELECT COUNT(*), MIN(a) FROM gc").rows == [(2000, 8000)]
+    # caches keyed by TableData identity see the rewrite
+    s.execute("INSERT INTO gc VALUES (1)")
+    assert s.query("SELECT COUNT(*) FROM gc WHERE a = 1").rows == [(1,)]

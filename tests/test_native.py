@@ -47,6 +47,16 @@ def test_edge_values():
     check(chunk, fts, seq=250)      # seq wraps mid-batch
 
 
+def test_float_repr_parity():
+    # exactly the notation boundaries where std::to_chars and python repr
+    # disagree by default: fixed vs scientific selection
+    fts = [T.double()]
+    vals = [100000.0, 0.0001, 2e5, 1e16, 1e15, 9.999e15, 1e-4, 9e-5,
+            -1.5e-5, 1e22, 123456789012345.6, -0.0, 2.5e-10, 3e300]
+    chunk = Chunk.from_rows(fts, [(v,) for v in vals])
+    check(chunk, fts)
+
+
 def test_bulk_random_roundtrip():
     rng = np.random.default_rng(5)
     n = 5000
@@ -69,6 +79,17 @@ def test_wire_roundtrip_uses_native(monkeypatch):
     from test_server import MiniClient
     from tidb_tpu.server import Server
     from tidb_tpu.session import Engine
+    if native.get_lib() is None:
+        pytest.skip("native rowcodec unavailable (no toolchain)")
+    calls = []
+    real = native.encode_text_rows
+
+    def spy(chunk, ftypes, seq):
+        out = real(chunk, ftypes, seq)
+        calls.append(out is not None)
+        return out
+
+    monkeypatch.setattr(native, "encode_text_rows", spy)
     srv = Server(Engine(), port=0).start()
     try:
         c = MiniClient(srv.port)
@@ -79,3 +100,4 @@ def test_wire_roundtrip_uses_native(monkeypatch):
         c.close()
     finally:
         srv.stop()
+    assert calls and all(calls), "native encoder did not carry the rows"

@@ -113,13 +113,20 @@ class Catalog:
         self._ids = itertools.count(1)
         self._snapshot = InfoSchema(0, {})
         self._history: List[str] = []  # DDL job log (ref: meta DDL job queue)
+        # schema version excluding session-private temp tables (CTE
+        # materializations): the commit-time lease check compares THIS, so
+        # a txn's own WITH queries don't read as concurrent DDL
+        self.user_version = 0
 
     @property
     def info_schema(self) -> InfoSchema:
         return self._snapshot
 
-    def _bump(self, tables: Dict[str, TableInfo], job: str) -> None:
+    def _bump(self, tables: Dict[str, TableInfo], job: str,
+              temp: bool = False) -> None:
         self._snapshot = InfoSchema(self._snapshot.version + 1, tables)
+        if not temp:
+            self.user_version += 1
         self._history.append(job)
 
     def ddl_history(self) -> List[str]:
@@ -140,7 +147,8 @@ class Catalog:
                              tuple(primary_key), tuple(indexes))
             tables = dict(self._snapshot._tables)
             tables[key] = info
-            self._bump(tables, f"create table {name}")
+            self._bump(tables, f"create table {name}",
+                       temp=name.startswith("#"))
             return info
 
     def add_index(self, table: str, index: IndexInfo) -> TableInfo:
@@ -219,7 +227,8 @@ class Catalog:
                 raise UnknownTableError(f"Unknown table '{name}'")
             tables = dict(self._snapshot._tables)
             del tables[key]
-            self._bump(tables, f"drop table {name}")
+            self._bump(tables, f"drop table {name}",
+                       temp=name.startswith("#"))
             return info
 
     def rename_table(self, old: str, new: str) -> TableInfo:

@@ -795,6 +795,8 @@ class TpuFragmentExec:
     # ---- device pipeline ---------------------------------------------------
     def _run_device(self) -> Chunk:
         from tidb_tpu.executor import device_cache
+        from tidb_tpu.util import failpoint
+        failpoint.inject("device-fragment")
 
         if getattr(self.plan, "dist", 0) > 1:
             return self._run_device_dist()

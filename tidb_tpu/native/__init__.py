@@ -143,4 +143,4 @@ def encode_text_rows(chunk, ftypes, seq: int) -> Optional[Tuple[bytes, int]]:
                                    ctypes.byref(seq_io), out, cap)
     if written < 0:
         return None
-    return bytes(bytearray(out)[:written]), seq_io.value
+    return ctypes.string_at(out, written), seq_io.value

@@ -72,6 +72,67 @@ void civil_from_days(int64_t z, int &y, int &m, int &d) {
     y = static_cast<int>(yoe + era * 400 + (mp >= 10 ? 1 : 0));
 }
 
+// Python repr(float): shortest round-trip digits, FIXED notation when the
+// decimal exponent is in [-4, 16), else scientific with a sign and a
+// >=2-digit exponent. std::to_chars's shortest form picks notation by
+// string length instead, so we render scientific and re-format.
+size_t fmt_double_pyrepr(double v, char *tmp, size_t cap) {
+    if (v != v) { memcpy(tmp, "nan", 3); return 3; }
+    if (v == __builtin_inf()) { memcpy(tmp, "inf", 3); return 3; }
+    if (v == -__builtin_inf()) { memcpy(tmp, "-inf", 4); return 4; }
+    char sci[48];
+    auto r = std::to_chars(sci, sci + sizeof sci, v,
+                           std::chars_format::scientific);
+    size_t sn = r.ptr - sci;
+    // parse: [-]D[.DDDD]e±XX
+    char *p = tmp;
+    size_t i = 0;
+    if (sci[0] == '-') { *p++ = '-'; i = 1; }
+    char digits[40];
+    int nd = 0;
+    digits[nd++] = sci[i++];
+    if (i < sn && sci[i] == '.') {
+        i++;
+        while (i < sn && sci[i] != 'e') digits[nd++] = sci[i++];
+    }
+    // exponent
+    int exp = 0, esign = 1;
+    i++;                                   // past 'e'
+    if (sci[i] == '-') { esign = -1; i++; }
+    else if (sci[i] == '+') { i++; }
+    while (i < sn) exp = exp * 10 + (sci[i++] - '0');
+    exp *= esign;
+    if (exp >= -4 && exp < 16) {           // fixed notation
+        if (exp >= 0) {
+            int k = 0;
+            for (; k <= exp; k++) *p++ = k < nd ? digits[k] : '0';
+            *p++ = '.';
+            if (k < nd) { for (; k < nd; k++) *p++ = digits[k]; }
+            else *p++ = '0';
+        } else {
+            *p++ = '0'; *p++ = '.';
+            for (int z = 0; z < -exp - 1; z++) *p++ = '0';
+            for (int k = 0; k < nd; k++) *p++ = digits[k];
+        }
+        return p - tmp;
+    }
+    // scientific: d[.ddd]e±XX (exponent at least 2 digits)
+    *p++ = digits[0];
+    if (nd > 1) {
+        *p++ = '.';
+        for (int k = 1; k < nd; k++) *p++ = digits[k];
+    }
+    *p++ = 'e';
+    *p++ = exp < 0 ? '-' : '+';
+    int ae = exp < 0 ? -exp : exp;
+    char eb[8];
+    int en = 0;
+    while (ae) { eb[en++] = '0' + ae % 10; ae /= 10; }
+    while (en < 2) eb[en++] = '0';
+    while (en) *p++ = eb[--en];
+    return p - tmp;
+}
+
 size_t fmt_value(const Col &c, int64_t row, char *tmp, size_t cap) {
     switch (c.kind) {
     case 0: {  // int64
@@ -79,17 +140,9 @@ size_t fmt_value(const Col &c, int64_t row, char *tmp, size_t cap) {
         auto r = std::to_chars(tmp, tmp + cap, v);
         return r.ptr - tmp;
     }
-    case 1: {  // float64, shortest round-trip (matches python repr)
+    case 1: {  // float64 — byte-identical to python repr()
         double v = static_cast<const double *>(c.values)[row];
-        auto r = std::to_chars(tmp, tmp + cap, v);
-        size_t n = r.ptr - tmp;
-        // python repr spells integral floats "1.0", to_chars says "1"
-        bool plain = true;
-        for (size_t i = 0; i < n; i++)
-            if (tmp[i] == '.' || tmp[i] == 'e' || tmp[i] == 'n' ||
-                tmp[i] == 'i') { plain = false; break; }
-        if (plain && n + 2 <= cap) { tmp[n++] = '.'; tmp[n++] = '0'; }
-        return n;
+        return fmt_double_pyrepr(v, tmp, cap);
     }
     case 2: {  // DECIMAL: scaled int64 → fixed point
         int64_t v = static_cast<const int64_t *>(c.values)[row];
@@ -175,8 +228,9 @@ long long encode_text_rows(const Col *cols, int32_t n_cols,
                 ro.lenenc_str(tmp, n);
             }
         }
-        // frame: 3-byte length + seq (rows < 16MB each by construction)
         size_t plen = ro.buf.size();
+        if (plen >= 0xFFFFFF) return -2;   // needs continuation packets:
+                                           // python path handles those
         o.byte(plen & 0xff);
         o.byte((plen >> 8) & 0xff);
         o.byte((plen >> 16) & 0xff);

@@ -255,8 +255,12 @@ class _Conn:
             status = 0x0002 | (SERVER_MORE_RESULTS_EXISTS
                                if i + 1 < len(results) else 0)
             if rs.is_query:
-                self.write_resultset(rs.names, rs.ftypes, rs.rows, status,
-                                     chunks=rs.chunks)
+                # pass rows=None when chunks exist: ResultSet.rows is a
+                # LAZY property and touching it would decode every row
+                self.write_resultset(
+                    rs.names, rs.ftypes,
+                    None if rs.chunks is not None else rs.rows,
+                    status, chunks=rs.chunks)
             else:
                 self.write_ok(affected=rs.affected_rows, status=status)
 

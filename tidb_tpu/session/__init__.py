@@ -279,8 +279,7 @@ class Session:
             if self.txn is not None:
                 self.txn.commit()  # implicit commit (MySQL semantics)
             self.txn = self.engine.store.begin()
-            self._txn_schema_version = \
-                self.engine.catalog.info_schema.version
+            self._txn_schema_version = self.engine.catalog.user_version
             return ok()
         if isinstance(stmt, ast.CommitStmt):
             if self.txn is not None:
@@ -288,7 +287,7 @@ class Session:
                     # schema lease check (domain/schema_validator.go): a
                     # concurrent DDL may have changed layouts the staged
                     # chunks were built against — abort, don't corrupt
-                    if self.engine.catalog.info_schema.version != \
+                    if self.engine.catalog.user_version != \
                             getattr(self, "_txn_schema_version", None) \
                             and self.txn.has_staged_writes():
                         self.txn.rollback()

@@ -92,6 +92,7 @@ class Store:
         self._tables: Dict[int, TableData] = {}
         self._region_ids = itertools.count(1)
         self._version = 0
+        self._open_txns = 0     # compaction defers while txns are open
 
     # ---- lifecycle -------------------------------------------------------
     def create_table(self, table_id: int) -> None:
@@ -146,12 +147,58 @@ class Store:
                                       np.zeros(part.num_rows, dtype=bool)))
         self._tables[table_id] = TableData(tuple(regions))
 
+    GC_DEAD_RATIO = 0.5     # compact when half a table is tombstones
+
     def delete(self, table_id: int, region_masks: Dict[int, np.ndarray]) -> int:
         """Mark rows deleted; masks are keyed by region id. Returns count."""
         with self._lock:
             n = self._delete_locked(table_id, region_masks)
+            self._maybe_compact_locked(table_id)
             self._version += 1
             return n
+
+    def _maybe_compact_locked(self, table_id: int,
+                              closing: int = 0) -> None:
+        """GC (ref: store/gcworker/gc_worker.go — MVCC version GC; here
+        tombstone reclamation): rewrite regions dropping deleted rows once
+        the dead fraction crosses GC_DEAD_RATIO. Produces fresh TableData,
+        so every identity-keyed cache (HBM tables, sorted indexes)
+        invalidates for free."""
+        if self._open_txns - closing > 0:
+            # an open txn may hold staged deletes against current region
+            # ids; rewriting them would abort it spuriously (GC safepoint
+            # discipline, gc_worker.go — don't GC under active readers);
+            # `closing` excludes the txn whose commit is applying now
+            return
+        td = self._tables.get(table_id)
+        if td is None or not td.regions:
+            return
+        total = sum(r.num_rows for r in td.regions)
+        dead = sum(int(r.deleted.sum()) for r in td.regions)
+        if total == 0 or dead / total < self.GC_DEAD_RATIO:
+            return
+        regions = []
+        for r in td.regions:
+            if not r.deleted.any():
+                regions.append(r)
+                continue
+            alive = ~r.deleted
+            if not alive.any():
+                continue            # fully dead region vanishes
+            kept = r.chunk.take(np.nonzero(alive)[0])
+            regions.append(Region(next(self._region_ids), kept,
+                                  np.zeros(kept.num_rows, dtype=bool)))
+        self._tables[table_id] = TableData(tuple(regions))
+
+    def gc_stats(self, table_id: int):
+        """(live_rows, dead_rows, regions) — observability hook."""
+        with self._lock:
+            td = self._tables.get(table_id)
+            if td is None:
+                return (0, 0, 0)
+            total = sum(r.num_rows for r in td.regions)
+            dead = sum(int(r.deleted.sum()) for r in td.regions)
+            return (total - dead, dead, len(td.regions))
 
     def _pad_mask(self, mask: np.ndarray, region: Region) -> np.ndarray:
         """A staged mask may be shorter than the region if rows were appended
@@ -203,9 +250,17 @@ class Store:
 
     # ---- transactions ----------------------------------------------------
     def begin(self) -> "Transaction":
+        with self._lock:
+            self._open_txns += 1
         return Transaction(self, self.snapshot())
 
+    def _txn_closed(self) -> None:
+        with self._lock:
+            self._open_txns = max(self._open_txns - 1, 0)
+
     def commit(self, txn: "Transaction") -> None:
+        from tidb_tpu.util import failpoint
+        failpoint.inject("store-commit")
         with self._lock:
             # first-committer-wins: validate EVERYTHING before applying
             # anything, so a conflict leaves no partial writes behind
@@ -219,6 +274,8 @@ class Store:
             for tid, chunks in txn.staged_inserts.items():
                 for ch in chunks:
                     self._append_locked(tid, ch)
+            for tid in txn.staged_deletes:
+                self._maybe_compact_locked(tid, closing=1)
             self._version += 1
 
     # ---- introspection ---------------------------------------------------
@@ -283,6 +340,11 @@ class Transaction:
                 if sd is not None:
                     mask = mask & ~sd
                 yield r, r.chunk, mask
+        elif self._store.snapshot().has_table(table_id):
+            # table created AFTER this txn began (session-private CTE
+            # temp materialization): read it from the current store view
+            for r, alive in self._store.snapshot().scan(table_id):
+                yield r, r.chunk, alive
         for ch in self.staged_inserts.get(table_id, []):
             if ch.num_rows:
                 yield None, ch, np.ones(ch.num_rows, dtype=bool)
@@ -295,8 +357,11 @@ class Transaction:
             self._store.commit(self)
         finally:
             self.active = False
+            self._store._txn_closed()
 
     def rollback(self) -> None:
+        if self.active:
+            self._store._txn_closed()
         self.active = False
         self.staged_inserts.clear()
         self.staged_deletes.clear()

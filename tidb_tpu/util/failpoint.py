@@ -1,0 +1,66 @@
+"""Failpoints — deterministic fault injection (ref: pingcap/failpoint,
+enabled across ~hundreds of reference sites via make failpoint-enable;
+kv/fault_injection.go wraps storage the same way).
+
+Usage at a site:    failpoint.inject("commit-error")
+In a test:          with failpoint.enabled("commit-error", raise_=TxnError("boom")): ...
+
+Actions: raise an exception, return a value (site decides how to use it),
+or call a hook. Zero overhead when nothing is enabled (one dict probe).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Callable, Dict, Optional
+
+_lock = threading.Lock()
+_active: Dict[str, dict] = {}
+
+
+def enable(name: str, *, raise_: Optional[BaseException] = None,
+           value=None, hook: Optional[Callable] = None) -> None:
+    with _lock:
+        _active[name] = {"raise": raise_, "value": value, "hook": hook,
+                         "hits": 0}
+
+
+def disable(name: str) -> None:
+    with _lock:
+        _active.pop(name, None)
+
+
+def hits(name: str) -> int:
+    with _lock:
+        ent = _active.get(name)
+        return ent["hits"] if ent else 0
+
+
+def inject(name: str):
+    """Trip the failpoint if enabled: runs the hook, raises, or returns
+    the configured value (None when disabled)."""
+    if not _active:              # fast path: nothing enabled anywhere
+        return None
+    with _lock:
+        ent = _active.get(name)
+        if ent is None:
+            return None
+        ent["hits"] += 1
+        exc = ent["raise"]
+        hook = ent["hook"]
+        value = ent["value"]
+    if hook is not None:
+        hook()
+    if exc is not None:
+        raise exc
+    return value
+
+
+@contextlib.contextmanager
+def enabled(name: str, **kwargs):
+    enable(name, **kwargs)
+    try:
+        yield
+    finally:
+        disable(name)
