@@ -1,23 +1,25 @@
-"""Benchmark: TPC-H Q1 hash-aggregation rows/sec, device engine vs the CPU
-vectorized volcano baseline (BASELINE.json config #2; north-star metric).
+"""Benchmark: TPC-H-shaped queries, device engine vs the CPU vectorized
+volcano baseline (BASELINE.json north-star ladder at SF=10).
 
-Generates lineitem-shaped columns (the mockDataSource pattern of the
-reference's executor/benchmark_test.go — no storage round trip), loads them
-into the columnar region store, then times
+Generates lineitem/orders/customer-shaped columns (the mockDataSource
+pattern of the reference's executor/benchmark_test.go — no storage round
+trip), loads them into the columnar region store, then times three query
+shapes through the CPU pipeline and the fused TPU fragments:
 
-    SELECT l_returnflag, l_linestatus, SUM(l_quantity),
-           SUM(l_extendedprice), SUM(l_extendedprice * (1 - l_discount)),
-           SUM(l_extendedprice * (1 - l_discount) * (1 + l_tax)),
-           AVG(l_quantity), AVG(l_extendedprice), AVG(l_discount), COUNT(*)
-    FROM lineitem WHERE l_shipdate <= '1998-09-02'
-    GROUP BY l_returnflag, l_linestatus ORDER BY l_returnflag, l_linestatus
+  Q1  hash-agg over one table          (BASELINE config #2, headline)
+  Q3  join + agg                       (BASELINE config #3)
+  Q5  3-table join chain + agg         (BASELINE config #5 shape)
 
-once through the CPU pipeline and once through the fused TPU fragment.
-Prints ONE JSON line: value = device rows/sec, vs_baseline = speedup over
-the CPU engine on this host.
+Prints ONE JSON line: value = device Q1 rows/sec, vs_baseline = speedup
+over the CPU engine on this host. Extras carry Q3/Q5 numbers, exec-only
+device seconds (device compute + transfers, no host decode/plan), and an
+absolute host reference: the measured host memory stream bandwidth and the
+implied Q1 roofline time (bytes touched / bandwidth) — the fastest ANY
+host CPU engine could run Q1, making `vs_baseline` non-self-referential.
 
-Env: BENCH_SF (default 1.0) scales row count (SF=1 → 6,001,215 rows);
-BENCH_REPS (default 3) timed repetitions (best-of).
+Env: BENCH_SF (default 10) scales row count (SF=1 → 6,001,215 lineitem
+rows); BENCH_REPS (default 2) timed repetitions (best-of); BENCH_CPU_REPS
+(default 1).
 """
 
 from __future__ import annotations
@@ -35,6 +37,19 @@ Q1 = """SELECT l_returnflag, l_linestatus, SUM(l_quantity),
  AVG(l_quantity), AVG(l_extendedprice), AVG(l_discount), COUNT(*)
  FROM lineitem WHERE l_shipdate <= '1998-09-02'
  GROUP BY l_returnflag, l_linestatus ORDER BY l_returnflag, l_linestatus"""
+
+Q3 = """SELECT o_orderpriority, COUNT(*),
+ SUM(l_extendedprice * (1 - l_discount))
+ FROM lineitem JOIN orders ON l_orderkey = o_orderkey
+ WHERE l_shipdate <= '1998-09-02' AND o_orderdate < '1998-01-01'
+ GROUP BY o_orderpriority ORDER BY o_orderpriority"""
+
+Q5 = """SELECT c_mktsegment, COUNT(*),
+ SUM(l_extendedprice * (1 - l_discount))
+ FROM lineitem JOIN orders ON l_orderkey = o_orderkey
+ JOIN customer ON o_custkey = c_custkey
+ WHERE l_shipdate <= '1998-09-02'
+ GROUP BY c_mktsegment ORDER BY c_mktsegment"""
 
 
 def log(msg: str):
@@ -91,11 +106,16 @@ def probe_backend(retries: int = 5) -> str:
     os.execve(sys.executable, [sys.executable] + sys.argv, env)
 
 
-Q3 = """SELECT o_orderpriority, COUNT(*),
- SUM(l_extendedprice * (1 - l_discount))
- FROM lineitem JOIN orders ON l_orderkey = o_orderkey
- WHERE l_shipdate <= '1998-09-02' AND o_orderdate < '1998-01-01'
- GROUP BY o_orderpriority ORDER BY o_orderpriority"""
+def host_stream_gbs() -> float:
+    """Measured host memory stream bandwidth (GB/s): sum-reduce a 1-GiB
+    array, best of 3 — the roofline any host CPU engine is bound by."""
+    a = np.ones(1 << 27, dtype=np.float64)      # 1 GiB
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        a.sum()
+        best = min(best, time.perf_counter() - t0)
+    return a.nbytes / best / 1e9
 
 
 def make_lineitem(n: int):
@@ -124,12 +144,16 @@ def build_engine(n_rows: int):
         "l_tax DECIMAL(15,2), l_returnflag CHAR(1), l_linestatus CHAR(1), "
         "l_shipdate DATE, l_orderkey BIGINT)")
     s.execute(
-        "CREATE TABLE orders (o_orderkey BIGINT, o_orderdate DATE, "
-        "o_orderpriority CHAR(1))")
+        "CREATE TABLE orders (o_orderkey BIGINT PRIMARY KEY, "
+        "o_orderdate DATE, o_orderpriority CHAR(1), o_custkey BIGINT)")
+    s.execute(
+        "CREATE TABLE customer (c_custkey BIGINT PRIMARY KEY, "
+        "c_mktsegment CHAR(10))")
     info = eng.catalog.info_schema.table("lineitem")
     qty, price, disc, tax, rflag, lstatus, shipdate = make_lineitem(n_rows)
     rng = np.random.default_rng(7)
     n_orders = max(n_rows // 4, 1)
+    n_cust = max(n_rows // 40, 1)
     okey = rng.integers(0, n_orders, n_rows).astype(np.int64)
     fts = [c.ftype for c in info.columns]
     chunk = Chunk([
@@ -140,8 +164,11 @@ def build_engine(n_rows: int):
     txn = eng.store.begin()
     txn.append(info.id, chunk)
     txn.commit()
+    del qty, price, disc, tax, rflag, lstatus, shipdate, okey, chunk
     oinfo = eng.catalog.info_schema.table("orders")
     ofts = [c.ftype for c in oinfo.columns]
+    segs = np.array(["AUTOMOBILE", "BUILDING", "FURNITURE", "MACHINERY",
+                     "HOUSEHOLD"], dtype=object)
     ochunk = Chunk([
         Column(ofts[0], np.arange(n_orders, dtype=np.int64), None),
         Column(ofts[1], rng.integers(8036, 10590,
@@ -149,56 +176,51 @@ def build_engine(n_rows: int):
         Column(ofts[2], np.array(["1", "2", "3", "4", "5"],
                                  dtype=object)[rng.integers(0, 5,
                                                             n_orders)],
-               None)])
+               None),
+        Column(ofts[3], rng.integers(0, n_cust,
+                                     n_orders).astype(np.int64), None)])
     txn = eng.store.begin()
     txn.append(oinfo.id, ochunk)
     txn.commit()
+    del ochunk
+    cinfo = eng.catalog.info_schema.table("customer")
+    cfts = [c.ftype for c in cinfo.columns]
+    cchunk = Chunk([
+        Column(cfts[0], np.arange(n_cust, dtype=np.int64), None),
+        Column(cfts[1], segs[rng.integers(0, 5, n_cust)], None)])
+    txn = eng.store.begin()
+    txn.append(cinfo.id, cchunk)
+    txn.commit()
+    del cchunk
     s.execute("ANALYZE TABLE lineitem")
     s.execute("ANALYZE TABLE orders")
+    s.execute("ANALYZE TABLE customer")
     return eng, s
 
 
-def time_query(s, reps: int, sql: str = Q1) -> float:
+def time_query(s, reps: int, sql: str = Q1):
+    """→ (best wall seconds, device-exec seconds of the best run)."""
+    from tidb_tpu.executor import fragment as frag_mod
     best = float("inf")
-    for _ in range(reps):
+    exec_s = 0.0
+    for _ in range(max(reps, 1)):
+        frag_mod.LAST_DEVICE_EXEC_S = 0.0
         t0 = time.perf_counter()
         rs = s.query(sql)
         dt = time.perf_counter() - t0
-        best = min(best, dt)
+        if dt < best:
+            best = dt
+            exec_s = frag_mod.LAST_DEVICE_EXEC_S
         assert rs.rows, "query returned no rows"
-    return best
+    return best, exec_s
 
 
-def main():
-    sf = float(os.environ.get("BENCH_SF", "1"))
-    reps = int(os.environ.get("BENCH_REPS", "3"))
-    n_rows = int(sf * 6_001_215)
-
-    # probe/initialize the backend FIRST — datagen takes a while and a dead
-    # backend must be discovered (and retried/re-execed) before spending it
-    backend_name = probe_backend()
-
-    log(f"generating lineitem SF={sf} ({n_rows:,} rows)")
-    eng, s = build_engine(n_rows)
-
-    # CPU baseline (the reference-equivalent vectorized volcano engine)
-    s.vars["tidb_tpu_engine"] = "off"
-    log("warming CPU path…")
-    time_query(s, 1)
-    cpu_t = time_query(s, reps)
-    log(f"CPU engine: {cpu_t:.3f}s ({n_rows / cpu_t / 1e6:.1f}M rows/s)")
-
-    # Device path (fused fragment)
-    s.vars["tidb_tpu_engine"] = "on"
-    s.vars["tidb_tpu_row_threshold"] = 32768
-    log("warming device path (compile)…")
-    time_query(s, 1)
-    # verify the fragment actually routed to the device engine
+def check_device_used(s, sql: str) -> bool:
     from tidb_tpu.executor import build as build_exec
-    from tidb_tpu.executor.fragment import TpuFragmentExec
     from tidb_tpu.executor import run_to_completion
+    from tidb_tpu.executor.fragment import TpuFragmentExec
     from tidb_tpu.parser import parse
-    plan = s._plan(parse(Q1)[0])
+    plan = s._plan(parse(sql)[0])
     root = build_exec(plan)
     run_to_completion(root, s._exec_ctx())
     frags = []
@@ -210,33 +232,80 @@ def main():
             walk(c)
 
     walk(root)
-    used_device = bool(frags) and all(f.used_device for f in frags)
+    for f in frags:
+        if not f.used_device:
+            log(f"  fragment fell back: {f.fallback_reason}")
+    return bool(frags) and all(f.used_device for f in frags)
+
+
+def main():
+    sf = float(os.environ.get("BENCH_SF", "10"))
+    reps = int(os.environ.get("BENCH_REPS", "2"))
+    cpu_reps = int(os.environ.get("BENCH_CPU_REPS", "1"))
+    n_rows = int(sf * 6_001_215)
+
+    # probe/initialize the backend FIRST — datagen takes a while and a dead
+    # backend must be discovered (and retried/re-execed) before spending it
+    backend_name = probe_backend()
+    gbs = host_stream_gbs()
+    # Q1 touches 7 lineitem columns (4×8B decimals, 2 dict codes ≈ 8B, 4B
+    # date) per row — the minimum bytes any columnar CPU engine must stream
+    q1_bytes = n_rows * (4 * 8 + 2 * 8 + 4)
+    roofline_s = q1_bytes / (gbs * 1e9)
+    log(f"host stream bandwidth {gbs:.1f} GB/s; Q1 roofline "
+        f"{roofline_s:.2f}s at SF={sf}")
+
+    log(f"generating TPC-H-shaped data SF={sf} ({n_rows:,} lineitem rows)")
+    eng, s = build_engine(n_rows)
+
+    extra = {"backend": backend_name, "scale_factor": sf,
+             "host_stream_gbs": round(gbs, 1),
+             "q1_cpu_roofline_s": round(roofline_s, 3)}
+
+    # CPU baseline (the reference-equivalent vectorized volcano engine)
+    s.vars["tidb_tpu_engine"] = "off"
+    log("timing CPU Q1…")
+    cpu_t, _ = time_query(s, cpu_reps)
+    log(f"CPU engine Q1: {cpu_t:.3f}s ({n_rows / cpu_t / 1e6:.1f}M rows/s, "
+        f"{q1_bytes / cpu_t / 1e9:.1f} GB/s effective)")
+
+    # Device path (fused fragment)
+    s.vars["tidb_tpu_engine"] = "on"
+    s.vars["tidb_tpu_row_threshold"] = 32768
+    log("warming device path (compile)…")
+    time_query(s, 1)
+    used_device = check_device_used(s, Q1)
     log(f"device fragment active: {used_device}")
+    dev_t, dev_exec = time_query(s, reps)
+    log(f"TPU engine Q1: {dev_t:.3f}s wall / {dev_exec:.3f}s exec "
+        f"({n_rows / dev_t / 1e6:.1f}M rows/s)")
+    extra.update({"device_fragment": used_device,
+                  "cpu_rows_per_sec": round(n_rows / cpu_t, 1),
+                  "q1_device_exec_s": round(dev_exec, 3),
+                  "q1_vs_roofline": round(roofline_s / dev_t, 3)})
 
-    dev_t = time_query(s, reps)
-    log(f"TPU engine: {dev_t:.3f}s ({n_rows / dev_t / 1e6:.1f}M rows/s)")
+    # secondary metrics: Q3 join and Q5 3-table join (configs #3/#5)
+    for name, sql in (("q3", Q3), ("q5", Q5)):
+        try:
+            s.vars["tidb_tpu_engine"] = "off"
+            c_t, _ = time_query(s, cpu_reps, sql)
+            s.vars["tidb_tpu_engine"] = "on"
+            time_query(s, 1, sql)          # compile warmup
+            used = check_device_used(s, sql)
+            d_t, d_exec = time_query(s, reps, sql)
+            log(f"{name.upper()} join: CPU {c_t:.3f}s, TPU {d_t:.3f}s wall "
+                f"/ {d_exec:.3f}s exec ({c_t / d_t:.1f}x, device={used})")
+            extra.update({
+                f"{name}_join_rows_per_sec": round(n_rows / d_t, 1),
+                f"{name}_vs_cpu": round(c_t / d_t, 3),
+                f"{name}_device_exec_s": round(d_exec, 3),
+                f"{name}_device_fragment": used,
+                f"{name}_cpu_s": round(c_t, 3)})
+        except Exception as e:  # noqa: BLE001 — must not sink the headline
+            log(f"{name} bench failed (headline unaffected): {e}")
+            extra[f"{name}_error"] = str(e)[:200]
 
-    # secondary metric: Q3-shaped join+aggregate (BASELINE config #3)
-    q3 = {}
-    try:
-        s.vars["tidb_tpu_engine"] = "off"
-        q3_cpu = time_query(s, 1, Q3)
-        s.vars["tidb_tpu_engine"] = "on"
-        time_query(s, 1, Q3)          # compile warmup
-        q3_dev = time_query(s, reps, Q3)
-        log(f"Q3 join: CPU {q3_cpu:.3f}s, TPU {q3_dev:.3f}s "
-            f"({q3_cpu / q3_dev:.1f}x)")
-        q3 = {"q3_join_rows_per_sec": round(n_rows / q3_dev, 1),
-              "q3_vs_cpu": round(q3_cpu / q3_dev, 3)}
-    except Exception as e:  # noqa: BLE001 — Q3 must not sink the headline
-        log(f"Q3 bench failed (headline unaffected): {e}")
-        q3 = {"q3_error": str(e)[:200]}
-
-    value = n_rows / dev_t
-    vs = cpu_t / dev_t
-    extra = {"backend": backend_name, "device_fragment": used_device,
-             "cpu_rows_per_sec": round(n_rows / cpu_t, 1), **q3}
-    emit(value, vs, extra)
+    emit(n_rows / dev_t, cpu_t / dev_t, extra)
 
 
 if __name__ == "__main__":

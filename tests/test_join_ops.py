@@ -1,0 +1,154 @@
+"""Unit tests for the device join primitives (ops/join.py) against a
+numpy oracle: LUT and sort formulations, unique and multi variants, and
+the static-shape expansion kernel."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.ops import join as J
+from tidb_tpu.ops.jax_env import jnp
+
+
+def np_matches(build, ok_b, probe, ok_p):
+    """Oracle: per probe row, the list of matching build row indices."""
+    out = []
+    for p, okp in zip(probe, ok_p):
+        if not okp:
+            out.append([])
+        else:
+            out.append([i for i, (b, okb) in enumerate(zip(build, ok_b))
+                        if okb and b == p])
+    return out
+
+
+def _case(seed, nb, np_, dom):
+    rng = np.random.default_rng(seed)
+    build = rng.integers(0, dom, nb).astype(np.int64)
+    probe = rng.integers(-2, dom + 2, np_).astype(np.int64)
+    ok_b = rng.random(nb) > 0.2
+    ok_p = rng.random(np_) > 0.2
+    return build, probe, ok_b, ok_p
+
+
+def test_lut_probe_unique_matches_oracle():
+    nb, npr, dom = 37, 64, 50
+    rng = np.random.default_rng(0)
+    build = rng.permutation(dom)[:nb].astype(np.int64)   # unique keys
+    probe = rng.integers(-3, dom + 3, npr).astype(np.int64)
+    ok_b = rng.random(nb) > 0.2
+    ok_p = rng.random(npr) > 0.2
+    pc = np.clip(probe, 0, dom - 1)
+    ok_probe = ok_p & (probe >= 0) & (probe < dom)
+    idx, matched, unique = J.lut_probe_unique(
+        jnp.asarray(build), jnp.asarray(ok_b), dom,
+        jnp.asarray(pc), jnp.asarray(ok_probe))
+    assert bool(unique)
+    oracle = np_matches(build, ok_b, probe, ok_probe)
+    for i, m in enumerate(oracle):
+        assert bool(matched[i]) == (len(m) == 1)
+        if m:
+            assert int(idx[i]) == m[0]
+
+
+def test_lut_probe_unique_flags_duplicates():
+    build = np.array([5, 7, 5, 9], dtype=np.int64)
+    ok_b = np.ones(4, bool)
+    _, _, unique = J.lut_probe_unique(
+        jnp.asarray(build), jnp.asarray(ok_b), 16,
+        jnp.zeros(4, np.int64), jnp.ones(4, bool))
+    assert not bool(unique)
+    # dead duplicate doesn't count
+    ok_b2 = np.array([True, True, False, True])
+    _, _, unique2 = J.lut_probe_unique(
+        jnp.asarray(build), jnp.asarray(ok_b2), 16,
+        jnp.zeros(4, np.int64), jnp.ones(4, bool))
+    assert bool(unique2)
+
+
+@pytest.mark.parametrize("form", ["lut", "sort"])
+def test_probe_multi_matches_oracle(form):
+    dom = 20
+    build, probe, ok_b, ok_p = _case(3, 41, 57, dom)
+    if form == "lut":
+        pc = np.clip(probe, 0, dom - 1)
+        okp = ok_p & (probe >= 0) & (probe < dom)
+        start, count, order = J.lut_probe_multi(
+            jnp.asarray(build), jnp.asarray(ok_b), dom,
+            jnp.asarray(pc), jnp.asarray(okp))
+        oracle = np_matches(build, ok_b, probe, okp)
+    else:
+        start, count, order = J.sorted_probe_multi(
+            jnp.asarray(build), jnp.asarray(ok_b),
+            jnp.asarray(probe), jnp.asarray(ok_p))
+        oracle = np_matches(build, ok_b, probe, ok_p)
+    start, count, order = map(np.asarray, (start, count, order))
+    for i, m in enumerate(oracle):
+        assert count[i] == len(m)
+        got = sorted(order[start[i]:start[i] + count[i]].tolist())
+        assert got == sorted(m)
+
+
+@pytest.mark.parametrize("outer", [False, True])
+def test_expand_matches_oracle(outer):
+    dom = 12
+    build, probe, ok_b, ok_p = _case(7, 23, 31, dom)
+    live = np.ones(31, bool)
+    live[-3:] = False
+    start, count, order = J.sorted_probe_multi(
+        jnp.asarray(build), jnp.asarray(ok_b),
+        jnp.asarray(probe), jnp.asarray(ok_p & live))
+    out_cap = 256
+    p_idx, b_idx, matched, out_live, k, total = J.expand(
+        start, count, order, out_cap, outer, jnp.asarray(live))
+    if outer:
+        k = np.asarray(k)
+        assert (k[np.asarray(out_live) & ~np.asarray(matched)] == 0).all()
+    p_idx, b_idx = np.asarray(p_idx), np.asarray(b_idx)
+    matched, out_live = np.asarray(matched), np.asarray(out_live)
+    oracle = np_matches(build, ok_b, probe, ok_p & live)
+    pairs = set()
+    extended = set()
+    for j in range(out_cap):
+        if not out_live[j]:
+            continue
+        if matched[j]:
+            pairs.add((int(p_idx[j]), int(b_idx[j])))
+        else:
+            extended.add(int(p_idx[j]))
+    want_pairs = {(i, b) for i, m in enumerate(oracle) if live[i]
+                  for b in m}
+    assert pairs == want_pairs
+    want_total = sum(max(len(m), 1) if outer else len(m)
+                     for i, m in enumerate(oracle) if live[i])
+    assert int(total) == want_total
+    if outer:
+        assert extended == {i for i, m in enumerate(oracle)
+                            if live[i] and not m}
+    else:
+        assert not extended
+
+
+def test_expand_overflow_reports_total():
+    build = np.zeros(8, np.int64)        # all same key: fanout 8 per probe
+    probe = np.zeros(4, np.int64)
+    ones8, ones4 = np.ones(8, bool), np.ones(4, bool)
+    start, count, order = J.sorted_probe_multi(
+        jnp.asarray(build), jnp.asarray(ones8),
+        jnp.asarray(probe), jnp.asarray(ones4))
+    _, _, _, out_live, _, total = J.expand(start, count, order, 16, False,
+                                           jnp.asarray(ones4))
+    assert int(total) == 32          # true need reported despite cap 16
+    assert int(np.asarray(out_live).sum()) == 16
+
+
+def test_pack_bounded_codes():
+    keys = [(jnp.asarray(np.array([3, 5, 9, 4], np.int64)),
+             jnp.asarray(np.array([True, True, True, False]))),
+            (jnp.asarray(np.array([-1, 0, 2, 1], np.int64)),
+             jnp.asarray(np.ones(4, bool)))]
+    codes, ok = J.pack_bounded_codes(keys, [(3, 8), (-1, 2)])
+    codes, ok = np.asarray(codes), np.asarray(ok)
+    assert ok.tolist() == [True, True, False, False]   # 9 out of bounds; NULL
+    # code = (v0-3) + (v1+1)*6
+    assert codes[0] == 0 + 0 * 6
+    assert codes[1] == 2 + 1 * 6

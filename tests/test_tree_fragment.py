@@ -141,10 +141,32 @@ def test_three_table_tree(session):
     assert_same(run_device(session, sql), session.query(sql).rows)
 
 
-def test_non_unique_build_falls_back(session):
-    # join key o_prio is NOT unique in orders → runtime fallback, correct rows
-    sql = ("SELECT COUNT(*) FROM li JOIN orders ON l_oid = o_prio")
-    dev = run_device(session, sql, expect_fallback="non-unique")
+def test_non_unique_build_runs_on_device(session):
+    # join key o_prio is NOT unique in orders (~100 rows per key): the
+    # expansion path materializes every match on device, no CPU fallback
+    sql = ("SELECT COUNT(*), SUM(l_price) FROM li JOIN orders "
+           "ON l_oid = o_prio")
+    dev = run_device(session, sql)
+    assert_same(dev, session.query(sql).rows)
+
+
+def test_non_unique_left_join_device(session):
+    # duplicate build keys + probe rows with no match (null-extended) +
+    # NULL probe keys, all through the expansion path
+    sql = ("SELECT COUNT(*), COUNT(o_id), SUM(o_date) FROM li "
+           "LEFT JOIN orders ON l_oid = o_prio")
+    dev = run_device(session, sql)
+    assert_same(dev, session.query(sql).rows)
+
+
+def test_string_key_join_device(session):
+    # VARCHAR equi key: probe codes remap into the build dictionary space
+    session.execute("CREATE TABLE segs (s_name VARCHAR(12), s_rank BIGINT)")
+    session.execute("INSERT INTO segs VALUES ('BUILDING',1),('AUTO',2),"
+                    "('STEEL',3),('GHOST',4)")
+    sql = ("SELECT s_rank, COUNT(*) FROM orders JOIN segs "
+           "ON o_seg = s_name GROUP BY s_rank")
+    dev = run_device(session, sql)
     assert_same(dev, session.query(sql).rows)
 
 
@@ -172,6 +194,45 @@ def test_explain_analyze_tree_uses_device(session):
         assert frag_rows and "device:yes" in frag_rows[0][2], frag_rows
     finally:
         session.vars["tidb_tpu_engine"] = "off"
+
+
+def test_multi_slab_join_device(session):
+    # slab cap 1024 → li (5000 rows) splits into 5 slabs that concatenate
+    # inside the program (the SF=10 shape scaled down)
+    session.vars["tidb_tpu_max_slab_rows"] = 1000
+    try:
+        sql = ("SELECT o_prio, COUNT(*), SUM(l_price * (1 - l_disc)) "
+               "FROM li JOIN orders ON l_oid = o_id GROUP BY o_prio")
+        assert_same(run_device(session, sql), session.query(sql).rows)
+        # non-unique build + multi-slab probe
+        sql2 = "SELECT COUNT(*), SUM(l_price) FROM li JOIN orders ON l_oid = o_prio"
+        assert_same(run_device(session, sql2), session.query(sql2).rows)
+    finally:
+        session.vars.pop("tidb_tpu_max_slab_rows", None)
+
+
+def test_multi_slab_distinct_agg_device(session):
+    session.vars["tidb_tpu_max_slab_rows"] = 1000
+    try:
+        sql = ("SELECT COUNT(DISTINCT l_oid), COUNT(*) FROM li "
+               "WHERE l_ship < '1999-01-01'")
+        assert_same(run_device(session, sql), session.query(sql).rows)
+        sql2 = ("SELECT l_ship, COUNT(DISTINCT l_oid) FROM li "
+                "GROUP BY l_ship")
+        assert_same(run_device(session, sql2), session.query(sql2).rows)
+    finally:
+        session.vars.pop("tidb_tpu_max_slab_rows", None)
+
+
+def test_multi_slab_window_device(session):
+    session.vars["tidb_tpu_max_slab_rows"] = 1000
+    try:
+        sql = ("SELECT l_oid, l_price, "
+               "RANK() OVER (PARTITION BY l_ship ORDER BY l_price DESC), "
+               "SUM(l_price) OVER (PARTITION BY l_ship) FROM li")
+        assert_same(run_device(session, sql), session.query(sql).rows)
+    finally:
+        session.vars.pop("tidb_tpu_max_slab_rows", None)
 
 
 def test_group_cap_retry_over_join(session):

@@ -1,0 +1,207 @@
+"""Shared traced emission helpers for device programs.
+
+One implementation of the root reductions — grouped aggregation (sort-
+factorize or stats-informed perfect-hash) and window evaluation — used by
+both the linear-chain fragment programs (executor/fragment.py) and the
+join-tree / distributed programs (executor/tree_fragment.py,
+dist_fragment.py). The reference splits the same logic between
+executor/aggregate.go and unistore's cophandler/mpp_exec.go; here it is
+literally one function.
+
+All helpers are pure traced functions of (ctx, live, plan node): `ctx` is
+an expression EvalContext over device arrays, `live` the row-liveness mask
+(the sel vector analog).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from tidb_tpu.expression import EvalContext
+from tidb_tpu.expression.aggfuncs import AggFunc
+
+
+def emit_agg(ctx: EvalContext, live, root, aggs: List[AggFunc],
+             group_cap: int, key_bounds=None):
+    """Grouped-aggregation partial over one batch → {keys, states,
+    n_groups, slot_live}. With `key_bounds` (per-group-key (lo, hi)
+    domains) grouping is a direct packed code + segment ops — no sort
+    (the perfect-hash path); otherwise sort-based factorize."""
+    from tidb_tpu.ops.jax_env import jnp
+    from tidb_tpu.ops import factorize as F
+    if root.group_exprs and key_bounds is not None:
+        return _emit_agg_perfect(ctx, live, root, aggs, group_cap,
+                                 key_bounds)
+    cap = group_cap
+    n = live.shape[0]
+    if root.group_exprs:
+        keys = [e.eval(ctx) for e in root.group_exprs]
+        gids, n_groups, rep = F.factorize(keys, live, cap)
+        # dead rows → out-of-range id: segment ops drop them, which is
+        # required for order-sensitive states (first_row)
+        gids = jnp.where(live, gids, jnp.int32(cap))
+        key_out = [(jnp.asarray(v)[rep], jnp.asarray(m)[rep] &
+                    (jnp.arange(cap) < n_groups)) for v, m in keys]
+    else:
+        gids = jnp.where(live, jnp.int32(0), jnp.int32(cap))
+        n_groups = jnp.int32(1)
+        key_out = []
+    states = _agg_states(ctx, live, root, aggs, gids, cap, n)
+    slot_live = jnp.arange(cap, dtype=jnp.int32) < n_groups
+    return {"keys": key_out, "states": states, "n_groups": n_groups,
+            "slot_live": slot_live}
+
+
+def _emit_agg_perfect(ctx: EvalContext, live, root, aggs, cap: int,
+                      key_bounds):
+    """Stats-informed grouping without sorting: group-key domains are
+    known small bounds (dictionary sizes / cached min-max), so the group
+    id is a direct packed code and aggregation is pure segment ops —
+    the TPU-native analog of the reference's hash table when NDV is low
+    (executor/aggregate.go getGroupKey), minus the sort factorize's
+    O(n log n) multi-operand bitonic sort. cap == the packed key domain.
+    """
+    from tidb_tpu.ops.jax_env import jnp
+    from tidb_tpu.ops import segment as seg
+    n = live.shape[0]
+    keys = [e.eval(ctx) for e in root.group_exprs]
+    # packed code: per-key code 0 = NULL (its own group), else 1+v-lo
+    gid = jnp.zeros(n, dtype=jnp.int32)
+    stride = 1
+    cards = []
+    for (v, m), (lo, hi) in zip(keys, key_bounds):
+        card = hi - lo + 2
+        code = jnp.where(jnp.asarray(m),
+                         (jnp.clip(jnp.asarray(v), lo, hi) - lo + 1)
+                         .astype(jnp.int32),
+                         jnp.int32(0))
+        gid = gid + code * jnp.int32(stride)
+        stride *= card
+        cards.append(card)
+    gids_raw = jnp.where(live, gid, jnp.int32(cap))
+    occupied = seg.segment_sum(
+        jnp, jnp.where(live, jnp.int32(1), jnp.int32(0)), gids_raw,
+        cap) > 0
+    # compact occupied slots to the front (argsort over cap, not rows)
+    perm = jnp.argsort(jnp.logical_not(occupied), stable=True)
+    n_groups = occupied.sum().astype(jnp.int32)
+    inv = jnp.zeros(cap, jnp.int32).at[perm].set(
+        jnp.arange(cap, dtype=jnp.int32))
+    gids = jnp.where(live, inv[gid], jnp.int32(cap))
+    slot_live = jnp.arange(cap, dtype=jnp.int32) < n_groups
+    # reconstruct key values from the packed slot code — no row gathers
+    key_out = []
+    stride = 1
+    for (v, m), (lo, hi), card in zip(keys, key_bounds, cards):
+        c = (perm // stride) % card
+        stride *= card
+        vals = (c - 1 + lo).astype(jnp.asarray(v).dtype)
+        key_out.append((vals, (c != 0) & slot_live))
+    states = _agg_states(ctx, live, root, aggs, gids, cap, n)
+    return {"keys": key_out, "states": states, "n_groups": n_groups,
+            "slot_live": slot_live}
+
+
+def _agg_states(ctx, live, root, aggs, gids, cap: int, n: int):
+    from tidb_tpu.ops.jax_env import jnp
+    from tidb_tpu.ops import factorize as F
+    states = []
+    for agg, desc in zip(aggs, root.aggs):
+        if desc.args:
+            v, m = desc.args[0].eval(ctx)
+            v = jnp.asarray(v)
+            m = jnp.asarray(m) & live
+        else:
+            v = jnp.zeros(n, dtype=jnp.int64)
+            m = live
+        if desc.distinct and desc.args:
+            # keep only the first (group, value) occurrence
+            m = m & F.distinct_mask(gids, v, m, live)
+        st = agg.init(jnp, cap)
+        states.append(agg.update(jnp, st, gids, cap, v, m))
+    return states
+
+
+# ---------------------------------------------------------------------------
+# Window root
+# ---------------------------------------------------------------------------
+
+
+def emit_window(ctx: EvalContext, live, root):
+    """Window root on device: one lax.sort per distinct (partition, order)
+    spec, then the cumulative/segment primitives of ops/window.py traced
+    with jnp (the whole-column reformulation of executor/window.go).
+    → {cols, live} with the window outputs appended to the child columns."""
+    from tidb_tpu.ops.jax_env import jnp
+    from tidb_tpu.ops import factorize as F
+    n = live.shape[0]
+    n_child = len(root.children[0].schema)
+    out_cols = [ctx.column(i) for i in range(n_child)]
+    layouts = {}
+    for d in root.wdescs:
+        lkey = repr((d.partition, d.order, d.descs))
+        layout = layouts.get(lkey)
+        if layout is None:
+            pkeys = [e.eval(ctx) for e in d.partition]
+            okeys = [e.eval(ctx) for e in d.order]
+            perm, _ = F.sort_perm(pkeys + okeys,
+                                  [False] * len(pkeys) + list(d.descs),
+                                  live)
+            lives_s = jnp.take(live, perm)
+            first = jnp.zeros(n, dtype=bool).at[0].set(True)
+
+            def flags(cols):
+                out = first | jnp.concatenate(
+                    [jnp.zeros(1, dtype=bool),
+                     lives_s[1:] != lives_s[:-1]])
+                for v, m in cols:
+                    vs = jnp.take(jnp.asarray(v), perm)
+                    ms = jnp.take(jnp.asarray(m), perm)
+                    # NULL slots hold garbage values: neutralize so all
+                    # NULLs form ONE group (SQL GROUP/PARTITION NULLs)
+                    vs = jnp.where(ms, vs, jnp.zeros_like(vs))
+                    out = out | jnp.concatenate(
+                        [jnp.zeros(1, dtype=bool),
+                         (vs[1:] != vs[:-1]) | (ms[1:] != ms[:-1])])
+                return out
+
+            pstart = flags(pkeys)
+            peerstart = flags(pkeys + okeys) if okeys else pstart
+            layout = (perm, pstart, peerstart)
+            layouts[lkey] = layout
+        perm, pstart, peerstart = layout
+        v, m = _window_value(ctx, live, d, n, perm, pstart, peerstart)
+        back_v = jnp.zeros(n, dtype=v.dtype).at[perm].set(v)
+        back_m = jnp.zeros(n, dtype=bool).at[perm].set(m)
+        out_cols.append((back_v, back_m & live))
+    return {"cols": [(jnp.asarray(v), jnp.asarray(m))
+                     for v, m in out_cols], "live": live}
+
+
+def _window_value(ctx, live, d, n, perm, pstart, peerstart):
+    from tidb_tpu.ops.jax_env import jnp
+    from tidb_tpu.ops import window as W
+    from tidb_tpu.types import TypeKind
+    vals = valid = fill = None
+    if d.args:
+        v, m = d.args[0].eval(ctx)
+        vals = jnp.take(jnp.asarray(v), perm)
+        valid = jnp.take(jnp.asarray(m) & live, perm)
+    elif d.name not in ("row_number", "rank", "dense_rank"):
+        vals = jnp.zeros(n, dtype=jnp.int64)        # COUNT(*)
+        valid = jnp.take(live, perm)
+    if d.name in ("lag", "lead"):
+        if d.default is not None and d.default.value is not None:
+            fv = d.args[0].ftype.encode_value(d.default.value)
+            fill = (jnp.full(n, fv, dtype=vals.dtype),
+                    jnp.ones(n, dtype=bool))
+        else:
+            fill = (jnp.zeros(n, dtype=vals.dtype),
+                    jnp.zeros(n, dtype=bool))
+    if d.name == "avg" and d.args and \
+            d.args[0].ftype.kind is TypeKind.DECIMAL:
+        from tidb_tpu.ops.jax_env import device_float_dtype
+        vals = vals.astype(device_float_dtype()) / \
+            d.args[0].ftype.decimal_multiplier
+    return W.compute(jnp, d.name, vals, valid, pstart, peerstart,
+                     bool(d.order), d.offset, fill)

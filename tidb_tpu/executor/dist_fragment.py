@@ -69,6 +69,7 @@ class DistTreeProgram(TreeProgram):
                           for n, v in zip(self.prep_nodes, prep_vals)
                           if v is not None}
         self._join_unique_flags = []
+        self._join_totals = []
         self._overflow_flags = []
         cols, live = self._emit(self.plan, scan_inputs, scan_rows)
         out = self._finish_dist(cols, live)
@@ -90,7 +91,7 @@ class DistTreeProgram(TreeProgram):
             slot = next(i for i, s in enumerate(self.scan_order)
                         if s is node)
             in_cols = scan_inputs[slot]
-            cap = self.caps[id(node)]
+            cap, _ = self.caps[id(node)]
             # per-shard row count arrives as a (1,) slice of (n_shards,)
             n_local = scan_rows[slot][0]
             live = jnp.arange(cap, dtype=jnp.int32) < n_local

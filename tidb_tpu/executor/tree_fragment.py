@@ -3,24 +3,40 @@
 Extends the linear-chain fragments (executor/fragment.py) to plan subtrees
 containing equi hash joins — the TPC-H Q3/Q5 shape. The whole tree traces
 into a single jitted XLA program per query: every table is lifted to HBM
-once as a padded slab (executor/device_cache.py), joins run as sort +
-binary-search against unique build sides (ops/join.py; the reference's
-hashRowContainer probe, executor/hash_table.go:110, without the hash
-table), and the root reduction reuses the factorize/segment machinery.
+once as padded slabs (executor/device_cache.py; multi-slab tables
+concatenate inside the program), and the root reduction reuses the
+factorize/segment machinery (executor/device_emit.py).
 
-Restrictions (fall back to the CPU volcano path otherwise):
-  * every table fits one slab (no multi-slab join builds yet);
-  * equi keys are non-string (dictionary unification across sides TBD);
-  * build sides are unique on the key (the PK-FK shape) — checked on
-    device, reported back, and non-unique builds fall back at runtime;
-  * outer joins must preserve the PROBE side (kind='left' requires
-    build_right, 'right' requires build-left): the unique-build probe
-    formulation emits probe-shaped output, so build rows that match
-    nothing cannot be null-extended.
+Join formulations (ops/join.py), chosen per join at execution time:
+
+  * **LUT (perfect-hash)** when the build keys are plan-traceable to scan
+    columns with cached (lo, hi) bounds and the packed domain is small —
+    true for every TPC-H PK-FK key and for all dictionary-encoded string
+    columns. Build = one scatter, probe = one gather; no sort.
+  * **Sort + searchsorted** otherwise (the general sort-merge join,
+    the TPU answer to executor/hash_table.go:110).
+
+  * **unique mode** (PK-FK bet): probe-shaped output, no expansion. The
+    bet is placed from table metadata (single-column primary key / unique
+    index on the build key) or the planner's join-size estimate, and
+    guarded by a runtime `unique` flag — a lost bet re-traces that join in
+    expand mode (one recompile), it never falls back to CPU.
+  * **expand mode**: duplicate build keys materialize via prefix-sum
+    offsets into a static `out_cap`-shaped batch; the true total comes
+    back with the result, so capacity overflow also retries exactly once.
+
+Outer joins must preserve the PROBE side (kind='left' requires
+build_right, 'right' requires build-left): both modes emit probe-anchored
+output, so build rows that match nothing cannot be null-extended. String
+equi keys are supported by remapping the probe side's dictionary codes
+into the build side's dictionary space host-side (`KeyRemap` — one
+searchsorted over the two sorted dictionaries per query, shipped as a
+prepared LUT input).
 """
 
 from __future__ import annotations
 
+from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -30,15 +46,25 @@ from tidb_tpu.expression.aggfuncs import build_agg
 from tidb_tpu.planner.physical import (PhysHashAgg, PhysHashJoin,
                                        PhysProjection, PhysSelection,
                                        PhysSort, PhysTableScan, PhysTopN,
-                                       PhysicalPlan)
+                                       PhysWindow, PhysicalPlan)
 
 JOIN_KINDS = ("inner", "left", "right", "semi", "anti")
+JOIN_DOMAIN_CAP = 1 << 25      # max packed build-key domain for LUT joins
+JOIN_OUT_CAP = 1 << 26         # max expand-mode output rows (HBM guard)
 
 
 def has_join(plan: PhysicalPlan) -> bool:
     if isinstance(plan, PhysHashJoin):
         return True
     return any(has_join(c) for c in plan.children)
+
+
+def _string_key_ok(l: Expression, r: Expression) -> bool:
+    """String equi keys must be bare ColumnRefs (so the probe side's codes
+    can be dictionary-remapped into the build side's space)."""
+    if not (l.ftype.kind.is_string or r.ftype.kind.is_string):
+        return True
+    return isinstance(l, ColumnRef) and isinstance(r, ColumnRef)
 
 
 def tree_ok(plan: PhysicalPlan, threshold: int) -> bool:
@@ -63,13 +89,13 @@ def tree_ok(plan: PhysicalPlan, threshold: int) -> bool:
         if isinstance(node, PhysHashJoin):
             if node.kind not in JOIN_KINDS or not node.equi:
                 return False
-            # probe-shaped output ⇒ the preserved side must be the probe
+            # probe-anchored output ⇒ the preserved side must be the probe
             if node.kind in ("left", "semi", "anti") and not node.build_right:
                 return False
             if node.kind == "right" and node.build_right:
                 return False
             for le, re in node.equi:
-                if le.ftype.kind.is_string or re.ftype.kind.is_string:
+                if not _string_key_ok(le, re):
                     return False
             return walk(node.children[0], False) and \
                 walk(node.children[1], False)
@@ -92,6 +118,9 @@ def tree_ok(plan: PhysicalPlan, threshold: int) -> bool:
             if not _string_exprs_are_refs(node.by):
                 return False
             return walk(node.children[0], False)
+        if is_root and isinstance(node, PhysWindow):
+            from tidb_tpu.executor.fragment import _window_device_ok
+            return _window_device_ok(node) and walk(node.children[0], False)
         return False
 
     return walk(plan, True) and has_join(plan) and max_scan[0] >= threshold
@@ -110,9 +139,20 @@ def dist_ok(plan: PhysicalPlan, threshold: int) -> bool:
         return False
     if isinstance(plan, PhysHashAgg) and any(d.distinct for d in plan.aggs):
         return False     # distinct partials don't merge across shards
+    if _tree_has_string_keys(plan):
+        return False     # exchange-side dictionary unification TBD
     if has_join(plan):
         return tree_ok(plan, threshold)
     return _chain_shape_ok(plan, threshold)
+
+
+def _tree_has_string_keys(plan: PhysicalPlan) -> bool:
+    for node in _walk_nodes(plan):
+        if isinstance(node, PhysHashJoin):
+            for l, r in node.equi or []:
+                if l.ftype.kind.is_string or r.ftype.kind.is_string:
+                    return True
+    return False
 
 
 def _chain_shape_ok(plan: PhysicalPlan, threshold: int) -> bool:
@@ -129,16 +169,93 @@ def _scans(plan: PhysicalPlan) -> List[PhysTableScan]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# Join key preparation (string dictionary remap)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(eq=False)
+class KeyRemap(Expression):
+    """Remaps the probe side's dictionary codes into the build side's
+    dictionary space so string equi keys compare as integers.
+
+    prepare() receives the JOIN's input dictionary list (left ++ right
+    children) and computes a probe-code → build-code LUT host-side
+    (one searchsorted of two sorted dictionaries); codes absent from the
+    build dictionary map to -1, which matches nothing. The LUT ships as a
+    traced input, so dictionary changes never recompile."""
+
+    child: Expression            # side-local probe key (ColumnRef)
+    my_flow_idx: int             # my column's index in the join flow (l++r)
+    build_flow_idx: int          # build key column's index in the join flow
+
+    def __post_init__(self):
+        self.ftype = self.child.ftype
+
+    def children(self):
+        return [self.child]
+
+    def prepare(self, dictionaries):
+        pdict = dictionaries[self.my_flow_idx] \
+            if self.my_flow_idx < len(dictionaries) else None
+        bdict = dictionaries[self.build_flow_idx] \
+            if self.build_flow_idx < len(dictionaries) else None
+        if pdict is None or bdict is None or len(bdict) == 0:
+            return np.full(max(len(pdict) if pdict is not None else 0, 1),
+                           -1, np.int32)
+        pos = np.searchsorted(bdict, pdict)
+        pos_c = np.clip(pos, 0, len(bdict) - 1)
+        hit = bdict[pos_c] == pdict
+        return np.where(hit, pos_c, -1).astype(np.int32)
+
+    def eval(self, ctx: EvalContext):
+        lut = ctx.prepared.get(id(self))
+        if lut is None:
+            raise AssertionError("KeyRemap without prepared LUT")
+        xp = ctx.xp
+        v, m = self.child.eval(ctx)
+        n_lut = lut.shape[0]
+        vc = xp.clip(v, 0, n_lut - 1).astype(xp.int32)
+        out = xp.take(xp.asarray(lut), vc).astype(xp.int64)
+        out = xp.where((v >= 0) & (v < n_lut), out, xp.int64(-1))
+        return out, m
+
+    def __repr__(self):
+        return f"remap({self.child!r})"
+
+
+def join_key_exprs(node: PhysHashJoin):
+    """→ (build_keys, probe_keys) in equi order, coerced to a shared
+    comparable domain, with probe-side string keys wrapped in KeyRemap.
+    Memoized on the node (wrappers must be identical objects across the
+    planner gate, prep collection, and trace)."""
+    cached = getattr(node, "_dev_join_keys", None)
+    if cached is not None:
+        return cached
+    from tidb_tpu.executor.join import coerce_key_pair
+    nl = len(node.children[0].schema)
+    bkeys: List[Expression] = []
+    pkeys: List[Expression] = []
+    for l, r in node.equi:
+        lc, rc = coerce_key_pair(l, r)
+        b, p = (rc, lc) if node.build_right else (lc, rc)
+        if b.ftype.kind.is_string and isinstance(b, ColumnRef) \
+                and isinstance(p, ColumnRef):
+            b_flow = (nl if node.build_right else 0) + b.index
+            p_flow = (0 if node.build_right else nl) + p.index
+            p = KeyRemap(p, p_flow, b_flow)
+        bkeys.append(b)
+        pkeys.append(p)
+    node._dev_join_keys = (bkeys, pkeys)
+    return bkeys, pkeys
+
+
 def _stage_exprs(node: PhysicalPlan) -> List[Expression]:
     from tidb_tpu.executor.fragment import _stage_exprs as chain_stage
     from tidb_tpu.planner.physical import PhysExchange
     if isinstance(node, PhysHashJoin):
-        out: List[Expression] = []
-        for l, r in node.equi:
-            out.append(l)
-            out.append(r)
-        out.extend(node.other_conditions or [])
-        return out
+        bkeys, pkeys = join_key_exprs(node)
+        return list(bkeys) + list(pkeys) + list(node.other_conditions or [])
     if isinstance(node, PhysExchange):
         return list(node.keys)
     return chain_stage(node)
@@ -158,19 +275,181 @@ def _walk_nodes(plan: PhysicalPlan) -> List[PhysicalPlan]:
     return out
 
 
-def tree_signature(plan: PhysicalPlan, caps: Dict[int, int],
-                   group_cap: int) -> str:
-    parts = [f"tree", f"gcap={group_cap}"]
+def _walk_joins(plan: PhysicalPlan) -> List[PhysHashJoin]:
+    return [n for n in _walk_nodes(plan) if isinstance(n, PhysHashJoin)]
+
+
+# ---------------------------------------------------------------------------
+# Per-join execution configuration (planner bet + runtime adaptation)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class JoinCfg:
+    mode: str                                     # 'unique' | 'expand'
+    out_cap: int = 0                              # expand-mode output shape
+    bounds: Optional[Tuple[Tuple[int, int], ...]] = None   # LUT key bounds
+    domain: int = 0                               # LUT table size
+    est: int = 0                                  # planner output estimate
+
+
+def _bounds_list(node: PhysicalPlan, scan_bounds
+                 ) -> List[Optional[Tuple[int, int]]]:
+    """Per output column (lo, hi) value bounds, traced from the device
+    cache's per-scan-column stats; schema-length list, None = unbounded."""
+    from tidb_tpu.planner.physical import PhysExchange
+    if isinstance(node, PhysTableScan):
+        b = scan_bounds.get(id(node), {})
+        return [b.get(i) for i in range(len(node.schema))]
+    if isinstance(node, (PhysSelection, PhysExchange)):
+        return _bounds_list(node.children[0], scan_bounds)
+    if isinstance(node, PhysProjection):
+        inp = _bounds_list(node.children[0], scan_bounds)
+        return [inp[e.index] if isinstance(e, ColumnRef)
+                and e.index < len(inp) else None for e in node.exprs]
+    if isinstance(node, PhysHashJoin):
+        l = _bounds_list(node.children[0], scan_bounds)
+        r = _bounds_list(node.children[1], scan_bounds)
+        nl = len(node.children[0].schema)
+        nr = len(node.children[1].schema)
+        l = (l + [None] * nl)[:nl]
+        r = (r + [None] * nr)[:nr]
+        if node.kind in ("semi", "anti"):
+            return l
+        return l + r
+    return [None] * len(node.schema)
+
+
+def _trace_scan_col(node: PhysicalPlan, idx: int):
+    """Trace a column through Sel/Proj down to (scan, col) WITHOUT crossing
+    joins (a join can duplicate rows, breaking uniqueness)."""
+    from tidb_tpu.planner.physical import PhysExchange
+    while True:
+        if isinstance(node, PhysTableScan):
+            return node, idx
+        if isinstance(node, (PhysSelection, PhysExchange)):
+            node = node.children[0]
+            continue
+        if isinstance(node, PhysProjection):
+            e = node.exprs[idx] if idx < len(node.exprs) else None
+            if not isinstance(e, ColumnRef):
+                return None
+            idx = e.index
+            node = node.children[0]
+            continue
+        return None
+
+
+def _build_unique_hint(node: PhysHashJoin) -> bool:
+    """Is the build side unique on the join key? Exact when the key is a
+    single-column primary key / unique index; otherwise bet on the
+    planner's join-size estimate (which already folds NDV stats in) —
+    wrong bets cost one recompile, never wrong results."""
+    bi = 1 if node.build_right else 0
+    build = node.children[bi]
+    raw_keys = [(r if node.build_right else l) for l, r in node.equi]
+    if len(raw_keys) == 1 and isinstance(raw_keys[0], ColumnRef):
+        hit = _trace_scan_col(build, raw_keys[0].index)
+        if hit is not None:
+            scan, idx = hit
+            table = scan.table
+            cols = getattr(table, "columns", [])
+            if idx < len(cols):
+                name = cols[idx].name.lower()
+                pk = [c.lower() for c in (getattr(table, "primary_key", None)
+                                          or [])]
+                if pk == [name]:
+                    return True
+                for ix in getattr(table, "indexes", []):
+                    if ix.unique and len(ix.columns) == 1 and \
+                            ix.columns[0].lower() == name:
+                        return True
+    probe = node.children[1 - bi]
+    return node.est_rows <= probe.est_rows * 1.05 + 16
+
+
+def plan_join_configs(root: PhysicalPlan, scan_bounds) -> List[JoinCfg]:
+    """Initial per-join configs in _walk_nodes order (the runtime adapts
+    mode/out_cap from the flags the program reports)."""
+    from tidb_tpu.executor.device_cache import _pow2
+    cfgs: List[JoinCfg] = []
+    for node in _walk_joins(root):
+        bi = 1 if node.build_right else 0
+        build = node.children[bi]
+        bkeys, _ = join_key_exprs(node)
+        bb = _bounds_list(build, scan_bounds)
+        bounds: Optional[List[Tuple[int, int]]] = []
+        domain = 1
+        for e in bkeys:
+            if isinstance(e, ColumnRef) and e.index < len(bb) \
+                    and bb[e.index] is not None:
+                lo, hi = bb[e.index]
+                domain *= (hi - lo + 1)
+                if domain > JOIN_DOMAIN_CAP:
+                    bounds = None
+                    break
+                bounds.append((lo, hi))
+            else:
+                bounds = None
+                break
+        est = max(int(node.est_rows), 1)
+        mode = "unique" if _build_unique_hint(node) else "expand"
+        out_cap = _pow2(int(est * 1.3), lo=1024) if mode == "expand" else 0
+        cfgs.append(JoinCfg(mode, out_cap,
+                            tuple(bounds) if bounds else None,
+                            domain if bounds else 0, est))
+    return cfgs
+
+
+def tree_agg_key_bounds(root: PhysicalPlan, scan_bounds,
+                        domain_cap: int) -> Optional[List[Tuple[int, int]]]:
+    """Perfect-hash group-key domains for an agg root over a tree, when
+    every group key is a bounded column and the packed domain is small."""
+    if not isinstance(root, PhysHashAgg) or not root.group_exprs:
+        return None
+    inp = _bounds_list(root.children[0], scan_bounds)
+    out: List[Tuple[int, int]] = []
+    domain = 1
+    for e in root.group_exprs:
+        if not (isinstance(e, ColumnRef) and e.index < len(inp)
+                and inp[e.index] is not None):
+            return None
+        lo, hi = inp[e.index]
+        domain *= (hi - lo + 2)
+        if domain > domain_cap:
+            return None
+        out.append((lo, hi))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Signature (compile cache key)
+# ---------------------------------------------------------------------------
+
+
+def tree_signature(plan: PhysicalPlan, caps: Dict[int, Tuple[int, int]],
+                   group_cap: int, join_cfgs: Optional[Sequence[JoinCfg]] = None,
+                   agg_key_bounds=None) -> str:
+    parts = ["tree", f"gcap={group_cap}", f"akb={agg_key_bounds}"]
+    ji = 0
     for node in _walk_nodes(plan):
         if isinstance(node, PhysTableScan):
+            cap = caps[id(node)]
+            cap = cap if isinstance(cap, tuple) else (cap, 1)
             parts.append(
-                f"Scan(id={node.table.id}, cap={caps[id(node)]}, "
+                f"Scan(id={node.table.id}, cap={cap[0]}x{cap[1]}, "
                 f"types={[str(ft) for ft in node.schema.field_types]}, "
                 f"filters={node.filters!r})")
         elif isinstance(node, PhysHashJoin):
+            cfg = join_cfgs[ji] if join_cfgs else None
+            ji += 1
+            # est is host-side-only (seeds the retry out_cap) — keep it out
+            # of the cache key or estimate drift forces spurious recompiles
+            cfg_s = (f"{cfg.mode},{cfg.out_cap},{cfg.bounds},{cfg.domain}"
+                     if cfg else None)
             parts.append(f"Join({node.kind}, build_right={node.build_right},"
                          f" equi={node.equi!r}, "
-                         f"other={node.other_conditions!r})")
+                         f"other={node.other_conditions!r}, cfg={cfg_s})")
         elif isinstance(node, PhysSelection):
             parts.append(f"Sel({node.conditions!r})")
         elif isinstance(node, PhysProjection):
@@ -184,26 +463,44 @@ def tree_signature(plan: PhysicalPlan, caps: Dict[int, int],
                          f"descs={node.descs}, "
                          f"k={getattr(node, 'count', None)}, "
                          f"off={getattr(node, 'offset', 0)})")
+        elif isinstance(node, PhysWindow):
+            parts.append(f"Window({node.wdescs!r})")
         elif type(node).__name__ == "PhysExchange":
             parts.append(f"Exch({node.kind}, keys={node.keys!r})")
     return "|".join(parts)
 
 
+# ---------------------------------------------------------------------------
+# The traced program
+# ---------------------------------------------------------------------------
+
+
 class TreeProgram:
-    """One jitted program for a join tree. Inputs: per-scan column dicts
-    (original column index → (values, validity)) + per-scan row counts +
-    positional prepared values.
+    """One jitted program for a join tree (or a mega-slab chain). Inputs:
+    per-scan column dicts (original column index → list of per-slab
+    (values, validity) pairs) + per-scan per-slab row counts + positional
+    prepared values.
 
-    Every join emits probe-shaped output: build rows are gathered through
-    the per-probe-row match index, so downstream shapes stay static — the
-    join itself never expands (guaranteed by the unique-build check)."""
+    Unique-mode joins emit probe-shaped output (build rows gathered
+    through the per-probe-row match index); expand-mode joins emit
+    out_cap-shaped output via prefix-sum expansion. Downstream shapes stay
+    static either way."""
 
-    def __init__(self, plan: PhysicalPlan, caps: Dict[int, int],
-                 group_cap: int):
+    def __init__(self, plan: PhysicalPlan, caps: Dict[int, object],
+                 group_cap: int,
+                 join_cfgs: Optional[Sequence[JoinCfg]] = None,
+                 agg_key_bounds=None):
         from tidb_tpu.ops.jax_env import jax
         self.plan = plan
-        self.caps = caps           # id(scan-node) → slab capacity
+        # id(scan-node) → (slab capacity, n_slabs); plain ints accepted
+        self.caps = {k: (v if isinstance(v, tuple) else (v, 1))
+                     for k, v in caps.items()}
         self.group_cap = group_cap
+        self.agg_key_bounds = agg_key_bounds
+        joins = _walk_joins(plan)
+        if join_cfgs is None:
+            join_cfgs = [JoinCfg("unique") for _ in joins]
+        self.join_cfgs = {id(n): c for n, c in zip(joins, join_cfgs)}
         self.scan_order = _scans(plan)
         if isinstance(plan, PhysHashAgg):
             self.aggs = [build_agg(d) for d in plan.aggs]
@@ -236,6 +533,7 @@ class TreeProgram:
                           for n, v in zip(self.prep_nodes, prep_vals)
                           if v is not None}
         self._join_unique_flags = []
+        self._join_totals = []
         cols, live = self._emit(self.plan, scan_inputs, scan_rows)
         return self._finish(cols, live)
 
@@ -254,9 +552,29 @@ class TreeProgram:
             slot = next(i for i, s in enumerate(self.scan_order)
                         if s is node)
             in_cols = scan_inputs[slot]
-            cap = self.caps[id(node)]
-            live = jnp.arange(cap, dtype=jnp.int32) < scan_rows[slot]
-            col_list = [in_cols.get(i) for i in range(len(node.schema))]
+            slab_cap, n_slabs = self.caps[id(node)]
+            col_list: List = []
+            for i in range(len(node.schema)):
+                c = in_cols.get(i)
+                if c is None:
+                    col_list.append(None)
+                elif isinstance(c, (list, tuple)) and c and \
+                        isinstance(c[0], tuple):
+                    if len(c) == 1:
+                        col_list.append(c[0])
+                    else:   # mega-slab: concatenate inside the program
+                        col_list.append(
+                            (jnp.concatenate([s[0] for s in c]),
+                             jnp.concatenate([s[1] for s in c])))
+                else:
+                    col_list.append(c)
+            rows = jnp.asarray(scan_rows[slot])
+            total_cap = slab_cap * n_slabs
+            iota = jnp.arange(total_cap, dtype=jnp.int32)
+            if rows.ndim == 0:
+                live = iota < rows
+            else:
+                live = (iota % slab_cap) < jnp.take(rows, iota // slab_cap)
             ctx = self._ctx(col_list)
             for f in node.filters:
                 v, m = f.eval(ctx)
@@ -275,38 +593,69 @@ class TreeProgram:
             return [e.eval(ctx) for e in node.exprs], live
         if isinstance(node, PhysHashJoin):
             return self._emit_join(node, scan_inputs, scan_rows)
-        if isinstance(node, (PhysHashAgg, PhysTopN, PhysSort)):
+        if isinstance(node, (PhysHashAgg, PhysTopN, PhysSort, PhysWindow)):
             return self._emit(node.children[0], scan_inputs, scan_rows)
         raise AssertionError(f"unexpected node {type(node).__name__}")
 
+    # -- join ---------------------------------------------------------------
     def _emit_join(self, node: PhysHashJoin, scan_inputs, scan_rows):
         from tidb_tpu.ops.jax_env import jnp
         from tidb_tpu.ops import join as J
-        from tidb_tpu.executor.join import coerce_key_pair
+        cfg = self.join_cfgs[id(node)]
         lcols, llive = self._emit(node.children[0], scan_inputs, scan_rows)
         rcols, rlive = self._emit(node.children[1], scan_inputs, scan_rows)
         if node.build_right:
             bcols, blive, pcols, plive = rcols, rlive, lcols, llive
-            bkeys = [coerce_key_pair(l, r)[1] for l, r in node.equi]
-            pkeys = [coerce_key_pair(l, r)[0] for l, r in node.equi]
         else:
             bcols, blive, pcols, plive = lcols, llive, rcols, rlive
-            bkeys = [coerce_key_pair(l, r)[0] for l, r in node.equi]
-            pkeys = [coerce_key_pair(l, r)[1] for l, r in node.equi]
+        bkeys, pkeys = join_key_exprs(node)
         bctx = self._ctx(bcols)
+        # the probe ctx must see the JOIN flow for KeyRemap preps, but
+        # KeyRemap evals its child against probe-side columns
         pctx = self._ctx(pcols)
         bk = [e.eval(bctx) for e in bkeys]
         pk = [e.eval(pctx) for e in pkeys]
         nb = blive.shape[0]
-        # shared exact code space: factorize over build++probe concatenated
-        both = [(jnp.concatenate([jnp.asarray(bv), jnp.asarray(pv)]),
-                 jnp.concatenate([jnp.asarray(bm), jnp.asarray(pm)]))
-                for (bv, bm), (pv, pm) in zip(bk, pk)]
-        both_live = jnp.concatenate([blive, plive])
-        codes, cvalid = J.combine_keys(both, both_live)
-        match_idx, matched, unique = J.build_probe(
-            codes[:nb], cvalid[:nb], blive, codes[nb:], cvalid[nb:], plive)
-        self._join_unique_flags.append(unique)
+
+        if cfg.bounds is not None:
+            bcode, bok = J.pack_bounded_codes(bk, cfg.bounds)
+            pcode, pok = J.pack_bounded_codes(pk, cfg.bounds)
+            bok = bok & blive
+            pok = pok & plive
+            if cfg.mode == "unique":
+                match_idx, matched, unique = J.lut_probe_unique(
+                    bcode, bok, cfg.domain, pcode, pok)
+            else:
+                start, count, order = J.lut_probe_multi(
+                    bcode, bok, cfg.domain, pcode, pok)
+        else:
+            # shared exact code space: factorize over build++probe concat
+            both = [(jnp.concatenate([jnp.asarray(bv), jnp.asarray(pv)]),
+                     jnp.concatenate([jnp.asarray(bm), jnp.asarray(pm)]))
+                    for (bv, bm), (pv, pm) in zip(bk, pk)]
+            both_live = jnp.concatenate([blive, plive])
+            codes, cvalid = J.combine_keys(both, both_live)
+            if cfg.mode == "unique":
+                match_idx, matched, unique = J.sorted_probe_unique(
+                    codes[:nb], cvalid[:nb], blive,
+                    codes[nb:], cvalid[nb:], plive)
+            else:
+                start, count, order = J.sorted_probe_multi(
+                    codes[:nb], cvalid[:nb] & blive,
+                    codes[nb:], cvalid[nb:] & plive)
+
+        if cfg.mode == "unique":
+            self._join_unique_flags.append(unique)
+            self._join_totals.append(jnp.int64(0))
+            return self._finish_join_unique(node, bcols, pcols, plive,
+                                            match_idx, matched)
+        self._join_unique_flags.append(jnp.bool_(True))
+        return self._finish_join_expand(node, cfg, bcols, pcols, plive,
+                                        start, count, order)
+
+    def _finish_join_unique(self, node, bcols, pcols, plive, match_idx,
+                            matched):
+        from tidb_tpu.ops.jax_env import jnp
 
         def gather_build(keep):
             out = []
@@ -346,42 +695,85 @@ class TreeProgram:
         # every live probe row survives (null-extended when unmatched)
         return joined, plive
 
+    def _finish_join_expand(self, node, cfg: JoinCfg, bcols, pcols, plive,
+                            start, count, order):
+        from tidb_tpu.ops.jax_env import jnp
+        from tidb_tpu.ops import join as J
+        from tidb_tpu.ops import segment as seg
+        P = plive.shape[0]
+        if node.kind in ("semi", "anti") and not node.other_conditions:
+            self._join_totals.append(jnp.int64(0))
+            matched = count > 0
+            live = plive & (matched if node.kind == "semi"
+                            else jnp.logical_not(matched))
+            return list(pcols), live
+        outer = node.kind in ("left", "right")
+        p_idx, b_idx, matched, out_live, k, total = J.expand(
+            start, count, order, cfg.out_cap, outer, plive)
+        self._join_totals.append(total)
+
+        def gather(cols, idx, keep):
+            out = []
+            for c in cols:
+                if c is None:
+                    out.append(None)
+                    continue
+                v, m = c
+                out.append((jnp.take(jnp.asarray(v), idx),
+                            jnp.take(jnp.asarray(m), idx) & keep))
+            return out
+
+        pcols_e = gather(pcols, p_idx, out_live)
+        bcols_e = gather(bcols, b_idx, matched)
+        joined = (pcols_e + bcols_e if node.build_right
+                  else bcols_e + pcols_e)
+        passing = matched
+        if node.other_conditions:
+            jctx = self._ctx(joined)
+            ok = jnp.ones_like(matched)
+            for cond in node.other_conditions:
+                v, m = cond.eval(jctx)
+                ok = ok & (v != 0) & m
+            passing = matched & ok
+        if node.kind in ("semi", "anti"):
+            pass_any = seg.segment_any(jnp, passing & out_live, p_idx, P)
+            live = plive & (pass_any if node.kind == "semi"
+                            else jnp.logical_not(pass_any))
+            return list(pcols), live
+        if node.kind == "inner":
+            return joined, out_live & passing
+        # outer: every live probe row keeps ≥1 slot; a probe row none of
+        # whose matches pass emits ONE null-extended row (its first slot)
+        pass_cnt = seg.segment_count(jnp, passing & out_live, p_idx, P)
+        keep_extended = (k == 0) & (jnp.take(pass_cnt, p_idx) == 0)
+        live = out_live & (passing | keep_extended)
+        if node.other_conditions:
+            # null-extend build cols on slots whose condition failed
+            bcols_e = gather(bcols, b_idx, passing)
+            joined = (pcols_e + bcols_e if node.build_right
+                      else bcols_e + pcols_e)
+        return joined, live
+
     # -- root reductions ------------------------------------------------------
     def _finish(self, cols, live):
         from tidb_tpu.ops.jax_env import jnp
         from tidb_tpu.ops import factorize as F
+        from tidb_tpu.executor import device_emit
         root = self.plan
         flags = self._join_unique_flags
-        uniq = jnp.stack(flags).all() if flags else jnp.bool_(True)
+        out_flags = {
+            "join_unique": (jnp.stack(flags) if flags
+                            else jnp.zeros(0, dtype=bool)),
+            "join_totals": (jnp.stack(self._join_totals)
+                            if self._join_totals
+                            else jnp.zeros(0, dtype=jnp.int64)),
+        }
         if isinstance(root, PhysHashAgg):
-            cap = self.group_cap
             ctx = self._ctx(cols)
-            if root.group_exprs:
-                keys = [e.eval(ctx) for e in root.group_exprs]
-                gids, n_groups, rep = F.factorize(keys, live, cap)
-                gids = jnp.where(live, gids, jnp.int32(cap))
-                key_out = [(jnp.asarray(v)[rep], jnp.asarray(m)[rep] &
-                            (jnp.arange(cap) < n_groups)) for v, m in keys]
-            else:
-                gids = jnp.where(live, jnp.int32(0), jnp.int32(cap))
-                n_groups = jnp.int32(1)
-                key_out = []
-            states = []
-            n = live.shape[0]
-            for agg, desc in zip(self.aggs, root.aggs):
-                if desc.args:
-                    v, m = desc.args[0].eval(ctx)
-                    v = jnp.asarray(v)
-                    m = jnp.asarray(m) & live
-                else:
-                    v = jnp.zeros(n, dtype=jnp.int64)
-                    m = live
-                if desc.distinct and desc.args:
-                    m = m & F.distinct_mask(gids, v, m, live)
-                st = agg.init(jnp, cap)
-                states.append(agg.update(jnp, st, gids, cap, v, m))
-            return {"keys": key_out, "states": states, "n_groups": n_groups,
-                    "unique": uniq}
+            out = device_emit.emit_agg(ctx, live, root, self.aggs,
+                                       self.group_cap, self.agg_key_bounds)
+            out.update(out_flags)
+            return out
         # non-agg roots emit every schema column; unused (None) positions
         # become all-NULL placeholders so output stays positionally aligned
         n = live.shape[0]
@@ -399,9 +791,14 @@ class TreeProgram:
             gathered = [(jnp.take(jnp.asarray(v), idx),
                          jnp.take(jnp.asarray(m), idx))
                         for v, m in cols[:n_out_cols]]
-            return {"cols": gathered, "n_out": n_out, "unique": uniq}
+            return {"cols": gathered, "n_out": n_out, **out_flags}
+        if isinstance(root, PhysWindow):
+            ctx = self._ctx(cols)
+            out = device_emit.emit_window(ctx, live, root)
+            out.update(out_flags)
+            return out
         return {"cols": [(jnp.asarray(v), jnp.asarray(m))
-                         for v, m in cols], "live": live, "unique": uniq}
+                         for v, m in cols], "live": live, **out_flags}
 
     def __call__(self, scan_inputs, scan_rows, prep_vals):
         return self.run(scan_inputs, scan_rows, prep_vals)
@@ -447,6 +844,8 @@ def dictionary_flows(plan: PhysicalPlan,
                            and e.index < len(inp) else None)
             out.extend([None] * len(node.aggs))
             return out
+        if isinstance(node, PhysWindow):
+            return inp + [None] * len(node.wdescs)
         return inp
 
     root_out = rec(plan)

@@ -1,25 +1,39 @@
-"""Device equi-join primitives — sort + binary-search, no hash table.
+"""Device equi-join primitives — LUT (perfect-hash) and sort formulations.
 
 The reference's HashJoinExec builds an open-address table over the build
-side and probes it row-at-a-time (executor/hash_table.go:77-146). The
-TPU-native formulation (SURVEY A.5, §7 stage 4): sort the build side's
-(exact, typed) keys once, then every probe row finds its match with a
-vectorized binary search — `searchsorted` lowers to a handful of MXU-free
-gather rounds and the whole probe is one fused kernel.
+side and probes it row-at-a-time (executor/hash_table.go:77-146). TPUs have
+no efficient random open-address probing, so two TPU-native formulations
+replace it (SURVEY A.5, §7 stage 4):
 
-v1 scope: the build side's keys are UNIQUE (the PK-FK shape of every
-TPC-H join); each probe row then matches at most one build row, so the
-output shape equals the probe shape — static, no fanout expansion. The
-kernel reports a `unique` flag; non-unique builds fall back to the CPU
-hash join (executor/join.py) until the expansion kernel lands.
+  * **LUT / perfect-hash** (`lut_probe_unique`, `lut_probe_multi`): when the
+    build keys live in a small dense domain — known from the device cache's
+    per-column (lo, hi) bounds, which TPC-H's dense surrogate keys and all
+    dictionary-encoded string codes satisfy — scatter the build rows into a
+    domain-sized table once, and every probe is a pure gather. No sort, no
+    binary search: the probe is O(1) per row and fuses with the surrounding
+    fragment.
+  * **Sort + search** (`sorted_probe_unique`, `sorted_probe_multi`): the
+    general fallback for unbounded keys. Sort the build side's exact typed
+    codes, probe with `searchsorted(method='sort')` (one concat+sort —
+    the TPU-friendly sort-merge join).
 
-Multi-column keys factorize to a single i64 code first (exact — see
-combine_keys): per-column dense ranks composed positionally.
+Both formulations come in a *unique* variant (PK-FK shape: probe-shaped
+output, no expansion — the planner picks it when a unique index or NDV
+stats prove build-key uniqueness, with a runtime flag guarding the bet)
+and a *multi* variant returning per-probe (start, count) into a
+build-row order array; `expand` then materializes the matches via
+prefix-sum offsets into a static `out_cap`-shaped output, reporting the
+true total so an overflow retries with the right capacity in ONE
+recompile (the group-cap discipline of ops/factorize.py).
+
+Multi-column keys pack into a single exact i64 code first: by bounds
+(strided, `pack_bounded_codes`) when the LUT path applies, else by dense
+ranks (`combine_keys` — per-column sort factorization, exact).
 """
 
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 from tidb_tpu.ops.jax_env import jax, jnp, lax
 
@@ -51,9 +65,83 @@ def combine_keys(keys: Sequence[Tuple], live):
     return codes, code_valid
 
 
-def build_probe(build_codes, build_valid, build_live,
-                probe_codes, probe_valid, probe_live):
-    """Unique-build equi-join core.
+def pack_bounded_codes(keys: Sequence[Tuple], bounds: Sequence[Tuple[int, int]]):
+    """Pack multi-column keys with known per-column (lo, hi) bounds into one
+    dense i64 code in [0, prod(hi-lo+1)) — no sort, pure arithmetic.
+
+    Returns (codes (N,) int64, ok (N,) bool). `ok` is False when any column
+    is NULL or falls outside its bounds (possible on the probe side, whose
+    values need not lie in the build side's domain; such rows match
+    nothing).
+    """
+    n = jnp.asarray(keys[0][0]).shape[0]
+    codes = jnp.zeros(n, dtype=jnp.int64)
+    ok = jnp.ones(n, dtype=bool)
+    stride = 1
+    for (v, m), (lo, hi) in zip(keys, bounds):
+        v = jnp.asarray(v).astype(jnp.int64)
+        m = jnp.asarray(m)
+        in_dom = (v >= lo) & (v <= hi)
+        ok = ok & m & in_dom
+        codes = codes + (jnp.clip(v, lo, hi) - lo) * stride
+        stride *= (hi - lo + 1)
+    return codes, ok
+
+
+# ---------------------------------------------------------------------------
+# LUT (perfect-hash) formulation — bounds-backed dense code domains
+# ---------------------------------------------------------------------------
+
+
+def lut_probe_unique(build_codes, ok_b, domain: int, probe_codes, ok_p):
+    """Unique-build LUT join: scatter build row ids into a (domain,) table,
+    probe by gather.
+
+    → (match_idx (P,) int32, matched (P,) bool, unique () bool). `unique`
+    is the runtime guard for the planner's uniqueness bet; on False the
+    caller re-traces in expansion mode.
+    """
+    nb = build_codes.shape[0]
+    iota = jnp.arange(nb, dtype=jnp.int32)
+    code = jnp.where(ok_b, build_codes, jnp.int64(domain))
+    cnt = jnp.zeros(domain, jnp.int32).at[code].add(
+        jnp.where(ok_b, jnp.int32(1), jnp.int32(0)), mode="drop")
+    lut = jnp.full(domain, -1, jnp.int32).at[code].set(iota, mode="drop")
+    unique = (cnt.max() <= 1) if domain else jnp.bool_(True)
+    pc = jnp.clip(probe_codes, 0, domain - 1)
+    matched = ok_p & (jnp.take(cnt, pc) > 0)
+    match_idx = jnp.where(matched, jnp.take(lut, pc), 0)
+    return jnp.clip(match_idx, 0, nb - 1), matched, unique
+
+
+def lut_probe_multi(build_codes, ok_b, domain: int, probe_codes, ok_p):
+    """General LUT join: per-probe (start, count) into a build-key-sorted
+    order array. One sort of the BUILD side only (to enumerate duplicate
+    matches); the probe stays a gather.
+
+    → (start (P,) int32, count (P,) int32, order (nb,) int32).
+    """
+    nb = build_codes.shape[0]
+    iota = jnp.arange(nb, dtype=jnp.int32)
+    code = jnp.where(ok_b, build_codes, jnp.int64(domain))
+    cnt = jnp.zeros(domain, jnp.int32).at[code].add(
+        jnp.where(ok_b, jnp.int32(1), jnp.int32(0)), mode="drop")
+    starts = jnp.cumsum(cnt) - cnt          # exclusive prefix per code
+    _, order = lax.sort((code, iota), num_keys=1)
+    pc = jnp.clip(probe_codes, 0, domain - 1)
+    count = jnp.where(ok_p, jnp.take(cnt, pc), jnp.int32(0))
+    start = jnp.take(starts, pc).astype(jnp.int32)
+    return start, count, order
+
+
+# ---------------------------------------------------------------------------
+# Sort formulation — unbounded/computed keys
+# ---------------------------------------------------------------------------
+
+
+def sorted_probe_unique(build_codes, build_valid, build_live,
+                        probe_codes, probe_valid, probe_live):
+    """Unique-build sort-merge probe.
 
     Returns (match_idx (P,) int32 — build row index per probe row (0 when
     no match), matched (P,) bool, build_unique () bool).
@@ -77,3 +165,73 @@ def build_probe(build_codes, build_valid, build_live,
     matched = hit & probe_valid & probe_live
     match_idx = jnp.where(matched, jnp.take(sorted_idx, pos), 0)
     return match_idx.astype(jnp.int32), matched, unique
+
+
+# kept name for existing callers (dist path, tests)
+build_probe = sorted_probe_unique
+
+
+def sorted_probe_multi(build_codes, ok_b, probe_codes, ok_p):
+    """General sort-merge probe: per-probe (start, count) into the
+    build-key-sorted order array (duplicate builds supported)."""
+    nb = build_codes.shape[0]
+    sentinel = jnp.iinfo(jnp.int64).max
+    sort_key = jnp.where(ok_b, build_codes, sentinel)
+    sorted_codes, order = lax.sort(
+        (sort_key, jnp.arange(nb, dtype=jnp.int32)), num_keys=1)
+    lo = jnp.searchsorted(sorted_codes, probe_codes, side="left",
+                          method="sort")
+    hi = jnp.searchsorted(sorted_codes, probe_codes, side="right",
+                          method="sort")
+    count = jnp.where(ok_p, (hi - lo).astype(jnp.int32), jnp.int32(0))
+    return lo.astype(jnp.int32), count, order
+
+
+# ---------------------------------------------------------------------------
+# Expansion — static-shape fan-out materialization
+# ---------------------------------------------------------------------------
+
+
+def expand(start, count, order, out_cap: int, outer: bool, probe_live):
+    """Materialize per-probe matches into a static (out_cap,)-shaped batch.
+
+    start/count: per-probe-row window into `order` (count must already be 0
+    for dead/NULL-key probe rows). outer=True reserves one output slot for
+    every live probe row even when count==0 (null-extended later).
+
+    → (p_idx (out_cap,) int32 — source probe row per output slot,
+       b_idx (out_cap,) int32 — build row per output slot (clamped),
+       matched (out_cap,) bool — slot carries a real build match,
+       out_live (out_cap,) bool,
+       k (out_cap,) int32 — match ordinal within the probe row's slot
+       range (k==0 marks the row's first slot, outer null-extension),
+       total () int64 — true required capacity; caller retries with
+       pow2(total) when total > out_cap).
+
+    Mechanics: exclusive prefix-sum of per-probe output counts gives each
+    probe row a contiguous slot range; a scatter of row ids at range starts
+    + cummax turns slot index into probe index — no sort, no search.
+    """
+    nb = order.shape[0]
+    P = count.shape[0]
+    oc = jnp.maximum(count, 1) if outer else count
+    oc = jnp.where(probe_live, oc, 0).astype(jnp.int64)
+    cum = jnp.cumsum(oc)
+    total = cum[P - 1] if P else jnp.int64(0)
+    begin = cum - oc
+    iota_p = jnp.arange(P, dtype=jnp.int32)
+    # probe rows with oc>0 mark their first slot with (row+1); cummax
+    # forward-fills (begins are increasing), -1 → row index
+    marks = jnp.zeros(out_cap, jnp.int32).at[
+        jnp.where(oc > 0, begin, jnp.int64(out_cap))].max(
+        iota_p + 1, mode="drop")
+    p_idx = lax.cummax(marks) - 1
+    p_safe = jnp.clip(p_idx, 0, P - 1)
+    j = jnp.arange(out_cap, dtype=jnp.int64)
+    k = (j - jnp.take(begin, p_safe)).astype(jnp.int32)
+    matched = (p_idx >= 0) & (k < jnp.take(count, p_safe)) & (j < total)
+    b_pos = jnp.take(start, p_safe) + k
+    b_idx = jnp.take(order, jnp.clip(b_pos, 0, nb - 1))
+    out_live = (j < total) & (p_idx >= 0)
+    return (p_safe.astype(jnp.int32), b_idx.astype(jnp.int32), matched,
+            out_live, k, total)
