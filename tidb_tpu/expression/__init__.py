@@ -117,6 +117,25 @@ class ColumnRef(Expression):
 
 
 @dataclass(eq=False)
+class CorrelatedRef(Expression):
+    """Reference to an OUTER query's column from inside a subquery (ref:
+    expression/column.go CorrelatedColumn). Only a planning-time artifact:
+    decorrelation (planner/decorrelate.py) must rewrite every one into a
+    join-side ColumnRef before execution."""
+
+    index: int               # column index in the OUTER schema
+    ftype: FieldType
+    name: str = ""
+
+    def eval(self, ctx: EvalContext):
+        raise AssertionError(
+            "CorrelatedRef survived planning — decorrelation failed")
+
+    def __repr__(self):
+        return f"corr#{self.index}" + (f"({self.name})" if self.name else "")
+
+
+@dataclass(eq=False)
 class Constant(Expression):
     """Literal (ref: expression/constant.go). Value is the *python* value."""
 

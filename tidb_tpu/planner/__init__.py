@@ -24,5 +24,12 @@ def optimize(stmt, info_schema, ctx):
     """AST statement → physical plan (ref: planner.Optimize)."""
     builder = PlanBuilder(info_schema, ctx)
     logical = builder.build(stmt)
+    return optimize_logical(logical, ctx)
+
+
+def optimize_logical(logical, ctx):
+    """Logical plan → physical plan (rules + engine-tagged physical);
+    lets callers that already built a logical plan — the decorrelator's
+    uncorrelated-subquery path — skip the AST rebuild."""
     logical = logical_optimize(logical)
     return physical_optimize(logical, ctx)

@@ -8,8 +8,9 @@ the select list are wrapped in FIRST_ROW aggregates
 
 Subqueries: uncorrelated scalar/IN/EXISTS subqueries are planned and executed
 eagerly at build time, substituting constants — the reference instead
-rewrites to (semi-)apply joins (expression_rewriter.go); correlated
-subqueries are deferred to a later round.
+rewrites to (semi-)apply joins (expression_rewriter.go). Correlated
+WHERE-clause subqueries decorrelate into semi/anti/left joins
+(planner/decorrelate.py, the rule_decorrelate.go analog).
 """
 
 from __future__ import annotations
@@ -59,6 +60,9 @@ class SubqueryEvaluator:
     def __init__(self, run: Callable[[ast.SelectStmt], Tuple[List[tuple],
                                                              List[FieldType]]]):
         self.run = run
+        # optional: execute an already-built logical plan (decorrelator's
+        # uncorrelated path) — (logical) → (rows, ftypes)
+        self.run_plan = None
 
 
 class ExpressionRewriter:
@@ -72,11 +76,12 @@ class ExpressionRewriter:
     def __init__(self, schema: Schema,
                  subq: Optional[SubqueryEvaluator] = None,
                  agg_ctx: Optional["AggContext"] = None,
-                 outer: Optional["ExpressionRewriter"] = None,
+                 outer_schema: Optional[Schema] = None,
                  window_map: Optional[Dict[int, Expression]] = None):
         self.schema = schema
         self.subq = subq
         self.agg_ctx = agg_ctx
+        self.outer_schema = outer_schema
         self.window_map = window_map or {}
 
     # -- entry -------------------------------------------------------------
@@ -107,7 +112,18 @@ class ExpressionRewriter:
         if isinstance(node, ast.Literal):
             return self._literal(node)
         if isinstance(node, ast.Name):
-            idx = self.schema.find(node.column, node.qualifier)
+            try:
+                idx = self.schema.find(node.column, node.qualifier)
+            except UnknownColumnError:
+                if self.outer_schema is not None:
+                    # outer-query column inside a subquery → correlation
+                    # marker, resolved by planner/decorrelate.py
+                    from tidb_tpu.expression import CorrelatedRef
+                    oidx = self.outer_schema.find(node.column,
+                                                  node.qualifier)
+                    oc = self.outer_schema.columns[oidx]
+                    return CorrelatedRef(oidx, oc.ftype, oc.name)
+                raise
             return self.schema.column_ref(idx)
         if isinstance(node, ast.UnaryOp):
             arg = self.rewrite(node.operand)
@@ -277,9 +293,11 @@ class ExpressionRewriter:
 class AggContext:
     """Aggregation scope shared by select/having/order rewriters."""
 
-    def __init__(self, child_schema: Schema, subq: Optional[SubqueryEvaluator]):
+    def __init__(self, child_schema: Schema, subq: Optional[SubqueryEvaluator],
+                 outer_schema: Optional[Schema] = None):
         self.child_schema = child_schema
-        self.child_rewriter = ExpressionRewriter(child_schema, subq)
+        self.child_rewriter = ExpressionRewriter(child_schema, subq,
+                                                 outer_schema=outer_schema)
         self.group_exprs: List[Expression] = []
         self.group_keys: List[str] = []          # repr of rewritten group expr
         self.group_names: List[str] = []
@@ -411,6 +429,28 @@ class PlanBuilder:
         # CTE name (lower) → materialized temp table (session-provided;
         # ref: executor/cte.go materializes into cteutil storage)
         self.cte_map = cte_map or getattr(ctx, "cte_map", None) or {}
+        # set on nested builders for correlated subqueries: the enclosing
+        # query's schema (expression_rewriter.go outerSchemas analog)
+        self.outer_schema: Optional[Schema] = None
+        self._subq_n = 0
+
+    def make_rewriter(self, schema: Schema, agg_ctx=None,
+                      window_map=None) -> "ExpressionRewriter":
+        return ExpressionRewriter(schema, self.subq, agg_ctx,
+                                  outer_schema=self.outer_schema,
+                                  window_map=window_map)
+
+    def next_subq_id(self) -> int:
+        self._subq_n += 1
+        return self._subq_n
+
+    def build_subquery_plan(self, sel, outer_schema: Schema) -> LogicalPlan:
+        """Build a subquery's plan with the enclosing schema visible —
+        unresolved names become CorrelatedRefs for decorrelation."""
+        nested = PlanBuilder(self.info_schema, self.ctx, self.subq,
+                             self.cte_map)
+        nested.outer_schema = outer_schema
+        return nested.build(sel)
 
     # -- statements ---------------------------------------------------------
     def build(self, stmt: ast.StmtNode) -> LogicalPlan:
@@ -454,10 +494,52 @@ class PlanBuilder:
                                   _shift(right.schema.column_ref(ri),
                                          len(left.schema))))
         elif j.on is not None:
-            rw = ExpressionRewriter(joined_schema, self.subq)
+            rw = self.make_rewriter(joined_schema)
             conds = split_conjunction(rw.rewrite(j.on))
         equi, other = classify_join_conditions(conds, len(left.schema))
         return LogicalJoin(kind, left, right, equi, other)
+
+    # -- WHERE (with correlated-subquery decorrelation) ----------------------
+    def _build_where(self, where: ast.ExprNode,
+                     plan: LogicalPlan) -> LogicalPlan:
+        conds: List[Expression] = []
+        for conj in _ast_conjuncts(where):
+            handled = self._try_correlated(conj, plan)
+            if handled is not None:
+                plan, extra = handled
+                conds.extend(extra)
+                continue
+            rw = self.make_rewriter(plan.schema)
+            conds.extend(split_conjunction(rw.rewrite(conj)))
+        return LogicalSelection(conds, plan) if conds else plan
+
+    def _try_correlated(self, conj: ast.ExprNode, plan: LogicalPlan):
+        """→ (new_plan, extra_conds) when the conjunct is a correlated
+        subquery predicate rewritten into a join; None otherwise (the
+        eager uncorrelated path applies)."""
+        from tidb_tpu.planner import decorrelate as DC
+        if isinstance(conj, ast.UnaryOp) and conj.op == "not" and \
+                isinstance(conj.operand, (ast.ExistsExpr, ast.InExpr)):
+            # NOT EXISTS (…) parses as not(ExistsExpr); fold the negation
+            inner = conj.operand
+            import copy as _copy
+            conj = _copy.copy(inner)
+            conj.negated = not inner.negated
+        if isinstance(conj, ast.ExistsExpr):
+            return DC.rewrite_exists(self, plan, conj)
+        if isinstance(conj, ast.InExpr) and conj.subquery is not None:
+            x = self.make_rewriter(plan.schema).rewrite(conj.expr)
+            return DC.rewrite_in(self, plan, conj, x)
+        if isinstance(conj, ast.BinaryOp) and conj.op in _CMP_OPS:
+            if isinstance(conj.right, ast.Subquery):
+                return DC.rewrite_scalar_cmp(self, plan, conj.op,
+                                             conj.left, conj.right,
+                                             flip=False)
+            if isinstance(conj.left, ast.Subquery):
+                return DC.rewrite_scalar_cmp(self, plan, conj.op,
+                                             conj.right, conj.left,
+                                             flip=True)
+        return None
 
     # -- SELECT --------------------------------------------------------------
     def build_select(self, sel: ast.SelectStmt) -> LogicalPlan:
@@ -470,11 +552,10 @@ class PlanBuilder:
         # expand stars now so the item list is concrete
         items = self._expand_stars(sel.items, plan.schema)
 
-        # WHERE (pre-aggregation scope)
+        # WHERE (pre-aggregation scope); top-level subquery conjuncts
+        # may decorrelate into joins that widen the plan
         if sel.where is not None:
-            rw = ExpressionRewriter(plan.schema, self.subq)
-            plan = LogicalSelection(split_conjunction(rw.rewrite(sel.where)),
-                                    plan)
+            plan = self._build_where(sel.where, plan)
 
         needs_agg = bool(sel.group_by) or \
             any(_has_agg(it.expr) for it in items) or \
@@ -495,7 +576,7 @@ class PlanBuilder:
             window_map: Dict[int, Expression] = {}
             if win_calls:
                 plan = self._build_window(win_calls, plan, window_map)
-            pre_rw = ExpressionRewriter(plan.schema, self.subq,
+            pre_rw = self.make_rewriter(plan.schema,
                                         window_map=window_map)
             proj_exprs = [pre_rw.rewrite(it.expr) for it in items]
             names = [self._item_name(it) for it in items]
@@ -551,7 +632,7 @@ class PlanBuilder:
         (ref: planner/core/logical_plan_builder.go buildWindowFunctions)."""
         from tidb_tpu.expression.aggfuncs import infer_agg_type
         from tidb_tpu.planner.logical import LogicalWindow, WinDesc
-        rw = ExpressionRewriter(plan.schema, self.subq)
+        rw = self.make_rewriter(plan.schema)
         base = len(plan.schema)
         wdescs: List[WinDesc] = []
         names: List[str] = []
@@ -657,7 +738,7 @@ class PlanBuilder:
     # -- aggregation ---------------------------------------------------------
     def _build_aggregation(self, sel: ast.SelectStmt,
                            items: List[ast.SelectItem], child: LogicalPlan):
-        agg_ctx = AggContext(child.schema, self.subq)
+        agg_ctx = AggContext(child.schema, self.subq, self.outer_schema)
         # GROUP BY list: ordinals, aliases, expressions
         for g in sel.group_by:
             node = self._resolve_group_item(g, items)
@@ -665,7 +746,7 @@ class PlanBuilder:
                 self._item_name_for(node, items)
             agg_ctx.add_group(node, name)
 
-        post_rw = ExpressionRewriter(child.schema, self.subq, agg_ctx)
+        post_rw = self.make_rewriter(child.schema, agg_ctx)
         proj_exprs = [post_rw.rewrite(it.expr) for it in items]
         names = [self._item_name(it) for it in items]
         for it, e in zip(items, proj_exprs):
@@ -733,7 +814,7 @@ class PlanBuilder:
             out = LogicalAggregation(refs, [], out, schema.names)
             out.schema = Schema(cols)
         if stmt.order_by:
-            rw = ExpressionRewriter(out.schema, self.subq)
+            rw = self.make_rewriter(out.schema)
             by, descs = [], []
             for e, d in stmt.order_by:
                 by.append(rw.rewrite(e))
@@ -796,6 +877,12 @@ class PlanBuilder:
 # ---------------------------------------------------------------------------
 # Expression utilities
 # ---------------------------------------------------------------------------
+
+
+def _ast_conjuncts(node: ast.ExprNode) -> List[ast.ExprNode]:
+    if isinstance(node, ast.BinaryOp) and node.op == "and":
+        return _ast_conjuncts(node.left) + _ast_conjuncts(node.right)
+    return [node]
 
 
 def split_conjunction(e: Expression) -> List[Expression]:

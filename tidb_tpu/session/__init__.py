@@ -312,7 +312,20 @@ class Session:
         def run(sel: ast.SelectStmt):
             rs = self._run_query(sel)
             return rs.rows, rs.ftypes
-        return SubqueryEvaluator(run)
+
+        def run_plan(logical):
+            # execute an already-built logical subquery plan (the
+            # decorrelator's probe build) without re-planning the AST
+            from tidb_tpu.planner import optimize_logical
+            phys = optimize_logical(logical, _PlanContext(self))
+            root = build(phys)
+            chunks = run_to_completion(root, self._exec_ctx())
+            rows = [r for ch in chunks for r in ch.rows()]
+            return rows, list(phys.schema.field_types)
+
+        ev = SubqueryEvaluator(run)
+        ev.run_plan = run_plan
+        return ev
 
     def _plan(self, stmt):
         ctx = _PlanContext(self)
