@@ -31,5 +31,5 @@ def optimize_logical(logical, ctx):
     """Logical plan → physical plan (rules + engine-tagged physical);
     lets callers that already built a logical plan — the decorrelator's
     uncorrelated-subquery path — skip the AST rebuild."""
-    logical = logical_optimize(logical)
+    logical = logical_optimize(logical, ctx)
     return physical_optimize(logical, ctx)

@@ -240,9 +240,22 @@ class ExpressionRewriter:
         if self.subq is None:
             raise PlanError("subqueries are not supported in this context")
 
+    def _run_eager(self, sel):
+        """Execute an uncorrelated subquery; unresolved columns get a
+        diagnosis that mentions correlation (the eager evaluator has no
+        outer scope, so a correlated reference in an unsupported position
+        would otherwise surface as a bare 'Unknown column')."""
+        try:
+            return self.subq.run(sel)
+        except UnknownColumnError as e:
+            raise PlanError(
+                f"{e} in subquery (if this references the outer query: "
+                f"correlated subqueries are only supported as top-level "
+                f"WHERE conjuncts)") from e
+
     def _scalar_subquery(self, node: ast.Subquery) -> Constant:
         self._require_subq()
-        rows, ftypes = self.subq.run(node.select)
+        rows, ftypes = self._run_eager(node.select)
         if len(ftypes) != 1:
             raise PlanError("Operand should contain 1 column(s)")
         if len(rows) > 1:
@@ -255,7 +268,7 @@ class ExpressionRewriter:
         e = self.rewrite(node.expr)
         if node.subquery is not None:
             self._require_subq()
-            rows, ftypes = self.subq.run(node.subquery.select)
+            rows, ftypes = self._run_eager(node.subquery.select)
             if len(ftypes) != 1:
                 raise PlanError("Operand should contain 1 column(s)")
             items = [Constant(r[0], ftypes[0]) for r in rows]
@@ -270,7 +283,7 @@ class ExpressionRewriter:
     def _exists(self, node: ast.ExistsExpr) -> Expression:
         self._require_subq()
         sel = node.subquery.select
-        rows, _ = self.subq.run(sel)
+        rows, _ = self._run_eager(sel)
         val = bool(rows)
         return lit(not val if node.negated else val)
 

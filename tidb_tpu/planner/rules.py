@@ -6,21 +6,22 @@ fixed-order shape with the rules that matter for the analytical path:
 
     1. constant folding          (expression_rewriter's foldConstant)
     2. predicate pushdown        (rule_predicate_push_down.go)
-    3. Sort+Limit fusion → TopN  (rule_topn_push_down.go)
-    4. scan column marking       (rule_column_pruning.go — here only marks
+    3. greedy join reorder       (rule_join_reorder.go solveGreedy)
+    4. Sort+Limit fusion → TopN  (rule_topn_push_down.go)
+    5. scan column marking       (rule_column_pruning.go — here only marks
        DataSource.used_columns: columnar storage makes unread columns free
        host-side, but the mark bounds host→device transfer)
 """
 
 from __future__ import annotations
 
-from typing import List, Optional, Set
+from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
 from tidb_tpu.errors import TiDBTPUError
 from tidb_tpu.expression import (ColumnRef, Constant, EvalContext, Expression,
-                                 ScalarFunc)
+                                 ScalarFunc, func)
 from tidb_tpu.planner.logical import (LogicalAggregation, LogicalDataSource,
                                       LogicalDual, LogicalJoin, LogicalLimit,
                                       LogicalPlan, LogicalProjection,
@@ -29,9 +30,10 @@ from tidb_tpu.planner.logical import (LogicalAggregation, LogicalDataSource,
                                       LogicalWindow)
 
 
-def logical_optimize(plan: LogicalPlan) -> LogicalPlan:
+def logical_optimize(plan: LogicalPlan, ctx=None) -> LogicalPlan:
     plan = fold_constants_plan(plan)
     plan = push_predicates(plan)
+    plan = reorder_joins(plan, ctx)
     plan = fuse_topn(plan)
     mark_used_columns(plan)
     return plan
@@ -250,7 +252,248 @@ def _clone(e: Expression) -> Expression:
 
 
 # ---------------------------------------------------------------------------
-# 3. TopN fusion (ref: planner/core/rule_topn_push_down.go)
+# 3. Greedy join reorder (ref: planner/core/rule_join_reorder.go)
+# ---------------------------------------------------------------------------
+
+
+def reorder_joins(plan: LogicalPlan, ctx) -> LogicalPlan:
+    """Rebuild maximal inner-join regions left-deep, smallest-first, the
+    reference's greedy solver (rule_join_reorder.go joinReorderGreedySolver):
+    start from the lowest-cardinality leaf, repeatedly join the connected
+    leaf minimizing the estimated intermediate size. A final projection
+    restores the original column order so parents are unaffected.
+
+    The MAXIMAL inner-join region is flattened top-down FIRST, then the
+    rule recurses into the region's leaves — recursing first would wrap
+    inner sub-regions in order-restoring projections that fragment the
+    region and defeat global reordering on 4+-table chains."""
+    if not (isinstance(plan, LogicalJoin) and plan.kind == "inner"
+            and plan.equi):
+        plan.children = [reorder_joins(c, ctx) for c in plan.children]
+        return plan
+    leaves: List[Tuple[LogicalPlan, int]] = []   # (subplan, global offset)
+    edges: List[Tuple[Expression, Expression]] = []   # globalized equi
+    others: List[Expression] = []                # globalized non-eq conds
+
+    def flatten(node: LogicalPlan, off: int) -> int:
+        if isinstance(node, LogicalJoin) and node.kind == "inner":
+            lw = flatten(node.children[0], off)
+            rw = flatten(node.children[1], off + lw)
+            for le, re in node.equi:
+                edges.append((_shift_refs(le, off),
+                              _shift_refs(re, off + lw)))
+            others.extend(_shift_refs(c, off)
+                          for c in node.other_conditions or [])
+            return lw + rw
+        leaves.append((reorder_joins(node, ctx), off))
+        return len(node.schema)
+
+    total = flatten(plan, 0)
+    if len(leaves) < 3:
+        # no reorder; splice the (possibly rewritten) leaves back into the
+        # original tree in left-to-right order
+        it = iter([lf for lf, _ in leaves])
+
+        def rebuild(node: LogicalPlan) -> LogicalPlan:
+            if isinstance(node, LogicalJoin) and node.kind == "inner":
+                node.children = [rebuild(c) for c in node.children]
+                return node
+            return next(it)
+
+        return rebuild(plan)
+
+    span: Dict[int, Tuple[int, int]] = {}      # leaf idx → [start, stop)
+    for i, (lf, off) in enumerate(leaves):
+        span[i] = (off, off + len(lf.schema))
+
+    def leaf_of(g: int) -> int:
+        for i, (lo, hi) in span.items():
+            if lo <= g < hi:
+                return i
+        raise AssertionError(g)
+
+    rows = [_logical_rows(lf, ctx) for lf, _ in leaves]
+    # edge list per leaf pair for connectivity & ndv-informed estimates
+    edge_leaves = []
+    for le, re in edges:
+        lrefs, rrefs = le.references(), re.references()
+        if not lrefs or not rrefs:
+            edge_leaves.append(None)
+            continue
+        li, ri = leaf_of(lrefs[0]), leaf_of(rrefs[0])
+        if any(leaf_of(g) != li for g in lrefs) or \
+                any(leaf_of(g) != ri for g in rrefs):
+            edge_leaves.append(None)
+        else:
+            edge_leaves.append((li, ri))
+
+    remaining = set(range(len(leaves)))
+    start = min(remaining, key=lambda i: rows[i])
+    joined = {start}
+    remaining.discard(start)
+    order = [start]
+    cur_rows = rows[start]
+    while remaining:
+        best = None
+        for cand in remaining:
+            connected = any(
+                el is not None and
+                ((el[0] in joined and el[1] == cand) or
+                 (el[1] in joined and el[0] == cand))
+                for el in edge_leaves)
+            ndv = _max_key_ndv(cand, leaves, edges, edge_leaves, joined, ctx)
+            if connected:
+                est = cur_rows * rows[cand] / max(ndv, 1.0)
+                est = max(min(est, cur_rows * rows[cand]), 1.0)
+            else:
+                est = cur_rows * rows[cand] * 1e6   # avoid cross joins
+            key = (0 if connected else 1, est)
+            if best is None or key < best[0]:
+                best = (key, cand, est)
+        _, cand, est = best
+        order.append(cand)
+        joined.add(cand)
+        remaining.discard(cand)
+        cur_rows = est if est > 0 else 1.0
+
+    if order == sorted(order):
+        return plan          # already in the greedy order: keep the tree
+
+    # rebuild left-deep in greedy order, remapping global refs as we go
+    pos: Dict[int, int] = {}
+    first_leaf, first_off = leaves[order[0]]
+    for k in range(len(first_leaf.schema)):
+        pos[first_off + k] = k
+    cur: LogicalPlan = first_leaf
+    used_edges: Set[int] = set()
+    used_others: Set[int] = set()
+    for cand in order[1:]:
+        lf, off = leaves[cand]
+        lw = len(cur.schema)
+        equi_pairs = []
+        for ei, (le, re) in enumerate(edges):
+            if ei in used_edges or edge_leaves[ei] is None:
+                continue
+            li, ri = edge_leaves[ei]
+            if li in pos_leaves(pos, span) and ri == cand:
+                equi_pairs.append((_map_refs(le, pos), _shift_refs(re, -off)))
+                used_edges.add(ei)
+            elif ri in pos_leaves(pos, span) and li == cand:
+                equi_pairs.append((_map_refs(re, pos), _shift_refs(le, -off)))
+                used_edges.add(ei)
+        for k in range(len(lf.schema)):
+            pos[off + k] = lw + k
+        other_here = []
+        for oi, c in enumerate(others):
+            if oi in used_others:
+                continue
+            if all(g in pos for g in c.references()):
+                other_here.append(_map_refs(c, pos))
+                used_others.add(oi)
+        for ei, (le, re) in enumerate(edges):
+            # unplaceable-as-equi edges (both sides already joined) become
+            # plain conditions once all their columns are present
+            if ei in used_edges or edge_leaves[ei] is None:
+                continue
+            li, ri = edge_leaves[ei]
+            if all(g in pos for g in le.references() + re.references()):
+                other_here.append(func("eq", _map_refs(le, pos),
+                                       _map_refs(re, pos)))
+                used_edges.add(ei)
+        cur = LogicalJoin("inner", cur, lf, equi_pairs, other_here)
+    # edges with non-single-leaf sides ride as residual conditions
+    residual = [func("eq", _map_refs(le, pos), _map_refs(re, pos))
+                for ei, (le, re) in enumerate(edges)
+                if ei not in used_edges] + \
+               [_map_refs(c, pos) for oi, c in enumerate(others)
+                if oi not in used_others]
+    if residual:
+        cur = LogicalSelection(residual, cur)
+    # restore original column order (and names) for the parents
+    orig_cols = plan.schema.columns
+    exprs = [ColumnRef(pos[g], orig_cols[g].ftype, orig_cols[g].name)
+             for g in range(total)]
+    out = LogicalProjection(exprs, [c.name for c in orig_cols], cur,
+                            [c.qualifier for c in orig_cols])
+    return out
+
+
+def pos_leaves(pos: Dict[int, int], span) -> Set[int]:
+    out = set()
+    for i, (lo, hi) in span.items():
+        if lo in pos:
+            out.add(i)
+    return out
+
+
+def _map_refs(e: Expression, pos: Dict[int, int]) -> Expression:
+    if isinstance(e, ColumnRef):
+        return ColumnRef(pos[e.index], e.ftype, e.name)
+    if isinstance(e, ScalarFunc):
+        return ScalarFunc(e.op, [_map_refs(a, pos) for a in e.args], e.ftype)
+    return e
+
+
+def _logical_rows(plan: LogicalPlan, ctx) -> float:
+    """Light cardinality estimate for reorder decisions (the full estimator
+    lives in physical.py; this one only needs relative order)."""
+    if isinstance(plan, LogicalDataSource):
+        fn = getattr(ctx, "table_row_count", None) if ctx is not None \
+            else None
+        n = float(fn(plan.table.id)) if fn is not None else 100000.0
+        if plan.filters:
+            from tidb_tpu.statistics import filters_selectivity
+            sfn = getattr(ctx, "table_stats", None) if ctx is not None \
+                else None
+            stats = sfn(plan.table.id) if sfn is not None else None
+            n *= filters_selectivity(plan.filters, stats)
+        return max(n, 1.0)
+    if isinstance(plan, LogicalSelection):
+        return max(_logical_rows(plan.children[0], ctx) * 0.25, 1.0)
+    if isinstance(plan, LogicalAggregation):
+        return max(_logical_rows(plan.children[0], ctx) / 8.0, 1.0)
+    if isinstance(plan, LogicalLimit):
+        return float(plan.count + plan.offset)
+    if isinstance(plan, LogicalJoin):
+        if plan.kind in ("semi", "anti"):
+            return max(_logical_rows(plan.children[0], ctx) * 0.5, 1.0)
+        return max(_logical_rows(plan.children[0], ctx),
+                   _logical_rows(plan.children[1], ctx))
+    if plan.children:
+        return _logical_rows(plan.children[0], ctx)
+    return 1.0
+
+
+def _max_key_ndv(cand: int, leaves, edges, edge_leaves, joined, ctx) -> float:
+    """Largest NDV among join-key columns connecting `cand` to the joined
+    set (the |L||R|/max(ndv) equi-join estimate)."""
+    from tidb_tpu.statistics import column_ndv
+    best = 1.0
+    for el, (le, re) in zip(edge_leaves, edges):
+        if el is None:
+            continue
+        li, ri = el
+        for side, expr in ((li, le), (ri, re)):
+            if side != cand:
+                continue
+            other = ri if side == li else li
+            if other not in joined:
+                continue
+            lf, off = leaves[cand]
+            if isinstance(expr, ColumnRef) and \
+                    isinstance(lf, LogicalDataSource):
+                sfn = getattr(ctx, "table_stats", None) if ctx is not None \
+                    else None
+                stats = sfn(lf.table.id) if sfn is not None else None
+                if stats is not None:
+                    ndv = column_ndv(stats, expr.index - off, -1.0)
+                    if ndv and ndv > 0:
+                        best = max(best, ndv)
+    return best
+
+
+# ---------------------------------------------------------------------------
+# 4. TopN fusion (ref: planner/core/rule_topn_push_down.go)
 # ---------------------------------------------------------------------------
 
 
@@ -286,9 +529,17 @@ def mark_used_columns(plan: LogicalPlan,
         return
     # compute child requirements per operator
     if isinstance(plan, LogicalProjection):
+        req = set(required) if required is not None else set(
+            range(len(plan.exprs)))
         child_req: Set[int] = set()
-        for e in plan.exprs:
-            child_req.update(e.references())
+        for i, e in enumerate(plan.exprs):
+            # unused plain passthrough columns don't pin their sources
+            # (the reorder rule's order-restoring projection would
+            # otherwise disable pruning for the whole region); computed
+            # exprs are still evaluated by the executors, so their inputs
+            # stay required
+            if i in req or not isinstance(e, ColumnRef):
+                child_req.update(e.references())
         mark_used_columns(plan.children[0], child_req)
         return
     if isinstance(plan, LogicalAggregation):
