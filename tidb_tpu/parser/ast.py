@@ -337,6 +337,27 @@ class AnalyzeTable(StmtNode):
 
 
 @dataclass
+class CreateUser(StmtNode):
+    user: str
+    password: str = ""
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropUser(StmtNode):
+    user: str
+    if_exists: bool = False
+
+
+@dataclass
+class GrantStmt(StmtNode):
+    privs: List[str]
+    scope: str                 # *.* | db.* | db.tbl | tbl
+    user: str
+    revoke: bool = False
+
+
+@dataclass
 class UseStmt(StmtNode):
     db: str
 

@@ -24,6 +24,7 @@ KEYWORDS = {
     "timestamp", "time", "unsigned", "signed", "auto_increment", "engine",
     "charset", "collate", "comment", "replace", "ignore", "start",
     "transaction", "over", "partition", "with", "recursive", "alter", "add", "rename", "to", "column",
+    "user", "grant", "grants", "revoke", "identified", "privileges",
 }
 
 

@@ -108,7 +108,7 @@ class Parser:
                 "comment", "engine", "charset", "begin", "analyze", "offset",
                 "set", "values", "variables", "if",
                 "add", "to", "column", "rename", "over", "partition",
-                "alter", "mod"):
+                "alter", "mod", "user", "grants", "privileges"):
             return self.advance().value
         raise ParseError(f"expected identifier near {self._near()}")
 
@@ -123,9 +123,17 @@ class Parser:
         if self.at_kw("select") or self.at_op("("):
             return self.select_with_setops()
         if self.at_kw("create"):
+            if self.toks[self.i + 1].is_kw("user"):
+                return self.create_user()
             return self.create_table()
         if self.at_kw("drop"):
+            if self.toks[self.i + 1].is_kw("user"):
+                return self.drop_user()
             return self.drop_table()
+        if self.at_kw("grant"):
+            return self.grant_stmt()
+        if self.at_kw("revoke"):
+            return self.grant_stmt(revoke=True)
         if self.at_kw("alter"):
             return self.alter_table()
         if self.at_kw("truncate"):
@@ -170,6 +178,82 @@ class Parser:
             self.advance()
             return ast.RollbackStmt()
         raise ParseError(f"unsupported statement near {self._near()}")
+
+    # ---- user admin (ref: parser grammar CreateUserStmt/GrantStmt) -------
+    def _user_spec(self) -> str:
+        """'u'@'host' | u@'host' | u — host is parsed and ignored (the
+        single-process engine has no host-based rules)."""
+        if self.at("str"):
+            name = self.advance().value
+        else:
+            name = self.ident()
+        if self.try_op("@"):
+            if self.at("str"):
+                self.advance()
+            else:
+                self.ident()
+        return name
+
+    def create_user(self) -> ast.StmtNode:
+        self.expect_kw("create")
+        self.expect_kw("user")
+        if_not_exists = False
+        if self.try_kw("if"):
+            self.expect_kw("not")
+            self.expect_kw("exists")
+            if_not_exists = True
+        user = self._user_spec()
+        password = ""
+        if self.try_kw("identified"):
+            self.expect_kw("by")
+            if not self.at("str"):
+                raise ParseError(f"expected password string near "
+                                 f"{self._near()}")
+            password = self.advance().value
+        return ast.CreateUser(user, password, if_not_exists)
+
+    def drop_user(self) -> ast.StmtNode:
+        self.expect_kw("drop")
+        self.expect_kw("user")
+        if_exists = False
+        if self.try_kw("if"):
+            self.expect_kw("exists")
+            if_exists = True
+        return ast.DropUser(self._user_spec(), if_exists)
+
+    def grant_stmt(self, revoke: bool = False) -> ast.StmtNode:
+        self.advance()                      # GRANT | REVOKE
+        privs = []
+        while True:
+            if self.try_kw("all"):
+                self.try_kw("privileges")
+                privs.append("ALL")
+            elif self.at_kw("select", "insert", "update", "delete",
+                            "create", "drop", "alter", "index"):
+                privs.append(self.advance().value.upper())
+            else:
+                raise ParseError(f"expected privilege near {self._near()}")
+            if not self.try_op(","):
+                break
+        self.expect_kw("on")
+        scope = self._grant_scope()
+        self.expect_kw("from" if revoke else "to")
+        user = self._user_spec()
+        return ast.GrantStmt(privs, scope, user, revoke)
+
+    def _grant_scope(self) -> str:
+        if self.try_op("*"):
+            if self.try_op("."):
+                if self.try_op("*"):
+                    return "*.*"
+                return f"*.{self.ident()}"
+            return "*.*"
+        first = self.ident()
+        if self.try_op("."):
+            if self.try_op("*"):
+                return f"{first}.*"
+            return f"{first}.{self.ident()}"
+        return first
 
     # ---- SELECT ----------------------------------------------------------
     def with_stmt(self) -> ast.StmtNode:
@@ -611,6 +695,12 @@ class Parser:
 
     def show_stmt(self) -> ast.ShowStmt:
         self.expect_kw("show")
+        if self.try_kw("grants"):
+            target = None
+            if self.at("ident") and str(self.cur.value).lower() == "for":
+                self.advance()
+                target = self._user_spec()
+            return ast.ShowStmt("grants", target=target)
         if self.try_kw("tables"):
             return ast.ShowStmt("tables")
         if self.try_kw("databases"):

@@ -2,12 +2,17 @@
 
 The reference's L1: a TCP listener speaking the MySQL client/server
 protocol so stock clients and drivers connect. This implementation covers
-the surface the reference's text protocol path exercises:
+both protocol paths the reference serves:
 
-  * protocol-41 handshake v10, any-password auth (the reference's
-    skip-grant-table mode), optional database in the handshake response;
+  * protocol-41 handshake v10 with real mysql_native_password challenge
+    auth against the engine's user table (privilege/privileges cache.go
+    analog in tidb_tpu/session/auth.py);
   * COM_QUERY → parse/plan/execute through a real Session, results as
     text resultsets (column definitions + length-encoded rows);
+  * prepared statements (server/conn_stmt.go): COM_STMT_PREPARE binds
+    `?` placeholders, COM_STMT_EXECUTE decodes binary parameters and
+    returns BINARY resultset rows (server/util.go:237 dumpBinaryRow),
+    COM_STMT_CLOSE / RESET / SEND_LONG_DATA;
   * COM_PING / COM_INIT_DB / COM_QUIT / COM_FIELD_LIST(no-op);
   * MySQL-coded error packets from the typed error hierarchy.
 
@@ -17,12 +22,15 @@ clientConn.Run without an event loop)."""
 
 from __future__ import annotations
 
+import datetime
+import hashlib
+import os
 import socket
 import socketserver
 import struct
 import threading
 import traceback
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from tidb_tpu.errors import TiDBTPUError
 from tidb_tpu.types import FieldType, TypeKind
@@ -56,6 +64,11 @@ COM_INIT_DB = 0x02
 COM_QUERY = 0x03
 COM_FIELD_LIST = 0x04
 COM_PING = 0x0E
+COM_STMT_PREPARE = 0x16
+COM_STMT_EXECUTE = 0x17
+COM_STMT_SEND_LONG_DATA = 0x18
+COM_STMT_CLOSE = 0x19
+COM_STMT_RESET = 0x1A
 
 # MySQL column type codes (type → protocol byte)
 _MYSQL_TYPE = {
@@ -81,15 +94,274 @@ def _lenenc_str(s: bytes) -> bytes:
     return _lenenc_int(len(s)) + s
 
 
+def _read_lenenc(data: bytes, i: int) -> Tuple[int, int]:
+    c = data[i]
+    if c < 251:
+        return c, i + 1
+    if c == 0xFC:
+        return data[i + 1] | (data[i + 2] << 8), i + 3
+    if c == 0xFD:
+        return int.from_bytes(data[i + 1:i + 4], "little"), i + 4
+    return int.from_bytes(data[i + 1:i + 9], "little"), i + 9
+
+
+# ---------------------------------------------------------------------------
+# Prepared statements (ref: server/conn_stmt.go, driver_stmt.go)
+# ---------------------------------------------------------------------------
+
+
+def _scan_segments(sql: str):
+    """Yield (is_marker, text): the single tokenizer behind placeholder
+    counting AND substitution — one scanner so the two can never disagree
+    about what counts as a `?` (strings, quoted identifiers, and all
+    three comment styles are opaque)."""
+    i, L = 0, len(sql)
+    start = 0
+    while i < L:
+        c = sql[i]
+        if c in ("'", '"', "`"):
+            q = c
+            i += 1
+            while i < L:
+                if sql[i] == "\\" and q != "`":
+                    i += 2
+                    continue
+                if sql[i] == q:
+                    if i + 1 < L and sql[i + 1] == q:
+                        i += 2
+                        continue
+                    i += 1
+                    break
+                i += 1
+            continue
+        if c == "-" and sql[i:i + 2] == "--":
+            j = sql.find("\n", i)
+            i = L if j < 0 else j + 1
+            continue
+        if c == "/" and sql[i:i + 2] == "/*":
+            j = sql.find("*/", i + 2)
+            i = L if j < 0 else j + 2
+            continue
+        if c == "#":
+            j = sql.find("\n", i)
+            i = L if j < 0 else j + 1
+            continue
+        if c == "?":
+            if i > start:
+                yield False, sql[start:i]
+            yield True, "?"
+            i += 1
+            start = i
+            continue
+        i += 1
+    if start < L:
+        yield False, sql[start:]
+
+
+def count_placeholders(sql: str) -> int:
+    """`?` markers outside string literals, quoted identifiers, comments."""
+    return sum(1 for is_marker, _ in _scan_segments(sql) if is_marker)
+
+
+def substitute_placeholders(sql: str, values: List[object]) -> str:
+    """Bind parameter values as SQL literals (the reference instead keeps
+    params through plan-cache slots; textual binding is equivalent for
+    correctness and reuses the whole parse/plan path)."""
+    out = []
+    vi = 0
+    for is_marker, text in _scan_segments(sql):
+        if is_marker:
+            out.append(_sql_literal(values[vi]))
+            vi += 1
+        else:
+            out.append(text)
+    return "".join(out)
+
+
+def _sql_literal(v) -> str:
+    if v is None:
+        return "NULL"
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, (int, float)):
+        return repr(v)
+    if isinstance(v, bytes):
+        v = v.decode("utf-8", "replace")
+    if isinstance(v, (datetime.datetime, datetime.date)):
+        v = str(v)
+    s = str(v).replace("\\", "\\\\").replace("'", "\\'")
+    return f"'{s}'"
+
+
+class PreparedStmt:
+    __slots__ = ("stmt_id", "sql", "n_params", "long_data", "param_types")
+
+    def __init__(self, stmt_id: int, sql: str):
+        self.stmt_id = stmt_id
+        self.sql = sql
+        self.n_params = count_placeholders(sql)
+        self.long_data: Dict[int, bytes] = {}
+        # cached from the first execute: C-client drivers send parameter
+        # types only when bindings change (new_params_bound_flag)
+        self.param_types: Optional[List[Tuple[int, bool]]] = None
+
+
+# binary protocol parameter decoding (ref: server/util.go parseExecArgs)
+def decode_binary_params(data: bytes, i: int, stmt: "PreparedStmt"
+                         ) -> List[object]:
+    n_params = stmt.n_params
+    long_data = stmt.long_data
+    null_bytes = (n_params + 7) // 8
+    null_bitmap = data[i:i + null_bytes]
+    i += null_bytes
+    new_bound = data[i]
+    i += 1
+    types: List[Tuple[int, bool]] = []
+    if new_bound:
+        for _ in range(n_params):
+            tp = data[i]
+            unsigned = bool(data[i + 1] & 0x80)
+            types.append((tp, unsigned))
+            i += 2
+        stmt.param_types = types
+    elif stmt.param_types is not None:
+        types = stmt.param_types
+    else:
+        raise TiDBTPUError("COM_STMT_EXECUTE without parameter types")
+    vals: List[object] = []
+    for p, (tp, unsigned) in enumerate(types):
+        if null_bitmap[p // 8] & (1 << (p % 8)):
+            vals.append(None)
+            continue
+        if p in long_data:
+            vals.append(long_data[p])
+            continue
+        if tp == 0x01:      # TINY
+            v = data[i]
+            i += 1
+            vals.append(v if unsigned else (v - 256 if v > 127 else v))
+        elif tp == 0x02:    # SHORT
+            v = struct.unpack_from("<H" if unsigned else "<h", data, i)[0]
+            i += 2
+            vals.append(v)
+        elif tp in (0x03, 0x09):   # LONG / INT24
+            v = struct.unpack_from("<I" if unsigned else "<i", data, i)[0]
+            i += 4
+            vals.append(v)
+        elif tp == 0x08:    # LONGLONG
+            v = struct.unpack_from("<Q" if unsigned else "<q", data, i)[0]
+            i += 8
+            vals.append(v)
+        elif tp == 0x04:    # FLOAT
+            v = struct.unpack_from("<f", data, i)[0]
+            i += 4
+            vals.append(v)
+        elif tp == 0x05:    # DOUBLE
+            v = struct.unpack_from("<d", data, i)[0]
+            i += 8
+            vals.append(v)
+        elif tp in (0x0A, 0x0C, 0x07):   # DATE/DATETIME/TIMESTAMP
+            ln = data[i]
+            i += 1
+            if ln == 0:
+                vals.append("0000-00-00")
+            else:
+                y, mo, d = struct.unpack_from("<HBB", data, i)
+                h = mi = s = 0
+                if ln >= 7:
+                    h, mi, s = data[i + 4], data[i + 5], data[i + 6]
+                i += ln
+                if tp == 0x0A and ln == 4:
+                    vals.append(f"{y:04d}-{mo:02d}-{d:02d}")
+                else:
+                    vals.append(f"{y:04d}-{mo:02d}-{d:02d} "
+                                f"{h:02d}:{mi:02d}:{s:02d}")
+        elif tp == 0x0B:    # TIME
+            ln = data[i]
+            i += 1
+            if ln == 0:
+                vals.append("00:00:00")
+            else:
+                neg = data[i]
+                days = struct.unpack_from("<I", data, i + 1)[0]
+                h, mi, s = data[i + 5], data[i + 6], data[i + 7]
+                i += ln
+                sign = "-" if neg else ""
+                vals.append(f"{sign}{days * 24 + h:02d}:{mi:02d}:{s:02d}")
+        elif tp == 0x06:    # NULL
+            vals.append(None)
+        else:               # strings / decimals / blobs: length-encoded
+            ln, i = _read_lenenc(data, i)
+            vals.append(data[i:i + ln].decode("utf-8", "replace"))
+            i += ln
+    return vals
+
+
+# binary resultset value encoding (ref: server/util.go dumpBinaryRow)
+def _encode_binary_value(v, ft: FieldType) -> bytes:
+    k = ft.kind
+    if k in (TypeKind.TINYINT,):
+        return struct.pack("<b", int(v))
+    if k is TypeKind.SMALLINT:
+        return struct.pack("<h", int(v))
+    if k is TypeKind.INT:
+        return struct.pack("<i", int(v))
+    if k is TypeKind.BIGINT:
+        return struct.pack("<q", int(v))
+    if k is TypeKind.FLOAT:
+        return struct.pack("<f", float(v))
+    if k is TypeKind.DOUBLE:
+        return struct.pack("<d", float(v))
+    if k in (TypeKind.DATE, TypeKind.DATETIME, TypeKind.TIMESTAMP):
+        s = str(v)
+        y, mo, d = int(s[0:4]), int(s[5:7]), int(s[8:10])
+        if len(s) > 10:
+            h, mi, sec = int(s[11:13]), int(s[14:16]), int(s[17:19])
+            return bytes([7]) + struct.pack("<HBBBBB", y, mo, d, h, mi, sec)
+        return bytes([4]) + struct.pack("<HBB", y, mo, d)
+    if k is TypeKind.TIME:
+        s = str(v)
+        neg = s.startswith("-")
+        if neg:
+            s = s[1:]
+        parts = s.split(":")
+        h, mi = int(parts[0]), int(parts[1])
+        sec = int(float(parts[2])) if len(parts) > 2 else 0
+        return bytes([8, 1 if neg else 0]) + struct.pack(
+            "<IBBB", h // 24, h % 24, mi, sec)
+    # decimals and strings travel as length-encoded text
+    return _lenenc_str(_text_value(v))
+
+
+# ---------------------------------------------------------------------------
+# mysql_native_password (ref: privilege auth; server/auth.go)
+# ---------------------------------------------------------------------------
+
+
+def native_password_verify(salt: bytes, token: bytes, stage2: bytes) -> bool:
+    """token = SHA1(pw) XOR SHA1(salt + SHA1(SHA1(pw))); server stores
+    stage2 = SHA1(SHA1(pw)). Recover SHA1(pw) and re-hash to compare."""
+    if not token:
+        return stage2 == b""           # empty password
+    if len(token) != 20 or stage2 == b"":
+        return False
+    mix = hashlib.sha1(salt + stage2).digest()
+    sha_pw = bytes(a ^ b for a, b in zip(token, mix))
+    return hashlib.sha1(sha_pw).digest() == stage2
+
+
 class _Conn:
     """One client connection (ref: clientConn in server/conn.go)."""
 
     def __init__(self, sock: socket.socket, engine, conn_id: int):
         self.sock = sock
+        self.engine = engine
         self.session = engine.new_session()
         self.conn_id = conn_id
         self.seq = 0
         self.caps = SERVER_CAPS
+        self.stmts: Dict[int, PreparedStmt] = {}
+        self._next_stmt_id = 0
 
     # -- packet framing ------------------------------------------------------
     def _recv_exact(self, n: int) -> bytes:
@@ -135,7 +407,8 @@ class _Conn:
 
     # -- handshake -----------------------------------------------------------
     def handshake(self) -> None:
-        salt = b"12345678" + b"90abcdefghij"      # 20 bytes, unused (no auth)
+        # random 20-byte printable nonzero salt (protocol requirement)
+        salt = bytes((b % 93) + 33 for b in os.urandom(20))
         greeting = (
             bytes([PROTOCOL_VERSION]) + SERVER_VERSION + b"\x00"
             + struct.pack("<I", self.conn_id)
@@ -157,15 +430,29 @@ class _Conn:
         # skip max packet (4) + charset (1) + filler (23)
         i = 32
         end = resp.index(b"\x00", i)
-        _user = resp[i:end]
+        user = resp[i:end].decode("utf-8", "replace")
         i = end + 1
+        token = b""
         if self.caps & CLIENT_SECURE_CONNECTION and i < len(resp):
             alen = resp[i]
-            i += 1 + alen                          # auth accepted blindly
+            token = resp[i + 1:i + 1 + alen]
+            i += 1 + alen
         if self.caps & CLIENT_CONNECT_WITH_DB and i < len(resp) and \
                 b"\x00" in resp[i:]:
             end = resp.index(b"\x00", i)
             _db = resp[i:end]
+        # mysql_native_password challenge verification against the
+        # engine's user table (cache.go analog); unknown user or bad
+        # scramble → ER_ACCESS_DENIED_ERROR
+        stage2 = self.engine.auth.stage2(user)
+        if stage2 is None or not native_password_verify(salt, token,
+                                                        stage2):
+            self.write_err(1045, f"Access denied for user '{user}'@'%' "
+                                 f"(using password: "
+                                 f"{'YES' if token else 'NO'})",
+                           b"28000")
+            raise ConnectionError("auth failed")
+        self.session.user = user.lower()
         self.write_ok()
 
     # -- results -------------------------------------------------------------
@@ -238,6 +525,25 @@ class _Conn:
                     self.write_eof()
                 elif cmd == COM_QUERY:
                     self._query(data.decode("utf-8", "replace"))
+                elif cmd == COM_STMT_PREPARE:
+                    self._stmt_prepare(data.decode("utf-8", "replace"))
+                elif cmd == COM_STMT_EXECUTE:
+                    self._stmt_execute(data)
+                elif cmd == COM_STMT_CLOSE:
+                    self.stmts.pop(struct.unpack("<I", data[:4])[0], None)
+                    # COM_STMT_CLOSE sends no response (protocol)
+                elif cmd == COM_STMT_RESET:
+                    st = self.stmts.get(struct.unpack("<I", data[:4])[0])
+                    if st is not None:
+                        st.long_data.clear()
+                    self.write_ok()
+                elif cmd == COM_STMT_SEND_LONG_DATA:
+                    sid, pidx = struct.unpack("<IH", data[:6])
+                    st = self.stmts.get(sid)
+                    if st is not None:
+                        st.long_data[pidx] = st.long_data.get(pidx, b"") + \
+                            data[6:]
+                    # no response (protocol)
                 else:
                     self.write_err(1047, f"unknown command {cmd}",
                                    b"08S01")
@@ -246,6 +552,67 @@ class _Conn:
             except Exception as e:  # noqa: BLE001 — conn must not die
                 traceback.print_exc()
                 self.write_err(1105, f"{type(e).__name__}: {e}")
+
+    # -- prepared statements (ref: server/conn_stmt.go) ----------------------
+    def _stmt_prepare(self, sql: str) -> None:
+        self._next_stmt_id += 1
+        st = PreparedStmt(self._next_stmt_id, sql)
+        self.stmts[st.stmt_id] = st
+        # response: [OK, stmt_id, n_cols(unknown→0), n_params, 0, warnings]
+        self.write_packet(b"\x00" + struct.pack("<IHH", st.stmt_id, 0,
+                                                st.n_params)
+                          + b"\x00" + struct.pack("<H", 0))
+        if st.n_params:
+            from tidb_tpu import types as T
+            for p in range(st.n_params):
+                self.write_packet(self._coldef(f"?{p}", T.varchar()))
+            self.write_eof()
+
+    def _stmt_execute(self, data: bytes) -> None:
+        sid = struct.unpack("<I", data[:4])[0]
+        st = self.stmts.get(sid)
+        if st is None:
+            self.write_err(1243, f"Unknown prepared statement handler "
+                                 f"({sid}) given to EXECUTE", b"HY000")
+            return
+        # flags (1) + iteration count (4)
+        i = 9
+        params: List[object] = []
+        if st.n_params:
+            params = decode_binary_params(data, i, st)
+        sql = substitute_placeholders(st.sql, params)
+        results = self.session.execute(sql)
+        for k, rs in enumerate(results):
+            status = 0x0002 | (SERVER_MORE_RESULTS_EXISTS
+                               if k + 1 < len(results) else 0)
+            if rs.is_query:
+                self._write_binary_resultset(rs.names, rs.ftypes, rs.rows,
+                                             status)
+            else:
+                self.write_ok(affected=rs.affected_rows, status=status)
+
+    def _write_binary_resultset(self, names: List[str],
+                                ftypes: List[FieldType],
+                                rows: List[tuple], status: int) -> None:
+        """Binary-protocol resultset (server/util.go:237 dumpBinaryRow):
+        0x00 header, NULL bitmap with 2-bit offset, typed values."""
+        self.write_packet(_lenenc_int(len(names)))
+        for nm, ft in zip(names, ftypes):
+            self.write_packet(self._coldef(nm, ft))
+        self.write_eof()
+        ncols = len(names)
+        nb = (ncols + 9) // 8
+        for row in rows:
+            bitmap = bytearray(nb)
+            body = b""
+            for ci, (v, ft) in enumerate(zip(row, ftypes)):
+                if v is None:
+                    pos = ci + 2
+                    bitmap[pos // 8] |= 1 << (pos % 8)
+                else:
+                    body += _encode_binary_value(v, ft)
+            self.write_packet(b"\x00" + bytes(bitmap) + body)
+        self.write_eof(status)
 
     def _query(self, sql: str) -> None:
         results = self.session.execute(sql)

@@ -82,16 +82,76 @@ def ok(affected: int = 0) -> ResultSet:
     return ResultSet([], [], [], affected_rows=affected, is_query=False)
 
 
+def _plan_tables(plan) -> List[str]:
+    """Base-table names a logical plan scans (privilege gate for plans
+    built outside the AST path)."""
+    from tidb_tpu.planner.logical import LogicalDataSource
+    out = []
+    def rec(n):
+        if isinstance(n, LogicalDataSource):
+            out.append(n.table.name.lower())
+        for c in n.children:
+            rec(c)
+    rec(plan)
+    return out
+
+
+def _stmt_tables(stmt) -> List[str]:
+    """Base-table names a statement touches (for the privilege gate).
+    Subqueries in expressions are covered by their own nested execution."""
+    names: List[str] = []
+
+    def from_ref(ref):
+        if isinstance(ref, ast.TableName):
+            names.append(ref.name.lower())
+        elif isinstance(ref, ast.JoinExpr):
+            from_ref(ref.left)
+            from_ref(ref.right)
+        elif isinstance(ref, ast.SubqueryTable):
+            sel(ref.select)
+
+    def sel(s):
+        if isinstance(s, ast.SetOpStmt):
+            sel(s.left)
+            sel(s.right)
+            return
+        if getattr(s, "from_", None) is not None:
+            from_ref(s.from_)
+
+    if isinstance(stmt, (ast.SelectStmt, ast.SetOpStmt)):
+        sel(stmt)
+    elif isinstance(stmt, ast.WithStmt):
+        cte_names = {c.name.lower() for c in stmt.ctes}
+        for c in stmt.ctes:
+            sel(c.select)
+        inner = _stmt_tables(stmt.stmt)
+        names.extend(t for t in inner if t not in cte_names)
+    elif isinstance(stmt, ast.Insert):
+        names.append(stmt.table.lower())
+    elif isinstance(stmt, (ast.Update, ast.Delete)):
+        names.append(stmt.table.name.lower())
+    elif isinstance(stmt, (ast.CreateTable, ast.TruncateTable)):
+        names.append(stmt.name.lower())
+    elif isinstance(stmt, ast.DropTable):
+        names.extend(n.lower() for n in stmt.names)
+    elif isinstance(stmt, (ast.AlterTable, ast.CreateIndex, ast.DropIndex)):
+        names.append(stmt.table.lower())
+    return names
+
+
 class Engine:
     """Process-wide catalog + storage owner (the Domain analog)."""
 
     def __init__(self):
+        from tidb_tpu.session.auth import AuthManager
         self.catalog = Catalog()
         self.store = Store()
         self.stats_lock = threading.Lock()
         # table_id → statistics.TableStats (histograms/NDV/TopN; ref:
         # statistics/handle — the Domain-owned stats cache)
         self.table_stats: Dict[int, object] = {}
+        # users/passwords/grants (privilege/privileges cache.go analog)
+        self.auth = AuthManager()
 
     def new_session(self) -> "Session":
         return Session(self)
@@ -158,6 +218,7 @@ class Session:
         self.conn_id = next(Session._next_conn_id)
         self.last_engine = "cpu"   # cpu | tpu — set by the fragment path
         self._cte_map: Dict[str, str] = {}
+        self.user = "root"         # set by the wire server after auth
 
     # ---- public API --------------------------------------------------------
     def execute(self, sql: str) -> List[ResultSet]:
@@ -226,10 +287,63 @@ class Session:
             self.txn.commit()
             self.txn = None
 
+    # ---- privilege gate (ref: privilege/privileges/privileges.go:62) -------
+    _STMT_PRIV = {
+        ast.Insert: "INSERT", ast.Update: "UPDATE", ast.Delete: "DELETE",
+        ast.CreateTable: "CREATE", ast.DropTable: "DROP",
+        ast.TruncateTable: "DROP", ast.AlterTable: "ALTER",
+        ast.CreateIndex: "INDEX", ast.DropIndex: "INDEX",
+    }
+
+    def _check_privileges(self, stmt: ast.StmtNode) -> None:
+        auth = self.engine.auth
+        if auth.is_superuser(self.user):
+            return
+        if isinstance(stmt, (ast.CreateUser, ast.DropUser, ast.GrantStmt)):
+            from tidb_tpu.session.auth import PrivilegeError
+            raise PrivilegeError(
+                f"Access denied for user '{self.user}'@'%' "
+                f"(user administration requires ALL on *.*)")
+        if isinstance(stmt, (ast.SelectStmt, ast.SetOpStmt, ast.WithStmt)):
+            for t in _stmt_tables(stmt):
+                auth.require(self.user, "SELECT", t)
+            return
+        if isinstance(stmt, ast.Insert):
+            # INSERT on the target; SELECT on INSERT…SELECT sources
+            auth.require(self.user, "INSERT", stmt.table)
+            if stmt.select is not None:
+                for t in _stmt_tables(stmt.select):
+                    auth.require(self.user, "SELECT", t)
+            return
+        priv = self._STMT_PRIV.get(type(stmt))
+        if priv is not None:
+            tables = _stmt_tables(stmt)
+            if tables:
+                for t in tables:
+                    auth.require(self.user, priv, t)
+            else:
+                auth.require(self.user, priv, None)
+
     # ---- dispatch ----------------------------------------------------------
     def _execute_stmt(self, stmt: ast.StmtNode) -> ResultSet:
+        self._check_privileges(stmt)
         if isinstance(stmt, self._DDL_STMTS):
             self._implicit_commit()
+        if isinstance(stmt, ast.CreateUser):
+            self.engine.auth.create_user(stmt.user, stmt.password,
+                                         stmt.if_not_exists)
+            return ok()
+        if isinstance(stmt, ast.DropUser):
+            self.engine.auth.drop_user(stmt.user, stmt.if_exists)
+            return ok()
+        if isinstance(stmt, ast.GrantStmt):
+            if stmt.revoke:
+                self.engine.auth.revoke(stmt.user, set(stmt.privs),
+                                        stmt.scope)
+            else:
+                self.engine.auth.grant(stmt.user, set(stmt.privs),
+                                       stmt.scope)
+            return ok()
         if isinstance(stmt, (ast.SelectStmt, ast.SetOpStmt)):
             return self._run_query(stmt)
         if isinstance(stmt, ast.WithStmt):
@@ -310,6 +424,9 @@ class Session:
     # ---- SELECT ------------------------------------------------------------
     def _subquery_evaluator(self) -> SubqueryEvaluator:
         def run(sel: ast.SelectStmt):
+            # expression subqueries read tables too — same privilege gate
+            # as a top-level SELECT (privileges.go checks every access)
+            self._check_privileges(sel)
             rs = self._run_query(sel)
             return rs.rows, rs.ftypes
 
@@ -317,6 +434,9 @@ class Session:
             # execute an already-built logical subquery plan (the
             # decorrelator's probe build) without re-planning the AST
             from tidb_tpu.planner import optimize_logical
+            if not self.engine.auth.is_superuser(self.user):
+                for t in _plan_tables(logical):
+                    self.engine.auth.require(self.user, "SELECT", t)
             phys = optimize_logical(logical, _PlanContext(self))
             root = build(phys)
             chunks = run_to_completion(root, self._exec_ctx())
@@ -688,6 +808,11 @@ class Session:
 
     def _show(self, stmt: ast.ShowStmt) -> ResultSet:
         info_schema = self.engine.catalog.info_schema
+        if stmt.kind == "grants":
+            target = stmt.target or self.user
+            rows = self.engine.auth.show_grants(target)
+            return ResultSet([f"Grants for {target}@%"], [T.varchar()],
+                             rows)
         if stmt.kind == "tables":
             rows = [(t.name,) for t in info_schema.list_tables()
                     if not t.name.startswith("#")]   # hide CTE temps
