@@ -1,0 +1,78 @@
+"""SQL plan cache (ref: planner/core/cache.go): repeated SELECT texts
+reuse the compiled physical plan; DDL/ANALYZE/var changes invalidate via
+the cache key; plans that baked eager-subquery results never cache."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.session import Engine
+
+
+@pytest.fixture()
+def s():
+    eng = Engine()
+    s = eng.new_session()
+    s.execute("CREATE TABLE pc (a BIGINT, b BIGINT)")
+    s.execute("INSERT INTO pc VALUES " +
+              ",".join(f"({i},{i % 7})" for i in range(500)))
+    return s
+
+
+def _hits(s):
+    from tidb_tpu.util.observability import REGISTRY
+    rows = s.query("SHOW METRICS").rows
+    for name, *rest in rows:
+        if name == "tidb_tpu_plan_cache_hits_total":
+            return float(rest[-1])
+    return 0.0
+
+
+def test_repeated_select_hits_cache(s):
+    sql = "SELECT b, COUNT(*), SUM(a) FROM pc GROUP BY b ORDER BY b"
+    first = s.query(sql).rows
+    h0 = _hits(s)
+    second = s.query(sql).rows
+    assert second == first
+    assert _hits(s) > h0
+    assert len(s._plan_cache) >= 1
+
+
+def test_ddl_invalidates(s):
+    sql = "SELECT COUNT(*) FROM pc"
+    s.query(sql)
+    assert any(k[0] == sql for k in s._plan_cache)
+    s.execute("ALTER TABLE pc ADD COLUMN c BIGINT")
+    # key embeds the schema version: old entry is unreachable
+    s.query(sql)
+    versions = {k[1] for k in s._plan_cache if k[0] == sql}
+    assert len(versions) == 2
+
+
+def test_dml_correctness_through_cache(s):
+    sql = "SELECT COUNT(*) FROM pc"
+    assert s.query(sql).rows == [(500,)]
+    s.execute("INSERT INTO pc VALUES (1000, 1)")
+    # same plan object, fresh execution: reads the new row
+    assert s.query(sql).rows == [(501,)]
+
+
+def test_eager_subquery_plans_never_cached(s):
+    sql = "SELECT COUNT(*) FROM pc WHERE a < (SELECT AVG(a) FROM pc)"
+    before = s.query(sql).rows
+    assert not any(k[0] == sql for k in s._plan_cache)
+    s.execute("INSERT INTO pc VALUES (100000, 1)")   # shifts AVG
+    after = s.query(sql).rows
+    assert after != before or True    # must recompute, not replay
+    # the subquery reran: the new AVG includes the outlier
+    avg = s.query("SELECT AVG(a) FROM pc").scalar()
+    want = s.query(f"SELECT COUNT(*) FROM pc WHERE a < {avg}").rows
+    assert after == want
+
+
+def test_var_change_misses(s):
+    sql = "SELECT SUM(a) FROM pc"
+    s.query(sql)
+    n0 = len(s._plan_cache)
+    s.vars["tidb_tpu_row_threshold"] = 1
+    s.query(sql)
+    assert len(s._plan_cache) == n0 + 1

@@ -102,6 +102,13 @@ def _emit_agg_perfect(ctx: EvalContext, live, root, aggs, cap: int,
             "slot_live": slot_live}
 
 
+def agg_states(ctx, live, root, aggs, gids, cap: int, n: int):
+    """Per-aggregate partial states over one batch (DISTINCT args dedup
+    via factorize.distinct_mask) — shared by single-device and per-shard
+    partials."""
+    return _agg_states(ctx, live, root, aggs, gids, cap, n)
+
+
 def _agg_states(ctx, live, root, aggs, gids, cap: int, n: int):
     from tidb_tpu.ops.jax_env import jnp
     from tidb_tpu.ops import factorize as F

@@ -613,7 +613,12 @@ class TpuFragmentExec:
 
     def _fallback_next(self) -> Optional[Chunk]:
         from tidb_tpu.executor import build
-        self._cpu_root = build(self.plan.root)
+        root = self.plan.root
+        if getattr(self.plan, "dist", 0) > 1:
+            # distributed plans carry Exchange nodes — pure repartitioning
+            # boundaries with no single-node executor; strip them
+            root = _strip_exchanges(root)
+        self._cpu_root = build(root)
         self._cpu_root.open(self.ctx)
         return self._cpu_root.next()
 
@@ -891,12 +896,15 @@ class TpuFragmentExec:
         flow_list = [flows.get(id(n), []) for n in TF._walk_nodes(root)]
 
         # initial bucket cap per hash exchange: 4× the balanced share
+        # (tidb_tpu_exchange_bucket_cap overrides — skew/retry testing)
+        cap_override = int(self.ctx.vars.get(
+            "tidb_tpu_exchange_bucket_cap", 0) or 0)
         bucket_caps: Dict[int, int] = {}
         for node in TF._walk_nodes(root):
             if isinstance(node, PhysExchange) and node.kind == "hash":
                 est = max(int(node.est_rows), 1)
-                bucket_caps[id(node)] = _pow2(4 * ((est + nd - 1) // nd),
-                                              lo=64)
+                bucket_caps[id(node)] = cap_override or _pow2(
+                    4 * ((est + nd - 1) // nd), lo=64)
 
         vars_ = self.ctx.vars
         group_cap = int(vars_.get("tidb_tpu_group_cap", DEFAULT_GROUP_CAP))
@@ -905,6 +913,9 @@ class TpuFragmentExec:
         gcap = _initial_group_cap(root, group_cap, max_cap * nd) \
             if is_agg else 1
 
+        hash_exchanges = [n for n in TF._walk_nodes(root)
+                          if isinstance(n, PhysExchange)
+                          and n.kind == "hash"]
         while True:
             prog = _get_dist_program(root, caps, gcap, mesh, bucket_caps)
             prep_vals = prog.collect_preps(flow_list)
@@ -912,10 +923,13 @@ class TpuFragmentExec:
             if not bool(out["unique"]):
                 raise FragmentFallback("non-unique join build side")
             retry = False
-            if bool(out["over_exchange"]):
-                for k in bucket_caps:
-                    bucket_caps[k] *= 2
-                retry = True
+            needs = np.asarray(out["exchange_need"])
+            for need, node in zip(needs, hash_exchanges):
+                if int(need) > bucket_caps[id(node)]:
+                    # resize only the overflowed exchange, to its exact
+                    # reported need — one recompile, no doubling ladder
+                    bucket_caps[id(node)] = _pow2(int(need), lo=64)
+                    retry = True
             if bool(out["over_groups"]):
                 if gcap >= max_cap * nd:
                     raise FragmentFallback("group cap overflow")
@@ -1102,6 +1116,14 @@ class TpuFragmentExec:
                                          _positional_dict(root, ci, dicts)))
             pieces.append(Chunk(piece))
         return Chunk.concat(pieces) if len(pieces) > 1 else pieces[0]
+
+
+def _strip_exchanges(plan: PhysicalPlan) -> PhysicalPlan:
+    from tidb_tpu.planner.physical import PhysExchange
+    plan.children = [_strip_exchanges(c) for c in plan.children]
+    if isinstance(plan, PhysExchange):
+        return plan.children[0]
+    return plan
 
 
 class _GroupCapOverflow(Exception):

@@ -138,7 +138,15 @@ def dist_ok(plan: PhysicalPlan, threshold: int) -> bool:
     if not isinstance(plan, (PhysHashAgg, PhysTopN, PhysSort)):
         return False
     if isinstance(plan, PhysHashAgg) and any(d.distinct for d in plan.aggs):
-        return False     # distinct partials don't merge across shards
+        # DISTINCT distributes by re-keying the exchange so every group
+        # (or every distinct value, for global aggs) is wholly on one
+        # shard (the repartition trick of cophandler/mpp_exec.go); a
+        # global agg needs all distinct args equal to pick ONE key
+        if not plan.group_exprs:
+            dargs = {repr(d.args[0]) for d in plan.aggs
+                     if d.distinct and d.args}
+            if len(dargs) != 1:
+                return False
     if _tree_has_string_keys(plan):
         return False     # exchange-side dictionary unification TBD
     if has_join(plan):

@@ -54,7 +54,10 @@ def exchange(arrays: Sequence, dest, live, n_shards: int, bucket_cap: int,
 
     arrays: per-row payload arrays (N,)...; dest (N,) int32; live (N,) bool.
     Returns (received_arrays [(n_shards*bucket_cap,)...], received_live,
-             overflowed () bool).
+             need () int32 — the largest per-destination row count across
+             all shards; need > bucket_cap means rows were dropped and the
+             caller must retry with capacity ≥ need — ONE recompile, not a
+             doubling ladder).
     """
     n = dest.shape[0]
     iota = jnp.arange(n, dtype=jnp.int32)
@@ -68,7 +71,6 @@ def exchange(arrays: Sequence, dest, live, n_shards: int, bucket_cap: int,
     rank = jnp.zeros(n, dtype=jnp.int32).at[sorted_row].set(rank_sorted)
     counts = jax.ops.segment_sum(jnp.ones(n, dtype=jnp.int32), d,
                                  num_segments=n_shards + 1)[:n_shards]
-    overflow_local = (counts > bucket_cap).any()
     slot = d * bucket_cap + rank
     ok = live & (rank < bucket_cap)
     slot = jnp.where(ok, slot, n_shards * bucket_cap)  # OOB → dropped
@@ -89,8 +91,8 @@ def exchange(arrays: Sequence, dest, live, n_shards: int, bucket_cap: int,
 
     recv = [swap(b) for b in out_arrays]
     recv_live = swap(sent_live)
-    overflowed = lax.pmax(overflow_local.astype(jnp.int32), axis) > 0
-    return recv, recv_live, overflowed
+    need = lax.pmax(counts.max(), axis)
+    return recv, recv_live, need
 
 
 def broadcast_build(arrays: Sequence, live, axis: str = "shard"):

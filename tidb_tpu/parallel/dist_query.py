@@ -122,7 +122,7 @@ def build_agg_join_step(mesh, bucket_cap: int, group_cap: int,
         gcounts = gstates[0][1]
         fkeys, fsums, fcounts, fl = _owned_final_merge(
             gkeys, gsums, gcounts, gslot, group_cap, n_shards)
-        overflow = p_over | b_over
+        overflow = jnp.maximum(p_over, b_over) > bucket_cap
         return (fkeys[0][0], fkeys[0][1], fsums[0], fcounts, fl,
                 overflow)
 

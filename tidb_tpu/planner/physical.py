@@ -231,8 +231,18 @@ def insert_exchanges(node: PhysicalPlan, n_shards: int) -> PhysicalPlan:
     """Fragmentation pass for a device fragment subtree: choose and insert
     exchange boundaries under every join (the planner-side MPP decision —
     broadcast when replicating the build side is cheaper than
-    repartitioning both sides, else hash on the equi keys)."""
+    repartitioning both sides, else hash on the equi keys). DISTINCT agg
+    roots additionally re-key the exchange on their group keys (or the
+    distinct value for global aggs) so per-shard dedup is globally exact
+    (the repartition trick of cophandler/mpp_exec.go:158-173)."""
     node.children = [insert_exchanges(c, n_shards) for c in node.children]
+    if isinstance(node, PhysHashAgg) and \
+            any(d.distinct for d in node.aggs):
+        keys = list(node.group_exprs)
+        if not keys:
+            keys = [d.args[0] for d in node.aggs if d.distinct][:1]
+        node.children[0] = PhysExchange(node.children[0], "hash", keys)
+        return node
     if not isinstance(node, PhysHashJoin) or not node.equi:
         return node
     from tidb_tpu.executor.join import coerce_key_pair

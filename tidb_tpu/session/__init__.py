@@ -14,6 +14,7 @@ from __future__ import annotations
 import itertools
 import threading
 import time
+from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
@@ -152,6 +153,8 @@ class Engine:
         self.table_stats: Dict[int, object] = {}
         # users/passwords/grants (privilege/privileges cache.go analog)
         self.auth = AuthManager()
+        # bumped by ANALYZE: plan-cache entries keyed on it go stale
+        self.stats_version = 0
 
     def new_session(self) -> "Session":
         return Session(self)
@@ -219,6 +222,13 @@ class Session:
         self.last_engine = "cpu"   # cpu | tpu — set by the fragment path
         self._cte_map: Dict[str, str] = {}
         self.user = "root"         # set by the wire server after auth
+        # SQL plan cache (ref: planner/core/cache.go): physical plans of
+        # repeated SELECT texts, keyed on schema/stats versions + the
+        # planning-relevant session vars; plans whose build ran an eager
+        # subquery bake data into constants and are never cached
+        self._plan_cache: "OrderedDict[tuple, object]" = OrderedDict()
+        self._subq_execs = 0
+        self._current_sql: Optional[str] = None
 
     # ---- public API --------------------------------------------------------
     def execute(self, sql: str) -> List[ResultSet]:
@@ -232,6 +242,7 @@ class Session:
         out = []
         for s, one in parse_with_text(sql):
             kind = type(s).__name__
+            self._current_sql = one
             self.last_engine = "cpu"
             REGISTRY.stmt_begin(self.conn_id, one[:256])
             t0 = _time.perf_counter()
@@ -242,6 +253,10 @@ class Session:
                              {"stmt": kind})
                 REGISTRY.stmt_end(self.conn_id)
                 raise
+            finally:
+                # never let this statement's text key a LATER direct
+                # _plan() call (plan-cache poisoning)
+                self._current_sql = None
             dt = _time.perf_counter() - t0
             REGISTRY.stmt_end(self.conn_id)
             REGISTRY.inc("tidb_tpu_stmt_total", {"stmt": kind})
@@ -427,6 +442,7 @@ class Session:
             # expression subqueries read tables too — same privilege gate
             # as a top-level SELECT (privileges.go checks every access)
             self._check_privileges(sel)
+            self._subq_execs += 1
             rs = self._run_query(sel)
             return rs.rows, rs.ftypes
 
@@ -434,6 +450,7 @@ class Session:
             # execute an already-built logical subquery plan (the
             # decorrelator's probe build) without re-planning the AST
             from tidb_tpu.planner import optimize_logical
+            self._subq_execs += 1
             if not self.engine.auth.is_superuser(self.user):
                 for t in _plan_tables(logical):
                     self.engine.auth.require(self.user, "SELECT", t)
@@ -447,9 +464,57 @@ class Session:
         ev.run_plan = run_plan
         return ev
 
+    PLAN_CACHE_SIZE = 128
+
     def _plan(self, stmt):
         ctx = _PlanContext(self)
-        return optimize(stmt, self.engine.catalog.info_schema, ctx)
+        key = self._plan_cache_key(stmt)
+        if key is not None:
+            hit = self._plan_cache.get(key)
+            if hit is not None:
+                self._plan_cache.move_to_end(key)
+                from tidb_tpu.util.observability import REGISTRY
+                REGISTRY.inc("tidb_tpu_plan_cache_hits_total")
+                return hit
+        before = self._subq_execs
+        plan = optimize(stmt, self.engine.catalog.info_schema, ctx)
+        if key is not None and self._subq_execs == before:
+            self._plan_cache[key] = plan
+            while len(self._plan_cache) > self.PLAN_CACHE_SIZE:
+                self._plan_cache.popitem(last=False)
+        return plan
+
+    def _plan_cache_key(self, stmt):
+        """None → uncacheable: non-SELECT, CTE scope (temp tables are
+        per-execution), inside an explicit transaction, or no statement
+        text available. Referenced-table live row counts are part of the
+        key — cardinality estimates bake into the plan (fragment routing,
+        join order), so any size change must re-plan."""
+        if not isinstance(stmt, (ast.SelectStmt, ast.SetOpStmt)):
+            return None
+        if self._cte_map or self._current_sql is None or \
+                self.txn is not None:
+            return None
+        info_schema = self.engine.catalog.info_schema
+        snap = self._read_view_snapshot()
+        sizes = []
+        for t in sorted(set(_stmt_tables(stmt))):
+            try:
+                info = info_schema.table(t)
+            except TiDBTPUError:
+                return None
+            n = snap.table_data(info.id).live_rows \
+                if snap.has_table(info.id) else 0
+            sizes.append((t, n))
+        v = self.vars
+        return (self._current_sql,
+                info_schema.version,
+                self.engine.stats_version,
+                tuple(sizes),
+                str(v.get("tidb_tpu_engine")),
+                int(v.get("tidb_tpu_row_threshold", 32768)),
+                str(v.get("tidb_tpu_dist_devices", 0)),
+                self.user)
 
     def _run_query_chunks(self, stmt, want_root: bool = False):
         plan = self._plan(stmt)
@@ -1072,6 +1137,7 @@ class Session:
             with self.engine.stats_lock:
                 ts.version = snap.version   # version of the analyzed data
                 self.engine.table_stats[info.id] = ts
+                self.engine.stats_version += 1
         return ok()
 
 
