@@ -1,0 +1,133 @@
+"""Index-lookup join (executor/index_join.py; ref:
+executor/index_lookup_join.go:59): a tiny outer probing a large indexed
+inner picks the index path in EXPLAIN and matches the hash-join oracle."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.session import Engine
+
+
+@pytest.fixture(scope="module")
+def s():
+    eng = Engine()
+    s = eng.new_session()
+    s.execute("CREATE TABLE fact (f_id BIGINT PRIMARY KEY, f_key BIGINT, "
+              "f_val DECIMAL(10,2))")
+    s.execute("CREATE TABLE probe (p_key BIGINT, p_tag VARCHAR(8))")
+    s.execute("CREATE INDEX ix_fkey ON fact (f_key)")
+    rng = np.random.default_rng(17)
+    rows = ",".join(
+        f"({i},{int(rng.integers(0, 500))},{round(float(rng.uniform(1, 99)), 2)})"
+        for i in range(40000))
+    s.execute("INSERT INTO fact VALUES " + rows)
+    rows = []
+    for i in range(30):
+        k = "NULL" if i == 7 else str(int(rng.integers(0, 520)))
+        rows.append(f"({k},'t{i}')")
+    s.execute("INSERT INTO probe VALUES " + ",".join(rows))
+    s.execute("ANALYZE TABLE fact")
+    s.execute("ANALYZE TABLE probe")
+    return s
+
+
+def oracle(s, sql):
+    # force the hash-join path as the semantic oracle
+    import tidb_tpu.planner.physical as P
+    saved = P.INDEX_JOIN_OUTER_CAP
+    P.INDEX_JOIN_OUTER_CAP = -1
+    try:
+        s._plan_cache.clear()
+        return s.query(sql).rows
+    finally:
+        P.INDEX_JOIN_OUTER_CAP = saved
+        s._plan_cache.clear()
+
+
+def test_explain_picks_index_join(s):
+    rows = s.query("EXPLAIN SELECT p_tag, f_val FROM probe "
+                   "JOIN fact ON p_key = f_key").rows
+    txt = "\n".join(str(r) for r in rows)
+    assert "IndexLookupJoin" in txt, txt
+    assert "ix_fkey" in txt, txt
+    # the inner table is NOT scanned
+    assert "table:fact" not in txt, txt
+
+
+@pytest.mark.parametrize("sql", [
+    "SELECT p_tag, f_id, f_val FROM probe JOIN fact ON p_key = f_key",
+    "SELECT p_tag, f_val FROM probe LEFT JOIN fact ON p_key = f_key",
+    "SELECT p_tag FROM probe WHERE p_key IN (SELECT f_key FROM fact)",
+    "SELECT p_tag FROM probe WHERE p_key NOT IN "
+    "(SELECT f_key FROM fact WHERE f_val < 50)",
+    "SELECT p_tag, COUNT(*) FROM probe JOIN fact ON p_key = f_key "
+    "WHERE f_val < 30 GROUP BY p_tag",
+])
+def test_index_join_matches_hash_join(s, sql):
+    got = sorted(map(str, s.query(sql).rows))
+    want = sorted(map(str, oracle(s, sql)))
+    assert got == want
+
+
+def test_pk_point_join(s):
+    sql = ("SELECT p_tag, f_val FROM probe JOIN fact ON p_key = f_id")
+    rows = s.query("EXPLAIN " + sql).rows
+    txt = "\n".join(str(r) for r in rows)
+    assert "PRIMARY" in txt, txt
+    assert sorted(map(str, s.query(sql).rows)) == \
+        sorted(map(str, oracle(s, sql)))
+
+
+def test_multi_column_index_prefix(s):
+    s.execute("CREATE TABLE mc (a BIGINT, b BIGINT, c BIGINT, "
+              "d VARCHAR(8))")
+    s.execute("CREATE INDEX ix_ab ON mc (a, b)")
+    rng = np.random.default_rng(5)
+    rows = []
+    for i in range(20000):
+        a = int(rng.integers(0, 40))
+        b = "NULL" if rng.random() < 0.05 else str(int(rng.integers(0, 50)))
+        rows.append(f"({a},{b},{i},'x{i % 9}')")
+    s.execute("INSERT INTO mc VALUES " + ",".join(rows))
+    s.execute("ANALYZE TABLE mc")
+
+    q_eq = "SELECT c FROM mc WHERE a = 7 AND b = 11 ORDER BY c"
+    q_rng = "SELECT COUNT(*), SUM(c) FROM mc WHERE a = 3 AND b BETWEEN 10 AND 20"
+    q_half = "SELECT COUNT(*) FROM mc WHERE a = 9 AND d = 'x3'"
+    txt = "\n".join(str(r) for r in s.query("EXPLAIN " + q_eq).rows)
+    assert "ix_ab" in txt and "prefix" in txt, txt
+
+    view = s.query("SELECT a, b, c, d FROM mc").rows
+    want_eq = sorted(c for a, b, c, d in view if a == 7 and b == 11)
+    assert [r[0] for r in s.query(q_eq).rows] == want_eq
+    want = [(sum(1 for a, b, c, d in view
+                 if a == 3 and b is not None and 10 <= b <= 20),
+             sum(c for a, b, c, d in view
+                 if a == 3 and b is not None and 10 <= b <= 20))]
+    assert s.query(q_rng).rows == want
+    # prefix shorter than the index: leading-column access + residual
+    assert s.query(q_half).rows == \
+        [(sum(1 for a, b, c, d in view if a == 9 and d == "x3"),)]
+
+
+def test_multi_column_prefix_null_rows(s):
+    # rows with NULL at level 2 must match prefix-only probes but never
+    # an equality on the NULL level
+    s.execute("CREATE TABLE mcn (a BIGINT, b BIGINT)")
+    s.execute("CREATE INDEX ix_n ON mcn (a, b)")
+    s.execute("INSERT INTO mcn VALUES " +
+              ",".join(f"({i % 5}, NULL)" for i in range(2000)) + "," +
+              ",".join(f"({i % 5}, {i % 3})" for i in range(2000)))
+    s.execute("ANALYZE TABLE mcn")
+    assert s.query("SELECT COUNT(*) FROM mcn WHERE a = 2 AND b = 1"
+                   ).rows == [(133,)]
+    assert s.query("SELECT COUNT(*) FROM mcn WHERE a = 2 AND b IS NULL"
+                   ).rows == [(400,)]
+
+
+def test_large_outer_keeps_hash_join(s):
+    # outer too big for the lookup gate: hash join remains
+    rows = s.query("EXPLAIN SELECT COUNT(*) FROM fact f1 "
+                   "JOIN fact f2 ON f1.f_key = f2.f_key").rows
+    txt = "\n".join(str(r) for r in rows)
+    assert "IndexLookupJoin" not in txt, txt

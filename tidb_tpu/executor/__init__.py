@@ -316,6 +316,10 @@ def build(plan: PhysicalPlan) -> Executor:
     if isinstance(plan, PhysIndexScan):
         from tidb_tpu.executor.index_scan import IndexScanExec
         return IndexScanExec(plan)
+    from tidb_tpu.planner.physical import PhysIndexLookupJoin
+    if isinstance(plan, PhysIndexLookupJoin):
+        from tidb_tpu.executor.index_join import IndexLookupJoinExec
+        return IndexLookupJoinExec(plan, build(plan.children[0]))
     if isinstance(plan, PhysDual):
         return DualExec(plan.schema.field_types, plan.n_rows)
     kids = [build(c) for c in plan.children]

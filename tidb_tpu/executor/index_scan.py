@@ -50,25 +50,73 @@ class SortedIndex:
             if r.include_null:
                 hits.append(self.null_pos)
                 continue
-            lo = 0
-            if r.lo is not None:
-                lo = int(np.searchsorted(self.sorted_vals, r.lo,
-                                         side="left" if r.lo_incl
-                                         else "right"))
-            hi = len(self.sorted_vals)
-            if r.hi is not None:
-                hi = int(np.searchsorted(self.sorted_vals, r.hi,
-                                         side="right" if r.hi_incl
-                                         else "left"))
-            if hi > lo:
-                hits.append(self.sorted_pos[lo:hi])
-        if not hits:
-            return np.empty(0, dtype=np.int64)
-        out = np.concatenate(hits) if len(hits) > 1 else hits[0]
-        return np.sort(out, kind="stable")     # storage row order
+            hit = _range_window(self.sorted_vals, self.sorted_pos, 0,
+                                len(self.sorted_vals), r)
+            if hit is not None:
+                hits.append(hit)
+        return _merge_hits(hits)
+
+
+def _range_window(sorted_vals: np.ndarray, pos: np.ndarray, lo: int,
+                  hi: int, r: Range) -> Optional[np.ndarray]:
+    """Row positions of one value Range within sorted_vals[lo:hi]."""
+    l2 = lo
+    if r.lo is not None:
+        l2 = lo + int(np.searchsorted(
+            sorted_vals[lo:hi], r.lo, side="left" if r.lo_incl
+            else "right"))
+    h2 = hi
+    if r.hi is not None:
+        h2 = lo + int(np.searchsorted(
+            sorted_vals[lo:hi], r.hi, side="right" if r.hi_incl
+            else "left"))
+    return pos[l2:h2] if h2 > l2 else None
+
+
+def _merge_hits(hits: List[np.ndarray]) -> np.ndarray:
+    if not hits:
+        return np.empty(0, dtype=np.int64)
+    out = np.concatenate(hits) if len(hits) > 1 else hits[0]
+    return np.sort(out, kind="stable")     # storage row order
+
+
+class PrefixSortedIndex:
+    """Lexsorted view over an index column PREFIX (detacher.go's
+    multi-column ranges): probe narrows [lo, hi) level by level with two
+    binary searches per consumed column. NULLs at a level sort after that
+    level's values (filled with the level's max value), so candidate
+    windows may over-approximate — callers re-verify with the original
+    predicates, which keeps sentinel collisions harmless."""
+
+    __slots__ = ("td", "arrs", "pos", "view", "cols")
+
+    def __init__(self, td, arrs, pos, view, cols):
+        self.td = td
+        self.arrs = arrs               # per-level sorted value arrays
+        self.pos = pos                 # row position per sorted slot
+        self.view = view
+        self.cols = cols
+
+    def probe(self, prefix_vals: List, ranges: List[Range]) -> np.ndarray:
+        lo, hi = 0, len(self.pos)
+        for lev, v in enumerate(prefix_vals):
+            a = self.arrs[lev]
+            lo2 = lo + int(np.searchsorted(a[lo:hi], v, side="left"))
+            hi2 = lo + int(np.searchsorted(a[lo:hi], v, side="right"))
+            lo, hi = lo2, hi2
+            if lo >= hi:
+                return np.empty(0, dtype=np.int64)
+        a = self.arrs[len(prefix_vals)]
+        hits = []
+        for r in ranges:
+            hit = _range_window(a, self.pos, lo, hi, r)
+            if hit is not None:
+                hits.append(hit)
+        return _merge_hits(hits)
 
 
 _CACHE: "OrderedDict[Tuple, SortedIndex]" = OrderedDict()
+_PREFIX_CACHE: "OrderedDict[Tuple, PrefixSortedIndex]" = OrderedDict()
 # live view shared across every index of one table snapshot (a wide table
 # with 3 indexes must not hold 3 copies of its rows)
 _VIEW_CACHE: "OrderedDict[Tuple, Tuple]" = OrderedDict()
@@ -76,13 +124,86 @@ _VIEW_CACHE: "OrderedDict[Tuple, Tuple]" = OrderedDict()
 
 def clear():
     _CACHE.clear()
+    _PREFIX_CACHE.clear()
     _VIEW_CACHE.clear()
+
+
+def _fill_nulls(vals: np.ndarray, valid: np.ndarray):
+    """NULL slots → the level's max value so the lexsorted array stays
+    monotonic (collisions are resolved by caller-side re-verification)."""
+    if valid.all():
+        return vals
+    if vals.dtype == object:
+        filler = max((str(v) for v in vals[valid]), default="")
+        out = np.array([str(v) if ok else filler
+                        for v, ok in zip(vals, valid)], dtype=object)
+        return out
+    filler = vals[valid].max() if valid.any() else vals.dtype.type(0)
+    return np.where(valid, vals, filler)
+
+
+def get_prefix_index(ctx, table_id: int, col_idxs, table_info
+                     ) -> PrefixSortedIndex:
+    cacheable = getattr(ctx, "txn", None) is None
+    td = ctx.snapshot.table_data(table_id) if cacheable else None
+    store = getattr(ctx.snapshot, "store", None) if cacheable else None
+    key = (id(store), table_id, tuple(col_idxs)) if cacheable else None
+    ent = _PREFIX_CACHE.get(key) if cacheable else None
+    if ent is not None and ent.td is td and \
+            len(ent.view.columns) == len(table_info.columns):
+        _PREFIX_CACHE.move_to_end(key)
+        return ent
+    view = _live_view(ctx, table_id, table_info, cacheable, td, store)
+    ctx.check_killed()
+    keys = []
+    for ci in reversed(list(col_idxs)):     # np.lexsort: LAST is primary
+        col = view.columns[ci]
+        keys.append(_fill_nulls(col.values, col.valid_mask()))
+    order = np.lexsort(keys) if view.num_rows else \
+        np.empty(0, dtype=np.int64)
+    arrs = [k[order] for k in reversed(keys)]
+    ent = PrefixSortedIndex(td, arrs, order.astype(np.int64), view,
+                            tuple(col_idxs))
+    if cacheable:
+        _PREFIX_CACHE[key] = ent
+        while len(_PREFIX_CACHE) > MAX_CACHED_INDEXES:
+            _PREFIX_CACHE.popitem(last=False)
+    return ent
+
+
+def _live_view(ctx, table_id: int, table_info, cacheable, td,
+               store) -> Chunk:
+    vkey = (id(store), table_id) if cacheable else None
+    if cacheable:
+        hit = _VIEW_CACHE.get(vkey)
+        if hit is not None and hit[0] is td and \
+                len(hit[1].columns) == len(table_info.columns):
+            _VIEW_CACHE.move_to_end(vkey)
+            return hit[1]
+    from tidb_tpu.executor.scan import align_chunk_to_schema
+    live_chunks: List[Chunk] = []
+    for _region, chunk, alive in ctx.scan_table(table_id):
+        ctx.check_killed()
+        chunk = align_chunk_to_schema(chunk, table_info)
+        if alive.all():
+            live_chunks.append(chunk)
+        else:
+            live_chunks.append(chunk.take(np.nonzero(alive)[0]))
+    if live_chunks:
+        view = Chunk.concat(live_chunks) if len(live_chunks) > 1 \
+            else live_chunks[0]
+    else:
+        view = _empty_chunk([c.ftype for c in table_info.columns])
+    if cacheable:
+        _VIEW_CACHE[vkey] = (td, view)
+        while len(_VIEW_CACHE) > MAX_CACHED_INDEXES:
+            _VIEW_CACHE.popitem(last=False)
+    return view
 
 
 def get_index(ctx, table_id: int, col_idx: int, table_info) -> SortedIndex:
     """→ index over the read view. Inside a transaction the index is built
     transiently over the staged view (staged rows must be visible)."""
-    from tidb_tpu.executor.scan import align_chunk_to_schema
     cacheable = getattr(ctx, "txn", None) is None
     td = ctx.snapshot.table_data(table_id) if cacheable else None
     store = getattr(ctx.snapshot, "store", None) if cacheable else None
@@ -94,32 +215,7 @@ def get_index(ctx, table_id: int, col_idx: int, table_info) -> SortedIndex:
         _CACHE.move_to_end(key)
         return ent
 
-    vkey = (id(store), table_id) if cacheable else None
-    view = None
-    if cacheable:
-        hit = _VIEW_CACHE.get(vkey)
-        if hit is not None and hit[0] is td and \
-                len(hit[1].columns) == len(table_info.columns):
-            _VIEW_CACHE.move_to_end(vkey)
-            view = hit[1]
-    if view is None:
-        live_chunks: List[Chunk] = []
-        for _region, chunk, alive in ctx.scan_table(table_id):
-            ctx.check_killed()
-            chunk = align_chunk_to_schema(chunk, table_info)
-            if alive.all():
-                live_chunks.append(chunk)
-            else:
-                live_chunks.append(chunk.take(np.nonzero(alive)[0]))
-        if live_chunks:
-            view = Chunk.concat(live_chunks) if len(live_chunks) > 1 \
-                else live_chunks[0]
-        else:
-            view = _empty_chunk([c.ftype for c in table_info.columns])
-        if cacheable:
-            _VIEW_CACHE[vkey] = (td, view)
-            while len(_VIEW_CACHE) > MAX_CACHED_INDEXES:
-                _VIEW_CACHE.popitem(last=False)
+    view = _live_view(ctx, table_id, table_info, cacheable, td, store)
     ctx.check_killed()
     col = view.columns[col_idx]
     vals, valid = col.values, col.valid_mask()
@@ -149,8 +245,15 @@ class IndexScanExec(MaterializingExec):
 
     def _materialize(self) -> Chunk:
         plan = self.plan
-        ent = get_index(self.ctx, plan.table.id, plan.key_col, plan.table)
-        rows = ent.probe(plan.ranges)
+        key_cols = getattr(plan, "key_cols", None)
+        if key_cols and len(key_cols) > 1:
+            ent = get_prefix_index(self.ctx, plan.table.id, key_cols,
+                                   plan.table)
+            rows = ent.probe(list(plan.prefix_vals), plan.ranges)
+        else:
+            ent = get_index(self.ctx, plan.table.id, plan.key_col,
+                            plan.table)
+            rows = ent.probe(plan.ranges)
         if not len(rows):
             return _empty_chunk(self.schema)
         out = ent.view.take(rows)

@@ -79,7 +79,8 @@ class PhysIndexScan(PhysicalPlan):
     run after the gather."""
 
     def __init__(self, ds: LogicalDataSource, key_col: int,
-                 index_name: str, ranges, residual):
+                 index_name: str, ranges, residual,
+                 key_cols=None, prefix_vals=()):
         super().__init__(ds.schema)
         self.table = ds.table
         self.alias = ds.alias
@@ -87,12 +88,18 @@ class PhysIndexScan(PhysicalPlan):
         self.index_name = index_name
         self.ranges = ranges
         self.residual = residual
+        # multi-column prefix access (util/ranger/detacher.go): leading
+        # columns pinned to prefix_vals, ranges over key_cols[len(prefix)]
+        self.key_cols = key_cols          # None → single-column index
+        self.prefix_vals = list(prefix_vals)
         self.used_columns = ds.used_columns
         self.filters = []          # scan-compat (fragment gate reads this)
 
     def describe(self):
-        s = (f"table:{self.table.name}, index:{self.index_name}, "
-             f"ranges:{self.ranges!r}")
+        s = (f"table:{self.table.name}, index:{self.index_name}, ")
+        if self.key_cols and len(self.key_cols) > 1:
+            s += f"prefix:{self.prefix_vals!r}, "
+        s += f"ranges:{self.ranges!r}"
         if self.residual:
             s += f", residual:{self.residual!r}"
         return s
@@ -151,6 +158,29 @@ class PhysHashJoin(PhysicalPlan):
                 f"equi:{self.equi}" +
                 (f", other:{self.other_conditions}"
                  if self.other_conditions else ""))
+
+
+class PhysIndexLookupJoin(PhysicalPlan):
+    """Small-outer equi join probing the inner table's sorted index
+    instead of scanning it (ref: executor/index_lookup_join.go:59).
+    children[0] is the outer (probe, preserved) side; the inner table is
+    accessed only at matched positions."""
+
+    def __init__(self, kind, outer, inner_table, inner_key_col: int,
+                 index_name: str, outer_key, inner_filters,
+                 other_conditions, schema):
+        super().__init__(schema, [outer])
+        self.kind = kind                  # inner | left | semi | anti
+        self.inner_table = inner_table
+        self.inner_key_col = inner_key_col
+        self.index_name = index_name
+        self.outer_key = outer_key        # expr over the outer schema
+        self.inner_filters = inner_filters
+        self.other_conditions = other_conditions
+
+    def describe(self):
+        return (f"{self.kind} join, inner:{self.inner_table.name} "
+                f"index:{self.index_name}, key:{self.outer_key!r}")
 
 
 class PhysWindow(PhysicalPlan):
@@ -351,7 +381,10 @@ def estimate(plan: PhysicalPlan, ctx) -> float:
     the device engine then trusts est_rows for its initial group cap."""
     if isinstance(plan, PhysIndexScan):
         n = plan.est_rows        # set by _try_index_access from ranges
-        if plan.residual:
+        if plan.residual and not (plan.key_cols and
+                                  len(plan.key_cols) > 1):
+            # multi-column paths keep the FULL filter set as re-verify
+            # residual; its selectivity is already in the range estimate
             from tidb_tpu.statistics import filters_selectivity
             stats = _table_stats(plan.table, ctx)
             n *= filters_selectivity(plan.residual, stats)
@@ -411,6 +444,22 @@ def estimate(plan: PhysicalPlan, ctx) -> float:
             out = max(l * r / denom if plan.equi else max(l, r), 1.0)
             if plan.kind in ("left", "right"):
                 out = max(out, l if plan.kind == "left" else r)
+    elif isinstance(plan, PhysIndexLookupJoin):
+        l = kids[0]
+        if plan.kind in ("semi", "anti"):
+            out = max(l * 0.5, 1.0)
+        else:
+            from tidb_tpu.statistics import column_ndv, filters_selectivity
+            inner_n = float(_table_rows(plan.inner_table, ctx))
+            stats = _table_stats(plan.inner_table, ctx)
+            if plan.inner_filters:
+                inner_n *= filters_selectivity(plan.inner_filters, stats)
+            ndv = column_ndv(stats, plan.inner_key_col, -1.0) \
+                if stats is not None else -1.0
+            per_key = inner_n / ndv if ndv and ndv > 0 else 1.0
+            out = max(l * max(per_key, 0.001), 1.0)
+            if plan.kind == "left":
+                out = max(out, l)
     elif isinstance(plan, (PhysTopN, PhysLimit)):
         out = float(min(kids[0], plan.count + plan.offset))
     elif isinstance(plan, PhysUnionAll):
@@ -463,6 +512,68 @@ def _distribute_fragments(plan: PhysicalPlan, n_shards: int,
         _distribute_fragments(c, n_shards, threshold)
 
 
+INDEX_JOIN_OUTER_CAP = 4096       # max outer rows for index-lookup join
+INDEX_JOIN_RATIO = 16.0           # inner must be ≥ this × outer
+
+
+def _try_index_join(join: LogicalJoin, left: PhysicalPlan,
+                    right: PhysicalPlan, lrows: float, rrows: float,
+                    ctx) -> Optional[PhysIndexLookupJoin]:
+    """Index nested-loop join when the outer side is tiny and the inner
+    side is a scan with an index on the (uncast) join key — probing beats
+    a full inner scan (find_best_task.go's index-join enumeration,
+    cost-gated on the outer estimate)."""
+    if join.kind not in ("inner", "left", "semi", "anti"):
+        return None
+    if len(join.equi) != 1 or join.other_conditions and \
+            any(is_corr(c) for c in join.other_conditions or []):
+        return None
+    if not isinstance(right, PhysTableScan):
+        return None
+    if lrows > INDEX_JOIN_OUTER_CAP or rrows < lrows * INDEX_JOIN_RATIO:
+        return None
+    from tidb_tpu.executor.join import coerce_key_pair
+    le, re = join.equi[0]
+    # string vs numeric keys compare NUMERICALLY in MySQL; the raw index
+    # probe can't serve that (coerce_key_pair passes strings through)
+    if le.ftype.kind.is_string != re.ftype.kind.is_string:
+        return None
+    lc, rc = coerce_key_pair(le, re)
+    # the index stores RAW values: the inner side must need no cast
+    if rc is not re or not isinstance(re, ColumnRef):
+        return None
+    table = right.table
+    idx_name = None
+    col_name = table.columns[re.index].name.lower() \
+        if re.index < len(table.columns) else None
+    if col_name is None:
+        return None
+    if table.primary_key and table.primary_key[0].lower() == col_name:
+        idx_name = "PRIMARY"
+    else:
+        for ix in getattr(table, "indexes", []):
+            if ix.columns[0].lower() == col_name:
+                idx_name = ix.name
+                break
+    if idx_name is None:
+        return None
+    # other conditions index the concatenated (outer ++ inner) schema —
+    # exactly the joined-chunk layout the executor evaluates them on
+    if join.kind in ("semi", "anti"):
+        schema = Schema(list(left.schema.columns))
+    else:
+        schema = Schema.concat(left.schema, right.schema)
+    out = PhysIndexLookupJoin(join.kind, left, table, re.index, idx_name,
+                              lc, list(right.filters),
+                              list(join.other_conditions or []), schema)
+    return out
+
+
+def is_corr(e) -> bool:
+    from tidb_tpu.expression import CorrelatedRef
+    return any(isinstance(s, CorrelatedRef) for s in e.walk())
+
+
 INDEX_SELECTIVITY_GATE = 0.15     # index path only below this fraction
 
 
@@ -481,12 +592,15 @@ def _index_candidates(table) -> List:
 def _try_index_access(ds: LogicalDataSource, ctx) -> Optional[PhysIndexScan]:
     """Cost gate (find_best_task.go skyline-lite): point access on a
     unique key always wins; range access needs stats showing the ranges
-    select under INDEX_SELECTIVITY_GATE of the table."""
+    select under INDEX_SELECTIVITY_GATE of the table. Multi-column
+    indexes try prefix derivation first (detacher.go) and re-verify the
+    full filter set on the gathered rows."""
     if not ds.filters:
         return None
     from tidb_tpu.planner.ranger import detach_ranges
     stats = _table_stats(ds.table, ctx)
     total = max(_table_rows(ds.table, ctx), 1)
+    multi = _try_multi_col_index(ds, ctx, stats, total)
     best = None
     for col_name, index_name, unique in _index_candidates(ds.table):
         try:
@@ -520,11 +634,73 @@ def _try_index_access(ds: LogicalDataSource, ctx) -> Optional[PhysIndexScan]:
             est = frac * total
         if best is None or est < best[0]:
             best = (est, col_idx, index_name, ranges, residual)
+    if best is not None and (multi is None or best[0] <= multi.est_rows):
+        est, col_idx, index_name, ranges, residual = best
+        scan = PhysIndexScan(ds, col_idx, index_name, ranges, residual)
+        scan.est_rows = max(est, 1.0)
+        return scan
+    return multi
+
+
+def _try_multi_col_index(ds: LogicalDataSource, ctx, stats,
+                         total: int) -> Optional[PhysIndexScan]:
+    from tidb_tpu.planner.ranger import detach_prefix_ranges
+    col_of = {c.name.lower(): i for i, c in enumerate(ds.table.columns)}
+    cands = []
+    if ds.table.primary_key and len(ds.table.primary_key) > 1:
+        cands.append(("PRIMARY", ds.table.primary_key))
+    for ix in getattr(ds.table, "indexes", []):
+        if len(ix.columns) > 1:
+            cands.append((ix.name, ix.columns))
+    best = None
+    for name, col_names in cands:
+        try:
+            idxs = [col_of[c.lower()] for c in col_names]
+        except KeyError:
+            continue
+        prefix, ranges, leftover = detach_prefix_ranges(ds.filters, idxs)
+        if ranges is None or (not prefix and len(ranges) == 1
+                              and ranges[0].lo is None
+                              and ranges[0].hi is None):
+            continue
+        n_used = len(prefix) + 1
+        if n_used < 2:
+            continue               # single-col candidates handle this
+        frac = 1.0
+        for lev, v in enumerate(prefix):
+            cs = stats.columns.get(idxs[lev]) if stats is not None else None
+            frac *= cs.eq_selectivity(v) if cs is not None else 0.1
+        range_frac = 0.0
+        cs = stats.columns.get(idxs[len(prefix)]) if stats is not None \
+            else None
+        for r in ranges:
+            if cs is None:
+                range_frac += 0.1
+            elif r.lo == r.hi and r.lo is not None:
+                range_frac += cs.eq_selectivity(r.lo)
+            else:
+                range_frac += cs.range_selectivity(r.lo, r.hi, r.lo_incl,
+                                                   r.hi_incl)
+        frac *= min(range_frac, 1.0)
+        if frac > INDEX_SELECTIVITY_GATE:
+            continue
+        # conjuncts the prefix didn't consume still narrow the estimate
+        # (the re-verify residual is the FULL set; est must not skip them)
+        if leftover:
+            from tidb_tpu.statistics import filters_selectivity
+            frac *= filters_selectivity(leftover, stats)
+        est = max(frac * total, 1.0)
+        if best is None or est < best[0]:
+            best = (est, idxs[:n_used], name, prefix, ranges)
     if best is None:
         return None
-    est, col_idx, index_name, ranges, residual = best
-    scan = PhysIndexScan(ds, col_idx, index_name, ranges, residual)
-    scan.est_rows = max(est, 1.0)
+    est, key_cols, name, prefix, ranges = best
+    # the prefix probe over-approximates (NULL-sentinel fill): the FULL
+    # original filter set re-verifies on the gathered rows
+    scan = PhysIndexScan(ds, key_cols[0], name, ranges,
+                         list(ds.filters), key_cols=key_cols,
+                         prefix_vals=prefix)
+    scan.est_rows = est
     return scan
 
 
@@ -547,6 +723,9 @@ def _to_physical(plan: LogicalPlan, ctx) -> PhysicalPlan:
         left, right = kids
         lrows = estimate(left, ctx)
         rrows = estimate(right, ctx)
+        ilj = _try_index_join(plan, left, right, lrows, rrows, ctx)
+        if ilj is not None:
+            return ilj
         if plan.kind in ("left", "semi", "anti"):
             build_right = True    # probe the outer side
         elif plan.kind == "right":

@@ -105,6 +105,44 @@ def _intersect(r: Range, lo=None, hi=None, lo_incl=True, hi_incl=True
     return out
 
 
+def detach_prefix_ranges(filters: Sequence[Expression],
+                         col_idxs: Sequence[int]):
+    """Multi-column index prefix derivation (ref: util/ranger/detacher.go
+    detachCNFCondAndBuildRangeForIndex): leading index columns consume
+    single-point equalities, the first column without one carries the
+    ranges.
+
+    → (eq_prefix raw values, ranges over column col_idxs[len(eq_prefix)],
+       residual) — or (None, None, filters) when even the first column is
+    unconstrained. IS-NULL points don't compose across columns here, so a
+    NULL range at any level returns unconstrained (the single-column path
+    still serves `col IS NULL`)."""
+    cur: List[Expression] = list(filters)
+    prefix: List[object] = []
+    for level, ci in enumerate(col_idxs):
+        ranges, residual = detach_ranges(cur, ci)
+        if ranges is None:
+            break
+        if any(r.include_null for r in ranges):
+            return None, None, list(filters)
+        if not ranges:                 # unsatisfiable conjunction
+            return prefix, [], residual
+        single_eq = (len(ranges) == 1 and ranges[0].lo is not None
+                     and ranges[0].lo == ranges[0].hi
+                     and ranges[0].lo_incl and ranges[0].hi_incl)
+        if single_eq and level + 1 < len(col_idxs):
+            prefix.append(ranges[0].lo)
+            cur = residual
+            continue
+        return prefix, ranges, residual
+    if not prefix:
+        return None, None, list(filters)
+    # every consumed level was an equality; the deepest one becomes the
+    # range level so the probe has a final search window
+    last = prefix.pop()
+    return prefix, [Range(last, last)], cur
+
+
 def detach_ranges(filters: Sequence[Expression], col_idx: int
                   ) -> Tuple[Optional[List[Range]], List[Expression]]:
     """→ (ranges or None if the column is unconstrained, residual filters).
