@@ -1,0 +1,152 @@
+"""Minimal MySQL-protocol client (text path).
+
+The reference ships tools (dumpling, br) that reach the cluster through
+stock MySQL drivers; no driver ships in this image, so this is the
+in-repo equivalent — handshake with mysql_native_password, COM_QUERY,
+text resultset decoding. Used by tidb_tpu.tools (dump/CSV CLIs) and
+available as a programmatic driver for the wire server."""
+
+from __future__ import annotations
+
+import hashlib
+import socket
+import struct
+from typing import List, Optional, Tuple
+
+
+class ClientError(RuntimeError):
+    def __init__(self, code: int, msg: str):
+        super().__init__(f"ERROR {code}: {msg}")
+        self.code = code
+
+
+def _scramble(password: str, salt: bytes) -> bytes:
+    if not password:
+        return b""
+    sha_pw = hashlib.sha1(password.encode()).digest()
+    stage2 = hashlib.sha1(sha_pw).digest()
+    mix = hashlib.sha1(salt + stage2).digest()
+    return bytes(a ^ b for a, b in zip(sha_pw, mix))
+
+
+class Client:
+    def __init__(self, host: str = "127.0.0.1", port: int = 4000,
+                 user: str = "root", password: str = "",
+                 timeout: float = 30.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.seq = 0
+        self._handshake(user, password)
+
+    # -- framing -------------------------------------------------------------
+    def _recv(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            part = self.sock.recv(n - len(buf))
+            if not part:
+                raise ClientError(2013, "server closed connection")
+            buf += part
+        return buf
+
+    def _read_packet(self) -> bytes:
+        h = self._recv(4)
+        ln = h[0] | (h[1] << 8) | (h[2] << 16)
+        self.seq = (h[3] + 1) & 0xFF
+        return self._recv(ln) if ln else b""
+
+    def _write_packet(self, payload: bytes) -> None:
+        self.sock.sendall(struct.pack("<I", len(payload))[:3]
+                          + bytes([self.seq]) + payload)
+        self.seq = (self.seq + 1) & 0xFF
+
+    @staticmethod
+    def _lenenc(data: bytes, i: int) -> Tuple[int, int]:
+        c = data[i]
+        if c < 251:
+            return c, i + 1
+        if c == 0xFC:
+            return data[i + 1] | (data[i + 2] << 8), i + 3
+        if c == 0xFD:
+            return int.from_bytes(data[i + 1:i + 4], "little"), i + 4
+        return int.from_bytes(data[i + 1:i + 9], "little"), i + 9
+
+    # -- protocol ------------------------------------------------------------
+    def _handshake(self, user: str, password: str) -> None:
+        g = self._read_packet()
+        if g and g[0] == 0xFF:
+            code = struct.unpack("<H", g[1:3])[0]
+            raise ClientError(code, g[9:].decode(errors="replace"))
+        i = g.index(b"\x00", 1) + 1
+        i += 4
+        salt = g[i:i + 8]
+        i += 9 + 2 + 1 + 2 + 2 + 1 + 10
+        salt += g[i:i + 12]
+        token = _scramble(password, salt)
+        caps = 0x0200 | 0x8000 | 0x1
+        resp = (struct.pack("<I", caps) + struct.pack("<I", 1 << 24)
+                + bytes([0xFF]) + b"\x00" * 23
+                + user.encode() + b"\x00"
+                + bytes([len(token)]) + token)
+        self._write_packet(resp)
+        ok = self._read_packet()
+        if ok[0] != 0x00:
+            code = struct.unpack("<H", ok[1:3])[0]
+            raise ClientError(code, ok[9:].decode(errors="replace"))
+
+    def query(self, sql: str) -> Tuple[List[str], List[Tuple]]:
+        """→ (column names, rows) for queries; ([], []) for OK packets.
+        Every value arrives as str or None (text protocol)."""
+        self.seq = 0
+        self._write_packet(b"\x03" + sql.encode())
+        first = self._read_packet()
+        if first[0] == 0xFF:
+            code = struct.unpack("<H", first[1:3])[0]
+            raise ClientError(code, first[9:].decode(errors="replace"))
+        if first[0] == 0x00:
+            return [], []
+        ncols, _ = self._lenenc(first, 0)
+        names = []
+        for _ in range(ncols):
+            col = self._read_packet()
+            i = 0
+            parts = []
+            for _f in range(6):
+                ln, i = self._lenenc(col, i)
+                parts.append(col[i:i + ln])
+                i += ln
+            names.append(parts[4].decode())
+        assert self._read_packet()[0] == 0xFE
+        rows: List[Tuple] = []
+        while True:
+            pkt = self._read_packet()
+            if pkt and pkt[0] == 0xFE and len(pkt) < 9:
+                break
+            i = 0
+            row = []
+            while i < len(pkt):
+                if pkt[i] == 0xFB:
+                    row.append(None)
+                    i += 1
+                else:
+                    ln, i = self._lenenc(pkt, i)
+                    row.append(pkt[i:i + ln].decode())
+                    i += ln
+            rows.append(tuple(row))
+        return names, rows
+
+    def execute(self, sql: str) -> None:
+        self.query(sql)
+
+    def close(self) -> None:
+        try:
+            self.seq = 0
+            self._write_packet(b"\x01")
+        except Exception:  # noqa: BLE001
+            pass
+        finally:
+            self.sock.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -337,6 +337,16 @@ class AnalyzeTable(StmtNode):
 
 
 @dataclass
+class BackupStmt(StmtNode):
+    path: str
+
+
+@dataclass
+class RestoreStmt(StmtNode):
+    path: str
+
+
+@dataclass
 class CreateUser(StmtNode):
     user: str
     password: str = ""

@@ -130,6 +130,18 @@ class Parser:
             if self.toks[self.i + 1].is_kw("user"):
                 return self.drop_user()
             return self.drop_table()
+        if self.at_kw("backup"):
+            self.advance()
+            self.expect_kw("to")
+            if not self.at("str"):
+                raise ParseError(f"expected path string near {self._near()}")
+            return ast.BackupStmt(self.advance().value)
+        if self.at_kw("restore"):
+            self.advance()
+            self.expect_kw("from")
+            if not self.at("str"):
+                raise ParseError(f"expected path string near {self._near()}")
+            return ast.RestoreStmt(self.advance().value)
         if self.at_kw("grant"):
             return self.grant_stmt()
         if self.at_kw("revoke"):

@@ -314,11 +314,12 @@ class Session:
         auth = self.engine.auth
         if auth.is_superuser(self.user):
             return
-        if isinstance(stmt, (ast.CreateUser, ast.DropUser, ast.GrantStmt)):
+        if isinstance(stmt, (ast.CreateUser, ast.DropUser, ast.GrantStmt,
+                             ast.BackupStmt, ast.RestoreStmt)):
             from tidb_tpu.session.auth import PrivilegeError
             raise PrivilegeError(
                 f"Access denied for user '{self.user}'@'%' "
-                f"(user administration requires ALL on *.*)")
+                f"(this operation requires ALL on *.*)")
         if isinstance(stmt, (ast.SelectStmt, ast.SetOpStmt, ast.WithStmt)):
             for t in _stmt_tables(stmt):
                 auth.require(self.user, "SELECT", t)
@@ -344,6 +345,16 @@ class Session:
         self._check_privileges(stmt)
         if isinstance(stmt, self._DDL_STMTS):
             self._implicit_commit()
+        if isinstance(stmt, ast.BackupStmt):
+            from tidb_tpu import tools
+            done = tools.backup(self.engine, stmt.path)
+            return ResultSet(["Table"], [T.varchar()],
+                             [(t,) for t in done])
+        if isinstance(stmt, ast.RestoreStmt):
+            from tidb_tpu import tools
+            done = tools.restore(self.engine, stmt.path)
+            return ResultSet(["Table"], [T.varchar()],
+                             [(t,) for t in done])
         if isinstance(stmt, ast.CreateUser):
             self.engine.auth.create_user(stmt.user, stmt.password,
                                          stmt.if_not_exists)
@@ -897,10 +908,10 @@ class Session:
                              [T.varchar(), T.varchar()], rows)
         if stmt.kind == "create_table":
             t = info_schema.table(stmt.target)
-            body = ",\n  ".join(f"`{c.name}` {c.ftype}" for c in t.columns)
-            ddl = f"CREATE TABLE `{t.name}` (\n  {body}\n)"
+            from tidb_tpu.tools import create_table_sql
             return ResultSet(["Table", "Create Table"],
-                             [T.varchar(), T.varchar()], [(t.name, ddl)])
+                             [T.varchar(), T.varchar()],
+                             [(t.name, create_table_sql(t))])
         if stmt.kind == "indexes":
             t = info_schema.table(stmt.target)
             rows = [(t.name, ix.name, ",".join(ix.columns),

@@ -1,0 +1,60 @@
+"""CLI for the ecosystem tools (ref: dumpling/main.go, br CLI).
+
+    python -m tidb_tpu.tools dump       --host H --port P -o DIR [tables…]
+    python -m tidb_tpu.tools export-csv --host H --port P -t TABLE -o FILE
+    python -m tidb_tpu.tools serve-demo            # throwaway server
+
+Backup/restore are engine-side (SQL `BACKUP TO '...'` / `RESTORE FROM
+'...'` or the tidb_tpu.tools library API): the backing store lives inside
+the server process, exactly like BR reaches the cluster through it."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tidb_tpu import tools
+from tidb_tpu.client import Client
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tidb_tpu.tools")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    d = sub.add_parser("dump", help="logical SQL dump (dumpling)")
+    d.add_argument("tables", nargs="*")
+    d.add_argument("-o", "--out", required=True)
+
+    e = sub.add_parser("export-csv")
+    e.add_argument("-t", "--table", required=True)
+    e.add_argument("-o", "--out", required=True)
+
+    i = sub.add_parser("import-csv")
+    i.add_argument("-t", "--table", required=True)
+    i.add_argument("-i", "--infile", required=True)
+
+    for p in (d, e, i):
+        p.add_argument("--host", default="127.0.0.1")
+        p.add_argument("--port", type=int, default=4000)
+        p.add_argument("-u", "--user", default="root")
+        p.add_argument("-p", "--password", default="")
+
+    args = ap.parse_args(argv)
+    with Client(args.host, args.port, args.user, args.password) as c:
+        if args.cmd == "dump":
+            done = tools.dump_sql(c, args.out, args.tables or None)
+            print(f"dumped {len(done)} table(s) to {args.out}")
+        elif args.cmd == "export-csv":
+            n = tools.export_csv(c, args.table, args.out)
+            print(f"exported {n} row(s)")
+        elif args.cmd == "import-csv":
+            class _SessionShim:
+                def execute(self, sql):
+                    c.execute(sql)
+            n = tools.import_csv(_SessionShim(), args.table, args.infile)
+            print(f"imported {n} row(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
