@@ -1,0 +1,62 @@
+"""TRACE statement + optimizer trace + per-operator spans
+(ref: executor/trace.go, util/tracing/opt_trace.go, the per-executor
+spans of executor.go:278)."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.session import Engine
+
+
+@pytest.fixture(scope="module")
+def s():
+    eng = Engine()
+    s = eng.new_session()
+    s.execute("CREATE TABLE tr (a BIGINT, b BIGINT)")
+    s.execute("INSERT INTO tr VALUES " +
+              ",".join(f"({i},{i % 5})" for i in range(1000)))
+    s.execute("ANALYZE TABLE tr")
+    return s
+
+
+def test_trace_select_renders_span_tree(s):
+    rs = s.query("TRACE SELECT b, COUNT(*) FROM tr WHERE a > 10 "
+                 "GROUP BY b ORDER BY b")
+    assert rs.names[0] == "operation"
+    ops = [r[0] for r in rs.rows]
+    text = "\n".join(ops)
+    # session + planner + executor phases
+    assert any("session.run" in o for o in ops), text
+    assert any("planner.optimize" in o for o in ops), text
+    assert any("executor.run" in o for o in ops), text
+    # optimizer trace: rewrite rules appear as child spans
+    assert any("rule.predicate_pushdown" in o for o in ops), text
+    assert any("rule.constant_folding" in o for o in ops), text
+    # per-operator spans with row counts
+    assert any("op.HashAggExec" in o or "op.TpuFragmentExec" in o
+               for o in ops), text
+    # durations parse as numbers and nest under the root
+    for _, start, dur in rs.rows:
+        float(start), float(dur)
+
+
+def test_trace_dml(s):
+    rs = s.query("TRACE INSERT INTO tr VALUES (10000, 1)")
+    ops = [r[0] for r in rs.rows]
+    assert any("session.run" in o for o in ops)
+    # the insert actually happened
+    assert s.query("SELECT COUNT(*) FROM tr WHERE a = 10000").rows == [(1,)]
+
+
+def test_trace_has_no_effect_outside_trace(s):
+    # a plain query right after TRACE carries no tracer
+    s.query("TRACE SELECT COUNT(*) FROM tr")
+    assert s._tracer is None
+    assert s.query("SELECT COUNT(*) FROM tr").rows[0][0] >= 1000
+
+
+def test_operator_spans_report_rows(s):
+    rs = s.query("TRACE SELECT * FROM tr WHERE b = 2")
+    op_rows = [r for r in rs.rows if r[0].strip().startswith("└─op.")
+               or "op." in r[0]]
+    assert any("rows=" in r[0] for r in op_rows), rs.rows

@@ -46,6 +46,7 @@ class ExecContext:
         # session; tidb_mem_quota_query, 0 = unlimited)
         quota = int(self.vars.get("tidb_mem_quota_query", 0) or 0)
         self.mem_tracker = Tracker("query", quota)
+        self.tracer = None         # Tracer while TRACE runs (trace.go)
 
     @property
     def chunk_size(self) -> int:

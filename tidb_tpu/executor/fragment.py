@@ -582,8 +582,13 @@ class TpuFragmentExec:
             strict = _var_bool(self.ctx.vars.get("tidb_tpu_strict", False))
             try:
                 import time as _time
+
+                from tidb_tpu.util.tracing import maybe_span
                 _t0 = _time.perf_counter()
-                self._result = self._run_device()
+                with maybe_span(getattr(self.ctx, "tracer", None),
+                                "device.fragment",
+                                root=self.plan.root.name):
+                    self._result = self._run_device()
                 global LAST_DEVICE_EXEC_S
                 LAST_DEVICE_EXEC_S = _time.perf_counter() - _t0
                 self.used_device = True

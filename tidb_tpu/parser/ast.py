@@ -337,6 +337,11 @@ class AnalyzeTable(StmtNode):
 
 
 @dataclass
+class TraceStmt(StmtNode):
+    stmt: StmtNode
+
+
+@dataclass
 class BackupStmt(StmtNode):
     path: str
 

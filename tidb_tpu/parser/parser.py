@@ -162,6 +162,9 @@ class Parser:
             self.advance()
             analyze = bool(self.try_kw("analyze"))
             return ast.Explain(self.statement(), analyze)
+        if self.at_kw("trace"):
+            self.advance()
+            return ast.TraceStmt(self.statement())
         if self.at_kw("set"):
             return self.set_stmt()
         if self.at_kw("show"):

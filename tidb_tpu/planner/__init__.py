@@ -31,5 +31,9 @@ def optimize_logical(logical, ctx):
     """Logical plan → physical plan (rules + engine-tagged physical);
     lets callers that already built a logical plan — the decorrelator's
     uncorrelated-subquery path — skip the AST rebuild."""
-    logical = logical_optimize(logical, ctx)
-    return physical_optimize(logical, ctx)
+    from tidb_tpu.util.tracing import maybe_span
+    tr = getattr(ctx, "tracer", None)
+    with maybe_span(tr, "optimize.logical"):
+        logical = logical_optimize(logical, ctx)
+    with maybe_span(tr, "optimize.physical"):
+        return physical_optimize(logical, ctx)

@@ -31,11 +31,18 @@ from tidb_tpu.planner.logical import (LogicalAggregation, LogicalDataSource,
 
 
 def logical_optimize(plan: LogicalPlan, ctx=None) -> LogicalPlan:
-    plan = fold_constants_plan(plan)
-    plan = push_predicates(plan)
-    plan = reorder_joins(plan, ctx)
-    plan = fuse_topn(plan)
-    mark_used_columns(plan)
+    from tidb_tpu.util.tracing import maybe_span
+    tr = getattr(ctx, "tracer", None)   # optimizer trace (opt_trace.go)
+    with maybe_span(tr, "rule.constant_folding"):
+        plan = fold_constants_plan(plan)
+    with maybe_span(tr, "rule.predicate_pushdown"):
+        plan = push_predicates(plan)
+    with maybe_span(tr, "rule.join_reorder"):
+        plan = reorder_joins(plan, ctx)
+    with maybe_span(tr, "rule.topn_fusion"):
+        plan = fuse_topn(plan)
+    with maybe_span(tr, "rule.column_pruning"):
+        mark_used_columns(plan)
     return plan
 
 

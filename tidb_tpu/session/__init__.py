@@ -140,6 +140,20 @@ def _stmt_tables(stmt) -> List[str]:
     return names
 
 
+def _operator_spans(tr, exec_root, depth: int = 0) -> None:
+    """Per-operator durations from runtime stats rendered as trace
+    events (the executor Next-wrapper spans of executor.go:278)."""
+    name = type(exec_root).__name__
+    info = ""
+    fn = getattr(exec_root, "runtime_info", None)
+    if fn is not None:
+        info = fn() or ""
+    tr.event(f"op.{name}", exec_root.stats.wall_ns / 1e9,
+             rows=exec_root.stats.rows, **({"info": info} if info else {}))
+    for c in getattr(exec_root, "children", []):
+        _operator_spans(tr, c, depth + 1)
+
+
 class Engine:
     """Process-wide catalog + storage owner (the Domain analog)."""
 
@@ -167,6 +181,7 @@ class _PlanContext:
         self.session = session
         self.subquery_evaluator = session._subquery_evaluator()
         self.cte_map = dict(getattr(session, "_cte_map", {}) or {})
+        self.tracer = session._tracer     # optimizer-trace sink
 
     def table_row_count(self, table_id: int) -> int:
         # exact live rows from the columnar store — cheap and fresher than
@@ -229,6 +244,7 @@ class Session:
         self._plan_cache: "OrderedDict[tuple, object]" = OrderedDict()
         self._subq_execs = 0
         self._current_sql: Optional[str] = None
+        self._tracer = None        # set while a TRACE statement runs
 
     # ---- public API --------------------------------------------------------
     def execute(self, sql: str) -> List[ResultSet]:
@@ -345,6 +361,8 @@ class Session:
         self._check_privileges(stmt)
         if isinstance(stmt, self._DDL_STMTS):
             self._implicit_commit()
+        if isinstance(stmt, ast.TraceStmt):
+            return self._trace(stmt)
         if isinstance(stmt, ast.BackupStmt):
             from tidb_tpu import tools
             done = tools.backup(self.engine, stmt.path)
@@ -527,11 +545,34 @@ class Session:
                 str(v.get("tidb_tpu_dist_devices", 0)),
                 self.user)
 
+    def _trace(self, stmt) -> ResultSet:
+        """TRACE <stmt>: run it with a span recorder attached and return
+        the span tree (ref: executor/trace.go)."""
+        from tidb_tpu.util.tracing import Tracer
+        self._tracer = Tracer()
+        try:
+            with self._tracer.span("session.run"):
+                self._execute_stmt(stmt.stmt)
+            rows = self._tracer.rows()
+        finally:
+            self._tracer = None
+        return ResultSet(["operation", "startTS(us)", "duration(us)"],
+                         [T.varchar(), T.varchar(), T.varchar()], rows)
+
     def _run_query_chunks(self, stmt, want_root: bool = False):
-        plan = self._plan(stmt)
+        from tidb_tpu.util.tracing import maybe_span
+        tr = self._tracer
+        with maybe_span(tr, "planner.optimize"):
+            plan = self._plan(stmt)
         self.last_plan = plan
-        exec_root = build(plan)
-        chunks = run_to_completion(exec_root, self._exec_ctx())
+        with maybe_span(tr, "executor.build"):
+            exec_root = build(plan)
+        with maybe_span(tr, "executor.run"):
+            ctx = self._exec_ctx()
+            ctx.tracer = tr
+            chunks = run_to_completion(exec_root, ctx)
+        if tr is not None:
+            _operator_spans(tr, exec_root)
         if want_root:
             return plan, chunks, exec_root
         return plan, chunks
