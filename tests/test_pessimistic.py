@@ -1,0 +1,165 @@
+"""Pessimistic transactions (row locks, SELECT … FOR UPDATE) and
+AS OF TIMESTAMP historical reads (ref: session/txn.go pessimistic mode,
+the TiKV lock CF, and the tidb_snapshot/stale-read path; GC safepoint
+discipline of store/gcworker)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tidb_tpu.errors import TxnError
+from tidb_tpu.session import Engine
+
+
+@pytest.fixture()
+def eng():
+    eng = Engine()
+    s = eng.new_session()
+    s.execute("CREATE TABLE acct (id BIGINT, bal BIGINT)")
+    s.execute("INSERT INTO acct VALUES (1, 100), (2, 200), (3, 300)")
+    return eng
+
+
+def test_select_for_update_blocks_conflicting_dml(eng):
+    s1, s2 = eng.new_session(), eng.new_session()
+    s2.vars["innodb_lock_wait_timeout"] = 0.2
+    s1.execute("BEGIN PESSIMISTIC")
+    rows = s1.query("SELECT * FROM acct WHERE id = 1 FOR UPDATE").rows
+    assert rows == [(1, 100)]
+    s2.execute("BEGIN PESSIMISTIC")
+    with pytest.raises(TxnError, match="Lock wait timeout"):
+        s2.execute("UPDATE acct SET bal = 0 WHERE id = 1")
+    # a different row is not blocked
+    s2.execute("UPDATE acct SET bal = 201 WHERE id = 2")
+    s2.execute("COMMIT")
+    s1.execute("COMMIT")
+    # after release the row is free again
+    s2.execute("BEGIN PESSIMISTIC")
+    s2.execute("UPDATE acct SET bal = 101 WHERE id = 1")
+    s2.execute("COMMIT")
+    assert eng.new_session().query(
+        "SELECT bal FROM acct WHERE id = 1").rows == [(101,)]
+
+
+def test_lock_wait_resolves_on_commit(eng):
+    s1, s2 = eng.new_session(), eng.new_session()
+    s1.execute("BEGIN PESSIMISTIC")
+    s1.execute("UPDATE acct SET bal = bal + 1 WHERE id = 1")
+    done = {}
+
+    def waiter():
+        s2.execute("BEGIN PESSIMISTIC")
+        s2.execute("UPDATE acct SET bal = bal + 10 WHERE id = 1")
+        s2.execute("COMMIT")
+        done["ok"] = True
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.15)          # let the waiter hit the lock
+    assert "ok" not in done
+    s1.execute("COMMIT")
+    t.join(timeout=10)
+    assert done.get("ok")
+    # both increments landed (no lost update)
+    assert eng.new_session().query(
+        "SELECT bal FROM acct WHERE id = 1").rows == [(111,)]
+
+
+def test_rollback_releases_locks(eng):
+    s1, s2 = eng.new_session(), eng.new_session()
+    s2.vars["innodb_lock_wait_timeout"] = 0.2
+    s1.execute("BEGIN PESSIMISTIC")
+    s1.query("SELECT * FROM acct FOR UPDATE")
+    s1.execute("ROLLBACK")
+    s2.execute("BEGIN PESSIMISTIC")
+    s2.execute("UPDATE acct SET bal = 1 WHERE id = 3")
+    s2.execute("COMMIT")
+
+
+def test_optimistic_txn_does_not_lock(eng):
+    s1, s2 = eng.new_session(), eng.new_session()
+    s2.vars["innodb_lock_wait_timeout"] = 0.2
+    s1.execute("BEGIN")                 # optimistic default
+    s1.execute("UPDATE acct SET bal = 7 WHERE id = 1")
+    # optimistic: no lock held, the other session proceeds…
+    s2.execute("UPDATE acct SET bal = 8 WHERE id = 1")
+    # …and the first committer won: s1's commit now conflicts
+    with pytest.raises(TxnError, match="conflict"):
+        s1.execute("COMMIT")
+
+
+def test_txn_mode_variable(eng):
+    s = eng.new_session()
+    s.vars["tidb_txn_mode"] = "pessimistic"
+    s.execute("BEGIN")
+    assert s.txn.pessimistic
+    s.execute("ROLLBACK")
+    s.execute("BEGIN OPTIMISTIC")
+    assert not s.txn.pessimistic
+    s.execute("ROLLBACK")
+
+
+def test_for_update_preserves_repeatable_read(eng):
+    # regression: FOR UPDATE must not shift the txn's start-ts view for
+    # later plain reads
+    s1, s2 = eng.new_session(), eng.new_session()
+    s1.execute("BEGIN PESSIMISTIC")
+    assert s1.query("SELECT COUNT(*) FROM acct").rows == [(3,)]
+    s2.execute("INSERT INTO acct VALUES (9, 900)")
+    # FOR UPDATE itself reads the LATEST committed version…
+    got = s1.query("SELECT COUNT(*) FROM acct FOR UPDATE").rows
+    assert got == [(4,)]
+    # …but plain reads stay at the transaction's start view
+    assert s1.query("SELECT COUNT(*) FROM acct").rows == [(3,)]
+    s1.execute("COMMIT")
+
+
+def test_stale_retry_locks_release(eng):
+    # rows locked under a stale snapshot but no longer matching after the
+    # for-update-ts refresh must not stay locked
+    s1, s2, s3 = (eng.new_session() for _ in range(3))
+    s3.vars["innodb_lock_wait_timeout"] = 0.2
+    s1.execute("BEGIN PESSIMISTIC")
+    s1.query("SELECT * FROM acct WHERE id = 1 FOR UPDATE")
+    s1.execute("COMMIT")
+    # id=1 must be free now for another pessimistic writer
+    s3.execute("BEGIN PESSIMISTIC")
+    s3.execute("UPDATE acct SET bal = 5 WHERE id = 1")
+    s3.execute("COMMIT")
+
+
+# ---- AS OF TIMESTAMP historical reads --------------------------------------
+
+
+def test_as_of_timestamp_reads_history(eng):
+    import datetime
+    s = eng.new_session()
+    time.sleep(0.02)
+    t0 = datetime.datetime.now()
+    time.sleep(0.02)
+    s.execute("UPDATE acct SET bal = 999 WHERE id = 1")
+    s.execute("INSERT INTO acct VALUES (4, 400)")
+    assert s.query("SELECT bal FROM acct WHERE id = 1").rows == [(999,)]
+    old = s.query(f"SELECT bal FROM acct AS OF TIMESTAMP '{t0}' "
+                  "WHERE id = 1").rows
+    assert old == [(100,)]
+    assert s.query(f"SELECT COUNT(*) FROM acct AS OF TIMESTAMP '{t0}'"
+                   ).rows == [(3,)]
+
+
+def test_as_of_before_safepoint_errors(eng):
+    s = eng.new_session()
+    with pytest.raises(TxnError, match="safepoint"):
+        s.query("SELECT * FROM acct AS OF TIMESTAMP '1999-01-01 00:00:00'")
+
+
+def test_as_of_rejected_in_txn(eng):
+    import datetime
+    s = eng.new_session()
+    t0 = datetime.datetime.now()
+    s.execute("BEGIN")
+    with pytest.raises(TxnError, match="not allowed"):
+        s.query(f"SELECT * FROM acct AS OF TIMESTAMP '{t0}'")
+    s.execute("ROLLBACK")

@@ -158,6 +158,7 @@ class TableRef(Node):
 class TableName(TableRef):
     name: str
     alias: Optional[str] = None
+    as_of: Optional[ExprNode] = None     # AS OF TIMESTAMP <expr>
 
     @property
     def ref_name(self) -> str:
@@ -204,6 +205,7 @@ class SelectStmt(StmtNode):
     order_by: List[Tuple[ExprNode, bool]] = field(default_factory=list)  # (e, desc)
     limit: Optional[Tuple[int, int]] = None   # (offset, count)
     distinct: bool = False
+    for_update: bool = False
 
 
 @dataclass
@@ -379,7 +381,7 @@ class UseStmt(StmtNode):
 
 @dataclass
 class BeginStmt(StmtNode):
-    pass
+    mode: Optional[str] = None     # pessimistic | optimistic | None
 
 
 @dataclass

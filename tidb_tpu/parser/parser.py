@@ -108,7 +108,7 @@ class Parser:
                 "comment", "engine", "charset", "begin", "analyze", "offset",
                 "set", "values", "variables", "if",
                 "add", "to", "column", "rename", "over", "partition",
-                "alter", "mod", "user", "grants", "privileges"):
+                "alter", "mod", "user", "grants", "privileges", "of"):
             return self.advance().value
         raise ParseError(f"expected identifier near {self._near()}")
 
@@ -181,7 +181,11 @@ class Parser:
             return ast.UseStmt(self.ident())
         if self.at_kw("begin"):
             self.advance()
-            return ast.BeginStmt()
+            mode = None
+            if self.at("ident") and str(self.cur.value).lower() in (
+                    "pessimistic", "optimistic"):
+                mode = self.advance().value.lower()
+            return ast.BeginStmt(mode)
         if self.at_kw("start"):
             self.advance()
             self.expect_kw("transaction")
@@ -336,8 +340,13 @@ class Parser:
         having = self.expr() if self.try_kw("having") else None
         order_by = self.order_by_clause() if allow_tail else []
         limit = self.limit_clause() if allow_tail else None
+        for_update = False
+        if allow_tail and self.try_kw("for"):
+            self.expect_kw("update")
+            for_update = True
         return ast.SelectStmt(items, from_, where, group_by, having,
-                               order_by, limit, distinct)
+                               order_by, limit, distinct,
+                               for_update=for_update)
 
     def select_item(self) -> ast.SelectItem:
         if self.at_op("*"):
@@ -452,11 +461,22 @@ class Parser:
             return refs
         name = self.ident()
         alias = None
+        as_of = None
         if self.try_kw("as"):
-            alias = self.ident()
+            if self.try_kw("of"):
+                self.expect_kw("timestamp")
+                as_of = self.expr()
+            else:
+                alias = self.ident()
         elif self.at("ident"):
             alias = self.advance().value
-        return ast.TableName(name, alias)
+        if as_of is not None and alias is None:
+            # optional alias AFTER the AS OF clause: t AS OF ... [AS] x
+            if self.try_kw("as"):
+                alias = self.ident()
+            elif self.at("ident"):
+                alias = self.advance().value
+        return ast.TableName(name, alias, as_of=as_of)
 
     # ---- DDL -------------------------------------------------------------
     def create_table(self):
@@ -712,8 +732,7 @@ class Parser:
         self.expect_kw("show")
         if self.try_kw("grants"):
             target = None
-            if self.at("ident") and str(self.cur.value).lower() == "for":
-                self.advance()
+            if self.try_kw("for"):
                 target = self._user_spec()
             return ast.ShowStmt("grants", target=target)
         if self.try_kw("tables"):

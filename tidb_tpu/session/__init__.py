@@ -140,18 +140,50 @@ def _stmt_tables(stmt) -> List[str]:
     return names
 
 
-def _operator_spans(tr, exec_root, depth: int = 0) -> None:
-    """Per-operator durations from runtime stats rendered as trace
-    events (the executor Next-wrapper spans of executor.go:278)."""
+def _stmt_as_of(stmt):
+    """The AS OF expression of a statement's table refs (one allowed)."""
+    found = []
+
+    def ref(r):
+        if isinstance(r, ast.TableName):
+            if r.as_of is not None:
+                found.append(r.as_of)
+        elif isinstance(r, ast.JoinExpr):
+            ref(r.left)
+            ref(r.right)
+        elif isinstance(r, ast.SubqueryTable):
+            sel(r.select)
+
+    def sel(s):
+        if isinstance(s, ast.SetOpStmt):
+            sel(s.left)
+            sel(s.right)
+        elif getattr(s, "from_", None) is not None:
+            ref(s.from_)
+
+    sel(stmt)
+    if len(found) > 1:
+        raise PlanError(
+            "only one AS OF TIMESTAMP is supported per statement")
+    return found[0] if found else None
+
+
+def _operator_spans(tr, exec_root) -> None:
+    """Per-operator runtime stats rendered as a NESTED span tree (the
+    executor Next-wrapper spans of executor.go:278); durations come from
+    accumulated wall time, carried as a tag."""
     name = type(exec_root).__name__
     info = ""
     fn = getattr(exec_root, "runtime_info", None)
     if fn is not None:
         info = fn() or ""
-    tr.event(f"op.{name}", exec_root.stats.wall_ns / 1e9,
-             rows=exec_root.stats.rows, **({"info": info} if info else {}))
-    for c in getattr(exec_root, "children", []):
-        _operator_spans(tr, c, depth + 1)
+    tags = {"rows": exec_root.stats.rows,
+            "wall_ms": round(exec_root.stats.wall_ns / 1e6, 3)}
+    if info:
+        tags["info"] = info
+    with tr.span(f"op.{name}", **tags):
+        for c in getattr(exec_root, "children", []):
+            _operator_spans(tr, c)
 
 
 class Engine:
@@ -245,6 +277,8 @@ class Session:
         self._subq_execs = 0
         self._current_sql: Optional[str] = None
         self._tracer = None        # set while a TRACE statement runs
+        self._stmt_snapshot = None  # pinned read view (AS OF TIMESTAMP)
+        self._for_update_snapshot = None
 
     # ---- public API --------------------------------------------------------
     def execute(self, sql: str) -> List[ResultSet]:
@@ -290,11 +324,16 @@ class Session:
 
     # ---- txn plumbing ------------------------------------------------------
     def _read_view_snapshot(self):
+        if self._stmt_snapshot is not None:
+            return self._stmt_snapshot
         if self.txn is not None:
             return self.txn.snapshot
         return self.engine.store.snapshot()
 
     def _exec_ctx(self) -> ExecContext:
+        if self._stmt_snapshot is not None:
+            return ExecContext(snapshot=self._stmt_snapshot,
+                               vars=self.vars)
         if self.txn is not None:
             return ExecContext(txn=self.txn, vars=self.vars)
         return ExecContext(snapshot=self.engine.store.snapshot(),
@@ -389,6 +428,19 @@ class Session:
                                        stmt.scope)
             return ok()
         if isinstance(stmt, (ast.SelectStmt, ast.SetOpStmt)):
+            as_of = _stmt_as_of(stmt)
+            if as_of is not None:
+                return self._run_as_of(stmt, as_of)
+            if isinstance(stmt, ast.SelectStmt) and stmt.for_update \
+                    and self.txn is not None:
+                self._lock_for_update(stmt)
+                orig = self.txn.snapshot
+                self.txn.snapshot = self._for_update_snapshot or orig
+                try:
+                    return self._run_query(stmt)
+                finally:
+                    self.txn.snapshot = orig
+                    self._for_update_snapshot = None
             return self._run_query(stmt)
         if isinstance(stmt, ast.WithStmt):
             return self._run_with(stmt)
@@ -437,6 +489,9 @@ class Session:
             if self.txn is not None:
                 self.txn.commit()  # implicit commit (MySQL semantics)
             self.txn = self.engine.store.begin()
+            mode = stmt.mode or str(self.vars.get("tidb_txn_mode",
+                                                  "optimistic"))
+            self.txn.pessimistic = (mode == "pessimistic")
             self._txn_schema_version = self.engine.catalog.user_version
             return ok()
         if isinstance(stmt, ast.CommitStmt):
@@ -522,7 +577,8 @@ class Session:
         if not isinstance(stmt, (ast.SelectStmt, ast.SetOpStmt)):
             return None
         if self._cte_map or self._current_sql is None or \
-                self.txn is not None:
+                self.txn is not None or self._stmt_snapshot is not None \
+                or self._tracer is not None:
             return None
         info_schema = self.engine.catalog.info_schema
         snap = self._read_view_snapshot()
@@ -545,17 +601,45 @@ class Session:
                 str(v.get("tidb_tpu_dist_devices", 0)),
                 self.user)
 
+    def _run_as_of(self, stmt, as_of_expr) -> ResultSet:
+        """Historical read (AS OF TIMESTAMP ...): resolve the timestamp,
+        pin the statement's read view to the matching store version."""
+        from tidb_tpu.planner.rules import fold_expr
+        rw = ExpressionRewriter(Schema([]), None)
+        const = fold_expr(rw.rewrite(as_of_expr))
+        from tidb_tpu.expression import Constant
+        if not isinstance(const, Constant) or const.value is None:
+            raise PlanError("AS OF TIMESTAMP requires a constant")
+        import datetime as _dt
+        v = const.value
+        if isinstance(v, _dt.datetime):
+            ts = v.timestamp()
+        elif isinstance(v, (int, float)):
+            ts = float(v)
+        else:
+            ts = _dt.datetime.fromisoformat(str(v)).timestamp()
+        if self.txn is not None:
+            raise TxnError(
+                "AS OF reads are not allowed inside a transaction")
+        self._stmt_snapshot = self.engine.store.snapshot_at(ts)
+        try:
+            return self._run_query(stmt)
+        finally:
+            self._stmt_snapshot = None
+
     def _trace(self, stmt) -> ResultSet:
         """TRACE <stmt>: run it with a span recorder attached and return
         the span tree (ref: executor/trace.go)."""
         from tidb_tpu.util.tracing import Tracer
-        self._tracer = Tracer()
+        prev = self._tracer
+        tr = Tracer()
+        self._tracer = tr
         try:
-            with self._tracer.span("session.run"):
+            with tr.span("session.run"):
                 self._execute_stmt(stmt.stmt)
-            rows = self._tracer.rows()
+            rows = tr.rows()
         finally:
-            self._tracer = None
+            self._tracer = prev
         return ResultSet(["operation", "startTS(us)", "duration(us)"],
                          [T.varchar(), T.varchar(), T.varchar()], rows)
 
@@ -801,6 +885,77 @@ class Session:
         _check_not_null_chunk(chunk, info)
         return chunk
 
+    def _pessimistic_match(self, txn, info, where):
+        """Pessimistic DML read-and-lock loop (ref: the for-update-ts
+        retry of pessimistic transactions): match rows, acquire their
+        locks (waiting on owners), then re-read at the LATEST committed
+        version — a concurrent commit while waiting must be visible, or
+        updates would be lost against the stale start-ts view. The
+        transaction's start-ts snapshot is RESTORED afterwards so plain
+        reads keep repeatable-read; locks from stale retry iterations
+        release before re-locking (they may cover rows that no longer
+        match)."""
+        store = self.engine.store
+        orig = txn.snapshot
+        base = len(txn.locked)
+        try:
+            for _ in range(16):
+                txn.snapshot = store.snapshot()
+                region_masks, staged_keep, matched = self._match_masks(
+                    info, where, txn)
+                self._maybe_lock(txn, info, region_masks)
+                if store.snapshot().version == txn.snapshot.version:
+                    return region_masks, staged_keep, matched
+                store.release_entries(txn, txn.locked[base:])
+                del txn.locked[base:]
+            raise TxnError("pessimistic statement retry limit exceeded")
+        finally:
+            txn.snapshot = orig
+
+    def _maybe_lock(self, txn, info, region_masks,
+                    force: bool = False) -> None:
+        """Pessimistic row locks (ref: session/txn.go pessimistic mode,
+        TiKV's lock CF): DML inside a pessimistic txn — and any
+        SELECT ... FOR UPDATE — acquires row locks at statement time,
+        blocking on conflicting owners up to innodb_lock_wait_timeout."""
+        if txn is None or not (force or txn.pessimistic):
+            return
+        if not region_masks:
+            return
+        timeout = float(self.vars.get("innodb_lock_wait_timeout", 5.0))
+        self.engine.store.lock_rows(txn, info.id, region_masks,
+                                    timeout_s=timeout)
+
+    def _lock_for_update(self, stmt: ast.SelectStmt) -> None:
+        """SELECT ... FOR UPDATE: lock matched rows of the (single)
+        scanned table for the current transaction."""
+        if self.txn is None:
+            return                # autocommit: lock would release at once
+        if not isinstance(stmt.from_, ast.TableName):
+            raise PlanError(
+                "FOR UPDATE is supported on single-table selects only")
+        info = self.engine.catalog.info_schema.table(stmt.from_.name)
+        store = self.engine.store
+        txn = self.txn
+        orig = txn.snapshot
+        base = len(txn.locked)
+        try:
+            for _ in range(16):
+                txn.snapshot = store.snapshot()
+                region_masks, _, _ = self._match_masks(info, stmt.where,
+                                                       txn)
+                self._maybe_lock(txn, info, region_masks, force=True)
+                if store.snapshot().version == txn.snapshot.version:
+                    return
+                store.release_entries(txn, txn.locked[base:])
+                del txn.locked[base:]
+            raise TxnError("pessimistic statement retry limit exceeded")
+        finally:
+            # FOR UPDATE reads the latest version for THIS statement only;
+            # plain reads stay at the start-ts view (repeatable read)
+            self._for_update_snapshot = txn.snapshot
+            txn.snapshot = orig
+
     def _match_masks(self, info: TableInfo, where: Optional[ast.ExprNode],
                      txn: Transaction):
         """Scan the table under `txn`, returning (region_masks, staged_keep,
@@ -834,8 +989,12 @@ class Session:
         info = self.engine.catalog.info_schema.table(stmt.table.name)
         txn, auto = self._write_txn()
         try:
-            region_masks, staged_keep, _ = self._match_masks(
-                info, stmt.where, txn)
+            if txn.pessimistic:
+                region_masks, staged_keep, _ = self._pessimistic_match(
+                    txn, info, stmt.where)
+            else:
+                region_masks, staged_keep, _ = self._match_masks(
+                    info, stmt.where, txn)
             n = sum(int(m.sum()) for m in region_masks.values())
             n += sum(int((~k).sum()) for k in staged_keep)
             if region_masks:
@@ -861,8 +1020,12 @@ class Session:
             assigns[name.lower()] = rw.rewrite(expr)
         txn, auto = self._write_txn()
         try:
-            region_masks, staged_keep, matched = self._match_masks(
-                info, stmt.where, txn)
+            if txn.pessimistic:
+                region_masks, staged_keep, matched = \
+                    self._pessimistic_match(txn, info, stmt.where)
+            else:
+                region_masks, staged_keep, matched = self._match_masks(
+                    info, stmt.where, txn)
             if not matched:
                 if auto:
                     txn.commit()

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time as _time
 from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
@@ -87,30 +88,126 @@ class Snapshot:
 class Store:
     """The storage engine singleton (ref: kv.Storage, kv/kv.go:409)."""
 
+    # MVCC history bounds (ref: store/gcworker safepoint discipline)
+    MAX_HISTORY = 256
+    GC_LIFE_SECONDS = 600.0
+
     def __init__(self):
         self._lock = threading.Lock()
         self._tables: Dict[int, TableData] = {}
         self._region_ids = itertools.count(1)
         self._version = 0
         self._open_txns = 0     # compaction defers while txns are open
+        # version history for AS OF reads: (version, wall time, tables).
+        # Region objects are immutable and shared, so an entry costs one
+        # dict — the MVCC version chain without per-row versions
+        self._history: List[Tuple[int, float, Dict[int, TableData]]] = [
+            (0, _time.time(), {})]
+        # pessimistic row locks: (table_id, region_id) → {row → txn_id}
+        # (ref: the TiKV lock CF the pessimistic mode acquires through)
+        self._locks: Dict[Tuple[int, int], Dict[int, int]] = {}
+        self._txn_seq = itertools.count(1)
+
+    def _bump_locked(self) -> None:
+        self._version += 1
+        now = _time.time()
+        self._history.append((self._version, now, dict(self._tables)))
+        cutoff = now - self.GC_LIFE_SECONDS
+        while len(self._history) > self.MAX_HISTORY or (
+                len(self._history) > 1 and self._history[1][1] <= cutoff
+                and self._history[0][1] < cutoff):
+            self._history.pop(0)
+
+    def snapshot_at(self, ts: float) -> Snapshot:
+        """Historical read view: the newest version committed at or
+        before `ts` (the tidb_snapshot / AS OF TIMESTAMP read path)."""
+        with self._lock:
+            best = None
+            for v, t, tables in self._history:
+                if t <= ts:
+                    best = (v, tables)
+                else:
+                    break
+            if best is None:
+                raise TxnError(
+                    "snapshot is older than the GC safepoint "
+                    "(tidb_gc_life_time)")
+            return Snapshot(dict(best[1]), best[0], self)
+
+    # ---- pessimistic row locks -------------------------------------------
+    def lock_rows(self, txn: "Transaction", table_id: int,
+                  region_masks: Dict[int, np.ndarray],
+                  timeout_s: float = 5.0) -> None:
+        """Acquire row locks, waiting (bounded) on conflicting owners —
+        SELECT ... FOR UPDATE / pessimistic-DML semantics. Lock-wait
+        beyond the timeout raises the MySQL lock-wait error."""
+        deadline = _time.time() + timeout_s
+        while True:
+            with self._lock:
+                blocked = False
+                for rid, mask in region_masks.items():
+                    owners = self._locks.get((table_id, rid))
+                    if not owners:
+                        continue
+                    for row in np.nonzero(mask)[0]:
+                        o = owners.get(int(row))
+                        if o is not None and o != txn.txn_id:
+                            blocked = True
+                            break
+                    if blocked:
+                        break
+                if not blocked:
+                    for rid, mask in region_masks.items():
+                        owners = self._locks.setdefault((table_id, rid), {})
+                        for row in np.nonzero(mask)[0]:
+                            owners[int(row)] = txn.txn_id
+                        txn.locked.append((table_id, rid, mask.copy()))
+                    return
+            if _time.time() >= deadline:
+                raise TxnError(
+                    "Lock wait timeout exceeded; try restarting "
+                    "transaction")
+            _time.sleep(0.005)
+
+    def release_entries(self, txn: "Transaction", entries) -> None:
+        """Release a subset of a txn's lock entries (stale retry
+        iterations of a pessimistic statement)."""
+        with self._lock:
+            self._release_entries_locked(txn, entries)
+
+    def _release_entries_locked(self, txn, entries) -> None:
+        for tid, rid, mask in entries:
+            owners = self._locks.get((tid, rid))
+            if not owners:
+                continue
+            for row in np.nonzero(mask)[0]:
+                if owners.get(int(row)) == txn.txn_id:
+                    del owners[int(row)]
+            if not owners:
+                del self._locks[(tid, rid)]
+
+    def release_locks(self, txn: "Transaction") -> None:
+        with self._lock:
+            self._release_entries_locked(txn, txn.locked)
+            txn.locked.clear()
 
     # ---- lifecycle -------------------------------------------------------
     def create_table(self, table_id: int) -> None:
         with self._lock:
             self._tables.setdefault(table_id, TableData(()))
-            self._version += 1
+            self._bump_locked()
 
     def drop_table(self, table_id: int) -> None:
         with self._lock:
             self._tables.pop(table_id, None)
-            self._version += 1
+            self._bump_locked()
 
     def truncate_table(self, table_id: int) -> None:
         with self._lock:
             if table_id not in self._tables:
                 raise UnknownTableError(f"no storage for table id {table_id}")
             self._tables[table_id] = TableData(())
-            self._version += 1
+            self._bump_locked()
 
     # ---- reads -----------------------------------------------------------
     def snapshot(self) -> Snapshot:
@@ -122,7 +219,7 @@ class Store:
         """Append rows, splitting into REGION_ROWS regions."""
         with self._lock:
             self._append_locked(table_id, chunk)
-            self._version += 1
+            self._bump_locked()
 
     def _append_locked(self, table_id: int, chunk: Chunk) -> None:
         td = self._tables.get(table_id)
@@ -154,7 +251,7 @@ class Store:
         with self._lock:
             n = self._delete_locked(table_id, region_masks)
             self._maybe_compact_locked(table_id)
-            self._version += 1
+            self._bump_locked()
             return n
 
     def _maybe_compact_locked(self, table_id: int,
@@ -276,7 +373,7 @@ class Store:
                     self._append_locked(tid, ch)
             for tid in txn.staged_deletes:
                 self._maybe_compact_locked(tid, closing=1)
-            self._version += 1
+            self._bump_locked()
 
     # ---- introspection ---------------------------------------------------
     def stats(self) -> Dict[int, Tuple[int, int]]:
@@ -297,6 +394,9 @@ class Transaction:
         self.staged_inserts: Dict[int, List[Chunk]] = {}
         self.staged_deletes: Dict[int, Dict[int, np.ndarray]] = {}
         self.active = True
+        self.txn_id = next(store._txn_seq)
+        self.pessimistic = False
+        self.locked: List[Tuple[int, int, np.ndarray]] = []
 
     def has_staged_writes(self) -> bool:
         return bool(self.staged_inserts) or bool(self.staged_deletes)
@@ -357,10 +457,12 @@ class Transaction:
             self._store.commit(self)
         finally:
             self.active = False
+            self._store.release_locks(self)
             self._store._txn_closed()
 
     def rollback(self) -> None:
         if self.active:
+            self._store.release_locks(self)
             self._store._txn_closed()
         self.active = False
         self.staged_inserts.clear()
