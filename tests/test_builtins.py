@@ -174,3 +174,37 @@ DEVICE_QUERIES = [
 @pytest.mark.parametrize("sql", DEVICE_QUERIES)
 def test_device_matches_cpu(session, sql):
     assert_same(run_device(session, sql), session.query(sql).rows)
+
+
+def test_epoch_digest_radix_builtins():
+    from tidb_tpu.session import Engine
+    s = Engine().new_session()
+    s.execute("CREATE TABLE bt (d DATETIME, x BIGINT, t VARCHAR(16))")
+    s.execute("INSERT INTO bt VALUES ('2024-03-05 14:30:45', 255, 'abc')")
+    r = s.query("SELECT UNIX_TIMESTAMP(d), "
+                "FROM_UNIXTIME(UNIX_TIMESTAMP(d)) FROM bt").rows[0]
+    assert r[0] == 1709649045
+    assert str(r[1]) == "2024-03-05 14:30:45"
+    r = s.query("SELECT MD5(t), SHA1(t), SHA2(t, 256), CRC32(t), BIN(x), "
+                "OCT(x), UNHEX('414243') FROM bt").rows[0]
+    assert r[0] == "900150983cd24fb0d6963f7d28e17f72"
+    assert r[1] == "a9993e364706816aba3e25717850c26c9cd0d89d"
+    assert r[2].startswith("ba7816bf8f01cfea")
+    assert r[3] == 891568578
+    assert (r[4], r[5], r[6]) == ("11111111", "377", "ABC")
+    r = s.query("SELECT DATE_FORMAT(d, '%Y/%c/%e %T %M %a %p %%') "
+                "FROM bt").rows[0][0]
+    assert r == "2024/3/5 14:30:45 March Tue PM %"
+
+
+def test_env_functions():
+    from tidb_tpu.session import Engine
+    eng = Engine()
+    s = eng.new_session()
+    assert s.query("SELECT VERSION()").rows[0][0] == "8.0.11-tidb-tpu"
+    assert s.query("SELECT USER()").rows[0][0] == "root@%"
+    assert s.query("SELECT DATABASE()").rows[0][0] == "test"
+    assert s.query("SELECT CONNECTION_ID()").rows[0][0] == s.conn_id
+    y = s.query("SELECT YEAR(NOW()), YEAR(CURDATE())").rows[0]
+    assert y[0] >= 2026 and y[1] >= 2026
+    assert s.query("SELECT UNIX_TIMESTAMP()").rows[0][0] > 1_700_000_000

@@ -1,0 +1,48 @@
+"""LOAD DATA INFILE (executor/load_data.go analog) and SET GLOBAL
+persistence (sessionctx/variable global scope)."""
+
+import pytest
+
+from tidb_tpu.session import Engine
+
+
+def test_load_data_infile(tmp_path):
+    eng = Engine()
+    s = eng.new_session()
+    s.execute("CREATE TABLE ld (a BIGINT, b VARCHAR(8), c DOUBLE)")
+    p = tmp_path / "in.csv"
+    p.write_text("# header\n1,one,1.5\n2,two,\\N\n3,th'ree,3.5\n")
+    rs = s.execute(f"LOAD DATA LOCAL INFILE '{p}' INTO TABLE ld "
+                   f"FIELDS TERMINATED BY ',' IGNORE 1 LINES")
+    assert rs[0].affected_rows == 3
+    assert s.query("SELECT * FROM ld ORDER BY a").rows == [
+        (1, "one", 1.5), (2, "two", None), (3, "th'ree", 3.5)]
+
+
+def test_load_data_requires_insert_priv(tmp_path):
+    eng = Engine()
+    s = eng.new_session()
+    s.execute("CREATE TABLE ld2 (a BIGINT)")
+    s.execute("CREATE USER u IDENTIFIED BY 'x'")
+    p = tmp_path / "in2.csv"
+    p.write_text("1\n")
+    s2 = eng.new_session()
+    s2.user = "u"
+    with pytest.raises(Exception, match="denied"):
+        s2.execute(f"LOAD DATA INFILE '{p}' INTO TABLE ld2")
+
+
+def test_set_global_inherited_and_gated():
+    eng = Engine()
+    s = eng.new_session()
+    s.execute("SET GLOBAL tidb_tpu_row_threshold = 777")
+    assert eng.new_session().vars["tidb_tpu_row_threshold"] == 777
+    # session scope does not leak
+    s.execute("SET max_chunk_size = 42")
+    assert eng.new_session().vars["max_chunk_size"] != 42
+    # non-superusers cannot SET GLOBAL
+    s.execute("CREATE USER v IDENTIFIED BY 'x'")
+    s2 = eng.new_session()
+    s2.user = "v"
+    with pytest.raises(Exception, match="SET GLOBAL"):
+        s2.execute("SET GLOBAL long_query_time = 1")

@@ -1488,7 +1488,177 @@ def _floor_mod(xp, a, n):
 # ops whose kernels can only run host-side (string results with no
 # dictionary precompute, or object-array machinery) — the device gate
 # (_fragment_ok/tree_ok) rejects fragments containing them up front
-HOST_ONLY_OPS = {"strcmp", "space", "dayname", "monthname"}
+# ---------------------------------------------------------------------------
+# Temporal epoch conversions, digests, radix conversions
+# (ref: expression/builtin_time.go, builtin_encryption.go, builtin_math.go)
+# ---------------------------------------------------------------------------
+
+
+@kernel("unix_timestamp")
+def _unix_timestamp(func, ctx):
+    xp = ctx.xp
+    v, m = func.args[0].eval(ctx)
+    ft = func.args[0].ftype
+    if ft.kind is TypeKind.DATE:
+        return (v.astype(xp.int64) * 86400), m
+    # DATETIME/TIMESTAMP raw = µs since epoch
+    return _floor_div_neg(xp, v, 1_000_000).astype(xp.int64), m
+
+
+@kernel("from_unixtime")
+def _from_unixtime(func, ctx):
+    xp = ctx.xp
+    v, m = func.args[0].eval(ctx)
+    fdt = _xp_dtype(xp, T.double(), ctx.on_device)
+    secs = _to_float(xp, v, func.args[0].ftype, fdt)
+    return (secs * 1_000_000.0).astype(xp.int64), m
+
+
+@kernel("crc32")
+def _crc32(func, ctx):
+    if ctx.on_device:
+        raise TypeError_("crc32: host-only")
+    import zlib
+    v, m = func.args[0].eval(ctx)
+    out = np.fromiter(
+        (zlib.crc32(str(x).encode()) for x in v), dtype=np.int64,
+        count=len(v))
+    return out, m
+
+
+def _digest_kernel(name, fn):
+    def k(func: ScalarFunc, ctx: EvalContext):
+        if ctx.on_device:
+            raise TypeError_(f"{name}: host-only")
+        v, m = func.args[0].eval(ctx)
+        out = np.array([fn(func, str(x)) for x in v], dtype=object)
+        return out, m
+    kernel(name)(k)
+
+
+def _md5(_f, s):
+    import hashlib
+    return hashlib.md5(s.encode()).hexdigest()
+
+
+def _sha1(_f, s):
+    import hashlib
+    return hashlib.sha1(s.encode()).hexdigest()
+
+
+def _sha2(f, s):
+    import hashlib
+    bits = 256
+    if len(f.args) > 1 and isinstance(f.args[1], Constant) and f.args[1].value:
+        bits = int(f.args[1].value)
+    algo = {224: "sha224", 256: "sha256", 384: "sha384",
+            512: "sha512", 0: "sha256"}.get(bits)
+    if algo is None:
+        return None
+    return getattr(hashlib, algo)(s.encode()).hexdigest()
+
+
+_digest_kernel("md5", _md5)
+_digest_kernel("sha1", _sha1)
+_digest_kernel("sha2", _sha2)
+
+
+@kernel("bin")
+def _bin(func, ctx):
+    if ctx.on_device:
+        raise TypeError_("bin: host-only")
+    v, m = func.args[0].eval(ctx)
+    return np.array([format(int(x), "b") for x in np.asarray(v)],
+                    dtype=object), m
+
+
+@kernel("oct")
+def _oct(func, ctx):
+    if ctx.on_device:
+        raise TypeError_("oct: host-only")
+    v, m = func.args[0].eval(ctx)
+    return np.array([format(int(x), "o") for x in np.asarray(v)],
+                    dtype=object), m
+
+
+@kernel("unhex")
+def _unhex(func, ctx):
+    if ctx.on_device:
+        raise TypeError_("unhex: host-only")
+    v, m = func.args[0].eval(ctx)
+    out = np.empty(len(v), dtype=object)
+    ok = np.asarray(m).copy()
+    for i, x in enumerate(v):
+        try:
+            out[i] = bytes.fromhex(str(x)).decode("utf-8", "replace")
+        except ValueError:
+            out[i] = ""
+            ok[i] = False
+    return out, ok
+
+
+_DATE_FORMAT_CODES = "YymcdeHisfMbWajprT%"
+
+
+@kernel("date_format")
+def _date_format(func, ctx):
+    """DATE_FORMAT(dt, fmt) — the common % codes (builtin_time.go
+    dateFormat); host-only (string result)."""
+    if ctx.on_device:
+        raise TypeError_("date_format: host-only")
+    xp = ctx.xp
+    v, m = func.args[0].eval(ctx)
+    fv, fm = func.args[1].eval(ctx)
+    ft = func.args[0].ftype
+    days = _as_days(xp, v, ft)
+    y, mo, d = _civil_from_days(xp, days)
+    if ft.kind in (TypeKind.DATETIME, TypeKind.TIMESTAMP):
+        us = _floor_mod(xp, v, 86_400_000_000)
+    else:
+        us = xp.zeros_like(v)
+    hh = us // 3_600_000_000
+    mi = (us // 60_000_000) % 60
+    ss = (us // 1_000_000) % 60
+    micro = us % 1_000_000
+    y, mo, d, hh, mi, ss, micro, days = map(
+        np.asarray, (y, mo, d, hh, mi, ss, micro, days))
+    out = np.empty(len(np.asarray(v)), dtype=object)
+    for i in range(len(out)):
+        fmt = str(fv[i]) if not np.isscalar(fv) else str(fv)
+        s = []
+        j = 0
+        while j < len(fmt):
+            c = fmt[j]
+            if c != "%" or j + 1 >= len(fmt):
+                s.append(c)
+                j += 1
+                continue
+            code = fmt[j + 1]
+            j += 2
+            wd = int((days[i] + 3) % 7)          # 0 = Monday
+            rep = {
+                "Y": f"{y[i]:04d}", "y": f"{y[i] % 100:02d}",
+                "m": f"{mo[i]:02d}", "c": str(mo[i]),
+                "d": f"{d[i]:02d}", "e": str(d[i]),
+                "H": f"{hh[i]:02d}", "i": f"{mi[i]:02d}",
+                "s": f"{ss[i]:02d}", "S": f"{ss[i]:02d}",
+                "f": f"{micro[i]:06d}",
+                "M": _MONTH_NAMES[mo[i] - 1], "b": _MONTH_NAMES[mo[i] - 1][:3],
+                "W": _DAY_NAMES[wd], "a": _DAY_NAMES[wd][:3],
+                "p": "AM" if hh[i] < 12 else "PM",
+                "r": f"{(hh[i] % 12) or 12:02d}:{mi[i]:02d}:{ss[i]:02d} "
+                     f"{'AM' if hh[i] < 12 else 'PM'}",
+                "T": f"{hh[i]:02d}:{mi[i]:02d}:{ss[i]:02d}",
+                "%": "%",
+            }.get(code)
+            s.append(rep if rep is not None else "%" + code)
+        out[i] = "".join(s)
+    return out, np.asarray(m) & np.asarray(fm)
+
+
+HOST_ONLY_OPS = {"strcmp", "space", "dayname", "monthname", "crc32",
+                 "md5", "sha1", "sha2", "bin", "oct", "unhex",
+                 "date_format"}
 
 _BOOL_OPS = {"eq", "ne", "lt", "le", "gt", "ge", "nulleq", "and", "or", "xor",
              "not", "isnull", "like", "in"}
@@ -1573,6 +1743,13 @@ def infer_type(op: str, args: Sequence[Expression]) -> FieldType:
         return T.varchar(nullable=nullable)
     if op in ("date", "last_day"):
         return T.date(nullable)
+    if op in ("unix_timestamp", "crc32"):
+        return T.bigint(nullable)
+    if op == "from_unixtime":
+        return T.datetime(nullable)
+    if op in ("md5", "sha1", "sha2", "bin", "oct", "unhex",
+              "date_format"):
+        return T.varchar(nullable=True)
     if op == "cast":
         raise AssertionError("cast requires explicit target type")
     raise TypeError_(f"cannot infer type for {op}")

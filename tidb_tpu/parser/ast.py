@@ -339,6 +339,14 @@ class AnalyzeTable(StmtNode):
 
 
 @dataclass
+class LoadData(StmtNode):
+    table: str
+    path: str
+    delimiter: str = ","
+    ignore_lines: int = 0
+
+
+@dataclass
 class TraceStmt(StmtNode):
     stmt: StmtNode
 

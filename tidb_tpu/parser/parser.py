@@ -108,7 +108,8 @@ class Parser:
                 "comment", "engine", "charset", "begin", "analyze", "offset",
                 "set", "values", "variables", "if",
                 "add", "to", "column", "rename", "over", "partition",
-                "alter", "mod", "user", "grants", "privileges", "of"):
+                "alter", "mod", "user", "grants", "privileges", "of",
+                "data", "load"):
             return self.advance().value
         raise ParseError(f"expected identifier near {self._near()}")
 
@@ -130,6 +131,8 @@ class Parser:
             if self.toks[self.i + 1].is_kw("user"):
                 return self.drop_user()
             return self.drop_table()
+        if self.at_kw("load"):
+            return self.load_data()
         if self.at_kw("backup"):
             self.advance()
             self.expect_kw("to")
@@ -197,6 +200,39 @@ class Parser:
             self.advance()
             return ast.RollbackStmt()
         raise ParseError(f"unsupported statement near {self._near()}")
+
+    def load_data(self) -> ast.StmtNode:
+        """LOAD DATA [LOCAL] INFILE 'p' INTO TABLE t
+        [FIELDS TERMINATED BY 'c'] [IGNORE n LINES]"""
+        self.expect_kw("load")
+        self.expect_kw("data")
+        if self.at("ident") and str(self.cur.value).lower() == "local":
+            self.advance()
+        if not (self.at("ident") and
+                str(self.cur.value).lower() == "infile"):
+            raise ParseError(f"expected INFILE near {self._near()}")
+        self.advance()
+        if not self.at("str"):
+            raise ParseError(f"expected file path near {self._near()}")
+        path = self.advance().value
+        self.expect_kw("into")
+        self.expect_kw("table")
+        table = self.ident()
+        delimiter = ","
+        if self.at("ident") and str(self.cur.value).lower() == "fields":
+            self.advance()
+            if not (self.at("ident") and
+                    str(self.cur.value).lower() == "terminated"):
+                raise ParseError(f"expected TERMINATED near {self._near()}")
+            self.advance()
+            self.expect_kw("by")
+            delimiter = self.advance().value
+        ignore_lines = 0
+        if self.try_kw("ignore"):
+            ignore_lines = int(self.advance().value)
+            if self.at("ident") and str(self.cur.value).lower() == "lines":
+                self.advance()
+        return ast.LoadData(table, path, delimiter, ignore_lines)
 
     # ---- user admin (ref: parser grammar CreateUserStmt/GrantStmt) -------
     def _user_spec(self) -> str:
@@ -945,7 +981,7 @@ class Parser:
             s = self.advance().value
             return ast.FuncCall(f"{kw}_literal", [ast.Literal(s, "str")])
         if t.is_kw("replace", "left", "right", "database",
-                   "truncate", "mod"):
+                   "truncate", "mod", "user", "data"):
             # keywords that double as function names
             if self.toks[self.i + 1].kind == "op" and \
                     self.toks[self.i + 1].value == "(":

@@ -46,6 +46,8 @@ _SCALAR_FUNCS = {
     "dayofyear", "quarter", "week", "hour", "minute", "second",
     "last_day", "dayname", "monthname",
     "if", "ifnull", "coalesce", "nullif", "isnull",
+    "unix_timestamp", "from_unixtime", "crc32", "md5", "sha1", "sha2",
+    "bin", "oct", "unhex", "date_format",
 }
 _CANON = {"ceiling": "ceil", "power": "pow", "ucase": "upper",
           "lcase": "lower", "character_length": "char_length",
@@ -77,12 +79,14 @@ class ExpressionRewriter:
                  subq: Optional[SubqueryEvaluator] = None,
                  agg_ctx: Optional["AggContext"] = None,
                  outer_schema: Optional[Schema] = None,
-                 window_map: Optional[Dict[int, Expression]] = None):
+                 window_map: Optional[Dict[int, Expression]] = None,
+                 env: Optional[Dict[str, object]] = None):
         self.schema = schema
         self.subq = subq
         self.agg_ctx = agg_ctx
         self.outer_schema = outer_schema
         self.window_map = window_map or {}
+        self.env = env or {}
 
     # -- entry -------------------------------------------------------------
     def rewrite(self, node: ast.ExprNode) -> Expression:
@@ -184,9 +188,41 @@ class ExpressionRewriter:
             return lit(None)
         return lit(node.value)
 
+    # zero-argument environment functions fold to constants at plan time
+    # (ref: builtin_info.go + builtin_time.go now-family; the reference
+    # also evaluates these once per statement)
+    _ENV_FUNCS = ("now", "current_timestamp", "localtime",
+                  "localtimestamp", "sysdate", "curdate", "current_date",
+                  "version", "user", "current_user", "database",
+                  "connection_id")
+
+    def _env_func(self, name: str, node: ast.FuncCall):
+        import datetime as _dt
+        if name in ("now", "current_timestamp", "localtime",
+                    "localtimestamp", "sysdate"):
+            return Constant(_dt.datetime.now().replace(microsecond=0),
+                            T.datetime(False))
+        if name in ("curdate", "current_date"):
+            return Constant(_dt.date.today(), T.date(False))
+        if name == "version":
+            return lit("8.0.11-tidb-tpu")
+        env = getattr(self, "env", None) or {}
+        if name in ("user", "current_user"):
+            return lit(str(env.get("user", "root")) + "@%")
+        if name == "database":
+            return lit(str(env.get("database", "test")))
+        if name == "connection_id":
+            return lit(int(env.get("connection_id", 0)))
+        raise AssertionError(name)
+
     def _func_call(self, node: ast.FuncCall) -> Expression:
         name = node.name.lower()
         name = _CANON.get(name, name)
+        if name in self._ENV_FUNCS and not node.args:
+            return self._env_func(name, node)
+        if name == "unix_timestamp" and not node.args:
+            import time as _time_mod
+            return lit(int(_time_mod.time()))
         if name in AGG_NAMES:
             raise PlanError(
                 f"aggregate function {name}() in a non-aggregate context")
@@ -449,9 +485,13 @@ class PlanBuilder:
 
     def make_rewriter(self, schema: Schema, agg_ctx=None,
                       window_map=None) -> "ExpressionRewriter":
+        sess = getattr(self.ctx, "session", None)
+        env = {"user": getattr(sess, "user", "root"),
+               "connection_id": getattr(sess, "conn_id", 0)} \
+            if sess is not None else {}
         return ExpressionRewriter(schema, self.subq, agg_ctx,
                                   outer_schema=self.outer_schema,
-                                  window_map=window_map)
+                                  window_map=window_map, env=env)
 
     def next_subq_id(self) -> int:
         self._subq_n += 1

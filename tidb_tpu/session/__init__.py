@@ -133,6 +133,8 @@ def _stmt_tables(stmt) -> List[str]:
         names.append(stmt.table.name.lower())
     elif isinstance(stmt, (ast.CreateTable, ast.TruncateTable)):
         names.append(stmt.name.lower())
+    elif isinstance(stmt, ast.LoadData):
+        names.append(stmt.table.lower())
     elif isinstance(stmt, ast.DropTable):
         names.extend(n.lower() for n in stmt.names)
     elif isinstance(stmt, (ast.AlterTable, ast.CreateIndex, ast.DropIndex)):
@@ -201,6 +203,8 @@ class Engine:
         self.auth = AuthManager()
         # bumped by ANALYZE: plan-cache entries keyed on it go stale
         self.stats_version = 0
+        # SET GLOBAL scope, inherited by new sessions (sysvar.go analog)
+        self.global_vars: Dict[str, object] = {}
 
     def new_session(self) -> "Session":
         return Session(self)
@@ -263,6 +267,7 @@ class Session:
     def __init__(self, engine: Optional[Engine] = None):
         self.engine = engine or Engine()
         self.vars: Dict[str, object] = dict(DEFAULT_VARS)
+        self.vars.update(self.engine.global_vars)
         self.txn: Optional[Transaction] = None
         self.last_plan = None
         self.conn_id = next(Session._next_conn_id)
@@ -360,6 +365,7 @@ class Session:
     # ---- privilege gate (ref: privilege/privileges/privileges.go:62) -------
     _STMT_PRIV = {
         ast.Insert: "INSERT", ast.Update: "UPDATE", ast.Delete: "DELETE",
+        ast.LoadData: "INSERT",
         ast.CreateTable: "CREATE", ast.DropTable: "DROP",
         ast.TruncateTable: "DROP", ast.AlterTable: "ALTER",
         ast.CreateIndex: "INDEX", ast.DropIndex: "INDEX",
@@ -402,6 +408,8 @@ class Session:
             self._implicit_commit()
         if isinstance(stmt, ast.TraceStmt):
             return self._trace(stmt)
+        if isinstance(stmt, ast.LoadData):
+            return self._load_data(stmt)
         if isinstance(stmt, ast.BackupStmt):
             from tidb_tpu import tools
             done = tools.backup(self.engine, stmt.path)
@@ -626,6 +634,41 @@ class Session:
             return self._run_query(stmt)
         finally:
             self._stmt_snapshot = None
+
+    def _load_data(self, stmt: ast.LoadData) -> ResultSet:
+        """LOAD DATA INFILE: bulk CSV ingest through the INSERT path so
+        type coercion, defaults and unique checks all apply (ref:
+        executor/load_data.go)."""
+        import csv
+        total = 0
+        batch: List[str] = []
+        info = self.engine.catalog.info_schema.table(stmt.table)
+        n_cols = len(info.columns)
+
+        def flush():
+            nonlocal total
+            if batch:
+                self.execute(f"INSERT INTO `{stmt.table}` VALUES " +
+                             ",".join(batch))
+                total += len(batch)
+                batch.clear()
+
+        with open(stmt.path, newline="") as f:
+            r = csv.reader(f, delimiter=stmt.delimiter)
+            for i, row in enumerate(r):
+                if i < stmt.ignore_lines:
+                    continue
+                row = (row + [None] * n_cols)[:n_cols]
+                vals = ", ".join(
+                    "NULL" if v is None or v == "\\N" else
+                    "'" + str(v).replace("\\", "\\\\")
+                    .replace("'", "\\'") + "'"
+                    for v in row)
+                batch.append(f"({vals})")
+                if len(batch) >= 2000:
+                    flush()
+            flush()
+        return ok(total)
 
     def _trace(self, stmt) -> ResultSet:
         """TRACE <stmt>: run it with a span recorder attached and return
@@ -1077,13 +1120,25 @@ class Session:
         return ResultSet(["id", "estRows", "info"], [T.varchar()] * 3, rows)
 
     def _set(self, stmt: ast.SetStmt) -> ResultSet:
+        """SET [GLOBAL] var = value. GLOBAL scope persists engine-wide
+        (ref: sessionctx/variable — global vars stored in
+        mysql.global_variables and inherited by new sessions); session
+        scope stays connection-local."""
         from tidb_tpu.expression import Constant
         from tidb_tpu.planner.rules import fold_expr
         rw = ExpressionRewriter(Schema([]))
         for name, expr in stmt.assignments:
             folded = fold_expr(rw.rewrite(expr))
             value = folded.value if isinstance(folded, Constant) else None
-            self.vars[name.lower().lstrip("@")] = value
+            key = name.lower().lstrip("@")
+            if stmt.global_scope and not name.startswith("@"):
+                if not self.engine.auth.is_superuser(self.user):
+                    from tidb_tpu.session.auth import PrivilegeError
+                    raise PrivilegeError(
+                        "SET GLOBAL requires ALL on *.*")
+                with self.engine.stats_lock:
+                    self.engine.global_vars[key] = value
+            self.vars[key] = value
         return ok()
 
     def _show(self, stmt: ast.ShowStmt) -> ResultSet:
