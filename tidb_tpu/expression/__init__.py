@@ -1656,9 +1656,228 @@ def _date_format(func, ctx):
     return out, np.asarray(m) & np.asarray(fm)
 
 
+# ---------------------------------------------------------------------------
+# JSON functions (ref: types/json + expression/builtin_json.go) — host-only
+# path evaluation over JSON text; results are JSON text (or unquoted str)
+# ---------------------------------------------------------------------------
+
+
+def _json_path_steps(path: str):
+    """'$.a.b[0].c' → ['a', 'b', 0, 'c'] (the common path subset)."""
+    if not path.startswith("$"):
+        raise TypeError_(f"Invalid JSON path expression: {path!r}")
+    steps = []
+    i = 1
+    n = len(path)
+    while i < n:
+        c = path[i]
+        if c == ".":
+            j = i + 1
+            if j < n and path[j] == '"':
+                k = path.index('"', j + 1)
+                steps.append(path[j + 1:k])
+                i = k + 1
+            else:
+                k = j
+                while k < n and path[k] not in ".[":
+                    k += 1
+                steps.append(path[j:k])
+                i = k
+        elif c == "[":
+            k = path.index("]", i)
+            steps.append(int(path[i + 1:k]))
+            i = k + 1
+        else:
+            raise TypeError_(f"Invalid JSON path expression: {path!r}")
+    return steps
+
+
+def _json_get(doc, steps):
+    for s in steps:
+        if isinstance(s, int):
+            if not isinstance(doc, list) or s >= len(doc):
+                return None, False
+            doc = doc[s]
+        else:
+            if not isinstance(doc, dict) or s not in doc:
+                return None, False
+            doc = doc[s]
+    return doc, True
+
+
+def _json_rows(func, ctx, arg_idx=0):
+    import json as _json
+    if ctx.on_device:
+        raise TypeError_(f"{func.op}: host-only")
+    v, m = func.args[arg_idx].eval(ctx)
+    docs = []
+    ok = np.asarray(m).copy()
+    for i, x in enumerate(v):
+        if not ok[i]:
+            docs.append(None)
+            continue
+        try:
+            docs.append(_json.loads(str(x)))
+        except (ValueError, TypeError):
+            docs.append(None)
+            ok[i] = False
+    return docs, ok
+
+
+@kernel("json_extract")
+def _json_extract(func, ctx):
+    import json as _json
+    docs, ok = _json_rows(func, ctx)
+    pv, pm = func.args[1].eval(ctx)
+    out = np.empty(len(docs), dtype=object)
+    valid = ok & np.asarray(pm)
+    for i, d in enumerate(docs):
+        if not valid[i]:
+            out[i] = ""
+            continue
+        hit, found = _json_get(d, _json_path_steps(str(pv[i])))
+        if not found:
+            out[i] = ""
+            valid[i] = False
+        else:
+            out[i] = _json.dumps(hit, separators=(", ", ": "))
+    return out, valid
+
+
+@kernel("json_unquote")
+def _json_unquote(func, ctx):
+    if ctx.on_device:
+        raise TypeError_("json_unquote: host-only")
+    import json as _json
+    v, m = func.args[0].eval(ctx)
+    out = np.empty(len(v), dtype=object)
+    for i, x in enumerate(v):
+        s = str(x)
+        if s.startswith('"'):
+            try:
+                out[i] = _json.loads(s)
+                continue
+            except ValueError:
+                pass
+        out[i] = s
+    return out, m
+
+
+@kernel("json_valid")
+def _json_valid(func, ctx):
+    import json as _json
+    if ctx.on_device:
+        raise TypeError_("json_valid: host-only")
+    v, m = func.args[0].eval(ctx)
+    out = np.zeros(len(v), dtype=np.int64)
+    for i, x in enumerate(v):
+        try:
+            _json.loads(str(x))
+            out[i] = 1
+        except (ValueError, TypeError):
+            out[i] = 0
+    return out, m
+
+
+@kernel("json_type")
+def _json_type(func, ctx):
+    docs, ok = _json_rows(func, ctx)
+    out = np.empty(len(docs), dtype=object)
+    for i, d in enumerate(docs):
+        out[i] = ("OBJECT" if isinstance(d, dict) else
+                  "ARRAY" if isinstance(d, list) else
+                  "STRING" if isinstance(d, str) else
+                  "BOOLEAN" if isinstance(d, bool) else
+                  "INTEGER" if isinstance(d, int) else
+                  "DOUBLE" if isinstance(d, float) else "NULL")
+    return out, ok
+
+
+@kernel("json_length")
+def _json_length(func, ctx):
+    docs, ok = _json_rows(func, ctx)
+    out = np.zeros(len(docs), dtype=np.int64)
+    for i, d in enumerate(docs):
+        out[i] = len(d) if isinstance(d, (dict, list)) else 1
+    return out, ok
+
+
+@kernel("json_keys")
+def _json_keys(func, ctx):
+    import json as _json
+    docs, ok = _json_rows(func, ctx)
+    out = np.empty(len(docs), dtype=object)
+    valid = ok.copy()
+    for i, d in enumerate(docs):
+        if isinstance(d, dict):
+            out[i] = _json.dumps(list(d.keys()), separators=(", ", ": "))
+        else:
+            out[i] = ""
+            valid[i] = False
+    return out, valid
+
+
+@kernel("json_contains")
+def _json_contains(func, ctx):
+    docs, ok = _json_rows(func, ctx)
+    cands, cok = _json_rows(func, ctx, arg_idx=1)
+
+    def contains(doc, cand):
+        if isinstance(doc, list):
+            return any(contains(x, cand) or x == cand for x in doc) \
+                or doc == cand
+        if isinstance(doc, dict) and isinstance(cand, dict):
+            return all(k in doc and contains(doc[k], v) or
+                       doc.get(k) == v for k, v in cand.items())
+        return doc == cand
+
+    out = np.zeros(len(docs), dtype=np.int64)
+    for i, (d, c) in enumerate(zip(docs, cands)):
+        out[i] = 1 if contains(d, c) else 0
+    return out, ok & cok
+
+
+def _json_build_kernel(name, array: bool):
+    def k(func: ScalarFunc, ctx: EvalContext):
+        import json as _json
+        if ctx.on_device:
+            raise TypeError_(f"{name}: host-only")
+        cols = [a.eval(ctx) for a in func.args]
+        n = ctx.num_rows
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            vals = []
+            for (v, m), arg in zip(cols, func.args):
+                x = None if not np.asarray(m)[i] else v[i]
+                if x is not None and arg.ftype.kind is TypeKind.JSON:
+                    x = _json.loads(str(x))     # nest, don't double-encode
+                elif x is not None and not arg.ftype.kind.is_string:
+                    x = arg.ftype.decode_value(x)
+                    if hasattr(x, "isoformat"):
+                        x = str(x)
+                    from decimal import Decimal
+                    if isinstance(x, Decimal):
+                        x = float(x)
+                vals.append(x)
+            if array:
+                out[i] = _json.dumps(vals, separators=(", ", ": "))
+            else:
+                obj = {str(vals[j]): vals[j + 1]
+                       for j in range(0, len(vals) - 1, 2)}
+                out[i] = _json.dumps(obj, separators=(", ", ": "))
+        return out, np.ones(n, dtype=bool)
+    kernel(name)(k)
+
+
+_json_build_kernel("json_array", True)
+_json_build_kernel("json_object", False)
+
+
 HOST_ONLY_OPS = {"strcmp", "space", "dayname", "monthname", "crc32",
                  "md5", "sha1", "sha2", "bin", "oct", "unhex",
-                 "date_format"}
+                 "date_format", "json_extract", "json_unquote",
+                 "json_valid", "json_type", "json_length", "json_keys",
+                 "json_contains", "json_array", "json_object"}
 
 _BOOL_OPS = {"eq", "ne", "lt", "le", "gt", "ge", "nulleq", "and", "or", "xor",
              "not", "isnull", "like", "in"}
@@ -1748,8 +1967,14 @@ def infer_type(op: str, args: Sequence[Expression]) -> FieldType:
     if op == "from_unixtime":
         return T.datetime(nullable)
     if op in ("md5", "sha1", "sha2", "bin", "oct", "unhex",
-              "date_format"):
+              "date_format", "json_unquote", "json_type", "json_keys"):
         return T.varchar(nullable=True)
+    if op in ("json_extract",):
+        return T.json_type(True)
+    if op in ("json_array", "json_object"):
+        return T.json_type(False)
+    if op in ("json_valid", "json_length", "json_contains"):
+        return T.bigint(True)
     if op == "cast":
         raise AssertionError("cast requires explicit target type")
     raise TypeError_(f"cannot infer type for {op}")

@@ -39,8 +39,8 @@ class Token:
         return self.kind == "kw" and self.value in kws
 
 
-_TWO_CHAR_OPS = {"<=", ">=", "<>", "!=", "&&", "||", ":="}
-_THREE_CHAR_OPS = {"<=>"}
+_TWO_CHAR_OPS = {"<=", ">=", "<>", "!=", "&&", "||", ":=", "->"}
+_THREE_CHAR_OPS = {"<=>", "->>"}
 _ONE_CHAR_OPS = set("+-*/%(),.;=<>!@")
 
 

@@ -629,6 +629,22 @@ class Parser:
 
     def field_type(self) -> FieldType:
         t = self.advance()
+        if t.kind == "ident" and str(t.value).lower() == "json":
+            return FieldType(TypeKind.JSON, True)
+        if t.kind == "kw" and t.value == "set" or \
+                t.kind == "ident" and str(t.value).lower() == "enum":
+            kind = TypeKind.SET if t.value == "set" else TypeKind.ENUM
+            self.expect_op("(")
+            elems = []
+            while True:
+                if not self.at("str"):
+                    raise ParseError(
+                        f"expected string element near {self._near()}")
+                elems.append(self.advance().value)
+                if not self.try_op(","):
+                    break
+            self.expect_op(")")
+            return FieldType(kind, True, elems=tuple(elems))
         if t.kind != "kw":
             raise ParseError(f"expected type near {self._near()}")
         kw = t.value
@@ -917,7 +933,17 @@ class Parser:
             return ast.UnaryOp("minus", self.unary_expr())
         if self.try_op("+"):
             return self.unary_expr()
-        return self.primary()
+        e = self.primary()
+        # JSON path extraction operators: col->'$.a' / col->>'$.a'
+        while self.at_op("->", "->>"):
+            op = self.advance().value
+            if not self.at("str"):
+                raise ParseError(f"expected path string near {self._near()}")
+            path = ast.Literal(self.advance().value, "str")
+            e = ast.FuncCall("json_extract", [e, path])
+            if op == "->>":
+                e = ast.FuncCall("json_unquote", [e])
+        return e
 
     def primary(self) -> ast.ExprNode:
         t = self.cur

@@ -48,6 +48,9 @@ _SCALAR_FUNCS = {
     "if", "ifnull", "coalesce", "nullif", "isnull",
     "unix_timestamp", "from_unixtime", "crc32", "md5", "sha1", "sha2",
     "bin", "oct", "unhex", "date_format",
+    "json_extract", "json_unquote", "json_valid", "json_type",
+    "json_length", "json_keys", "json_contains", "json_array",
+    "json_object",
 }
 _CANON = {"ceiling": "ceil", "power": "pow", "ucase": "upper",
           "lcase": "lower", "character_length": "char_length",
@@ -1007,6 +1010,15 @@ def _coerce_temporal_cmp(op: str, left: Expression, right: Expression):
             try:
                 ft = target.ftype.with_nullable(False)
                 return Constant(ft.decode_value(ft.encode_value(e.value)), ft)
+            except (ValueError, TypeError):
+                return e
+        from tidb_tpu.types import TypeKind as _TK
+        if (isinstance(e, Constant) and e.ftype.kind.is_string
+                and target.ftype.kind in (_TK.ENUM, _TK.SET)
+                and e.value is not None):
+            try:
+                ft = target.ftype.with_nullable(False)
+                return Constant(e.value, ft)   # encodes to index at eval
             except (ValueError, TypeError):
                 return e
         return e

@@ -40,6 +40,9 @@ class TypeKind(enum.Enum):
     DATETIME = "datetime"
     TIMESTAMP = "timestamp"
     TIME = "time"  # MySQL duration
+    ENUM = "enum"  # 1-based element index (0 = invalid/empty)
+    SET = "set"    # bitmask over elements
+    JSON = "json"  # normalized JSON text (types/json — text, not binary)
     NULLTYPE = "null"
 
     @property
@@ -78,19 +81,21 @@ class FieldType:
     precision: int = 0   # DECIMAL precision / display width
     scale: int = 0       # DECIMAL scale / fractional-second precision
     unsigned: bool = False
+    elems: tuple = ()    # ENUM/SET member strings (types/etc.go)
 
     # ---- physical layout -------------------------------------------------
     @property
     def np_dtype(self) -> np.dtype:
         k = self.kind
         if k.is_integer or k is TypeKind.DECIMAL or k in (
-                TypeKind.DATETIME, TypeKind.TIMESTAMP, TypeKind.TIME):
+                TypeKind.DATETIME, TypeKind.TIMESTAMP, TypeKind.TIME,
+                TypeKind.ENUM, TypeKind.SET):
             return np.dtype(np.int64)
         if k is TypeKind.DATE:
             return np.dtype(np.int32)
         if k.is_float:
             return np.dtype(np.float64)
-        if k.is_string:
+        if k.is_string or k is TypeKind.JSON:
             return np.dtype(object)
         if k is TypeKind.NULLTYPE:
             return np.dtype(np.int64)
@@ -98,7 +103,7 @@ class FieldType:
 
     @property
     def is_varlen(self) -> bool:
-        return self.kind.is_string
+        return self.kind.is_string or self.kind is TypeKind.JSON
 
     @property
     def decimal_multiplier(self) -> int:
@@ -154,6 +159,40 @@ class FieldType:
             if isinstance(v, _dt.timedelta):
                 return v // _dt.timedelta(microseconds=1)
             return int(v)
+        if k is TypeKind.ENUM:
+            if isinstance(v, str):
+                low = v.lower()
+                for i, e in enumerate(self.elems):
+                    if e.lower() == low:
+                        return i + 1          # 1-based index
+                raise ValueError(f"Data truncated: {v!r} not in ENUM")
+            idx = int(v)
+            if not 0 <= idx <= len(self.elems):
+                raise ValueError(f"Data truncated: {v!r} not in ENUM")
+            return idx
+        if k is TypeKind.SET:
+            if isinstance(v, str):
+                mask = 0
+                for part in filter(None, v.split(",")):
+                    low = part.strip().lower()
+                    for i, e in enumerate(self.elems):
+                        if e.lower() == low:
+                            mask |= 1 << i
+                            break
+                    else:
+                        raise ValueError(
+                            f"Data truncated: {part!r} not in SET")
+                return mask
+            mask = int(v)
+            if mask >> len(self.elems):
+                raise ValueError(f"Data truncated: {v!r} not in SET")
+            return mask
+        if k is TypeKind.JSON:
+            import json as _json
+            if isinstance(v, str):
+                # validate + normalize (types/json BinaryJSON parse)
+                return _json.dumps(_json.loads(v), separators=(", ", ": "))
+            return _json.dumps(v, separators=(", ", ": "))
         if k.is_string:
             return str(v)
         return v
@@ -179,10 +218,20 @@ class FieldType:
             return _dt.datetime(1970, 1, 1) + _dt.timedelta(microseconds=int(raw))
         if k is TypeKind.TIME:
             return _dt.timedelta(microseconds=int(raw))
+        if k is TypeKind.ENUM:
+            i = int(raw)
+            return self.elems[i - 1] if 1 <= i <= len(self.elems) else ""
+        if k is TypeKind.SET:
+            mask = int(raw)
+            return ",".join(e for i, e in enumerate(self.elems)
+                            if mask & (1 << i))
         return raw
 
     def __str__(self) -> str:
-        if self.kind is TypeKind.DECIMAL:
+        if self.kind in (TypeKind.ENUM, TypeKind.SET):
+            body = ",".join(f"'{e}'" for e in self.elems)
+            s = f"{self.kind.value}({body})"
+        elif self.kind is TypeKind.DECIMAL:
             s = f"decimal({self.precision},{self.scale})"
         elif self.kind.is_string and self.precision:
             s = f"{self.kind.value}({self.precision})"
@@ -227,6 +276,18 @@ def datetime(nullable: bool = True) -> FieldType:
     return FieldType(TypeKind.DATETIME, nullable)
 
 
+def json_type(nullable: bool = True) -> FieldType:
+    return FieldType(TypeKind.JSON, nullable)
+
+
+def enum_(elems, nullable: bool = True) -> FieldType:
+    return FieldType(TypeKind.ENUM, nullable, elems=tuple(elems))
+
+
+def set_(elems, nullable: bool = True) -> FieldType:
+    return FieldType(TypeKind.SET, nullable, elems=tuple(elems))
+
+
 def null_type() -> FieldType:
     return FieldType(TypeKind.NULLTYPE, True)
 
@@ -242,6 +303,11 @@ _NUMERIC_ORDER = {
 
 def merge_numeric(a: FieldType, b: FieldType) -> FieldType:
     """Result type of a binary arithmetic op — MySQL-ish promotion."""
+    # ENUM/SET act as their integer index/bitmask in numeric contexts
+    if a.kind in (TypeKind.ENUM, TypeKind.SET):
+        a = FieldType(TypeKind.BIGINT, a.nullable)
+    if b.kind in (TypeKind.ENUM, TypeKind.SET):
+        b = FieldType(TypeKind.BIGINT, b.nullable)
     if a.kind is TypeKind.NULLTYPE:
         return b.with_nullable(True)
     if b.kind is TypeKind.NULLTYPE:
