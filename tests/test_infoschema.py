@@ -1,0 +1,71 @@
+"""information_schema virtual tables (ref: infoschema/tables.go)."""
+
+import pytest
+
+from tidb_tpu.session import Engine
+
+
+@pytest.fixture()
+def s():
+    eng = Engine()
+    s = eng.new_session()
+    s.execute("CREATE TABLE t1 (a BIGINT PRIMARY KEY, b VARCHAR(8))")
+    s.execute("CREATE INDEX ib ON t1 (b)")
+    s.execute("INSERT INTO t1 VALUES (1,'x'),(2,'y'),(3,'z')")
+    s.execute("CREATE TABLE t2 (c DOUBLE)")
+    return s
+
+
+def test_tables_and_columns(s):
+    rows = dict((r[0], r[1]) for r in s.query(
+        "SELECT table_name, table_rows FROM information_schema.tables"
+    ).rows)
+    assert rows == {"t1": 3, "t2": 0}
+    cols = s.query("SELECT column_name, column_key FROM "
+                   "information_schema.columns WHERE table_name = 't1' "
+                   "ORDER BY ordinal_position").rows
+    assert cols == [("a", "PRI"), ("b", "")]
+
+
+def test_statistics_lists_indexes(s):
+    rows = s.query("SELECT index_name, column_name, non_unique FROM "
+                   "information_schema.statistics "
+                   "WHERE table_name = 't1' ORDER BY index_name").rows
+    assert rows == [("PRIMARY", "a", 0), ("ib", "b", 1)]
+
+
+def test_user_privileges_and_variables(s):
+    s.execute("CREATE USER w IDENTIFIED BY 'p'")
+    s.execute("GRANT SELECT, INSERT ON t1 TO w")
+    rows = s.query("SELECT privilege_type FROM "
+                   "information_schema.user_privileges "
+                   "WHERE grantee = \"'w'@'%'\" ORDER BY 1").rows
+    assert rows == [("INSERT",), ("SELECT",)]
+    n = s.query("SELECT COUNT(*) FROM "
+                "information_schema.session_variables").scalar()
+    assert n >= 5
+
+
+def test_memtables_compose_with_sql(s):
+    # joins/aggregates over memtables run through the normal planner
+    rows = s.query(
+        "SELECT t.table_name, COUNT(*) FROM information_schema.tables t "
+        "JOIN information_schema.columns c ON t.table_name = c.table_name "
+        "GROUP BY t.table_name ORDER BY t.table_name").rows
+    assert rows == [("t1", 2), ("t2", 1)]
+
+
+def test_memtable_fresh_per_execution(s):
+    q = ("SELECT table_rows FROM information_schema.tables "
+         "WHERE table_name = 't1'")
+    assert s.query(q).rows == [(3,)]
+    s.execute("INSERT INTO t1 VALUES (4,'w')")
+    assert s.query(q).rows == [(4,)]
+
+
+def test_non_superuser_can_read_infoschema(s):
+    s.execute("CREATE USER viewer IDENTIFIED BY 'v'")
+    s2 = s.engine.new_session()
+    s2.user = "viewer"
+    assert s2.query("SELECT COUNT(*) FROM information_schema.tables"
+                    ).scalar() >= 2

@@ -150,6 +150,35 @@ class MaterializingExec(Executor):
         return out
 
 
+class MemTableExec(MaterializingExec):
+    """information_schema virtual-table scan (ref: infoschema/tables.go
+    memtable retrievers): rows materialize fresh per execution."""
+
+    def __init__(self, plan):
+        super().__init__(plan.schema.field_types, [])
+        self.plan = plan
+
+    def runtime_info(self) -> str:
+        return f"memtable:{self.plan.mt_name}"
+
+    def _materialize(self) -> Chunk:
+        rows = self.plan.rows_fn()
+        if not rows:
+            return _empty_chunk(self.schema)
+        cols = []
+        for ci, ft in enumerate(self.schema):
+            raw = [ft.encode_value(r[ci]) for r in rows]
+            mask = np.array([x is not None for x in raw], dtype=bool)
+            if ft.is_varlen:
+                vals = np.array([x if x is not None else "" for x in raw],
+                                dtype=object)
+            else:
+                vals = np.array([x if x is not None else 0 for x in raw],
+                                dtype=ft.np_dtype)
+            cols.append(Column(ft, vals, None if mask.all() else mask))
+        return Chunk(cols)
+
+
 def _empty_chunk(schema: List[FieldType]) -> Chunk:
     cols = []
     for ft in schema:
@@ -317,7 +346,9 @@ def build(plan: PhysicalPlan) -> Executor:
     if isinstance(plan, PhysIndexScan):
         from tidb_tpu.executor.index_scan import IndexScanExec
         return IndexScanExec(plan)
-    from tidb_tpu.planner.physical import PhysIndexLookupJoin
+    from tidb_tpu.planner.physical import PhysIndexLookupJoin, PhysMemTable
+    if isinstance(plan, PhysMemTable):
+        return MemTableExec(plan)
     if isinstance(plan, PhysIndexLookupJoin):
         from tidb_tpu.executor.index_join import IndexLookupJoinExec
         return IndexLookupJoinExec(plan, build(plan.children[0]))

@@ -1,0 +1,146 @@
+"""information_schema virtual tables (ref: infoschema/tables.go — the
+reference exposes ~60 memtables; these are the core inspection set).
+
+Each table is a (schema, rows-closure) pair: rows materialize at
+execution time from the live catalog/storage/observability state, so a
+cached plan still reads fresh data. The reference computes its memtables
+the same way (infoschema retrievers fill chunks on demand)."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Tuple
+
+from tidb_tpu import types as T
+from tidb_tpu.errors import UnknownTableError
+
+# name → (column name, type) list + row builder(session) → rows
+_TABLES: Dict[str, Tuple[List[Tuple[str, object]],
+                         Callable[[object], List[tuple]]]] = {}
+
+
+def register(name: str, columns):
+    def deco(fn):
+        _TABLES[name.lower()] = (columns, fn)
+        return fn
+    return deco
+
+
+def lookup(name: str):
+    hit = _TABLES.get(name.lower())
+    if hit is None:
+        raise UnknownTableError(
+            f"Unknown table 'information_schema.{name}'")
+    return hit
+
+
+def table_names() -> List[str]:
+    return sorted(_TABLES)
+
+
+def _user_tables(session):
+    return [t for t in session.engine.catalog.info_schema.list_tables()
+            if not t.name.startswith("#")]
+
+
+@register("tables", [("TABLE_SCHEMA", T.varchar()),
+                     ("TABLE_NAME", T.varchar()),
+                     ("TABLE_ROWS", T.bigint()),
+                     ("TABLE_ID", T.bigint()),
+                     ("REGIONS", T.bigint())])
+def _tables(session):
+    stats = session.engine.store.stats()
+    out = []
+    for t in _user_tables(session):
+        regions, live = stats.get(t.id, (0, 0))
+        out.append(("test", t.name, live, t.id, regions))
+    return out
+
+
+@register("columns", [("TABLE_NAME", T.varchar()),
+                      ("COLUMN_NAME", T.varchar()),
+                      ("ORDINAL_POSITION", T.bigint()),
+                      ("IS_NULLABLE", T.varchar()),
+                      ("DATA_TYPE", T.varchar()),
+                      ("COLUMN_KEY", T.varchar())])
+def _columns(session):
+    out = []
+    for t in _user_tables(session):
+        for i, c in enumerate(t.columns):
+            out.append((t.name, c.name, i + 1,
+                        "YES" if c.ftype.nullable else "NO",
+                        c.ftype.kind.value,
+                        "PRI" if c.primary_key else ""))
+    return out
+
+
+@register("statistics", [("TABLE_NAME", T.varchar()),
+                         ("INDEX_NAME", T.varchar()),
+                         ("SEQ_IN_INDEX", T.bigint()),
+                         ("COLUMN_NAME", T.varchar()),
+                         ("NON_UNIQUE", T.bigint())])
+def _statistics(session):
+    out = []
+    for t in _user_tables(session):
+        if t.primary_key:
+            for i, c in enumerate(t.primary_key):
+                out.append((t.name, "PRIMARY", i + 1, c, 0))
+        for ix in t.indexes:
+            for i, c in enumerate(ix.columns):
+                out.append((t.name, ix.name, i + 1, c,
+                            0 if ix.unique else 1))
+    return out
+
+
+@register("user_privileges", [("GRANTEE", T.varchar()),
+                              ("PRIVILEGE_TYPE", T.varchar()),
+                              ("SCOPE", T.varchar())])
+def _user_privileges(session):
+    auth = session.engine.auth
+    out = []
+    with auth._lock:
+        grants = {u: {k: set(v) for k, v in g.items()}
+                  for u, g in auth.grants.items()}
+    for user, scopes in sorted(grants.items()):
+        for (db, tbl), privs in sorted(scopes.items()):
+            for p in sorted(privs):
+                out.append((f"'{user}'@'%'", p, f"{db}.{tbl}"))
+    return out
+
+
+@register("session_variables", [("VARIABLE_NAME", T.varchar()),
+                                ("VARIABLE_VALUE", T.varchar())])
+def _session_variables(session):
+    return sorted((k, str(v)) for k, v in session.vars.items())
+
+
+@register("processlist", [("ID", T.bigint()),
+                          ("USER", T.varchar()),
+                          ("TIME", T.double()),
+                          ("INFO", T.varchar())])
+def _processlist(session):
+    from tidb_tpu.util.observability import REGISTRY
+    return [(cid, session.user, secs, sql)
+            for cid, secs, sql in REGISTRY.process_rows()]
+
+
+@register("table_storage_stats", [("TABLE_NAME", T.varchar()),
+                                  ("LIVE_ROWS", T.bigint()),
+                                  ("DEAD_ROWS", T.bigint()),
+                                  ("REGION_COUNT", T.bigint())])
+def _table_storage_stats(session):
+    out = []
+    for t in _user_tables(session):
+        live, dead, regions = session.engine.store.gc_stats(t.id)
+        out.append((t.name, live, dead, regions))
+    return out
+
+
+@register("engines", [("ENGINE", T.varchar()),
+                      ("SUPPORT", T.varchar()),
+                      ("COMMENT", T.varchar())])
+def _engines(session):
+    import jax
+    backend = jax.default_backend()
+    return [("tidb_tpu_cpu", "YES", "vectorized numpy volcano"),
+            ("tidb_tpu_device", "DEFAULT" if backend == "tpu" else "YES",
+             f"fused XLA fragments ({backend})")]

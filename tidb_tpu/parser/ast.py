@@ -159,6 +159,7 @@ class TableName(TableRef):
     name: str
     alias: Optional[str] = None
     as_of: Optional[ExprNode] = None     # AS OF TIMESTAMP <expr>
+    db: Optional[str] = None             # db-qualified: db.table
 
     @property
     def ref_name(self) -> str:

@@ -496,6 +496,10 @@ class Parser:
             self.expect_op(")")
             return refs
         name = self.ident()
+        db = None
+        if self.try_op("."):
+            db = name
+            name = self.ident()
         alias = None
         as_of = None
         if self.try_kw("as"):
@@ -512,7 +516,7 @@ class Parser:
                 alias = self.ident()
             elif self.at("ident"):
                 alias = self.advance().value
-        return ast.TableName(name, alias, as_of=as_of)
+        return ast.TableName(name, alias, as_of=as_of, db=db)
 
     # ---- DDL -------------------------------------------------------------
     def create_table(self):

@@ -519,6 +519,8 @@ class PlanBuilder:
     # -- FROM ---------------------------------------------------------------
     def build_table_ref(self, ref: ast.TableRef) -> LogicalPlan:
         if isinstance(ref, ast.TableName):
+            if ref.db and ref.db.lower() == "information_schema":
+                return self._build_memtable(ref)
             mapped = self.cte_map.get(ref.name.lower())
             if mapped is not None:
                 info = self.info_schema.table(mapped)
@@ -535,6 +537,20 @@ class PlanBuilder:
         if isinstance(ref, ast.JoinExpr):
             return self.build_join(ref)
         raise PlanError(f"unsupported table reference {ref!r}")
+
+    def _build_memtable(self, ref: ast.TableName) -> LogicalPlan:
+        """information_schema.<name> → virtual memtable over live state
+        (ref: infoschema/tables.go)."""
+        from tidb_tpu import infoschema_tables as IT
+        from tidb_tpu.planner.logical import LogicalMemTable
+        columns, rows_builder = IT.lookup(ref.name)
+        qual = (ref.alias or ref.name).lower()
+        schema = Schema([SchemaColumn(n, ft, qual) for n, ft in columns])
+        sess = getattr(self.ctx, "session", None)
+        if sess is None:
+            raise PlanError("information_schema requires a session")
+        return LogicalMemTable(ref.name.lower(), schema,
+                               lambda: rows_builder(sess))
 
     def build_join(self, j: ast.JoinExpr) -> LogicalPlan:
         left = self.build_table_ref(j.left)

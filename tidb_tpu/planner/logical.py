@@ -136,6 +136,16 @@ class LogicalDual(LogicalPlan):
         self.n_rows = n_rows
 
 
+class LogicalMemTable(LogicalPlan):
+    """A virtual in-memory table (ref: infoschema memtable retrievers):
+    `rows_fn()` materializes fresh rows at execution time."""
+
+    def __init__(self, mt_name: str, schema: Schema, rows_fn):
+        super().__init__(schema)
+        self.mt_name = mt_name
+        self.rows_fn = rows_fn
+
+
 class LogicalSelection(LogicalPlan):
     def __init__(self, conditions: List[Expression], child: LogicalPlan):
         super().__init__(child.schema, [child])

@@ -22,10 +22,11 @@ from tidb_tpu.expression import ColumnRef, Expression
 from tidb_tpu.expression.aggfuncs import AggDesc, build_agg
 from tidb_tpu.planner.logical import (LogicalAggregation, LogicalDataSource,
                                       LogicalDual, LogicalJoin, LogicalLimit,
-                                      LogicalPlan, LogicalProjection,
-                                      LogicalSelection, LogicalSort,
-                                      LogicalTopN, LogicalUnionAll,
-                                      LogicalWindow, Schema)
+                                      LogicalMemTable, LogicalPlan,
+                                      LogicalProjection, LogicalSelection,
+                                      LogicalSort, LogicalTopN,
+                                      LogicalUnionAll, LogicalWindow,
+                                      Schema)
 
 DEFAULT_TPU_ROW_THRESHOLD = 32768
 
@@ -103,6 +104,18 @@ class PhysIndexScan(PhysicalPlan):
         if self.residual:
             s += f", residual:{self.residual!r}"
         return s
+
+
+class PhysMemTable(PhysicalPlan):
+    """Virtual-table scan (infoschema memtable)."""
+
+    def __init__(self, mt: LogicalMemTable):
+        super().__init__(mt.schema)
+        self.mt_name = mt.mt_name
+        self.rows_fn = mt.rows_fn
+
+    def describe(self):
+        return f"memtable:information_schema.{self.mt_name}"
 
 
 class PhysDual(PhysicalPlan):
@@ -397,6 +410,9 @@ def estimate(plan: PhysicalPlan, ctx) -> float:
             stats = _table_stats(plan.table, ctx)
             n *= filters_selectivity(plan.filters, stats)
         plan.est_rows = max(n, 1.0)
+        return plan.est_rows
+    if isinstance(plan, PhysMemTable):
+        plan.est_rows = 64.0
         return plan.est_rows
     if isinstance(plan, PhysDual):
         plan.est_rows = float(plan.n_rows)
@@ -710,6 +726,8 @@ def _to_physical(plan: LogicalPlan, ctx) -> PhysicalPlan:
         if idx is not None:
             return idx
         return PhysTableScan(plan)
+    if isinstance(plan, LogicalMemTable):
+        return PhysMemTable(plan)
     if isinstance(plan, LogicalDual):
         return PhysDual(plan.schema, plan.n_rows)
     kids = [_to_physical(c, ctx) for c in plan.children]

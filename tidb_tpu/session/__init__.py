@@ -104,6 +104,8 @@ def _stmt_tables(stmt) -> List[str]:
 
     def from_ref(ref):
         if isinstance(ref, ast.TableName):
+            if (ref.db or "").lower() == "information_schema":
+                return          # world-readable virtual tables
             names.append(ref.name.lower())
         elif isinstance(ref, ast.JoinExpr):
             from_ref(ref.left)
