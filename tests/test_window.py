@@ -211,3 +211,120 @@ def test_device_window_matches_cpu(session, sql):
                 assert abs(x - y) <= 1e-4 * max(1.0, abs(x)), (a, b)
             else:
                 assert x == y, (a, b)
+
+
+# ---- frame clauses (ROWS BETWEEN …) ----------------------------------------
+
+def _frame_oracle(rows, key, val, pre, post, agg):
+    """Brute-force ROWS-frame oracle over (partition_key, value) rows."""
+    from collections import defaultdict
+    parts = defaultdict(list)
+    for i, (k, v) in enumerate(rows):
+        parts[k].append((i, v))
+    out = {}
+    for k, items in parts.items():
+        for j, (i, _v) in enumerate(items):
+            lo = 0 if pre is None else max(j - pre, 0)
+            hi = len(items) - 1 if post is None else min(j + post,
+                                                         len(items) - 1)
+            window = [v for _, v in items[lo:hi + 1] if v is not None]
+            if agg == "sum":
+                out[i] = sum(window) if window else None
+            elif agg == "count":
+                out[i] = len(window)
+            elif agg == "min":
+                out[i] = min(window) if window else None
+            elif agg == "max":
+                out[i] = max(window) if window else None
+    return out
+
+
+def test_rows_frame_sum_count_min_max():
+    import numpy as np
+    from tidb_tpu.session import Engine
+    s = Engine().new_session()
+    s.execute("CREATE TABLE wf (id BIGINT, k BIGINT, v BIGINT)")
+    rng = np.random.default_rng(31)
+    data = []
+    for i in range(400):
+        k = int(rng.integers(0, 5))
+        v = None if rng.random() < 0.1 else int(rng.integers(0, 100))
+        data.append((k, v))
+    s.execute("INSERT INTO wf VALUES " + ",".join(
+        f"({i},{k},{v if v is not None else 'NULL'})"
+        for i, (k, v) in enumerate(data)))
+    for agg, pre, post, clause in [
+        ("sum", 2, 0, "ROWS BETWEEN 2 PRECEDING AND CURRENT ROW"),
+        ("sum", 1, 3, "ROWS BETWEEN 1 PRECEDING AND 3 FOLLOWING"),
+        ("count", None, 0, "ROWS UNBOUNDED PRECEDING"),
+        ("min", 3, 3, "ROWS BETWEEN 3 PRECEDING AND 3 FOLLOWING"),
+        ("max", 0, None,
+         "ROWS BETWEEN CURRENT ROW AND UNBOUNDED FOLLOWING"),
+        ("min", None, 2, "ROWS BETWEEN UNBOUNDED PRECEDING AND "
+                         "2 FOLLOWING"),
+    ]:
+        got = dict(s.query(
+            f"SELECT id, {agg.upper()}(v) OVER "
+            f"(PARTITION BY k ORDER BY id {clause}) FROM wf").rows)
+        want = _frame_oracle(data, "k", "v", pre, post, agg)
+        assert got == want, (agg, clause)
+
+
+def test_first_last_value():
+    from tidb_tpu.session import Engine
+    s = Engine().new_session()
+    s.execute("CREATE TABLE fv (id BIGINT, k BIGINT, v BIGINT)")
+    s.execute("INSERT INTO fv VALUES (1,1,10),(2,1,20),(3,1,20),(4,1,30),"
+              "(5,2,7)")
+    rows = s.query(
+        "SELECT id, FIRST_VALUE(v) OVER (PARTITION BY k ORDER BY v), "
+        "LAST_VALUE(v) OVER (PARTITION BY k ORDER BY v) FROM fv "
+        "ORDER BY id").rows
+    # default frame: last_value ends at the current PEER group (MySQL)
+    assert rows == [(1, 10, 10), (2, 10, 20), (3, 10, 20), (4, 10, 30),
+                    (5, 7, 7)]
+    rows = s.query(
+        "SELECT id, LAST_VALUE(v) OVER (PARTITION BY k ORDER BY v "
+        "ROWS BETWEEN UNBOUNDED PRECEDING AND UNBOUNDED FOLLOWING) "
+        "FROM fv ORDER BY id").rows
+    assert rows == [(1, 30), (2, 30), (3, 30), (4, 30), (5, 7)]
+
+
+def test_frames_on_device():
+    import numpy as np
+    from tidb_tpu.session import Engine
+    from tidb_tpu.executor import build, run_to_completion
+    from tidb_tpu.executor.fragment import TpuFragmentExec
+    from tidb_tpu.parser import parse
+    s = Engine().new_session()
+    s.execute("CREATE TABLE wd (id BIGINT, k BIGINT, v BIGINT)")
+    rng = np.random.default_rng(13)
+    s.execute("INSERT INTO wd VALUES " + ",".join(
+        f"({i},{int(rng.integers(0, 7))},{int(rng.integers(0, 50))})"
+        for i in range(3000)))
+    sql = ("SELECT id, SUM(v) OVER (PARTITION BY k ORDER BY id "
+           "ROWS BETWEEN 2 PRECEDING AND 1 FOLLOWING), "
+           "MIN(v) OVER (PARTITION BY k ORDER BY id "
+           "ROWS BETWEEN 3 PRECEDING AND CURRENT ROW) FROM wd")
+    cpu = sorted(map(str, s.query(sql).rows))
+    s.vars.update({"tidb_tpu_engine": "on", "tidb_tpu_row_threshold": 1,
+                   "tidb_tpu_strict": "on"})
+    try:
+        plan = s._plan(parse(sql)[0])
+        root = build(plan)
+        chunks = run_to_completion(root, s._exec_ctx())
+        frags = []
+
+        def walk(e):
+            if isinstance(e, TpuFragmentExec):
+                frags.append(e)
+            for c in getattr(e, "children", []):
+                walk(c)
+
+        walk(root)
+        assert frags and all(f.used_device for f in frags), \
+            [f.fallback_reason for f in frags]
+        dev = sorted(map(str, (r for ch in chunks for r in ch.rows())))
+    finally:
+        s.vars.update({"tidb_tpu_engine": "off", "tidb_tpu_strict": "off"})
+    assert dev == cpu

@@ -211,4 +211,5 @@ def _window_value(ctx, live, d, n, perm, pstart, peerstart):
         vals = vals.astype(device_float_dtype()) / \
             d.args[0].ftype.decimal_multiplier
     return W.compute(jnp, d.name, vals, valid, pstart, peerstart,
-                     bool(d.order), d.offset, fill)
+                     bool(d.order), d.offset, fill,
+                     frame=getattr(d, "frame", None))

@@ -92,7 +92,8 @@ class WindowExec(MaterializingExec):
             vals = vals.astype(np.float64) / \
                 d.args[0].ftype.decimal_multiplier
         return W.compute(np, d.name, vals, valid, pstart, peerstart,
-                         bool(d.order), d.offset, fill)
+                         bool(d.order), d.offset, fill,
+                         frame=getattr(d, "frame", None))
 
 
 def _sorted_layout(chunk: Chunk, n: int, d):

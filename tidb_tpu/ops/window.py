@@ -147,11 +147,134 @@ def running_agg(xp, name, vals, valid, pstart, peerstart):
     raise AssertionError(f"running {name} is not supported")
 
 
+def _frame_bounds(xp, pstart, pre, post):
+    """Per-row [lo, hi] ROWS-frame positions clamped to the partition
+    (None = unbounded on that side)."""
+    from tidb_tpu.ops import segment as seg
+    n = pstart.shape[0]
+    iota = _iota(xp, n)
+    ppos = _pstart_pos(xp, pstart)
+    pid = partition_ids(xp, pstart)
+    last = seg.segment_max(xp, iota, pid.astype(xp.int32)
+                           if xp is not np else pid, n)
+    plast = xp.take(last, pid)
+    lo = ppos if pre is None else xp.maximum(iota - pre, ppos)
+    hi = plast if post is None else xp.minimum(iota + post, plast)
+    return lo, hi
+
+
+def rows_frame_agg(xp, name, vals, valid, pstart, pre, post):
+    """Aggregate over an explicit ROWS frame (ref: executor/window.go
+    slide frames; here prefix sums / a doubling sparse table instead of
+    per-row slide state)."""
+    n = pstart.shape[0]
+    lo, hi = _frame_bounds(xp, pstart, pre, post)
+    empty = hi < lo
+    ccnt = xp.cumsum(valid.astype(xp.int64))
+    base_c = xp.where(lo > 0, xp.take(ccnt, xp.maximum(lo - 1, 0)),
+                      xp.int64(0))
+    c = xp.where(empty, xp.int64(0),
+                 xp.take(ccnt, xp.clip(hi, 0, n - 1)) - base_c)
+    if name == "count":
+        return c, xp.ones(n, dtype=bool)
+    if name in ("sum", "avg"):
+        z = xp.where(valid, vals, xp.zeros_like(vals))
+        acc_dt = (xp.float64 if xp is np else z.dtype) \
+            if z.dtype.kind == "f" else xp.int64
+        cum = xp.cumsum(z.astype(acc_dt))
+        base = xp.where(lo > 0, xp.take(cum, xp.maximum(lo - 1, 0)),
+                        xp.zeros((), dtype=cum.dtype))
+        s = xp.take(cum, xp.clip(hi, 0, n - 1)) - base
+        if name == "sum":
+            return s, (c > 0) & ~empty
+        safe = xp.where(c > 0, c, xp.ones_like(c))
+        out = s / safe.astype(s.dtype) if s.dtype.kind == "f" else s / safe
+        return out, (c > 0) & ~empty
+    if name in ("min", "max"):
+        from tidb_tpu.ops import segment as seg
+        op = xp.minimum if name == "min" else xp.maximum
+        if pre is None or post is None:
+            ident = seg._max_identity(vals.dtype) if name == "min" \
+                else seg._min_identity(vals.dtype)
+            masked = xp.where(valid, vals,
+                              xp.asarray(ident, dtype=vals.dtype))
+            ok = (c > 0) & ~empty
+            if pre is None:
+                # [partition start, hi]: inclusive prefix scan
+                scan = _segmented_scan(xp, masked, pstart, op)
+                return xp.take(scan, xp.clip(hi, 0, n - 1)), ok
+            # [lo, partition end]: suffix scan via the flipped layout
+            iota = _iota(xp, n)
+            pid = partition_ids(xp, pstart)
+            last = seg.segment_max(xp, iota, pid.astype(xp.int32)
+                                   if xp is not np else pid, n)
+            plast = xp.take(last, pid)
+            pstart_r = xp.flip(iota == plast)
+            scan_r = _segmented_scan(xp, xp.flip(masked), pstart_r, op)
+            suffix = xp.flip(scan_r)
+            return xp.take(suffix, xp.clip(lo, 0, n - 1)), ok
+        ident = seg._max_identity(vals.dtype) if name == "min" \
+            else seg._min_identity(vals.dtype)
+        masked = xp.where(valid, vals, xp.asarray(ident, dtype=vals.dtype))
+        # sparse table: level k = reduce over [i, i+2^k); static K from
+        # the static frame width, so this traces under jit
+        width = pre + post + 1
+        K = max(int(width).bit_length() - 1, 0)
+        tables = [masked]
+        for k in range(K):
+            step = 1 << k
+            shiftd = xp.concatenate(
+                [tables[-1][step:],
+                 xp.full(min(step, n), ident, dtype=masked.dtype)])[:n]
+            tables.append(op(tables[-1], shiftd))
+        stack = xp.stack(tables)                     # (K+1, n)
+        w = xp.maximum(hi - lo + 1, 1)
+        # floor(log2(w)) without float logs (exact for small ints)
+        kk = xp.zeros(n, dtype=xp.int64)
+        for k in range(1, K + 1):
+            kk = xp.where(w >= (1 << k), xp.int64(k), kk)
+        flat = stack.reshape(-1)
+        a = xp.take(flat, kk * n + xp.clip(lo, 0, n - 1))
+        b = xp.take(flat, kk * n +
+                    xp.clip(hi - (xp.int64(1) << kk) + 1, 0, n - 1))
+        return op(a, b), (c > 0) & ~empty
+    raise AssertionError(f"unsupported framed window aggregate {name}")
+
+
+def frame_value(xp, name, vals, valid, pstart, peerstart, has_order: bool,
+                frame):
+    """FIRST_VALUE / LAST_VALUE: a gather at the frame edge. The default
+    frame with ORDER BY ends at the current PEER group (the classic
+    last_value gotcha — MySQL semantics preserved)."""
+    n = pstart.shape[0]
+    if frame is not None:
+        pre, post = frame
+        lo, hi = _frame_bounds(xp, pstart, pre, post)
+        empty = hi < lo
+        pos = lo if name == "first_value" else hi
+        pos = xp.clip(pos, 0, n - 1)
+        return xp.take(vals, pos), xp.take(valid, pos) & ~empty
+    if name == "first_value":
+        pos = _pstart_pos(xp, pstart)
+    elif has_order:
+        pos = _next_peerstart_pos(xp, peerstart)
+    else:
+        from tidb_tpu.ops import segment as seg
+        iota = _iota(xp, n)
+        pid = partition_ids(xp, pstart)
+        last = seg.segment_max(xp, iota, pid.astype(xp.int32)
+                               if xp is not np else pid, n)
+        pos = xp.take(last, pid)
+    return xp.take(vals, pos), xp.take(valid, pos)
+
+
 def compute(xp, name, vals, valid, pstart, peerstart, has_order: bool,
-            offset: int = 1, fill=None):
+            offset: int = 1, fill=None, frame=None):
     """Shared dispatch for host (numpy) and device (jnp) window columns.
     vals/valid are the function argument in SORTED layout (None for the
-    rank family); fill = (fill_vals, fill_valid) for lag/lead."""
+    rank family); fill = (fill_vals, fill_valid) for lag/lead; frame =
+    (pre, post) row offsets (None side = unbounded) or None for the
+    default frame."""
     n = pstart.shape[0]
     ones = xp.ones(n, dtype=bool)
     if name == "row_number":
@@ -163,6 +286,12 @@ def compute(xp, name, vals, valid, pstart, peerstart, has_order: bool,
     if name in ("lag", "lead"):
         off = offset if name == "lag" else -offset
         return shifted(xp, vals, valid, pstart, off, fill[0], fill[1])
+    if name in ("first_value", "last_value"):
+        return frame_value(xp, name, vals, valid, pstart, peerstart,
+                           has_order, frame)
+    if frame is not None:
+        pre, post = frame
+        return rows_frame_agg(xp, name, vals, valid, pstart, pre, post)
     if has_order:
         return running_agg(xp, name, vals, valid, pstart, peerstart)
     return full_frame_agg(xp, name, vals, valid, pstart, n)

@@ -75,9 +75,12 @@ class FuncCall(ExprNode):
 
 @dataclass
 class WindowSpec(ExprNode):
-    """OVER (PARTITION BY … ORDER BY …) — ref: parser/ast WindowSpec."""
+    """OVER (PARTITION BY … ORDER BY … [frame]) — parser/ast WindowSpec.
+    frame = (unit, start, end); bounds are ('unbounded'|'current'|int n,
+    'preceding'|'following') pairs; None = the default frame."""
     partition_by: List[ExprNode]
     order_by: List[Tuple[ExprNode, bool]]   # (expr, desc)
+    frame: Optional[tuple] = None
 
 
 @dataclass

@@ -1070,9 +1070,47 @@ class Parser:
                 order.append((e, desc))
                 if not self.try_op(","):
                     break
+        frame = None
+        if self.at("ident") and str(self.cur.value).lower() in ("rows",
+                                                                "range"):
+            unit = self.advance().value.lower()
+            if self.try_kw("between"):
+                start = self._frame_bound()
+                self.expect_kw("and")
+                end = self._frame_bound()
+            else:
+                start = self._frame_bound()
+                end = ("current", 0)
+            frame = (unit, start, end)
         self.expect_op(")")
-        call.window = ast.WindowSpec(partition, order)
+        call.window = ast.WindowSpec(partition, order, frame)
         return call
+
+    def _frame_bound(self):
+        """UNBOUNDED PRECEDING|FOLLOWING | CURRENT ROW | n PRECEDING|
+        FOLLOWING → ('unbounded'|'current'|n, direction)."""
+        if self.at("ident") and str(self.cur.value).lower() == "unbounded":
+            self.advance()
+            d = str(self.advance().value).lower()
+            if d not in ("preceding", "following"):
+                raise ParseError(f"expected PRECEDING/FOLLOWING near "
+                                 f"{self._near()}")
+            return ("unbounded", d)
+        if self.at("ident") and str(self.cur.value).lower() == "current":
+            self.advance()
+            if not (self.at("ident") and
+                    str(self.cur.value).lower() == "row"):
+                raise ParseError(f"expected ROW near {self._near()}")
+            self.advance()
+            return ("current", 0)
+        if self.at("int"):
+            n = self.advance().value
+            d = str(self.advance().value).lower()
+            if d not in ("preceding", "following"):
+                raise ParseError(f"expected PRECEDING/FOLLOWING near "
+                                 f"{self._near()}")
+            return (int(n), d)
+        raise ParseError(f"expected frame bound near {self._near()}")
 
     def case_expr(self) -> ast.CaseExpr:
         self.expect_kw("case")

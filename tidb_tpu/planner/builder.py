@@ -419,6 +419,7 @@ class AggContext:
 
 
 _WINDOW_FUNCS = {"row_number", "rank", "dense_rank", "sum", "count", "avg",
+                 "first_value", "last_value",
                  "min", "max", "lag", "lead"}
 
 
@@ -742,6 +743,11 @@ class PlanBuilder:
                     raise PlanError(f"{name}() takes no arguments")
                 args = []
                 ftype = T.bigint(False)
+            elif name in ("first_value", "last_value"):
+                if not call.args:
+                    raise PlanError(f"{name}() needs an argument")
+                args = [rw.rewrite(call.args[0])]
+                ftype = args[0].ftype.with_nullable(True)
             else:   # sum/count/avg/min/max over the window
                 args = [rw.rewrite(a) for a in call.args
                         if not isinstance(a, ast.Star)]
@@ -758,8 +764,9 @@ class PlanBuilder:
                 ftype = infer_agg_type(name, args, False)
                 if name == "avg":
                     ftype = T.double(True)   # windowed AVG computes double
+            frame = _convert_frame(spec.frame)
             wdescs.append(WinDesc(name, args, partition, order, descs,
-                                  ftype, offset, default))
+                                  ftype, offset, default, frame))
             names.append(f"_win_{i}")
             window_map[id(call)] = ColumnRef(base + i, ftype,
                                              f"_win_{i}")
@@ -949,6 +956,41 @@ class PlanBuilder:
 # ---------------------------------------------------------------------------
 # Expression utilities
 # ---------------------------------------------------------------------------
+
+
+def _convert_frame(spec_frame):
+    """Window frame clause → (pre, post) row offsets, None side =
+    unbounded; returns None for the default frame. RANGE frames support
+    only the peers-default and the full-partition forms (the reference's
+    RANGE-with-offset needs order-key arithmetic)."""
+    if spec_frame is None:
+        return None
+    unit, start, end = spec_frame
+    if unit == "range":
+        if start == ("unbounded", "preceding") and end == ("current", 0):
+            return None                      # the default frame
+        if start == ("unbounded", "preceding") and \
+                end == ("unbounded", "following"):
+            return (None, None)
+        raise PlanError("RANGE frames with offsets are not supported")
+
+    def pre_of(b):
+        if b == ("unbounded", "preceding"):
+            return None
+        if b == ("current", 0):
+            return 0
+        n, d = b
+        return n if d == "preceding" else -n
+
+    def post_of(b):
+        if b == ("unbounded", "following"):
+            return None
+        if b == ("current", 0):
+            return 0
+        n, d = b
+        return n if d == "following" else -n
+
+    return (pre_of(start), post_of(end))
 
 
 def _ast_conjuncts(node: ast.ExprNode) -> List[ast.ExprNode]:

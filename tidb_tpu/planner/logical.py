@@ -220,7 +220,7 @@ class WinDesc:
     """One window-function column (ref: planner/core WindowFuncDesc)."""
 
     def __init__(self, name, args, partition, order, descs, ftype,
-                 offset: int = 1, default=None):
+                 offset: int = 1, default=None, frame=None):
         self.name = name              # row_number|rank|dense_rank|sum|...
         self.args = args              # List[Expression]
         self.partition = partition    # List[Expression]
@@ -229,6 +229,9 @@ class WinDesc:
         self.ftype = ftype
         self.offset = offset          # lag/lead shift
         self.default = default        # lag/lead default Constant or None
+        # (pre, post) row offsets, None = unbounded on that side;
+        # absent (frame is None) = the default RANGE peers frame
+        self.frame = frame
 
     def __repr__(self):
         return (f"{self.name}({self.args!r}) over(p={self.partition!r}, "
