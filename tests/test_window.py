@@ -328,3 +328,27 @@ def test_frames_on_device():
     finally:
         s.vars.update({"tidb_tpu_engine": "off", "tidb_tpu_strict": "off"})
     assert dev == cpu
+
+
+def test_frame_edge_cases():
+    from tidb_tpu.session import Engine
+    import pytest as _pt
+    s = Engine().new_session()
+    s.execute("CREATE TABLE wfe (id BIGINT, v BIGINT)")
+    s.execute("INSERT INTO wfe VALUES (1,10),(2,20),(3,30),(4,40)")
+    # fully-FOLLOWING frames run off the partition end: empty -> NULL
+    rows = s.query(
+        "SELECT id, SUM(v) OVER (ORDER BY id ROWS BETWEEN 2 FOLLOWING "
+        "AND 3 FOLLOWING), MIN(v) OVER (ORDER BY id ROWS BETWEEN "
+        "2 FOLLOWING AND 3 FOLLOWING) FROM wfe ORDER BY id").rows
+    assert rows == [(1, 70, 30), (2, 70, 40), (3, 40, 40),
+                    (4, None, None)]
+    # invalid bounds are clean errors, not crashes
+    with _pt.raises(Exception, match="UNBOUNDED FOLLOWING"):
+        s.query("SELECT SUM(v) OVER (ORDER BY id ROWS BETWEEN "
+                "UNBOUNDED FOLLOWING AND CURRENT ROW) FROM wfe")
+    with _pt.raises(Exception, match="shorthand|PRECEDING"):
+        s.query("SELECT SUM(v) OVER (ORDER BY id ROWS 2 FOLLOWING) "
+                "FROM wfe")
+    with _pt.raises(Exception, match="parameter count"):
+        s.query("SELECT FIRST_VALUE(v, id) OVER (ORDER BY id) FROM wfe")

@@ -148,8 +148,8 @@ def running_agg(xp, name, vals, valid, pstart, peerstart):
 
 
 def _frame_bounds(xp, pstart, pre, post):
-    """Per-row [lo, hi] ROWS-frame positions clamped to the partition
-    (None = unbounded on that side)."""
+    """Per-row ([lo, hi] ROWS-frame positions clamped to the partition,
+    plast) — None offset = unbounded on that side."""
     from tidb_tpu.ops import segment as seg
     n = pstart.shape[0]
     iota = _iota(xp, n)
@@ -158,9 +158,10 @@ def _frame_bounds(xp, pstart, pre, post):
     last = seg.segment_max(xp, iota, pid.astype(xp.int32)
                            if xp is not np else pid, n)
     plast = xp.take(last, pid)
-    lo = ppos if pre is None else xp.maximum(iota - pre, ppos)
+    lo = ppos if pre is None else \
+        xp.clip(iota - pre, ppos, plast + 1)      # +1 ⇒ empty, in range
     hi = plast if post is None else xp.minimum(iota + post, plast)
-    return lo, hi
+    return lo, hi, plast
 
 
 def rows_frame_agg(xp, name, vals, valid, pstart, pre, post):
@@ -168,13 +169,14 @@ def rows_frame_agg(xp, name, vals, valid, pstart, pre, post):
     slide frames; here prefix sums / a doubling sparse table instead of
     per-row slide state)."""
     n = pstart.shape[0]
-    lo, hi = _frame_bounds(xp, pstart, pre, post)
+    lo, hi, plast = _frame_bounds(xp, pstart, pre, post)
     empty = hi < lo
+    lo_c = xp.clip(lo, 0, n - 1)
+    hi_c = xp.clip(hi, 0, n - 1)
     ccnt = xp.cumsum(valid.astype(xp.int64))
-    base_c = xp.where(lo > 0, xp.take(ccnt, xp.maximum(lo - 1, 0)),
+    base_c = xp.where(lo > 0, xp.take(ccnt, xp.clip(lo - 1, 0, n - 1)),
                       xp.int64(0))
-    c = xp.where(empty, xp.int64(0),
-                 xp.take(ccnt, xp.clip(hi, 0, n - 1)) - base_c)
+    c = xp.where(empty, xp.int64(0), xp.take(ccnt, hi_c) - base_c)
     if name == "count":
         return c, xp.ones(n, dtype=bool)
     if name in ("sum", "avg"):
@@ -182,9 +184,9 @@ def rows_frame_agg(xp, name, vals, valid, pstart, pre, post):
         acc_dt = (xp.float64 if xp is np else z.dtype) \
             if z.dtype.kind == "f" else xp.int64
         cum = xp.cumsum(z.astype(acc_dt))
-        base = xp.where(lo > 0, xp.take(cum, xp.maximum(lo - 1, 0)),
+        base = xp.where(lo > 0, xp.take(cum, xp.clip(lo - 1, 0, n - 1)),
                         xp.zeros((), dtype=cum.dtype))
-        s = xp.take(cum, xp.clip(hi, 0, n - 1)) - base
+        s = xp.take(cum, hi_c) - base
         if name == "sum":
             return s, (c > 0) & ~empty
         safe = xp.where(c > 0, c, xp.ones_like(c))
@@ -193,29 +195,21 @@ def rows_frame_agg(xp, name, vals, valid, pstart, pre, post):
     if name in ("min", "max"):
         from tidb_tpu.ops import segment as seg
         op = xp.minimum if name == "min" else xp.maximum
-        if pre is None or post is None:
-            ident = seg._max_identity(vals.dtype) if name == "min" \
-                else seg._min_identity(vals.dtype)
-            masked = xp.where(valid, vals,
-                              xp.asarray(ident, dtype=vals.dtype))
-            ok = (c > 0) & ~empty
-            if pre is None:
-                # [partition start, hi]: inclusive prefix scan
-                scan = _segmented_scan(xp, masked, pstart, op)
-                return xp.take(scan, xp.clip(hi, 0, n - 1)), ok
-            # [lo, partition end]: suffix scan via the flipped layout
-            iota = _iota(xp, n)
-            pid = partition_ids(xp, pstart)
-            last = seg.segment_max(xp, iota, pid.astype(xp.int32)
-                                   if xp is not np else pid, n)
-            plast = xp.take(last, pid)
-            pstart_r = xp.flip(iota == plast)
-            scan_r = _segmented_scan(xp, xp.flip(masked), pstart_r, op)
-            suffix = xp.flip(scan_r)
-            return xp.take(suffix, xp.clip(lo, 0, n - 1)), ok
         ident = seg._max_identity(vals.dtype) if name == "min" \
             else seg._min_identity(vals.dtype)
         masked = xp.where(valid, vals, xp.asarray(ident, dtype=vals.dtype))
+        ok = (c > 0) & ~empty
+        if pre is None:
+            # [partition start, hi]: inclusive prefix scan
+            scan = _segmented_scan(xp, masked, pstart, op)
+            return xp.take(scan, hi_c), ok
+        if post is None:
+            # [lo, partition end]: suffix scan via the flipped layout
+            iota = _iota(xp, n)
+            pstart_r = xp.flip(iota == plast)
+            scan_r = _segmented_scan(xp, xp.flip(masked), pstart_r, op)
+            suffix = xp.flip(scan_r)
+            return xp.take(suffix, lo_c), ok
         # sparse table: level k = reduce over [i, i+2^k); static K from
         # the static frame width, so this traces under jit
         width = pre + post + 1
@@ -234,10 +228,10 @@ def rows_frame_agg(xp, name, vals, valid, pstart, pre, post):
         for k in range(1, K + 1):
             kk = xp.where(w >= (1 << k), xp.int64(k), kk)
         flat = stack.reshape(-1)
-        a = xp.take(flat, kk * n + xp.clip(lo, 0, n - 1))
+        a = xp.take(flat, kk * n + lo_c)
         b = xp.take(flat, kk * n +
                     xp.clip(hi - (xp.int64(1) << kk) + 1, 0, n - 1))
-        return op(a, b), (c > 0) & ~empty
+        return op(a, b), ok
     raise AssertionError(f"unsupported framed window aggregate {name}")
 
 
@@ -249,7 +243,7 @@ def frame_value(xp, name, vals, valid, pstart, peerstart, has_order: bool,
     n = pstart.shape[0]
     if frame is not None:
         pre, post = frame
-        lo, hi = _frame_bounds(xp, pstart, pre, post)
+        lo, hi, _plast = _frame_bounds(xp, pstart, pre, post)
         empty = hi < lo
         pos = lo if name == "first_value" else hi
         pos = xp.clip(pos, 0, n - 1)

@@ -1079,7 +1079,16 @@ class Parser:
                 self.expect_kw("and")
                 end = self._frame_bound()
             else:
+                # shorthand: only UNBOUNDED PRECEDING / n PRECEDING /
+                # CURRENT ROW are legal starts (MySQL frame grammar)
                 start = self._frame_bound()
+                if start not in (("unbounded", "preceding"),
+                                 ("current", 0)) and \
+                        not (isinstance(start[0], int)
+                             and start[1] == "preceding"):
+                    raise ParseError(
+                        "frame shorthand requires a PRECEDING or "
+                        "CURRENT ROW bound")
                 end = ("current", 0)
             frame = (unit, start, end)
         self.expect_op(")")

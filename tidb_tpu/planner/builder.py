@@ -744,8 +744,9 @@ class PlanBuilder:
                 args = []
                 ftype = T.bigint(False)
             elif name in ("first_value", "last_value"):
-                if not call.args:
-                    raise PlanError(f"{name}() needs an argument")
+                if len(call.args) != 1:
+                    raise PlanError(
+                        f"Incorrect parameter count to {name}()")
                 args = [rw.rewrite(call.args[0])]
                 ftype = args[0].ftype.with_nullable(True)
             else:   # sum/count/avg/min/max over the window
@@ -980,6 +981,8 @@ def _convert_frame(spec_frame):
         if b == ("current", 0):
             return 0
         n, d = b
+        if n == "unbounded":
+            raise PlanError("frame start cannot be UNBOUNDED FOLLOWING")
         return n if d == "preceding" else -n
 
     def post_of(b):
@@ -988,6 +991,8 @@ def _convert_frame(spec_frame):
         if b == ("current", 0):
             return 0
         n, d = b
+        if n == "unbounded":
+            raise PlanError("frame end cannot be UNBOUNDED PRECEDING")
         return n if d == "following" else -n
 
     return (pre_of(start), post_of(end))
