@@ -341,7 +341,9 @@ def test_frame_edge_cases():
         "SELECT id, SUM(v) OVER (ORDER BY id ROWS BETWEEN 2 FOLLOWING "
         "AND 3 FOLLOWING), MIN(v) OVER (ORDER BY id ROWS BETWEEN "
         "2 FOLLOWING AND 3 FOLLOWING) FROM wfe ORDER BY id").rows
-    assert rows == [(1, 70, 30), (2, 70, 40), (3, 40, 40),
+    # row 2's window [idx 3, idx 4] clamps to just idx 3; rows 3/4 run
+    # entirely off the end → empty frame → NULL
+    assert rows == [(1, 70, 30), (2, 40, 40), (3, None, None),
                     (4, None, None)]
     # invalid bounds are clean errors, not crashes
     with _pt.raises(Exception, match="UNBOUNDED FOLLOWING"):
