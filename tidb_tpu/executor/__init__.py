@@ -346,9 +346,13 @@ def build(plan: PhysicalPlan) -> Executor:
     if isinstance(plan, PhysIndexScan):
         from tidb_tpu.executor.index_scan import IndexScanExec
         return IndexScanExec(plan)
-    from tidb_tpu.planner.physical import PhysIndexLookupJoin, PhysMemTable
+    from tidb_tpu.planner.physical import (PhysIndexLookupJoin,
+                                           PhysMemTable, PhysMergeJoin)
     if isinstance(plan, PhysMemTable):
         return MemTableExec(plan)
+    if isinstance(plan, PhysMergeJoin):
+        from tidb_tpu.executor.merge_join import MergeJoinExec
+        return MergeJoinExec(plan)
     if isinstance(plan, PhysIndexLookupJoin):
         from tidb_tpu.executor.index_join import IndexLookupJoinExec
         return IndexLookupJoinExec(plan, build(plan.children[0]))

@@ -196,6 +196,31 @@ class PhysIndexLookupJoin(PhysicalPlan):
                 f"index:{self.index_name}, key:{self.outer_key!r}")
 
 
+class PhysMergeJoin(PhysicalPlan):
+    """Inner join merged over both sides' cached sorted-index views
+    (ref: executor/merge_join.go; inputs arrive key-ordered from
+    indexes, so no hash build and no per-query sort)."""
+
+    def __init__(self, left_table, left_key: int, left_index: str,
+                 right_table, right_key: int, right_index: str,
+                 left_filters, right_filters, other_conditions, schema):
+        super().__init__(schema)
+        self.left_table = left_table
+        self.left_key = left_key
+        self.left_index = left_index
+        self.right_table = right_table
+        self.right_key = right_key
+        self.right_index = right_index
+        self.left_filters = left_filters
+        self.right_filters = right_filters
+        self.other_conditions = other_conditions
+
+    def describe(self):
+        return (f"inner merge join, {self.left_table.name}."
+                f"{self.left_index} × {self.right_table.name}."
+                f"{self.right_index}")
+
+
 class PhysWindow(PhysicalPlan):
     """Window functions over sorted partitions (ref: executor/window.go:31;
     computed whole-column via ops/window.py instead of streamed frames)."""
@@ -460,6 +485,15 @@ def estimate(plan: PhysicalPlan, ctx) -> float:
             out = max(l * r / denom if plan.equi else max(l, r), 1.0)
             if plan.kind in ("left", "right"):
                 out = max(out, l if plan.kind == "left" else r)
+    elif isinstance(plan, PhysMergeJoin):
+        from tidb_tpu.statistics import column_ndv
+        ln = float(_table_rows(plan.left_table, ctx))
+        rn = float(_table_rows(plan.right_table, ctx))
+        stats = _table_stats(plan.left_table, ctx)
+        ndv = column_ndv(stats, plan.left_key, -1.0) \
+            if stats is not None else -1.0
+        denom = max(ndv, 1.0) if ndv and ndv > 0 else max(ln, rn, 1.0)
+        out = max(ln * rn / denom, 1.0)
     elif isinstance(plan, PhysIndexLookupJoin):
         l = kids[0]
         if plan.kind in ("semi", "anti"):
@@ -526,6 +560,59 @@ def _distribute_fragments(plan: PhysicalPlan, n_shards: int,
         return
     for c in plan.children:
         _distribute_fragments(c, n_shards, threshold)
+
+
+def _indexed_col(table, col_idx: int):
+    """Index name covering exactly this column as its first key, or None."""
+    if col_idx >= len(table.columns):
+        return None
+    name = table.columns[col_idx].name.lower()
+    if table.primary_key and table.primary_key[0].lower() == name:
+        return "PRIMARY"
+    for ix in getattr(table, "indexes", []):
+        if ix.columns[0].lower() == name:
+            return ix.name
+    return None
+
+
+MERGE_JOIN_MIN_ROWS = 8192        # both sides must be at least this big
+
+
+def _try_merge_join(join: LogicalJoin, left: PhysicalPlan,
+                    right: PhysicalPlan, lrows: float, rrows: float,
+                    ctx) -> Optional["PhysMergeJoin"]:
+    """Merge join when BOTH sides are table scans indexed on their
+    (uncast, non-string-mixed) join keys and both are large — the
+    key-ordered-inputs case of exhaust_physical_plans.go's merge-join
+    enumeration. Inner only; other kinds keep the hash path."""
+    if getattr(ctx, "use_tpu", False):
+        # large indexed joins fuse into device LUT-join trees instead;
+        # the merge join is the CPU engine's answer to this shape
+        return None
+    if join.kind != "inner" or len(join.equi) != 1:
+        return None
+    if not isinstance(left, PhysTableScan) or \
+            not isinstance(right, PhysTableScan):
+        return None
+    if min(lrows, rrows) < MERGE_JOIN_MIN_ROWS:
+        return None
+    from tidb_tpu.executor.join import coerce_key_pair
+    le, re = join.equi[0]
+    if le.ftype.kind.is_string != re.ftype.kind.is_string:
+        return None
+    lc, rc = coerce_key_pair(le, re)
+    if lc is not le or rc is not re:
+        return None               # raw index values must be comparable
+    if not (isinstance(le, ColumnRef) and isinstance(re, ColumnRef)):
+        return None
+    lix = _indexed_col(left.table, le.index)
+    rix = _indexed_col(right.table, re.index)
+    if lix is None or rix is None:
+        return None
+    schema = Schema.concat(left.schema, right.schema)
+    return PhysMergeJoin(left.table, le.index, lix, right.table, re.index,
+                         rix, list(left.filters), list(right.filters),
+                         list(join.other_conditions or []), schema)
 
 
 INDEX_JOIN_OUTER_CAP = 4096       # max outer rows for index-lookup join
@@ -744,6 +831,9 @@ def _to_physical(plan: LogicalPlan, ctx) -> PhysicalPlan:
         ilj = _try_index_join(plan, left, right, lrows, rrows, ctx)
         if ilj is not None:
             return ilj
+        mj = _try_merge_join(plan, left, right, lrows, rrows, ctx)
+        if mj is not None:
+            return mj
         if plan.kind in ("left", "semi", "anti"):
             build_right = True    # probe the outer side
         elif plan.kind == "right":
