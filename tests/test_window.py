@@ -354,3 +354,33 @@ def test_frame_edge_cases():
                 "FROM wfe")
     with _pt.raises(Exception, match="parameter count"):
         s.query("SELECT FIRST_VALUE(v, id) OVER (ORDER BY id) FROM wfe")
+
+
+def test_rank_family_extras():
+    from tidb_tpu.session import Engine
+    s = Engine().new_session()
+    s.execute("CREATE TABLE wr (id BIGINT, k BIGINT, v BIGINT)")
+    s.execute("INSERT INTO wr VALUES (1,1,10),(2,1,20),(3,1,20),(4,1,40),"
+              "(5,2,5),(6,2,6),(7,2,7)")
+    rows = s.query(
+        "SELECT id, PERCENT_RANK() OVER (PARTITION BY k ORDER BY v), "
+        "CUME_DIST() OVER (PARTITION BY k ORDER BY v), "
+        "NTILE(2) OVER (PARTITION BY k ORDER BY v), "
+        "NTH_VALUE(v, 2) OVER (PARTITION BY k ORDER BY v) "
+        "FROM wr ORDER BY id").rows
+    # partition k=1: ranks 1,2,2,4 over 4 rows
+    assert rows[0][1:] == (0.0, 0.25, 1, None)       # nth frame ends at peer
+    assert rows[1][1] == pytest.approx(1 / 3)
+    assert rows[1][2] == pytest.approx(0.75)
+    assert rows[1][3] == 1 and rows[1][4] == 20
+    assert rows[2][1] == pytest.approx(1 / 3)
+    assert rows[2][3] == 2 and rows[2][4] == 20
+    assert rows[3][1:] == (1.0, 1.0, 2, 20)
+    # partition k=2: 3 rows, NTILE(2) → buckets 1,1,2
+    assert [r[3] for r in rows[4:]] == [1, 1, 2]
+    # NTH_VALUE with an explicit full frame sees the whole partition
+    rows = s.query(
+        "SELECT id, NTH_VALUE(v, 3) OVER (PARTITION BY k ORDER BY v "
+        "ROWS BETWEEN UNBOUNDED PRECEDING AND UNBOUNDED FOLLOWING) "
+        "FROM wr ORDER BY id").rows
+    assert [r[1] for r in rows] == [20, 20, 20, 20, 7, 7, 7]

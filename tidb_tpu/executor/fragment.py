@@ -149,7 +149,8 @@ def _fragment_ok(plan: PhysicalPlan, threshold: int) -> bool:
 
 _DEVICE_WINDOW_FUNCS = ("row_number", "rank", "dense_rank", "sum",
                         "count", "avg", "min", "max", "lag", "lead",
-                        "first_value", "last_value")
+                        "first_value", "last_value", "percent_rank",
+                        "cume_dist", "ntile", "nth_value")
 
 
 def _window_device_ok(node: PhysWindow) -> bool:

@@ -262,6 +262,75 @@ def frame_value(xp, name, vals, valid, pstart, peerstart, has_order: bool,
     return xp.take(vals, pos), xp.take(valid, pos)
 
 
+def percent_rank(xp, pstart, peerstart):
+    """(rank-1)/(rows-1), 0 for single-row partitions."""
+    n = pstart.shape[0]
+    r = rank(xp, pstart, peerstart).astype(xp.float64 if xp is np
+                                           else xp.float32)
+    rows = _partition_rows(xp, pstart)
+    denom = xp.maximum(rows - 1, 1).astype(r.dtype)
+    return xp.where(rows > 1, (r - 1) / denom, xp.zeros_like(r))
+
+
+def cume_dist(xp, pstart, peerstart):
+    """peers-inclusive cumulative distribution."""
+    n = pstart.shape[0]
+    nxt = _next_peerstart_pos(xp, peerstart)
+    pp = _pstart_pos(xp, pstart)
+    rows = _partition_rows(xp, pstart)
+    fdt = xp.float64 if xp is np else xp.float32
+    return (nxt - pp + 1).astype(fdt) / rows.astype(fdt)
+
+
+def ntile(xp, pstart, n_buckets: int):
+    """MySQL NTILE: earlier buckets absorb the remainder."""
+    k = row_number(xp, pstart) - 1
+    rows = _partition_rows(xp, pstart)
+    q = rows // n_buckets
+    r = rows % n_buckets
+    big = r * (q + 1)
+    in_big = k < big
+    safe_q = xp.maximum(q, 1)
+    bucket = xp.where(in_big, k // xp.maximum(q + 1, 1) + 1,
+                      r + (k - big) // safe_q + 1)
+    # more buckets than rows: bucket = row_number
+    return xp.where(q > 0, bucket, k + 1)
+
+
+def nth_value(xp, vals, valid, pstart, peerstart, has_order: bool,
+              frame, nth: int):
+    """NTH_VALUE(v, n): the frame's n-th row, NULL when the frame is
+    shorter (frame-aware like first/last value)."""
+    n = pstart.shape[0]
+    if frame is not None:
+        pre, post = frame
+        lo, hi, _plast = _frame_bounds(xp, pstart, pre, post)
+    else:
+        lo = _pstart_pos(xp, pstart)
+        hi = _next_peerstart_pos(xp, peerstart) if has_order else None
+        if hi is None:
+            from tidb_tpu.ops import segment as seg
+            iota = _iota(xp, n)
+            pid = partition_ids(xp, pstart)
+            last = seg.segment_max(xp, iota, pid.astype(xp.int32)
+                                   if xp is not np else pid, n)
+            hi = xp.take(last, pid)
+    pos = lo + (nth - 1)
+    ok = pos <= hi
+    pos = xp.clip(pos, 0, n - 1)
+    return xp.take(vals, pos), xp.take(valid, pos) & ok
+
+
+def _partition_rows(xp, pstart):
+    from tidb_tpu.ops import segment as seg
+    n = pstart.shape[0]
+    pid = partition_ids(xp, pstart)
+    cnt = seg.segment_count(xp, xp.ones(n, dtype=bool),
+                            pid.astype(xp.int32) if xp is not np else pid,
+                            n)
+    return xp.take(cnt, pid)
+
+
 def compute(xp, name, vals, valid, pstart, peerstart, has_order: bool,
             offset: int = 1, fill=None, frame=None):
     """Shared dispatch for host (numpy) and device (jnp) window columns.
@@ -283,6 +352,15 @@ def compute(xp, name, vals, valid, pstart, peerstart, has_order: bool,
     if name in ("first_value", "last_value"):
         return frame_value(xp, name, vals, valid, pstart, peerstart,
                            has_order, frame)
+    if name == "percent_rank":
+        return percent_rank(xp, pstart, peerstart), ones
+    if name == "cume_dist":
+        return cume_dist(xp, pstart, peerstart), ones
+    if name == "ntile":
+        return ntile(xp, pstart, offset), ones
+    if name == "nth_value":
+        return nth_value(xp, vals, valid, pstart, peerstart, has_order,
+                         frame, offset)
     if frame is not None:
         pre, post = frame
         return rows_frame_agg(xp, name, vals, valid, pstart, pre, post)

@@ -419,7 +419,8 @@ class AggContext:
 
 
 _WINDOW_FUNCS = {"row_number", "rank", "dense_rank", "sum", "count", "avg",
-                 "first_value", "last_value",
+                 "first_value", "last_value", "percent_rank", "cume_dist",
+                 "ntile", "nth_value",
                  "min", "max", "lag", "lead"}
 
 
@@ -748,6 +749,34 @@ class PlanBuilder:
                     raise PlanError(
                         f"Incorrect parameter count to {name}()")
                 args = [rw.rewrite(call.args[0])]
+                ftype = args[0].ftype.with_nullable(True)
+            elif name in ("percent_rank", "cume_dist"):
+                if call.args and not isinstance(call.args[0], ast.Star):
+                    raise PlanError(f"{name}() takes no arguments")
+                args = []
+                ftype = T.double(False)
+            elif name == "ntile":
+                if len(call.args) != 1:
+                    raise PlanError("NTILE() needs a bucket count")
+                nb = rw.rewrite(call.args[0])
+                if not isinstance(nb, Constant) or \
+                        not isinstance(nb.value, int) or nb.value <= 0:
+                    raise PlanError(
+                        "NTILE() requires a positive integer literal")
+                args = []
+                offset = nb.value       # bucket count rides in offset
+                ftype = T.bigint(False)
+            elif name == "nth_value":
+                if len(call.args) != 2:
+                    raise PlanError(
+                        "Incorrect parameter count to nth_value()")
+                args = [rw.rewrite(call.args[0])]
+                nth = rw.rewrite(call.args[1])
+                if not isinstance(nth, Constant) or \
+                        not isinstance(nth.value, int) or nth.value <= 0:
+                    raise PlanError(
+                        "nth_value() requires a positive integer literal")
+                offset = nth.value      # n rides in offset
                 ftype = args[0].ftype.with_nullable(True)
             else:   # sum/count/avg/min/max over the window
                 args = [rw.rewrite(a) for a in call.args
