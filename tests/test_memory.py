@@ -203,3 +203,26 @@ def test_gc_compaction_reclaims_tombstones():
     # caches keyed by TableData identity see the rewrite
     s.execute("INSERT INTO gc VALUES (1)")
     assert s.query("SELECT COUNT(*) FROM gc WHERE a = 1").rows == [(1,)]
+
+
+def test_parallel_partial_workers_match_sequential():
+    # the hash-agg partial-worker pipeline (tidb_tpu_cpu_concurrency > 1)
+    # must be byte-identical to sequential, incl. order-sensitive
+    # first_row states and DISTINCT dedup
+    import numpy as np
+    from tidb_tpu.session import Engine
+    eng = Engine()
+    s = eng.new_session()
+    s.execute("CREATE TABLE pw (g BIGINT, v BIGINT, t VARCHAR(4))")
+    rng = np.random.default_rng(2)
+    s.execute("INSERT INTO pw VALUES " + ",".join(
+        f"({int(rng.integers(0, 50))},{int(rng.integers(0, 1000))},"
+        f"'t{int(rng.integers(0, 3))}')" for i in range(30000)))
+    s.vars["max_chunk_size"] = 1024      # many batches
+    sql = ("SELECT g, COUNT(*), SUM(v), COUNT(DISTINCT v), MIN(t) "
+           "FROM pw GROUP BY g ORDER BY g")
+    s.vars["tidb_tpu_cpu_concurrency"] = 1
+    seq = s.query(sql).rows
+    s.vars["tidb_tpu_cpu_concurrency"] = 8
+    par = s.query(sql).rows
+    assert par == seq

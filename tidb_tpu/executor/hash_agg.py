@@ -123,64 +123,60 @@ class HashAggExec(Executor):
 
         tracker.add_handler(engage_spill)
 
+        def collect(result):
+            # spill/tracker bookkeeping stays on the driving thread;
+            # collection order == submission order so order-sensitive
+            # states (first_row) remain deterministic
+            nonlocal tracked, saw_rows
+            pk, states, batch_distinct, batch_bytes = result
+            saw_rows = True
+            if spill is not None:
+                self._spill_batch(spill, pk, states, batch_distinct)
+                return
+            partial_keys.append(pk)
+            partial_states.append(states)
+            for i, bd in enumerate(batch_distinct):
+                if bd is not None:
+                    distinct_rows[i].append(bd)
+            tracked += batch_bytes
+            tracker.consume(batch_bytes)
+
+        # intra-operator parallelism (the partial-worker graph of
+        # executor/aggregate.go:127-164): per-batch partials are pure, so
+        # a bounded thread pipeline can compute them concurrently.
+        # Measured on this engine the gain is ~nil — Python-level kernel
+        # dispatch holds the GIL between numpy cores — so the default is
+        # sequential; the worker graph exists for API parity and for
+        # interpreters with real parallelism (the TPU engine is the
+        # intended parallel path, SURVEY §2.4.4's "deliberate bet")
+        conc = max(int(self.ctx.vars.get("tidb_tpu_cpu_concurrency", 1)),
+                   1)
         try:
-            while True:
-                ch = self.child_next()
-                if ch is None:
-                    break
-                if ch.num_rows == 0:
-                    continue
-                saw_rows = True
-                ctx = host_context(ch)
-                key_cols = [e.eval(ctx) for e in self.group_exprs]
-                gids, n_groups, reps = factorize_columns(key_cols)
-                if self.scalar:
-                    gids = np.zeros(ch.num_rows, dtype=np.int64)
-                    n_groups, reps = 1, np.zeros(1, dtype=np.int64)
-                states = []
-                batch_distinct = [None] * len(self.aggs)
-                for i, (agg, desc) in enumerate(zip(self.aggs, self.descs)):
-                    if desc.args:
-                        # multi-arg only for COUNT(DISTINCT a, b): row counts
-                        # iff every arg is non-NULL (MySQL semantics)
-                        vs, ms = [], []
-                        for a in desc.args:
-                            v, m = a.eval(ctx)
-                            vs.append(np.asarray(v))
-                            ms.append(np.asarray(m, dtype=bool))
-                        m = ms[0]
-                        for extra in ms[1:]:
-                            m = m & extra
-                        v = vs[0]
-                    else:  # COUNT(*)
-                        vs = [np.zeros(ch.num_rows, dtype=np.int64)]
-                        v = vs[0]
-                        m = np.ones(ch.num_rows, dtype=bool)
-                    if desc.distinct:
-                        batch_distinct[i] = (gids, vs, m)
-                        states.append(None)
-                    else:
-                        st = agg.init(np, n_groups)
-                        states.append(agg.update(np, st, gids, n_groups, v, m))
-                pk = [(np.asarray(v)[reps], np.asarray(m, dtype=bool)[reps])
-                      for v, m in key_cols]
-                if spill is not None:
-                    self._spill_batch(spill, pk, states, batch_distinct)
-                    continue
-                partial_keys.append(pk)
-                partial_states.append(states)
-                for i, bd in enumerate(batch_distinct):
-                    if bd is not None:
-                        distinct_rows[i].append(bd)
-                batch_bytes = sum(M.array_bytes(v, m) for v, m in pk)
-                for st in states:
-                    if st is not None:
-                        batch_bytes += M.array_bytes(*st)
-                for bd in batch_distinct:
-                    if bd is not None:
-                        batch_bytes += M.array_bytes(bd[0], bd[2], *bd[1])
-                tracked += batch_bytes
-                tracker.consume(batch_bytes)
+            if conc == 1:
+                while True:
+                    ch = self.child_next()
+                    if ch is None:
+                        break
+                    if ch.num_rows == 0:
+                        continue
+                    collect(self._batch_partial(ch))
+            else:
+                from concurrent.futures import ThreadPoolExecutor
+                from collections import deque
+                with ThreadPoolExecutor(conc) as pool:
+                    pending = deque()
+                    while True:
+                        ch = self.child_next()
+                        if ch is None:
+                            break
+                        if ch.num_rows == 0:
+                            continue
+                        pending.append(
+                            pool.submit(self._batch_partial, ch))
+                        if len(pending) >= conc * 2:
+                            collect(pending.popleft().result())
+                    while pending:
+                        collect(pending.popleft().result())
 
             if spill is None:
                 return self._merge_partials(partial_keys, partial_states,
@@ -191,6 +187,52 @@ class HashAggExec(Executor):
             tracker.release(tracked)
             if spill is not None:
                 spill.close()
+
+    def _batch_partial(self, ch: Chunk):
+        """One batch → (partial keys, states, distinct rows, bytes).
+        Pure computation — safe on worker threads."""
+        from tidb_tpu.util import memory as M
+        ctx = host_context(ch)
+        key_cols = [e.eval(ctx) for e in self.group_exprs]
+        gids, n_groups, reps = factorize_columns(key_cols)
+        if self.scalar:
+            gids = np.zeros(ch.num_rows, dtype=np.int64)
+            n_groups, reps = 1, np.zeros(1, dtype=np.int64)
+        states = []
+        batch_distinct = [None] * len(self.aggs)
+        for i, (agg, desc) in enumerate(zip(self.aggs, self.descs)):
+            if desc.args:
+                # multi-arg only for COUNT(DISTINCT a, b): row counts
+                # iff every arg is non-NULL (MySQL semantics)
+                vs, ms = [], []
+                for a in desc.args:
+                    v, m = a.eval(ctx)
+                    vs.append(np.asarray(v))
+                    ms.append(np.asarray(m, dtype=bool))
+                m = ms[0]
+                for extra in ms[1:]:
+                    m = m & extra
+                v = vs[0]
+            else:  # COUNT(*)
+                vs = [np.zeros(ch.num_rows, dtype=np.int64)]
+                v = vs[0]
+                m = np.ones(ch.num_rows, dtype=bool)
+            if desc.distinct:
+                batch_distinct[i] = (gids, vs, m)
+                states.append(None)
+            else:
+                st = agg.init(np, n_groups)
+                states.append(agg.update(np, st, gids, n_groups, v, m))
+        pk = [(np.asarray(v)[reps], np.asarray(m, dtype=bool)[reps])
+              for v, m in key_cols]
+        batch_bytes = sum(M.array_bytes(v, m) for v, m in pk)
+        for st in states:
+            if st is not None:
+                batch_bytes += M.array_bytes(*st)
+        for bd in batch_distinct:
+            if bd is not None:
+                batch_bytes += M.array_bytes(bd[0], bd[2], *bd[1])
+        return pk, states, batch_distinct, batch_bytes
 
     def _spill_batch(self, spill, pk, states, batch_distinct) -> None:
         """Split one batch's partial groups by key hash into partitions."""
