@@ -163,20 +163,43 @@ class HashAggExec(Executor):
             else:
                 from concurrent.futures import ThreadPoolExecutor
                 from collections import deque
+
+                def in_flight_bytes(ch: Chunk) -> int:
+                    # reservation for an un-collected batch: its input
+                    # chunk (the partial is the same order of magnitude);
+                    # keeps the pipeline visible to the quota so spill
+                    # still engages under pressure
+                    return sum(
+                        c.values.nbytes + (c.validity.nbytes
+                                           if c.validity is not None
+                                           else 0)
+                        for c in ch.columns)
+
                 with ThreadPoolExecutor(conc) as pool:
                     pending = deque()
+
+                    def drain_one():
+                        fut, reserved = pending.popleft()
+                        try:
+                            collect(fut.result())
+                        finally:
+                            tracker.release(reserved)
+
                     while True:
                         ch = self.child_next()
                         if ch is None:
                             break
                         if ch.num_rows == 0:
                             continue
+                        reserve = in_flight_bytes(ch)
+                        tracker.consume(reserve)
                         pending.append(
-                            pool.submit(self._batch_partial, ch))
+                            (pool.submit(self._batch_partial, ch),
+                             reserve))
                         if len(pending) >= conc * 2:
-                            collect(pending.popleft().result())
+                            drain_one()
                     while pending:
-                        collect(pending.popleft().result())
+                        drain_one()
 
             if spill is None:
                 return self._merge_partials(partial_keys, partial_states,
