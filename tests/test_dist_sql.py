@@ -37,8 +37,22 @@ def session():
                     f"'199{int(rng.integers(5, 9))}-0"
                     f"{int(rng.integers(1, 10))}-11')")
     s.execute("INSERT INTO li VALUES " + ",".join(rows))
+    # dup_orders: each id appears 1-3 times → a NON-unique join build side
+    s.execute("CREATE TABLE dup_orders (d_id BIGINT, d_prio BIGINT, "
+              "d_seg VARCHAR(12))")
+    rows = []
+    for i in range(n_orders):
+        seg = ["BUILDING", "AUTO", "STEEL"][int(rng.integers(0, 3))]
+        for _ in range(int(rng.integers(1, 4))):
+            rows.append(f"({i},{int(rng.integers(0, 5))},'{seg}')")
+    s.execute("INSERT INTO dup_orders VALUES " + ",".join(rows))
+    s.execute("CREATE TABLE segs (s_name VARCHAR(12), s_rank BIGINT)")
+    s.execute("INSERT INTO segs VALUES ('BUILDING',1),('AUTO',2),"
+              "('STEEL',3)")
     s.execute("ANALYZE TABLE orders")
     s.execute("ANALYZE TABLE li")
+    s.execute("ANALYZE TABLE dup_orders")
+    s.execute("ANALYZE TABLE segs")
     return s
 
 
@@ -205,6 +219,57 @@ def test_dist_fallback_strips_exchanges(session):
         session.vars["tidb_tpu_engine"] = "off"
         session.vars.pop("tidb_tpu_dist_devices", None)
     assert_same(got, session.query(sql).rows)
+
+
+# ---- single-chip parity: non-unique builds, string keys, window/row roots
+
+
+def test_dist_nonunique_build_join(session):
+    # duplicate build keys: the unique bet is lost on some shard; the
+    # expand-mode re-trace (per-shard out caps) must recover, not fall
+    # back (round-3 seam: FragmentFallback("non-unique join build side"))
+    sql = ("SELECT d_prio, COUNT(*), SUM(l_price) FROM li "
+           "JOIN dup_orders ON l_oid = d_id GROUP BY d_prio")
+    assert_same(run_dist(session, sql), session.query(sql).rows)
+
+
+def test_dist_nonunique_left_join(session):
+    sql = ("SELECT d_seg, COUNT(*), COUNT(d_id) FROM li "
+           "LEFT JOIN dup_orders ON l_oid = d_id GROUP BY d_seg")
+    assert_same(run_dist(session, sql), session.query(sql).rows)
+
+
+def test_dist_varchar_join_key(session):
+    # string equi keys: dictionaries unified host-side before sharding so
+    # equal strings hash equal across scans (round-3 seam: "exchange-side
+    # dictionary unification TBD")
+    sql = ("SELECT s_rank, COUNT(*) FROM li "
+           "JOIN orders ON l_oid = o_id "
+           "JOIN segs ON o_seg = s_name GROUP BY s_rank")
+    assert_same(run_dist(session, sql), session.query(sql).rows)
+
+
+def test_dist_varchar_key_groupby_string(session):
+    sql = ("SELECT o_seg, s_rank, COUNT(*) FROM orders "
+           "JOIN segs ON o_seg = s_name GROUP BY o_seg, s_rank")
+    assert_same(run_dist(session, sql), session.query(sql).rows)
+
+
+def test_dist_window_root(session):
+    # window root: the planner inserts a hash exchange on the partition
+    # keys so per-shard windows are globally exact
+    sql = ("SELECT l_flag, l_price, "
+           "SUM(l_price) OVER (PARTITION BY l_flag ORDER BY l_price), "
+           "ROW_NUMBER() OVER (PARTITION BY l_flag ORDER BY l_price DESC)"
+           " FROM li")
+    assert_same(run_dist(session, sql), session.query(sql).rows)
+
+
+def test_dist_row_root_join(session):
+    # selection/join row root: per-shard rows, host concatenates
+    sql = ("SELECT l_oid, l_price, o_prio FROM li "
+           "JOIN orders ON l_oid = o_id WHERE l_price > 890")
+    assert_same(run_dist(session, sql), session.query(sql).rows)
 
 
 def test_dist_matches_single_device_tree(session):

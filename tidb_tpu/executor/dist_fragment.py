@@ -26,36 +26,44 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from tidb_tpu.executor.tree_fragment import (TreeProgram, _scans,
+from tidb_tpu.executor.tree_fragment import (JoinCfg, TreeProgram, _scans,
                                              _walk_nodes, tree_signature)
 from tidb_tpu.planner.physical import (PhysExchange, PhysHashAgg, PhysSort,
-                                       PhysTableScan, PhysTopN, PhysicalPlan)
+                                       PhysTableScan, PhysTopN, PhysWindow,
+                                       PhysicalPlan)
 
 AXIS = "shard"
 
 
 class DistTreeProgram(TreeProgram):
     """Shard_map-compiled fragment: per-shard emission is TreeProgram's,
-    plus Exchange nodes and a distributed root reduction."""
+    plus Exchange nodes and a distributed root reduction. Join modes
+    mirror the single-chip tree engine — unique (PK-FK bet) and expand
+    (non-unique builds via prefix-sum expansion, per-shard out caps) —
+    with lost bets / capacity overflows reported per join so the executor
+    re-traces exactly once (never a CPU fallback)."""
 
     def __init__(self, plan: PhysicalPlan, caps: Dict[int, int],
-                 group_cap: int, mesh, bucket_caps: Dict[int, int]):
+                 group_cap: int, mesh, bucket_caps: Dict[int, int],
+                 join_cfgs: Optional[Sequence[JoinCfg]] = None):
         from tidb_tpu.ops.jax_env import jax, shard_map
         self.mesh = mesh
         self.n_shards = mesh.devices.size
         self.bucket_caps = bucket_caps    # id(exchange-node) → bucket cap
         # TreeProgram.__init__ builds prep_nodes and jits self._run; we
         # re-wrap with shard_map afterwards.
-        super().__init__(plan, caps, group_cap)
+        super().__init__(plan, caps, group_cap, join_cfgs)
         P = jax.sharding.PartitionSpec
         root = plan
-        flags = {"unique": P(), "over_groups": P(), "exchange_need": P()}
+        flags = {"join_unique": P(), "join_need": P(),
+                 "over_groups": P(), "exchange_need": P()}
         if isinstance(root, PhysHashAgg):
             out_specs = {"keys": P(AXIS), "states": P(AXIS),
                          "out_live": P(AXIS), **flags}
-        else:                      # dist_ok guarantees a TopN/Sort root
-            assert isinstance(root, (PhysTopN, PhysSort)), root
+        elif isinstance(root, (PhysTopN, PhysSort)):
             out_specs = {"cols": P(AXIS), "n_out": P(AXIS), **flags}
+        else:   # window / selection / projection / join row root
+            out_specs = {"cols": P(AXIS), "live": P(AXIS), **flags}
         self.run = jax.jit(shard_map(
             self._run, mesh=mesh,
             in_specs=(P(AXIS), P(AXIS), P()),
@@ -73,9 +81,16 @@ class DistTreeProgram(TreeProgram):
         self._overflow_flags = []
         cols, live = self._emit(self.plan, scan_inputs, scan_rows)
         out = self._finish_dist(cols, live)
-        flags = self._join_unique_flags
-        uniq_local = jnp.stack(flags).all() if flags else jnp.bool_(True)
-        out["unique"] = lax.pmin(uniq_local.astype(jnp.int32), AXIS) > 0
+        # per-join global verdicts: a bet is lost if ANY shard saw dup
+        # build keys; an expand cap must cover the LARGEST shard's need
+        if self._join_unique_flags:
+            ju = jnp.stack(self._join_unique_flags).astype(jnp.int32)
+            out["join_unique"] = lax.pmin(ju, AXIS) > 0
+            out["join_need"] = lax.pmax(
+                jnp.stack(self._join_totals), AXIS)
+        else:
+            out["join_unique"] = jnp.zeros(0, dtype=bool)
+            out["join_need"] = jnp.zeros(0, dtype=jnp.int64)
         over_g = out.pop("_over_local", jnp.bool_(False))
         out["over_groups"] = lax.pmax(over_g.astype(jnp.int32), AXIS) > 0
         # per-exchange NEEDED capacities (already pmax'd by exchange()):
@@ -183,25 +198,102 @@ class DistTreeProgram(TreeProgram):
                 f_states.append(agg.merge(jnp, st, fgids, cap, clean))
             return {"keys": f_keys, "states": f_states,
                     "out_live": out_live, "_over_local": over}
-        # ---- TopN / Sort: per-shard candidates, host merges ----
-        assert isinstance(root, (PhysTopN, PhysSort)), root
         n = live.shape[0]
         cols = [(jnp.zeros(n, dtype=jnp.int64), jnp.zeros(n, dtype=bool))
                 if c is None else c for c in cols]
-        ctx = self._ctx(cols)
-        keys = [e.eval(ctx) for e in root.by]
-        n_out_cols = len(root.schema)
-        if isinstance(root, PhysTopN):
-            k = min(root.count + root.offset, n)
-            idx, n_out = F.topn(keys, root.descs, live, k)
-        else:
-            idx, n_out = F.sort_perm(keys, root.descs, live)
-        gathered = [(jnp.take(jnp.asarray(v), idx),
-                     jnp.take(jnp.asarray(m), idx))
-                    for v, m in cols[:n_out_cols]]
-        return {"cols": gathered,
-                "n_out": jnp.reshape(n_out, (1,)),
-                "_over_local": jnp.bool_(False)}
+        if isinstance(root, (PhysTopN, PhysSort)):
+            # ---- TopN / Sort: per-shard candidates, host merges ----
+            ctx = self._ctx(cols)
+            keys = [e.eval(ctx) for e in root.by]
+            n_out_cols = len(root.schema)
+            if isinstance(root, PhysTopN):
+                k = min(root.count + root.offset, n)
+                idx, n_out = F.topn(keys, root.descs, live, k)
+            else:
+                idx, n_out = F.sort_perm(keys, root.descs, live)
+            gathered = [(jnp.take(jnp.asarray(v), idx),
+                         jnp.take(jnp.asarray(m), idx))
+                        for v, m in cols[:n_out_cols]]
+            return {"cols": gathered,
+                    "n_out": jnp.reshape(n_out, (1,)),
+                    "_over_local": jnp.bool_(False)}
+        if isinstance(root, PhysWindow):
+            # ---- window root: the exchange co-located every partition on
+            # one shard, so per-shard emit_window is globally exact ----
+            from tidb_tpu.executor import device_emit
+            ctx = self._ctx(cols)
+            out = device_emit.emit_window(ctx, live, root)
+            out["_over_local"] = jnp.bool_(False)
+            return out
+        # ---- selection / projection / join row root: per-shard rows,
+        # host compacts by live and concatenates ----
+        return {"cols": [(jnp.asarray(v), jnp.asarray(m))
+                         for v, m in cols[:len(root.schema)]],
+                "live": live, "_over_local": jnp.bool_(False)}
+
+
+def unify_string_join_dicts(root: PhysicalPlan, host_cols) -> None:
+    """Exchange-side dictionary unification for string equi-join keys.
+
+    Each class of scan columns transitively connected by string equi
+    joins is re-encoded into ONE shared sorted dictionary host-side,
+    before sharding. Equal strings then carry equal codes on every side,
+    so hash exchanges co-locate them (the repartition invariant of
+    cophandler/mpp_exec.go:158-173) and the probe-side KeyRemap LUT
+    degenerates to identity. host_cols: (id(scan), col_idx) →
+    [codes, valid, dictionary], mutated in place."""
+    from tidb_tpu.executor.fragment import FragmentFallback
+    from tidb_tpu.executor.tree_fragment import _trace_scan_col
+    from tidb_tpu.expression import ColumnRef
+    from tidb_tpu.planner.physical import PhysHashJoin
+    parent: Dict = {}
+
+    def find(x):
+        root_ = x
+        while parent.get(root_, root_) != root_:
+            root_ = parent[root_]
+        while parent.get(x, x) != x:
+            parent[x], x = root_, parent[x]
+        return root_
+
+    def union(a, b):
+        parent.setdefault(a, a)
+        parent.setdefault(b, b)
+        ra, rb = find(a), find(b)
+        if ra != rb:
+            parent[rb] = ra
+
+    for node in _walk_nodes(root):
+        if not isinstance(node, PhysHashJoin):
+            continue
+        for l, r in node.equi or []:
+            if not (l.ftype.kind.is_string or r.ftype.kind.is_string):
+                continue
+            lh = _trace_scan_col(node.children[0], l.index) \
+                if isinstance(l, ColumnRef) else None
+            rh = _trace_scan_col(node.children[1], r.index) \
+                if isinstance(r, ColumnRef) else None
+            if lh is None or rh is None:
+                raise FragmentFallback(
+                    "string join key is not a scan column")
+            union((id(lh[0]), lh[1]), (id(rh[0]), rh[1]))
+
+    groups: Dict = {}
+    for m in parent:
+        groups.setdefault(find(m), []).append(m)
+    for members in groups.values():
+        if len(members) < 2:
+            continue
+        dicts = [host_cols[m][2] for m in members
+                 if m in host_cols and host_cols[m][2] is not None]
+        if len(dicts) < len(members):
+            raise FragmentFallback("string join key without dictionary")
+        union_d = np.unique(np.concatenate(dicts))
+        for m in members:
+            codes, _valid, d = host_cols[m]
+            remap = np.searchsorted(union_d, d).astype(np.int32)
+            host_cols[m][0] = remap[codes]
+            host_cols[m][2] = union_d
 
 
 def _flatten_cols(cols):

@@ -456,7 +456,8 @@ def get_program(chain, used_cols, in_types, slab_cap, group_cap,
     return prog
 
 
-def _get_dist_program(root, caps, group_cap, mesh, bucket_caps):
+def _get_dist_program(root, caps, group_cap, mesh, bucket_caps,
+                      join_cfgs=None):
     from tidb_tpu.executor.dist_fragment import DistTreeProgram
     from tidb_tpu.executor.tree_fragment import (_walk_nodes,
                                                  tree_signature)
@@ -464,11 +465,11 @@ def _get_dist_program(root, caps, group_cap, mesh, bucket_caps):
     bux = ",".join(str(bucket_caps[id(n)]) for n in _walk_nodes(root)
                    if isinstance(n, PhysExchange) and n.kind == "hash")
     sig = (f"dist={mesh.devices.size}|bux={bux}|" +
-           tree_signature(root, caps, group_cap))
+           tree_signature(root, caps, group_cap, join_cfgs))
     prog = _cache_get(sig)
     if prog is None:
         prog = DistTreeProgram(root, caps, group_cap, mesh,
-                               dict(bucket_caps))
+                               dict(bucket_caps), join_cfgs)
         _cache_put(sig, prog)
     return prog
 
@@ -821,22 +822,10 @@ class TpuFragmentExec:
                                 dicts_root.get(ci))
                     for ci, ((v, m), ft) in
                     enumerate(zip(host_cols, root.schema.field_types))]
-            merged = Chunk(cols)
-            if isinstance(root, PhysTopN):
-                lo = min(root.offset, merged.num_rows)
-                hi = min(root.offset + root.count, merged.num_rows)
-                merged = merged.slice(lo, hi)
-            return merged
+            return _topn_slice(Chunk(cols), root)
         # join/selection/projection/window root: compact by live on host
-        live = np.asarray(host["live"])
-        idx = np.nonzero(live)[0]
-        cols = []
-        for ci, ((v, m), ft) in enumerate(zip(host["cols"],
-                                              root.schema.field_types)):
-            cols.append(_decode_col(ft, np.asarray(v)[idx],
-                                    np.asarray(m)[idx],
-                                    dicts_root.get(ci)))
-        return Chunk(cols)
+        return _compact_decode(host["cols"], host["live"],
+                               root.schema.field_types, dicts_root)
 
     # ---- distributed (multi-shard) pipeline --------------------------------
     def _run_device_dist(self) -> Chunk:
@@ -869,6 +858,9 @@ class TpuFragmentExec:
         scan_inputs = []
         scan_rows = []
         scan_dicts = {}
+        scan_bounds: Dict[int, Dict[int, Tuple[int, int]]] = {}
+        host_cols: Dict[Tuple[int, int], list] = {}
+        scan_meta = []
         for scan in scans:
             used = scan.used_columns if scan.used_columns else \
                 list(range(len(scan.schema)))
@@ -876,15 +868,29 @@ class TpuFragmentExec:
             if total == 0:
                 raise FragmentFallback("empty input")
             shim = pytypes.SimpleNamespace(parts=parts)
-            cap = _pow2((total + nd - 1) // nd, lo=8)
-            caps[id(scan)] = cap
-            cols = {}
-            dicts = {}
             ftypes = scan.schema.field_types
             for i in used:
                 vals, valid = _materialize_col(shim, i)
                 vals, dictionary = _encode_col(ftypes[i], vals, valid)
+                host_cols[(id(scan), i)] = [vals, valid, dictionary]
+            scan_meta.append((scan, used, total))
+        # string equi-join keys: unify dictionaries BEFORE sharding so
+        # equal strings hash equal on every shard (dist_fragment doc)
+        from tidb_tpu.executor.dist_fragment import unify_string_join_dicts
+        unify_string_join_dicts(root, host_cols)
+        from tidb_tpu.executor.device_cache import _col_bounds
+        for scan, used, total in scan_meta:
+            cap = _pow2((total + nd - 1) // nd, lo=8)
+            caps[id(scan)] = cap
+            cols = {}
+            dicts = {}
+            bounds: Dict[int, Tuple[int, int]] = {}
+            for i in used:
+                vals, valid, dictionary = host_cols[(id(scan), i)]
                 dicts[i] = dictionary
+                b = _col_bounds(vals, valid, dictionary)
+                if b is not None:
+                    bounds[i] = b
                 pv = np.zeros(nd * cap, dtype=vals.dtype)
                 pv[:total] = vals
                 pm = np.zeros(nd * cap, dtype=bool)
@@ -896,6 +902,7 @@ class TpuFragmentExec:
             scan_inputs.append(cols)
             scan_rows.append(jax.device_put(rows, sharding))
             scan_dicts[id(scan)] = dicts
+            scan_bounds[id(scan)] = bounds
         scan_inputs = tuple(scan_inputs)
         scan_rows = tuple(scan_rows)
 
@@ -923,13 +930,42 @@ class TpuFragmentExec:
         hash_exchanges = [n for n in TF._walk_nodes(root)
                           if isinstance(n, PhysExchange)
                           and n.kind == "hash"]
+        from dataclasses import replace as d_replace
+
+        from tidb_tpu.executor.tree_fragment import JOIN_OUT_CAP
+
+        def _shard_out_cap(cfg):
+            # expand caps are PER SHARD: start from the balanced share of
+            # the global estimate; skew comes back as join_need → 1 retry
+            return _pow2(int(cfg.est * 1.3 / nd) + 16, lo=1024)
+
+        join_cfgs = TF.plan_join_configs(root, scan_bounds)
+        join_cfgs = [d_replace(c, out_cap=_shard_out_cap(c))
+                     if c.mode == "expand" else c for c in join_cfgs]
         while True:
-            prog = _get_dist_program(root, caps, gcap, mesh, bucket_caps)
+            prog = _get_dist_program(root, caps, gcap, mesh, bucket_caps,
+                                     join_cfgs)
             prep_vals = prog.collect_preps(flow_list)
             out = jax.device_get(prog(scan_inputs, scan_rows, prep_vals))
-            if not bool(out["unique"]):
-                raise FragmentFallback("non-unique join build side")
             retry = False
+            ju = np.asarray(out["join_unique"])
+            jneed = np.asarray(out["join_need"])
+            for ji, cfg in enumerate(join_cfgs):
+                if cfg.mode == "unique" and not bool(ju[ji]):
+                    # lost PK-FK bet on some shard: re-trace that join in
+                    # expand mode (one recompile, never a CPU fallback)
+                    join_cfgs[ji] = d_replace(cfg, mode="expand",
+                                              out_cap=_shard_out_cap(cfg))
+                    retry = True
+                elif cfg.mode == "expand" and int(jneed[ji]) > cfg.out_cap:
+                    if int(jneed[ji]) > JOIN_OUT_CAP:
+                        raise FragmentFallback(
+                            f"join fan-out {int(jneed[ji])} exceeds "
+                            f"device cap")
+                    # the largest shard's true need came back: retry once
+                    join_cfgs[ji] = d_replace(
+                        cfg, out_cap=_pow2(int(jneed[ji])))
+                    retry = True
             needs = np.asarray(out["exchange_need"])
             for need, node in zip(needs, hash_exchanges):
                 if int(need) > bucket_caps[id(node)]:
@@ -967,28 +1003,30 @@ class TpuFragmentExec:
                 from tidb_tpu.executor import _empty_chunk
                 return _empty_chunk(self.schema)
             return Chunk(cols)
-        # dist_ok guarantees the remaining root is TopN/Sort: per-shard
-        # candidates arrive concatenated; host does the final k-way merge
-        n_outs = np.asarray(out["n_out"])
-        per_shard = out["cols"][0][0].shape[0] // nd if out["cols"] else 0
-        pieces = []
-        for s in range(nd):
-            lo = s * per_shard
-            n = int(n_outs[s])
-            piece = []
-            for ci, ((v, m), ft) in enumerate(
-                    zip(out["cols"], root.schema.field_types)):
-                piece.append(_decode_col(
-                    ft, np.asarray(v)[lo:lo + n],
-                    np.asarray(m)[lo:lo + n], dicts_root.get(ci)))
-            pieces.append(Chunk(piece))
-        merged = Chunk.concat(pieces) if len(pieces) > 1 else pieces[0]
-        merged = _host_order(merged, root, root.schema)
-        if isinstance(root, PhysTopN):
-            lo = min(root.offset, merged.num_rows)
-            hi = min(root.offset + root.count, merged.num_rows)
-            merged = merged.slice(lo, hi)
-        return merged
+        if isinstance(root, (PhysTopN, PhysSort)):
+            # per-shard candidates arrive concatenated; the host does the
+            # final k-way merge (the MPPGather role)
+            n_outs = np.asarray(out["n_out"])
+            per_shard = out["cols"][0][0].shape[0] // nd \
+                if out["cols"] else 0
+            pieces = []
+            for s in range(nd):
+                lo = s * per_shard
+                n = int(n_outs[s])
+                piece = []
+                for ci, ((v, m), ft) in enumerate(
+                        zip(out["cols"], root.schema.field_types)):
+                    piece.append(_decode_col(
+                        ft, np.asarray(v)[lo:lo + n],
+                        np.asarray(m)[lo:lo + n], dicts_root.get(ci)))
+                pieces.append(Chunk(piece))
+            merged = Chunk.concat(pieces) if len(pieces) > 1 else pieces[0]
+            merged = _host_order(merged, root, root.schema)
+            return _topn_slice(merged, root)
+        # window / selection / projection / join row root: compact the
+        # shard-concatenated padded output by its live mask
+        return _compact_decode(out["cols"], out["live"],
+                               root.schema.field_types, dicts_root)
 
     @staticmethod
     def _slab(ent, slab_idx: int, used: Sequence[int]):
@@ -1088,11 +1126,7 @@ class TpuFragmentExec:
             # per-slab top-(k+off) candidates merged on host (small)
             merged = Chunk.concat(pieces)
             merged = _host_order(merged, root, self.plan.root.schema)
-        if isinstance(root, PhysTopN):
-            lo = min(root.offset, merged.num_rows)
-            hi = min(root.offset + root.count, merged.num_rows)
-            merged = merged.slice(lo, hi)
-        return merged
+        return _topn_slice(merged, root)
 
     def _cols_chunk(self, root, host_cols, dicts) -> Chunk:
         child_types = [ft for ft in root.schema.field_types]
@@ -1167,6 +1201,24 @@ def _positional_dict(node: PhysicalPlan, out_idx: int, dicts
         cur = cur.children[0] if cur.children else None
         if cur is None:
             return None
+
+
+def _compact_decode(cols_vm, live_mask, ftypes, dicts_root) -> Chunk:
+    """Compact padded (values, validity) columns by a live mask and decode
+    them into a host Chunk (shared by the single-chip and distributed
+    row/window-root result paths)."""
+    idx = np.nonzero(np.asarray(live_mask))[0]
+    return Chunk([_decode_col(ft, np.asarray(v)[idx], np.asarray(m)[idx],
+                              dicts_root.get(ci))
+                  for ci, ((v, m), ft) in enumerate(zip(cols_vm, ftypes))])
+
+
+def _topn_slice(chunk: Chunk, root) -> Chunk:
+    if isinstance(root, PhysTopN):
+        lo = min(root.offset, chunk.num_rows)
+        hi = min(root.offset + root.count, chunk.num_rows)
+        return chunk.slice(lo, hi)
+    return chunk
 
 
 def _decode_col(ft: FieldType, vals: np.ndarray, mask: np.ndarray,

@@ -129,38 +129,48 @@ def tree_ok(plan: PhysicalPlan, threshold: int) -> bool:
 def dist_ok(plan: PhysicalPlan, threshold: int) -> bool:
     """Eligibility for the multi-shard (shard_map) compilation: the same
     operator allowlist as tree_ok, but joins are optional (a linear Q1
-    chain distributes as shard-partials + owned final merge) and agg/topN
-    roots are required (a distributed result needs a shard-reducible
-    root)."""
+    chain distributes as shard-partials + owned final merge). Reducible
+    roots (agg/TopN/Sort) merge across shards; window roots repartition on
+    their partition keys; selection/projection/join roots emit per-shard
+    rows the host concatenates. String join keys work because the dist
+    executor unifies the key dictionaries host-side before sharding, so
+    equal strings hash equal on every shard (the mpp repartition invariant
+    of cophandler/mpp_exec.go:158-173)."""
     from tidb_tpu.planner.physical import PhysExchange
     if isinstance(plan, PhysExchange):
         return False               # already fragmented
-    if not isinstance(plan, (PhysHashAgg, PhysTopN, PhysSort)):
+    if isinstance(plan, PhysHashAgg):
+        if any(d.distinct for d in plan.aggs):
+            # DISTINCT distributes by re-keying the exchange so every
+            # group (or every distinct value, for global aggs) is wholly
+            # on one shard (the repartition trick of cophandler/
+            # mpp_exec.go); a global agg needs all distinct args equal to
+            # pick ONE key
+            if not plan.group_exprs:
+                dargs = {repr(d.args[0]) for d in plan.aggs
+                         if d.distinct and d.args}
+                if len(dargs) != 1:
+                    return False
+    elif isinstance(plan, PhysWindow):
+        # per-shard windows need every partition wholly on one shard: all
+        # specs must share ONE non-empty bare-ColumnRef partition list so
+        # a single hash exchange co-locates them (insert_exchanges)
+        parts = {repr(d.partition) for d in plan.wdescs}
+        if len(parts) != 1 or not plan.wdescs[0].partition:
+            return False
+        if not all(isinstance(e, ColumnRef)
+                   for e in plan.wdescs[0].partition):
+            return False
+    elif not isinstance(plan, (PhysTopN, PhysSort, PhysSelection,
+                               PhysProjection, PhysHashJoin)):
         return False
-    if isinstance(plan, PhysHashAgg) and any(d.distinct for d in plan.aggs):
-        # DISTINCT distributes by re-keying the exchange so every group
-        # (or every distinct value, for global aggs) is wholly on one
-        # shard (the repartition trick of cophandler/mpp_exec.go); a
-        # global agg needs all distinct args equal to pick ONE key
-        if not plan.group_exprs:
-            dargs = {repr(d.args[0]) for d in plan.aggs
-                     if d.distinct and d.args}
-            if len(dargs) != 1:
-                return False
-    if _tree_has_string_keys(plan):
-        return False     # exchange-side dictionary unification TBD
+    # interior windows would need their own repartition point mid-tree —
+    # only a window ROOT is distributable
+    if any(isinstance(n, PhysWindow) for n in _walk_nodes(plan)[:-1]):
+        return False
     if has_join(plan):
         return tree_ok(plan, threshold)
     return _chain_shape_ok(plan, threshold)
-
-
-def _tree_has_string_keys(plan: PhysicalPlan) -> bool:
-    for node in _walk_nodes(plan):
-        if isinstance(node, PhysHashJoin):
-            for l, r in node.equi or []:
-                if l.ftype.kind.is_string or r.ftype.kind.is_string:
-                    return True
-    return False
 
 
 def _chain_shape_ok(plan: PhysicalPlan, threshold: int) -> bool:

@@ -52,8 +52,12 @@ def factorize(keys: Sequence[Tuple], live, cap: int):
     iota = jnp.arange(n, dtype=jnp.int32)
     operands: List = [_not(live)]  # live rows sort first
     for v, m in keys:
-        operands.append(jnp.asarray(m))   # NULL group sorts before non-NULL
-        operands.append(jnp.asarray(v))
+        v = jnp.asarray(v)
+        m = jnp.asarray(m)
+        operands.append(m)   # NULL group sorts before non-NULL
+        # NULL slots hold garbage (e.g. outer-join null extension gathers
+        # an arbitrary build row): neutralize so all NULLs form ONE group
+        operands.append(jnp.where(m, v, jnp.zeros_like(v)))
     operands.append(iota)
     out = lax.sort(tuple(operands), num_keys=len(operands) - 1)
     sidx = out[-1]

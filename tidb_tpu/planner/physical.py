@@ -304,6 +304,12 @@ def insert_exchanges(node: PhysicalPlan, n_shards: int) -> PhysicalPlan:
     distinct value for global aggs) so per-shard dedup is globally exact
     (the repartition trick of cophandler/mpp_exec.go:158-173)."""
     node.children = [insert_exchanges(c, n_shards) for c in node.children]
+    if isinstance(node, PhysWindow):
+        # co-locate every window partition on one shard (dist_ok already
+        # guaranteed all specs share one non-empty partition key list)
+        keys = list(node.wdescs[0].partition)
+        node.children[0] = PhysExchange(node.children[0], "hash", keys)
+        return node
     if isinstance(node, PhysHashAgg) and \
             any(d.distinct for d in node.aggs):
         keys = list(node.group_exprs)
