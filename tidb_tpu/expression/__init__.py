@@ -198,6 +198,13 @@ class ScalarFunc(Expression):
     def children(self):
         return self.args
 
+    def rebuild(self, args: List["Expression"]) -> "ScalarFunc":
+        """Reconstruct with new args — subclasses carrying extra state
+        (e.g. planner/apply.ApplySubquery) override to preserve it, so
+        generic expression transformers (fold, shift, remap) don't
+        downgrade them to a plain ScalarFunc."""
+        return ScalarFunc(self.op, args, self.ftype)
+
     def eval(self, ctx: EvalContext):
         fn = _KERNELS.get(self.op)
         if fn is None:
@@ -1877,7 +1884,8 @@ HOST_ONLY_OPS = {"strcmp", "space", "dayname", "monthname", "crc32",
                  "md5", "sha1", "sha2", "bin", "oct", "unhex",
                  "date_format", "json_extract", "json_unquote",
                  "json_valid", "json_type", "json_length", "json_keys",
-                 "json_contains", "json_array", "json_object"}
+                 "json_contains", "json_array", "json_object",
+                 "apply_subquery"}
 
 _BOOL_OPS = {"eq", "ne", "lt", "le", "gt", "ge", "nulleq", "and", "or", "xor",
              "not", "isnull", "like", "in"}

@@ -1,0 +1,264 @@
+"""Apply fallback for correlated subqueries decorrelation can't rewrite.
+
+The decorrelator (planner/decorrelate.py) turns the common correlated
+shapes into joins; anything it can't prove rewritable used to raise
+`CorrelationError`. This module is the universal fallback the reference
+keeps for the same purpose — a row-at-a-time apply over the inner plan
+with a result cache keyed on the correlated values
+(executor/parallel_apply.go:46 drives the inner executor once per outer
+row; executor/apply_cache.go memoizes on the correlated datums).
+
+The TPU translation: the OUTER query stays a fully vectorized plan
+(device-eligible operators keep their fragments); only the apply
+predicate itself is a host expression — `ApplySubquery`, a ScalarFunc
+whose args are the probe expression plus one ColumnRef per correlated
+outer column (so column pruning and ref remapping see every dependency).
+Its eval binds each DISTINCT correlated tuple into the inner plan
+template (CorrelatedRef → Constant), executes it through the session's
+plan runner, caches the row set, and folds it per mode:
+
+  * exists / not_exists — row-count test
+  * in / not_in        — membership with MySQL three-valued NULL logic
+  * scalar             — the single value (error on >1 row), compared by
+                         an ordinary ScalarFunc above
+
+Plans containing an ApplySubquery are marked dynamic (note_dynamic) so
+the session's plan cache skips them — the instance-level cache then
+lives for exactly one statement, matching apply_cache.go's lifetime.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tidb_tpu.errors import ExecutionError, PlanError
+from tidb_tpu.expression import (ColumnRef, Constant, CorrelatedRef,
+                                 Expression, ScalarFunc, func, lit)
+from tidb_tpu.planner.logical import (LogicalAggregation, LogicalDataSource,
+                                      LogicalJoin, LogicalPlan,
+                                      LogicalProjection, LogicalSelection,
+                                      LogicalSort, LogicalTopN,
+                                      LogicalWindow, WinDesc)
+
+
+# ---------------------------------------------------------------------------
+# Binding: CorrelatedRef → Constant over a plan template
+# ---------------------------------------------------------------------------
+
+
+def _bind_expr(e: Expression, values: Dict[int, object]) -> Expression:
+    if isinstance(e, CorrelatedRef):
+        if e.index in values:
+            return Constant(values[e.index], e.ftype.with_nullable(True))
+        return e
+    if isinstance(e, ScalarFunc):
+        return e.rebuild([_bind_expr(a, values) for a in e.args])
+    return e
+
+
+def bind_correlated(plan: LogicalPlan,
+                    values: Dict[int, object]) -> LogicalPlan:
+    """Shallow-copy the template with every CorrelatedRef replaced by the
+    given python value as a Constant. Node objects are copied (the rules
+    passes mutate plans in place); untouched expressions are shared."""
+    import copy
+    p = copy.copy(plan)
+    p.children = [bind_correlated(c, values) for c in plan.children]
+    if isinstance(p, LogicalSelection):
+        p.conditions = [_bind_expr(c, values) for c in p.conditions]
+    elif isinstance(p, LogicalProjection):
+        p.exprs = [_bind_expr(e, values) for e in p.exprs]
+    elif isinstance(p, LogicalAggregation):
+        from tidb_tpu.expression.aggfuncs import AggDesc
+        p.group_exprs = [_bind_expr(e, values) for e in p.group_exprs]
+        p.aggs = [AggDesc(d.name, [_bind_expr(a, values) for a in d.args],
+                          d.distinct, d.ftype) for d in p.aggs]
+    elif isinstance(p, LogicalJoin):
+        p.equi = [(_bind_expr(l, values), _bind_expr(r, values))
+                  for l, r in (p.equi or [])]
+        p.other_conditions = [_bind_expr(c, values)
+                              for c in (p.other_conditions or [])]
+    elif isinstance(p, (LogicalSort, LogicalTopN)):
+        p.by = [_bind_expr(e, values) for e in p.by]
+    elif isinstance(p, LogicalDataSource):
+        p.filters = [_bind_expr(f, values) for f in p.filters]
+    elif isinstance(p, LogicalWindow):
+        p.wdescs = [WinDesc(d.name,
+                            [_bind_expr(a, values) for a in d.args],
+                            [_bind_expr(a, values) for a in d.partition],
+                            [_bind_expr(a, values) for a in d.order],
+                            d.descs, d.ftype, d.offset, d.default, d.frame)
+                    for d in p.wdescs]
+    return p
+
+
+# ---------------------------------------------------------------------------
+# The apply expression
+# ---------------------------------------------------------------------------
+
+
+@dataclass(eq=False)
+class ApplySubquery(ScalarFunc):
+    """Host-only predicate/value expression executing a correlated inner
+    plan per DISTINCT correlated tuple (op='apply_subquery' is in
+    HOST_ONLY_OPS, so fragments never claim it).
+
+    args layout: [probe?] + one ColumnRef per corr_idx entry — pruning
+    and index remapping operate on args; corr binding pairs the LAST
+    len(corr_idx) args positionally with corr_idx, so remapped outer
+    indices keep working."""
+
+    mode: str = "exists"             # exists|not_exists|in|not_in|scalar
+    template: Optional[LogicalPlan] = None
+    corr_idx: Tuple[int, ...] = ()
+    runner: Optional[Callable] = None
+    _cache: Dict = field(default_factory=dict)
+
+    def rebuild(self, args: List[Expression]) -> "ApplySubquery":
+        return ApplySubquery("apply_subquery", args, self.ftype,
+                             self.mode, self.template, self.corr_idx,
+                             self.runner, self._cache)
+
+    def prepare(self, dictionaries):
+        return None
+
+    def __repr__(self):
+        return (f"apply_{self.mode}({', '.join(map(repr, self.args))})")
+
+    # -- evaluation ---------------------------------------------------------
+    def _decode(self, ft, v, m, r):
+        if not bool(m[r]):
+            return None
+        raw = v[r]
+        if ft.kind.is_string:
+            return str(raw)
+        return ft.decode_value(raw)
+
+    def _rows_for(self, key: Tuple) -> List[Tuple]:
+        hit = self._cache.get(key)
+        if hit is None:
+            bound = bind_correlated(self.template,
+                                    dict(zip(self.corr_idx, key)))
+            hit, _ftypes = self.runner(bound)
+            self._cache[key] = hit
+        return hit
+
+    def eval(self, ctx):
+        if ctx.on_device:
+            raise AssertionError("ApplySubquery traced on device")
+        n = ctx.num_rows
+        k = len(self.corr_idx)
+        evs = [(np.asarray(v), np.asarray(m))
+               for v, m in (a.eval(ctx) for a in self.args)]
+        corr_evs = evs[len(evs) - k:]
+        corr_fts = [a.ftype for a in self.args[len(evs) - k:]]
+        probe = evs[0] if self.mode in ("in", "not_in") else None
+        probe_ft = self.args[0].ftype if probe is not None else None
+        scalar = self.mode == "scalar"
+        if scalar and self.ftype.kind.is_string:
+            out_v = np.zeros(n, dtype=object)
+        elif scalar:
+            out_v = np.zeros(n, dtype=self.ftype.np_dtype)
+        else:
+            out_v = np.zeros(n, dtype=np.int64)
+        out_m = np.zeros(n, dtype=bool)
+        for r in range(n):
+            key = tuple(self._decode(ft, v, m, r)
+                        for ft, (v, m) in zip(corr_fts, corr_evs))
+            rows = self._rows_for(key)
+            if self.mode in ("exists", "not_exists"):
+                out_v[r] = (len(rows) > 0) == (self.mode == "exists")
+                out_m[r] = True
+                continue
+            if scalar:
+                if len(rows) > 1:
+                    raise ExecutionError("Subquery returns more than 1 row")
+                val = rows[0][0] if rows else None
+                if val is None:
+                    continue
+                out_m[r] = True
+                out_v[r] = val if self.ftype.kind.is_string \
+                    else self.ftype.encode_value(val)
+                continue
+            # in / not_in with MySQL three-valued logic
+            x = self._decode(probe_ft, probe[0], probe[1], r)
+            s = [row[0] for row in rows]
+            if not s:
+                res, valid = False, True     # x IN (∅) is FALSE, even NULL x
+            elif x is None:
+                res, valid = False, False
+            elif any(y is not None and _eq(y, x) for y in s):
+                res, valid = True, True
+            elif any(y is None for y in s):
+                res, valid = False, False    # no match but NULL in set
+            else:
+                res, valid = False, True
+            if self.mode == "not_in":
+                res = not res
+            out_v[r] = res
+            out_m[r] = valid
+        return out_v, out_m
+
+
+def _eq(a, b) -> bool:
+    try:
+        return bool(a == b)
+    except TypeError:
+        return str(a) == str(b)
+
+
+# ---------------------------------------------------------------------------
+# Builder hooks (the CorrelationError fallbacks)
+# ---------------------------------------------------------------------------
+
+
+def _make_apply(builder, outer, inner, mode: str,
+                pre_args: List[Expression], ftype) -> ApplySubquery:
+    from tidb_tpu.planner.decorrelate import (CorrelationError, _plan_exprs,
+                                              is_correlated)
+    runner = getattr(builder.subq, "run_plan", None) \
+        if builder.subq is not None else None
+    if runner is None:
+        raise CorrelationError(
+            "correlated subquery requires a session evaluator")
+    corr_idx = sorted({r.index for e in _plan_exprs(inner)
+                       for r in e.walk() if isinstance(r, CorrelatedRef)})
+    if any(is_correlated(a) for a in pre_args):
+        raise CorrelationError("correlated probe expression")
+    refs = [outer.schema.column_ref(i) for i in corr_idx]
+    note = getattr(builder.subq, "note_dynamic", None)
+    if note is not None:
+        note()      # apply results depend on data: skip the plan cache
+    return ApplySubquery("apply_subquery", list(pre_args) + refs, ftype,
+                         mode, inner, tuple(corr_idx), runner)
+
+
+def apply_exists(builder, outer, node):
+    """EXISTS fallback (ref: parallel_apply.go semi-apply)."""
+    inner = builder.build_subquery_plan(node.subquery.select, outer.schema)
+    mode = "not_exists" if node.negated else "exists"
+    return outer, [_make_apply(builder, outer, inner, mode, [],
+                               lit(1).ftype)]
+
+
+def apply_in(builder, outer, node, x):
+    inner = builder.build_subquery_plan(node.subquery.select, outer.schema)
+    if len(inner.schema) != 1:
+        raise PlanError("Operand should contain 1 column(s)")
+    mode = "not_in" if node.negated else "in"
+    return outer, [_make_apply(builder, outer, inner, mode, [x],
+                               lit(1).ftype)]
+
+
+def apply_scalar_cmp(builder, outer, op: str, x_ast, sub, flip: bool):
+    from tidb_tpu.planner.decorrelate import _FLIP
+    inner = builder.build_subquery_plan(sub.select, outer.schema)
+    if len(inner.schema) != 1:
+        raise PlanError("Operand should contain 1 column(s)")
+    vtype = inner.schema.field_types[0].with_nullable(True)
+    app = _make_apply(builder, outer, inner, "scalar", [], vtype)
+    x_rw = builder.make_rewriter(outer.schema).rewrite(x_ast)
+    return outer, [func(_FLIP[op] if flip else op, x_rw, app)]

@@ -68,6 +68,9 @@ class SubqueryEvaluator:
         # optional: execute an already-built logical plan (decorrelator's
         # uncorrelated path) — (logical) → (rows, ftypes)
         self.run_plan = None
+        # optional: mark the statement's plan data-dependent (apply
+        # fallback) so the session skips its plan cache
+        self.note_dynamic = None
 
 
 class ExpressionRewriter:
@@ -590,7 +593,10 @@ class PlanBuilder:
     def _try_correlated(self, conj: ast.ExprNode, plan: LogicalPlan):
         """→ (new_plan, extra_conds) when the conjunct is a correlated
         subquery predicate rewritten into a join; None otherwise (the
-        eager uncorrelated path applies)."""
+        eager uncorrelated path applies). Shapes the decorrelator can't
+        rewrite fall back to the row-at-a-time cached Apply
+        (planner/apply.py, the parallel_apply.go:46 role)."""
+        from tidb_tpu.planner import apply as AP
         from tidb_tpu.planner import decorrelate as DC
         if isinstance(conj, ast.UnaryOp) and conj.op == "not" and \
                 isinstance(conj.operand, (ast.ExistsExpr, ast.InExpr)):
@@ -600,19 +606,26 @@ class PlanBuilder:
             conj = _copy.copy(inner)
             conj.negated = not inner.negated
         if isinstance(conj, ast.ExistsExpr):
-            return DC.rewrite_exists(self, plan, conj)
+            try:
+                return DC.rewrite_exists(self, plan, conj)
+            except DC.CorrelationError:
+                return AP.apply_exists(self, plan, conj)
         if isinstance(conj, ast.InExpr) and conj.subquery is not None:
             x = self.make_rewriter(plan.schema).rewrite(conj.expr)
-            return DC.rewrite_in(self, plan, conj, x)
+            try:
+                return DC.rewrite_in(self, plan, conj, x)
+            except DC.CorrelationError:
+                return AP.apply_in(self, plan, conj, x)
         if isinstance(conj, ast.BinaryOp) and conj.op in _CMP_OPS:
-            if isinstance(conj.right, ast.Subquery):
-                return DC.rewrite_scalar_cmp(self, plan, conj.op,
-                                             conj.left, conj.right,
-                                             flip=False)
-            if isinstance(conj.left, ast.Subquery):
-                return DC.rewrite_scalar_cmp(self, plan, conj.op,
-                                             conj.right, conj.left,
-                                             flip=True)
+            for x_ast, sub, flip in ((conj.left, conj.right, False),
+                                     (conj.right, conj.left, True)):
+                if isinstance(sub, ast.Subquery):
+                    try:
+                        return DC.rewrite_scalar_cmp(self, plan, conj.op,
+                                                     x_ast, sub, flip=flip)
+                    except DC.CorrelationError:
+                        return AP.apply_scalar_cmp(self, plan, conj.op,
+                                                   x_ast, sub, flip=flip)
         return None
 
     # -- SELECT --------------------------------------------------------------
@@ -1123,7 +1136,7 @@ def _shift(e: Expression, delta: int) -> Expression:
     if isinstance(e, ColumnRef):
         return ColumnRef(e.index + delta, e.ftype, e.name)
     if isinstance(e, ScalarFunc):
-        return ScalarFunc(e.op, [_shift(a, delta) for a in e.args], e.ftype)
+        return e.rebuild([_shift(a, delta) for a in e.args])
     return e
 
 

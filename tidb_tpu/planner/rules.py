@@ -56,7 +56,7 @@ def fold_expr(e: Expression) -> Expression:
         return e
     if isinstance(e, ScalarFunc):
         args = [fold_expr(a) for a in e.args]
-        e = ScalarFunc(e.op, args, e.ftype)
+        e = e.rebuild(args)
         if e.is_constant() and e.op not in ("like",):
             try:
                 ctx = EvalContext(np, [], on_device=False, n_rows=1)
@@ -241,7 +241,7 @@ def _substitute(e: Expression, mapping) -> Optional[Expression]:
             if s is None:
                 return None
             args.append(s)
-        return ScalarFunc(e.op, args, e.ftype)
+        return e.rebuild(args)
     return None
 
 
@@ -249,8 +249,7 @@ def _shift_refs(e: Expression, delta: int) -> Expression:
     if isinstance(e, ColumnRef):
         return ColumnRef(e.index + delta, e.ftype, e.name)
     if isinstance(e, ScalarFunc):
-        return ScalarFunc(e.op, [_shift_refs(a, delta) for a in e.args],
-                          e.ftype)
+        return e.rebuild([_shift_refs(a, delta) for a in e.args])
     return e
 
 
@@ -437,7 +436,7 @@ def _map_refs(e: Expression, pos: Dict[int, int]) -> Expression:
     if isinstance(e, ColumnRef):
         return ColumnRef(pos[e.index], e.ftype, e.name)
     if isinstance(e, ScalarFunc):
-        return ScalarFunc(e.op, [_map_refs(a, pos) for a in e.args], e.ftype)
+        return e.rebuild([_map_refs(a, pos) for a in e.args])
     return e
 
 

@@ -556,6 +556,13 @@ class Session:
 
         ev = SubqueryEvaluator(run)
         ev.run_plan = run_plan
+
+        def note_dynamic():
+            # apply-fallback plans embed data-dependent row sets; bumping
+            # the subquery counter makes _plan skip caching them
+            self._subq_execs += 1
+
+        ev.note_dynamic = note_dynamic
         return ev
 
     PLAN_CACHE_SIZE = 128
