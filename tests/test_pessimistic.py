@@ -163,3 +163,45 @@ def test_as_of_rejected_in_txn(eng):
     with pytest.raises(TxnError, match="not allowed"):
         s.query(f"SELECT * FROM acct AS OF TIMESTAMP '{t0}'")
     s.execute("ROLLBACK")
+
+
+def test_deadlock_detected_in_milliseconds(eng):
+    # opposite-order locking: the wait-for cycle must abort one waiter
+    # with ER 1213 (unistore/tikv/detector.go), NOT stall both to the
+    # full innodb_lock_wait_timeout
+    from tidb_tpu.errors import DeadlockError
+    s1, s2 = eng.new_session(), eng.new_session()
+    for s in (s1, s2):
+        s.vars["innodb_lock_wait_timeout"] = 30.0   # long: detector must win
+    s1.execute("BEGIN PESSIMISTIC")
+    s2.execute("BEGIN PESSIMISTIC")
+    s1.execute("UPDATE acct SET bal = 1 WHERE id = 1")
+    s2.execute("UPDATE acct SET bal = 2 WHERE id = 2")
+    errs = []
+
+    def cross(sess, sql):
+        try:
+            sess.execute(sql)
+        except TxnError as e:
+            errs.append(e)
+            sess.execute("ROLLBACK")
+
+    t = threading.Thread(
+        target=cross, args=(s1, "UPDATE acct SET bal = 1 WHERE id = 2"))
+    t0 = time.perf_counter()
+    t.start()
+    time.sleep(0.1)        # let s1 enter the wait
+    cross(s2, "UPDATE acct SET bal = 2 WHERE id = 1")
+    t.join(timeout=10)
+    elapsed = time.perf_counter() - t0
+    assert len(errs) == 1, errs            # exactly ONE victim
+    assert isinstance(errs[0], DeadlockError)
+    assert errs[0].code == 1213
+    assert "Deadlock found" in str(errs[0])
+    assert elapsed < 5, elapsed            # ms-scale, not lock_wait_timeout
+
+
+def test_deadlock_error_reaches_wire_code(eng):
+    from tidb_tpu.errors import DeadlockError
+    assert DeadlockError("x").code == 1213
+    assert issubclass(DeadlockError, TxnError)   # drivers matching 1205 path

@@ -56,7 +56,14 @@ class DivisionByZero(TiDBTPUError):
 
 
 class TxnError(TiDBTPUError):
-    code = 1205
+    code = 1205  # ER_LOCK_WAIT_TIMEOUT
+
+
+class DeadlockError(TxnError):
+    """Wait-for cycle between pessimistic transactions (ref:
+    unistore/tikv/detector.go)."""
+
+    code = 1213  # ER_LOCK_DEADLOCK
 
 
 class DuplicateKeyError(TiDBTPUError):

@@ -29,7 +29,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 import numpy as np
 
 from tidb_tpu.chunk import Chunk, Column
-from tidb_tpu.errors import TxnError, UnknownTableError
+from tidb_tpu.errors import DeadlockError, TxnError, UnknownTableError
 
 REGION_ROWS = 1 << 16  # region split threshold (ref: TiKV region ~96MB)
 
@@ -106,6 +106,9 @@ class Store:
         # pessimistic row locks: (table_id, region_id) → {row → txn_id}
         # (ref: the TiKV lock CF the pessimistic mode acquires through)
         self._locks: Dict[Tuple[int, int], Dict[int, int]] = {}
+        # wait-for edges between blocked pessimistic txns: waiter → owner
+        # (the deadlock detector's graph, unistore/tikv/detector.go:24)
+        self._waits: Dict[int, int] = {}
         self._txn_seq = itertools.count(1)
 
     def _bump_locked(self) -> None:
@@ -144,7 +147,7 @@ class Store:
         deadline = _time.time() + timeout_s
         while True:
             with self._lock:
-                blocked = False
+                blocker = None
                 for rid, mask in region_masks.items():
                     owners = self._locks.get((table_id, rid))
                     if not owners:
@@ -152,18 +155,36 @@ class Store:
                     for row in np.nonzero(mask)[0]:
                         o = owners.get(int(row))
                         if o is not None and o != txn.txn_id:
-                            blocked = True
+                            blocker = o
                             break
-                    if blocked:
+                    if blocker is not None:
                         break
-                if not blocked:
+                if blocker is None:
+                    self._waits.pop(txn.txn_id, None)
                     for rid, mask in region_masks.items():
                         owners = self._locks.setdefault((table_id, rid), {})
                         for row in np.nonzero(mask)[0]:
                             owners[int(row)] = txn.txn_id
                         txn.locked.append((table_id, rid, mask.copy()))
                     return
+                # wait-for edge + cycle walk (detector.go:Detect): if this
+                # wait closes a cycle, the closing waiter aborts with
+                # ER 1213 in milliseconds instead of stalling every txn
+                # in the cycle to its full lock_wait_timeout
+                self._waits[txn.txn_id] = blocker
+                seen = set()
+                cur = blocker
+                while cur is not None and cur not in seen:
+                    if cur == txn.txn_id:
+                        self._waits.pop(txn.txn_id, None)
+                        raise DeadlockError(
+                            "Deadlock found when trying to get lock; "
+                            "try restarting transaction")
+                    seen.add(cur)
+                    cur = self._waits.get(cur)
             if _time.time() >= deadline:
+                with self._lock:
+                    self._waits.pop(txn.txn_id, None)
                 raise TxnError(
                     "Lock wait timeout exceeded; try restarting "
                     "transaction")
@@ -190,6 +211,7 @@ class Store:
         with self._lock:
             self._release_entries_locked(txn, txn.locked)
             txn.locked.clear()
+            self._waits.pop(txn.txn_id, None)
 
     # ---- lifecycle -------------------------------------------------------
     def create_table(self, table_id: int) -> None:
@@ -397,6 +419,9 @@ class Transaction:
         self.txn_id = next(store._txn_seq)
         self.pessimistic = False
         self.locked: List[Tuple[int, int, np.ndarray]] = []
+        # table_id → rows this txn modified; the session flushes it into
+        # the engine's auto-analyze counters at COMMIT (never on rollback)
+        self.modified: Dict[int, int] = {}
 
     def has_staged_writes(self) -> bool:
         return bool(self.staged_inserts) or bool(self.staged_deletes)
