@@ -224,6 +224,27 @@ def test_multi_slab_distinct_agg_device(session):
         session.vars.pop("tidb_tpu_max_slab_rows", None)
 
 
+def test_multi_slab_distinct_mixed_aggs(session):
+    # cross-slab pair-set merge (_distinct_pairs + _merge_distinct_states):
+    # SUM/AVG over DISTINCT values, several distinct aggs with different
+    # args alongside plain aggs, and a dictionary-coded (string) arg
+    # (slab cap 300 splits orders too, so the string query is multi-slab)
+    session.vars["tidb_tpu_max_slab_rows"] = 300
+    try:
+        for sql in [
+            "SELECT SUM(DISTINCT l_oid), AVG(DISTINCT l_oid), COUNT(*) "
+            "FROM li",
+            "SELECT o_prio, COUNT(DISTINCT l_oid), SUM(DISTINCT l_oid), "
+            "SUM(l_price) FROM li JOIN orders ON l_oid = o_id "
+            "GROUP BY o_prio",
+            "SELECT o_prio, COUNT(DISTINCT o_seg), COUNT(DISTINCT o_id) "
+            "FROM orders GROUP BY o_prio",
+        ]:
+            assert_same(run_device(session, sql), session.query(sql).rows)
+    finally:
+        session.vars.pop("tidb_tpu_max_slab_rows", None)
+
+
 def test_multi_slab_window_device(session):
     session.vars["tidb_tpu_max_slab_rows"] = 1000
     try:

@@ -384,3 +384,172 @@ def test_rank_family_extras():
         "ROWS BETWEEN UNBOUNDED PRECEDING AND UNBOUNDED FOLLOWING) "
         "FROM wr ORDER BY id").rows
     assert [r[1] for r in rows] == [20, 20, 20, 20, 7, 7, 7]
+
+
+# ---- RANGE frames with offsets ---------------------------------------------
+
+def _range_oracle(rows, pre, post, agg, desc=False):
+    """Positional oracle over (part, okey, val) rows, MySQL RANGE
+    semantics: sort each partition by key (NULLs first ASC / last DESC);
+    a NULL row's offset bound is its NULL-block edge; unbounded sides
+    reach the partition edges (and thus include NULL-key rows); non-NULL
+    offset bounds never include NULLs."""
+    from collections import defaultdict
+    parts = defaultdict(list)
+    for i, (p, k, v) in enumerate(rows):
+        parts[p].append((i, k, v))
+    out = {}
+    for items in parts.values():
+        items = sorted(items, key=lambda t: (
+            (t[1] is None) == desc, (-t[1] if desc else t[1])
+            if t[1] is not None else 0))
+        n = len(items)
+        null_pos = [j for j, (_i, k, _v) in enumerate(items)
+                    if k is None]
+        for j, (i, k, _v) in enumerate(items):
+            if k is None:
+                lo = 0 if pre is None else null_pos[0]
+                hi = n - 1 if post is None else null_pos[-1]
+            else:
+                def inside(kk):
+                    lo_ok = pre is None or (
+                        kk >= k - pre if not desc else kk <= k + pre)
+                    hi_ok = post is None or (
+                        kk <= k + post if not desc else kk >= k - post)
+                    return lo_ok and hi_ok
+                ok_pos = [jj for jj, (_x, kk, _y) in enumerate(items)
+                          if kk is not None and inside(kk)]
+                lo = 0 if pre is None else (min(ok_pos) if ok_pos
+                                            else n)
+                hi = n - 1 if post is None else (max(ok_pos) if ok_pos
+                                                 else -1)
+            window = [v for _x, _k, v in items[lo:hi + 1]
+                      if v is not None]
+            if agg == "sum":
+                out[i] = sum(window) if window else None
+            elif agg == "count":
+                out[i] = len(window)
+    return out
+
+
+def _mk_range_table(s, name, with_nulls=True):
+    import numpy as np
+    rng = np.random.default_rng(41)
+    data = []
+    for _ in range(500):
+        p = int(rng.integers(0, 4))
+        k = None if (with_nulls and rng.random() < 0.08) \
+            else int(rng.integers(0, 40))
+        v = None if rng.random() < 0.1 else int(rng.integers(0, 100))
+        data.append((p, k, v))
+    s.execute(f"CREATE TABLE {name} (id BIGINT, p BIGINT, k BIGINT, "
+              f"v BIGINT)")
+    s.execute(f"INSERT INTO {name} VALUES " + ",".join(
+        f"({i},{p},{'NULL' if k is None else k},"
+        f"{'NULL' if v is None else v})"
+        for i, (p, k, v) in enumerate(data)))
+    return data
+
+
+def test_range_frame_sum_count():
+    from tidb_tpu.session import Engine
+    s = Engine().new_session()
+    data = _mk_range_table(s, "rf")
+    for agg, pre, post, clause in [
+        ("sum", 3, 0, "RANGE BETWEEN 3 PRECEDING AND CURRENT ROW"),
+        ("sum", 2, 5, "RANGE BETWEEN 2 PRECEDING AND 5 FOLLOWING"),
+        ("count", 0, 0, "RANGE BETWEEN CURRENT ROW AND CURRENT ROW"),
+        ("sum", None, 1,
+         "RANGE BETWEEN UNBOUNDED PRECEDING AND 1 FOLLOWING"),
+        ("count", 4, None,
+         "RANGE BETWEEN 4 PRECEDING AND UNBOUNDED FOLLOWING"),
+        ("sum", 3, 3, "RANGE 3 PRECEDING"),     # shorthand: end=current…
+    ]:
+        if clause.endswith("3 PRECEDING") and "BETWEEN" not in clause:
+            post = 0
+        got = dict(s.query(
+            f"SELECT id, {agg.upper()}(v) OVER "
+            f"(PARTITION BY p ORDER BY k {clause}) FROM rf").rows)
+        want = _range_oracle(data, pre, post, agg)
+        assert got == want, (agg, clause,
+                             {i: (got[i], want[i]) for i in got
+                              if got[i] != want[i]})
+
+
+def test_range_frame_desc():
+    from tidb_tpu.session import Engine
+    s = Engine().new_session()
+    data = _mk_range_table(s, "rfd")
+    got = dict(s.query(
+        "SELECT id, SUM(v) OVER (PARTITION BY p ORDER BY k DESC "
+        "RANGE BETWEEN 3 PRECEDING AND CURRENT ROW) FROM rfd").rows)
+    want = _range_oracle(data, 3, 0, "sum", desc=True)
+    assert got == want
+
+
+def test_range_frame_first_last_value():
+    from tidb_tpu.session import Engine
+    s = Engine().new_session()
+    s.execute("CREATE TABLE rfv (id BIGINT, k BIGINT, v BIGINT)")
+    s.execute("INSERT INTO rfv VALUES (1,1,10),(2,2,20),(3,4,40),"
+              "(4,5,50),(5,9,90)")
+    rows = s.query(
+        "SELECT id, FIRST_VALUE(v) OVER (ORDER BY k "
+        "RANGE BETWEEN 2 PRECEDING AND 1 FOLLOWING), "
+        "LAST_VALUE(v) OVER (ORDER BY k "
+        "RANGE BETWEEN 2 PRECEDING AND 1 FOLLOWING) FROM rfv "
+        "ORDER BY id").rows
+    # frames: k=1→{1,2}; k=2→{1,2}; k=4→{2,4,5}; k=5→{4,5}; k=9→{9}
+    assert rows == [(1, 10, 20), (2, 10, 20), (3, 20, 50),
+                    (4, 40, 50), (5, 90, 90)]
+
+
+def test_range_frame_decimal_key_scaled_offsets():
+    from tidb_tpu.session import Engine
+    s = Engine().new_session()
+    s.execute("CREATE TABLE rdc (id BIGINT, k DECIMAL(8,2), v BIGINT)")
+    s.execute("INSERT INTO rdc VALUES (1,'1.00',1),(2,'1.75',2),"
+              "(3,'2.00',4),(4,'3.50',8),(5,'9.00',16)")
+    got = dict(s.query(
+        "SELECT id, SUM(v) OVER (ORDER BY k RANGE BETWEEN 1 PRECEDING "
+        "AND CURRENT ROW) FROM rdc").rows)
+    # offsets scale into DECIMAL units: 1 ⇒ 1.00
+    assert got == {1: 1, 2: 3, 3: 7, 4: 8, 5: 16}
+
+
+def test_range_frame_errors():
+    import pytest
+    from tidb_tpu.errors import PlanError
+    from tidb_tpu.session import Engine
+    s = Engine().new_session()
+    s.execute("CREATE TABLE rfe (id BIGINT, a BIGINT, b VARCHAR(4), "
+              "v BIGINT)")
+    s.execute("INSERT INTO rfe VALUES (1,1,'x',1)")
+    with pytest.raises(PlanError, match="exactly one ORDER BY"):
+        s.query("SELECT SUM(v) OVER (ORDER BY id, a RANGE BETWEEN 1 "
+                "PRECEDING AND CURRENT ROW) FROM rfe")
+    with pytest.raises(PlanError, match="numeric or temporal"):
+        s.query("SELECT SUM(v) OVER (ORDER BY b RANGE BETWEEN 1 "
+                "PRECEDING AND CURRENT ROW) FROM rfe")
+    with pytest.raises(PlanError, match="ROWS frame"):
+        s.query("SELECT MIN(v) OVER (ORDER BY a RANGE BETWEEN 1 "
+                "PRECEDING AND CURRENT ROW) FROM rfe")
+
+
+def test_range_frame_device_matches_cpu():
+    from tidb_tpu.session import Engine
+    s = Engine().new_session()
+    _mk_range_table(s, "rdev")
+    s.execute("ANALYZE TABLE rdev")
+    sql = ("SELECT id, SUM(v) OVER (PARTITION BY p ORDER BY k "
+           "RANGE BETWEEN 3 PRECEDING AND 2 FOLLOWING), "
+           "COUNT(v) OVER (PARTITION BY p ORDER BY k DESC "
+           "RANGE BETWEEN 1 PRECEDING AND CURRENT ROW) FROM rdev")
+    want = sorted(map(str, s.query(sql).rows))
+    s.vars.update(tidb_tpu_engine="on", tidb_tpu_row_threshold=1,
+                  tidb_tpu_strict="on")
+    try:
+        got = sorted(map(str, s.query(sql).rows))
+    finally:
+        s.vars.update(tidb_tpu_engine="off", tidb_tpu_strict="off")
+    assert got == want

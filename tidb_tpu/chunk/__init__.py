@@ -51,7 +51,7 @@ class Column:
             values = np.array([v if v is not None else "" for v in encoded],
                               dtype=object)
         else:
-            zero = 0 if ftype.np_dtype.kind in "iu" else 0.0
+            zero = 0 if ftype.np_dtype.kind in "iuO" else 0.0
             values = np.array([v if v is not None else zero for v in encoded],
                               dtype=ftype.np_dtype)
         return Column(ftype, values, None if validity.all() else validity)

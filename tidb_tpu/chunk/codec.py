@@ -37,7 +37,9 @@ def encode_column(col: Column) -> bytes:
     parts = [struct.pack("<II", n, null_count)]
     if null_count > 0:
         parts.append(_pack_bitmap(col.valid_mask()))
-    if col.ftype.is_varlen:
+    if col.ftype.is_varlen or col.ftype.is_wide_decimal:
+        # wide decimals hold arbitrary-precision ints: serialize decimal
+        # text like varlen (types/mydecimal.go ToString analog)
         encoded = [b"" if col.is_null(i) else str(col.values[i]).encode("utf-8")
                    for i in range(n)]
         lens = np.fromiter((len(e) for e in encoded), dtype=np.int64, count=n)
@@ -58,15 +60,19 @@ def decode_column(buf: bytes, pos: int, ftype: FieldType):
         nbytes = (n + 7) // 8
         validity = _unpack_bitmap(buf[pos:pos + nbytes], n)
         pos += nbytes
-    if ftype.is_varlen:
+    if ftype.is_varlen or ftype.is_wide_decimal:
         offsets = np.frombuffer(buf, dtype=np.int64, count=n + 1, offset=pos)
         pos += (n + 1) * 8
         total = int(offsets[-1]) if n else 0
         blob = buf[pos:pos + total]
         pos += total
-        values = np.array(
-            [blob[offsets[i]:offsets[i + 1]].decode("utf-8") for i in range(n)],
-            dtype=object)
+        texts = [blob[offsets[i]:offsets[i + 1]].decode("utf-8")
+                 for i in range(n)]
+        if ftype.is_wide_decimal:
+            values = np.array([int(t) if t else 0 for t in texts],
+                              dtype=object)
+        else:
+            values = np.array(texts, dtype=object)
     else:
         dt = ftype.np_dtype
         values = np.frombuffer(buf, dtype=dt, count=n, offset=pos).copy()

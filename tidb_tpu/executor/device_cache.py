@@ -153,9 +153,58 @@ def _col_bounds(vals: np.ndarray, valid: np.ndarray,
     return int(vv.min()), int(vv.max())
 
 
+WIDE_LIMB_BASE = 10 ** 9
+
+
+def wide_decimal_limbs(vals, n_limbs: int) -> np.ndarray:
+    """Arbitrary-precision scaled ints (object array) → (n_limbs, N) int64
+    base-10⁹ limb planes, floor-divmod so only the TOP limb is signed —
+    value == Σ limbs[k]·10^(9k) exactly. The device-side layout of
+    MyDecimal's 9-digit word vector (types/mydecimal.go:236-246), as
+    struct-of-arrays so per-limb segment sums stay exact int64."""
+    out = np.empty((n_limbs, len(vals)), dtype=np.int64)
+    cur = np.asarray(vals, dtype=object)
+    for k in range(n_limbs - 1):
+        out[k] = (cur % WIDE_LIMB_BASE).astype(np.int64)
+        cur = cur // WIDE_LIMB_BASE
+    out[n_limbs - 1] = cur.astype(np.int64)   # top: small, carries sign
+    return out
+
+
+def wide_decimal_unlimb(limbs: np.ndarray) -> np.ndarray:
+    """(n_limbs, G) int64 limb sums → object array of exact Python ints."""
+    n_limbs, g = limbs.shape
+    out = np.zeros(g, dtype=object)
+    for k in range(n_limbs - 1, -1, -1):
+        out = out * WIDE_LIMB_BASE + limbs[k].astype(object)
+    return out
+
+
 def _upload_col(ent: CachedTable, col_idx: int, ftype):
     from tidb_tpu.ops.jax_env import jnp
     vals, valid = _materialize_col(ent, col_idx)
+    if ftype.is_wide_decimal:
+        # wide decimals upload as base-10⁹ limb planes: (n_limbs, cap)
+        limbs = wide_decimal_limbs(vals, ftype.wide_limb_count)
+        ent.dicts[col_idx] = None
+        ent.bounds[col_idx] = None
+        slabs = []
+        for s in range(ent.n_slabs):
+            start = s * ent.slab_cap
+            stop = min(start + ent.slab_cap, ent.total)
+            n = stop - start
+            v = limbs[:, start:stop]
+            m = valid[start:stop]
+            if n < ent.slab_cap:
+                pv = np.zeros((limbs.shape[0], ent.slab_cap),
+                              dtype=np.int64)
+                pv[:, :n] = v
+                pm = np.zeros(ent.slab_cap, dtype=bool)
+                pm[:n] = m
+                v, m = pv, pm
+            slabs.append((jnp.asarray(v), jnp.asarray(m)))
+        ent.dev[col_idx] = slabs
+        return
     vals, dictionary = _encode_col(ftype, vals, valid)
     ent.dicts[col_idx] = dictionary
     ent.bounds[col_idx] = _col_bounds(vals, valid, dictionary)

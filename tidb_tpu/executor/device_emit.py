@@ -22,19 +22,27 @@ from tidb_tpu.expression.aggfuncs import AggFunc
 
 
 def emit_agg(ctx: EvalContext, live, root, aggs: List[AggFunc],
-             group_cap: int, key_bounds=None):
+             group_cap: int, key_bounds=None, pairs_out: bool = False):
     """Grouped-aggregation partial over one batch → {keys, states,
     n_groups, slot_live}. With `key_bounds` (per-group-key (lo, hi)
     domains) grouping is a direct packed code + segment ops — no sort
-    (the perfect-hash path); otherwise sort-based factorize."""
+    (the perfect-hash path); otherwise sort-based factorize.
+
+    With `pairs_out`, the result gains "pairs": {agg_idx: (cols,
+    n_pairs)} — the deduped (group-keys, value) tuples of every DISTINCT
+    agg, for the cross-slab host merge (fragment._merge_distinct_states).
+    The pair factorize is computed ONCE per distinct agg and shared with
+    the state first-occurrence mask: lax.sort compiles are the dominant
+    device-program compile cost (ops/factorize.py docstring), so no sort
+    runs twice."""
     from tidb_tpu.ops.jax_env import jnp
     from tidb_tpu.ops import factorize as F
-    if root.group_exprs and key_bounds is not None:
-        return _emit_agg_perfect(ctx, live, root, aggs, group_cap,
-                                 key_bounds)
-    cap = group_cap
     n = live.shape[0]
-    if root.group_exprs:
+    cap = group_cap
+    if root.group_exprs and key_bounds is not None:
+        keys, gids, n_groups, key_out, slot_live = _perfect_groups(
+            ctx, live, root, cap, key_bounds)
+    elif root.group_exprs:
         keys = [e.eval(ctx) for e in root.group_exprs]
         gids, n_groups, rep = F.factorize(keys, live, cap)
         # dead rows → out-of-range id: segment ops drop them, which is
@@ -42,18 +50,41 @@ def emit_agg(ctx: EvalContext, live, root, aggs: List[AggFunc],
         gids = jnp.where(live, gids, jnp.int32(cap))
         key_out = [(jnp.asarray(v)[rep], jnp.asarray(m)[rep] &
                     (jnp.arange(cap) < n_groups)) for v, m in keys]
+        slot_live = jnp.arange(cap, dtype=jnp.int32) < n_groups
     else:
+        keys = []
         gids = jnp.where(live, jnp.int32(0), jnp.int32(cap))
         n_groups = jnp.int32(1)
         key_out = []
-    states = _agg_states(ctx, live, root, aggs, gids, cap, n)
-    slot_live = jnp.arange(cap, dtype=jnp.int32) < n_groups
-    return {"keys": key_out, "states": states, "n_groups": n_groups,
-            "slot_live": slot_live}
+        slot_live = jnp.arange(cap, dtype=jnp.int32) < 1
+    dvals, dfirst, dpairs = {}, {}, {}
+    for ai, desc in enumerate(root.aggs):
+        if not (desc.distinct and desc.args):
+            continue
+        v, m = desc.args[0].eval(ctx)
+        v = jnp.asarray(v)
+        m = jnp.asarray(m) & live
+        dvals[ai] = (v, m)
+        first, _pg, n_pairs, rep = F.distinct_pair_factorize(
+            gids, v, m, live, n)
+        dfirst[ai] = first
+        if pairs_out:
+            pslot = jnp.arange(n, dtype=jnp.int32) < n_pairs
+            cols = [(jnp.asarray(kv)[rep], jnp.asarray(km)[rep] & pslot)
+                    for kv, km in keys]
+            cols.append((v[rep], pslot))
+            dpairs[ai] = (cols, n_pairs)
+    states = _agg_states(ctx, live, root, aggs, gids, cap, n,
+                         dfirst, dvals)
+    out = {"keys": key_out, "states": states, "n_groups": n_groups,
+           "slot_live": slot_live}
+    if pairs_out:
+        out["pairs"] = dpairs
+    return out
 
 
-def _emit_agg_perfect(ctx: EvalContext, live, root, aggs, cap: int,
-                      key_bounds):
+def _perfect_groups(ctx: EvalContext, live, root, cap: int,
+                    key_bounds):
     """Stats-informed grouping without sorting: group-key domains are
     known small bounds (dictionary sizes / cached min-max), so the group
     id is a direct packed code and aggregation is pure segment ops —
@@ -97,9 +128,7 @@ def _emit_agg_perfect(ctx: EvalContext, live, root, aggs, cap: int,
         stride *= card
         vals = (c - 1 + lo).astype(jnp.asarray(v).dtype)
         key_out.append((vals, (c != 0) & slot_live))
-    states = _agg_states(ctx, live, root, aggs, gids, cap, n)
-    return {"keys": key_out, "states": states, "n_groups": n_groups,
-            "slot_live": slot_live}
+    return keys, gids, n_groups, key_out, slot_live
 
 
 def agg_states(ctx, live, root, aggs, gids, cap: int, n: int):
@@ -109,12 +138,16 @@ def agg_states(ctx, live, root, aggs, gids, cap: int, n: int):
     return _agg_states(ctx, live, root, aggs, gids, cap, n)
 
 
-def _agg_states(ctx, live, root, aggs, gids, cap: int, n: int):
+def _agg_states(ctx, live, root, aggs, gids, cap: int, n: int,
+                distinct_first=None, distinct_vals=None):
     from tidb_tpu.ops.jax_env import jnp
     from tidb_tpu.ops import factorize as F
     states = []
-    for agg, desc in zip(aggs, root.aggs):
-        if desc.args:
+    for ai, (agg, desc) in enumerate(zip(aggs, root.aggs)):
+        if desc.distinct and desc.args and distinct_vals is not None \
+                and ai in distinct_vals:
+            v, m = distinct_vals[ai]     # evaluated once by emit_agg
+        elif desc.args:
             v, m = desc.args[0].eval(ctx)
             v = jnp.asarray(v)
             m = jnp.asarray(m) & live
@@ -123,7 +156,10 @@ def _agg_states(ctx, live, root, aggs, gids, cap: int, n: int):
             m = live
         if desc.distinct and desc.args:
             # keep only the first (group, value) occurrence
-            m = m & F.distinct_mask(gids, v, m, live)
+            if distinct_first is not None and ai in distinct_first:
+                m = m & distinct_first[ai]
+            else:
+                m = m & F.distinct_mask(gids, v, m, live)
         st = agg.init(jnp, cap)
         states.append(agg.update(jnp, st, gids, cap, v, m))
     return states
@@ -210,6 +246,13 @@ def _window_value(ctx, live, d, n, perm, pstart, peerstart):
         from tidb_tpu.ops.jax_env import device_float_dtype
         vals = vals.astype(device_float_dtype()) / \
             d.args[0].ftype.decimal_multiplier
+    frame = getattr(d, "frame", None)
+    range_key = None
+    if frame is not None and frame[0] == "range":
+        kv, km = d.order[0].eval(ctx)
+        range_key = (jnp.take(jnp.asarray(kv), perm),
+                     jnp.take(jnp.asarray(km) & live, perm),
+                     bool(d.descs[0]))
     return W.compute(jnp, d.name, vals, valid, pstart, peerstart,
-                     bool(d.order), d.offset, fill,
-                     frame=getattr(d, "frame", None))
+                     bool(d.order), d.offset, fill, frame=frame,
+                     range_key=range_key)

@@ -93,11 +93,16 @@ def _string_exprs_are_refs(exprs: Sequence[Expression]) -> bool:
 
 def _exprs_device_ok(exprs: Sequence[Expression]) -> bool:
     """Reject host-only builtins at plan time (quiet CPU routing instead
-    of a traced failure + warning per query)."""
+    of a traced failure + warning per query). Wide decimals (limb-plane
+    representation) are rejected here too: only the SUM/AVG/COUNT agg
+    arguments handled by _fragment_ok's special case consume limbs."""
     from tidb_tpu.expression import HOST_ONLY_OPS, ScalarFunc
     for e in exprs:
         for sub in e.walk():
             if isinstance(sub, ScalarFunc) and sub.op in HOST_ONLY_OPS:
+                return False
+            ft = getattr(sub, "ftype", None)
+            if ft is not None and ft.is_wide_decimal:
                 return False
     return True
 
@@ -114,7 +119,10 @@ def _fragment_ok(plan: PhysicalPlan, threshold: int) -> bool:
     reduction = isinstance(plan, (PhysHashAgg, PhysTopN, PhysSort))
     worthwhile = reduction or bool(scan.filters)
     for node in chain:
-        if not _exprs_device_ok(_stage_exprs(node)):
+        stage = _stage_exprs(node)
+        if isinstance(node, PhysHashAgg):
+            stage = list(node.group_exprs)   # agg args validated below
+        if not _exprs_device_ok(stage):
             return False
         if isinstance(node, PhysHashAgg):
             for desc in node.aggs:
@@ -127,6 +135,18 @@ def _fragment_ok(plan: PhysicalPlan, threshold: int) -> bool:
                     return False
                 if desc.args and desc.args[0].ftype.kind.is_string \
                         and desc.name != "count":
+                    return False
+                if any(a.ftype.is_wide_decimal for a in desc.args):
+                    # wide ARGUMENTS arrive as 2-D limb planes: only the
+                    # plain SUM/AVG/COUNT over a bare wide column consumes
+                    # them (SumAgg._update_wide); everything else → CPU.
+                    # A wide RESULT over narrow args needs no gate — the
+                    # device splits the int64 input into limbs itself.
+                    if desc.name not in ("sum", "avg", "count") or \
+                            desc.distinct or \
+                            not isinstance(desc.args[0], ColumnRef):
+                        return False
+                elif not _exprs_device_ok(desc.args):
                     return False
             if not _string_exprs_are_refs(node.group_exprs):
                 return False
@@ -159,6 +179,12 @@ def _window_device_ok(node: PhysWindow) -> bool:
             return False
         if d.args and d.args[0].ftype.kind.is_string:
             return False            # string lag/lead needs dict passthrough
+        if d.args and d.args[0].ftype.is_wide_decimal:
+            return False            # limb planes: window kernels are 1-D
+        fr = getattr(d, "frame", None)
+        if fr is not None and fr[0] == "range" and (
+                not d.order or d.order[0].ftype.kind.is_string):
+            return False            # RANGE bounds need a numeric key
         if not _string_exprs_are_refs(list(d.partition) + list(d.order)):
             return False
     return True
@@ -311,7 +337,7 @@ class _FragmentProgram:
 
     def __init__(self, chain: List[PhysicalPlan], used_cols: List[int],
                  in_types: List[FieldType], slab_cap: int, group_cap: int,
-                 key_bounds=None):
+                 key_bounds=None, want_pairs: bool = False):
         from tidb_tpu.ops.jax_env import jax
         self.chain = chain
         self.used_cols = used_cols
@@ -330,6 +356,11 @@ class _FragmentProgram:
                         self.prep_nodes.append(sub)
         self.partial = jax.jit(self._partial)
         self.merge = jax.jit(self._merge)
+        # emit distinct (group, value) pair sets only when a multi-slab
+        # execution will merge them — single-slab dedup is already exact
+        self.has_distinct = want_pairs and \
+            isinstance(self.root, PhysHashAgg) and \
+            any(d.distinct and d.args for d in self.root.aggs)
 
     # -- host-side per-execution preparation --------------------------------
     def collect_preps(self, dicts_by_index: Dict[int, Optional[np.ndarray]]):
@@ -388,8 +419,14 @@ class _FragmentProgram:
         ctx, live = self._eval_chain(cols, n_rows, prep_vals)
         root = self.root
         if isinstance(root, PhysHashAgg):
+            # pairs_out: DISTINCT aggs additionally emit their deduped
+            # (group, value) pair sets so multi-slab executions can merge
+            # them across slabs (the distinct-partials split of
+            # aggfuncs/func_sum.go:49-59) — no separate program, the pair
+            # factorize is shared with the state mask
             return device_emit.emit_agg(ctx, live, root, self.aggs,
-                                        self.group_cap, self.key_bounds)
+                                        self.group_cap, self.key_bounds,
+                                        pairs_out=self.has_distinct)
         if isinstance(root, (PhysTopN, PhysSort)):
             keys = [e.eval(ctx) for e in root.by]
             out_cols = [ctx.column(i) for i in range(len(root.schema))]
@@ -445,13 +482,13 @@ def _dict_list(dicts_by_index: Dict[int, Optional[np.ndarray]]) -> List:
 
 
 def get_program(chain, used_cols, in_types, slab_cap, group_cap,
-                key_bounds=None) -> _FragmentProgram:
+                key_bounds=None, want_pairs=False) -> _FragmentProgram:
     sig = _chain_signature(chain, used_cols, in_types, slab_cap, group_cap,
-                           key_bounds)
+                           key_bounds) + f"|pairs={want_pairs}"
     prog = _cache_get(sig)
     if prog is None:
         prog = _FragmentProgram(chain, used_cols, in_types, slab_cap,
-                                group_cap, key_bounds)
+                                group_cap, key_bounds, want_pairs)
         _cache_put(sig, prog)
     return prog
 
@@ -672,13 +709,12 @@ class TpuFragmentExec:
         # k-way run merge in _execute_order via rank-key lexsort (numpy's
         # stable sort is a merge sort — presorted runs merge cheaply), the
         # disk-spill multiWayMerge analog of executor/sort.go:56-58
-        if n_slabs > 1 and (
-                isinstance(root, PhysWindow) or
-                (isinstance(root, PhysHashAgg) and
-                 any(d.distinct for d in root.aggs))):
-            # window partitions / DISTINCT pairs span slabs: per-slab
-            # partials can't merge; run the chain as ONE mega-slab program
-            # (slabs concatenate inside the trace)
+        if n_slabs > 1 and isinstance(root, PhysWindow):
+            # window partitions span slabs: per-slab partials can't merge;
+            # run the chain as ONE mega-slab program (slabs concatenate
+            # inside the trace). DISTINCT aggs no longer take this path —
+            # per-slab distinct-pair sets merge on host (_distinct_pairs +
+            # _merge_distinct_states), keeping compiles per-slab-sized.
             return self._run_device_tree()
 
         # stats-informed grouping: small known key domains skip the sort
@@ -690,9 +726,11 @@ class TpuFragmentExec:
         elif isinstance(root, PhysHashAgg):
             group_cap = _initial_group_cap(root, group_cap, slab_cap)
 
+        want_pairs = ent.n_slabs > 1 and isinstance(root, PhysHashAgg) \
+            and any(d.distinct and d.args for d in root.aggs)
         while True:
             prog = get_program(chain, used, in_types, slab_cap, group_cap,
-                               key_bounds)
+                               key_bounds, want_pairs)
             prep_vals = prog.collect_preps(dicts)
             try:
                 result = self._execute(prog, chain, ent, dicts, prep_vals)
@@ -1049,10 +1087,27 @@ class TpuFragmentExec:
                      prep_vals) -> Chunk:
         from tidb_tpu.ops.jax_env import jax, jnp
         n_slabs = ent.n_slabs
+        has_distinct = any(d.distinct and d.args for d in root.aggs)
         partials = []
         for s in range(n_slabs):
             cols, n = self._slab(ent, s, prog.used_cols)
             partials.append(prog.partial(cols, jnp.int32(n), prep_vals))
+        host_pairs = None
+        if n_slabs > 1 and has_distinct:
+            # per-slab deduped (group, value) pair sets ride inside the
+            # partial outputs; slice to their true counts on device and
+            # fetch everything in one round trip
+            counts = jax.device_get(
+                [{ai: p["pairs"][ai][1] for ai in p["pairs"]}
+                 for p in partials])
+            sliced = [
+                {ai: [(v[:int(counts[si][ai])], m[:int(counts[si][ai])])
+                      for v, m in p["pairs"][ai][0]]
+                 for ai in p["pairs"]}
+                for si, p in enumerate(partials)]
+            per_slab = jax.device_get(sliced)
+            host_pairs = {ai: [ps[ai] for ps in per_slab]
+                          for ai in per_slab[0]} if per_slab else {}
         # per-slab overflow check, fetched in ONE batched round trip (the
         # tunnel pays ~100ms latency per device_get, not per array): a slab
         # whose distinct-group count exceeds group_cap clips gids (factorize
@@ -1083,9 +1138,11 @@ class TpuFragmentExec:
         if root.group_exprs and n_final == 0:
             from tidb_tpu.executor import _empty_chunk
             return _empty_chunk(self.schema)
-        return self._agg_chunk(root, out, dicts, max(n_final, 1))
+        return self._agg_chunk(root, out, dicts, max(n_final, 1),
+                               host_pairs)
 
-    def _agg_chunk(self, root: PhysHashAgg, out, dicts, n_final) -> Chunk:
+    def _agg_chunk(self, root: PhysHashAgg, out, dicts, n_final,
+                   distinct_pairs=None) -> Chunk:
         from tidb_tpu.ops.jax_env import jax
         # slice ON DEVICE, fetch EVERYTHING in one device_get: transfers
         # n_final rows per array in a single tunnel round trip
@@ -1094,6 +1151,14 @@ class TpuFragmentExec:
             [tuple(a[:n_final] for a in st) for st in out["states"]],
         )
         host_keys, host_states = jax.device_get(dev_tree)
+        if distinct_pairs:
+            # multi-slab DISTINCT: the device-merged distinct states
+            # deduped only within each slab — recompute them from the
+            # cross-slab-deduped pair sets
+            over = _merge_distinct_states(root, host_keys, distinct_pairs,
+                                          n_final)
+            host_states = [over.get(ai, st)
+                           for ai, st in enumerate(host_states)]
         cols: List[Column] = []
         for kc, e in enumerate(root.group_exprs):
             ft = self.schema[kc]
@@ -1201,6 +1266,85 @@ def _positional_dict(node: PhysicalPlan, out_idx: int, dicts
         cur = cur.children[0] if cur.children else None
         if cur is None:
             return None
+
+
+def _host_run_bounds(cols) -> Tuple[np.ndarray, np.ndarray]:
+    """Lexsort rows of [(values, valid), ...] → (order, first_of_run mask
+    over the sorted order). NULL slots canonicalize so all NULLs in a
+    column compare equal (the host mirror of ops/factorize.py)."""
+    arrays: List[np.ndarray] = []
+    for v, m in cols:
+        v = np.asarray(v)
+        m = np.asarray(m)
+        arrays.append(np.where(m, v, np.zeros((), dtype=v.dtype)))
+        arrays.append(m)
+    n = len(arrays[0]) if arrays else 0
+    order = np.lexsort(arrays[::-1]) if arrays else np.arange(0)
+    first = np.zeros(n, dtype=bool)
+    if n:
+        first[0] = True
+        for a in arrays:
+            sa = a[order]
+            first[1:] |= sa[1:] != sa[:-1]
+    return order, first
+
+
+def _host_group_index(final_cols, query_cols) -> np.ndarray:
+    """Map each query row's key tuple to its row index in final_cols
+    (−1 when absent). Vectorized via one shared lexsort — no Python dict,
+    so cross-slab DISTINCT merges scale to millions of pairs."""
+    nf = len(final_cols[0][0]) if final_cols else 0
+    nq = len(query_cols[0][0]) if query_cols else 0
+    if not final_cols:
+        return np.zeros(nq, dtype=np.int64)
+    both = [(np.concatenate([np.asarray(fv), np.asarray(qv)]),
+             np.concatenate([np.asarray(fm), np.asarray(qm)]))
+            for (fv, fm), (qv, qm) in zip(final_cols, query_cols)]
+    order, first = _host_run_bounds(both)
+    gid_sorted = np.cumsum(first) - 1
+    gid = np.empty(nf + nq, dtype=np.int64)
+    gid[order] = gid_sorted
+    slot_of = np.full(int(gid_sorted[-1]) + 1 if len(gid_sorted) else 1,
+                      -1, dtype=np.int64)
+    slot_of[gid[:nf]] = np.arange(nf)
+    return slot_of[gid[nf:]]
+
+
+def _merge_distinct_states(root, host_keys, distinct_pairs, n_final):
+    """Cross-slab DISTINCT merge: concatenate per-slab pair sets, dedup
+    globally (lexsort runs), map pairs onto the final merged groups, and
+    recompute each distinct aggregate's state with the numpy side of the
+    xp-generic agg framework (the distinct-partials split of
+    aggfuncs/func_sum.go:49-59). → {agg_index: state_tuple}."""
+    from tidb_tpu.expression.aggfuncs import build_agg
+    nk = len(root.group_exprs)
+    out = {}
+    for ai, slabs in distinct_pairs.items():
+        cols = []
+        for c in range(nk + 1):
+            v = np.concatenate([np.asarray(s[c][0]) for s in slabs])
+            m = np.concatenate([np.asarray(s[c][1]) for s in slabs])
+            cols.append((v, m))
+        order, first = _host_run_bounds(cols)
+        uniq = np.zeros(len(order), dtype=bool)
+        uniq[order] = first
+        vv, vm = cols[-1]
+        keep = uniq & np.asarray(vm)     # NULL values never count
+        if nk:
+            gidx = _host_group_index(
+                host_keys, [(np.asarray(v)[keep], np.asarray(m)[keep])
+                            for v, m in cols[:nk]])
+            ok = gidx >= 0   # every pair's group exists in the final set
+            gids = np.where(ok, gidx, 0).astype(np.int32)
+        else:
+            ok = np.ones(int(keep.sum()), dtype=bool)
+            gids = np.zeros(int(keep.sum()), dtype=np.int32)
+        agg = build_agg(root.aggs[ai])
+        st = agg.init(np, n_final)
+        out[ai] = agg.update(np, st, gids, n_final,
+                             np.asarray(vv)[keep],
+                             np.asarray(vm)[keep] & ok)
+    return out
 
 
 def _compact_decode(cols_vm, live_mask, ftypes, dicts_root) -> Chunk:

@@ -168,6 +168,13 @@ def dist_ok(plan: PhysicalPlan, threshold: int) -> bool:
     # only a window ROOT is distributable
     if any(isinstance(n, PhysWindow) for n in _walk_nodes(plan)[:-1]):
         return False
+    # wide-decimal COLUMNS can't shard (the dist scan encoder is 1-D);
+    # wide RESULTS over narrow args are fine — limb states all_gather as
+    # ordinary 1-D planes
+    if isinstance(plan, PhysHashAgg) and any(
+            any(a.ftype.is_wide_decimal for a in d.args)
+            for d in plan.aggs):
+        return False
     if has_join(plan):
         return tree_ok(plan, threshold)
     return _chain_shape_ok(plan, threshold)
@@ -581,8 +588,10 @@ class TreeProgram:
                     if len(c) == 1:
                         col_list.append(c[0])
                     else:   # mega-slab: concatenate inside the program
+                        # axis -1: rows are the LAST axis (wide-decimal
+                        # limb columns are (n_limbs, cap) planes)
                         col_list.append(
-                            (jnp.concatenate([s[0] for s in c]),
+                            (jnp.concatenate([s[0] for s in c], axis=-1),
                              jnp.concatenate([s[1] for s in c])))
                 else:
                     col_list.append(c)

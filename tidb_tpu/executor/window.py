@@ -91,9 +91,16 @@ class WindowExec(MaterializingExec):
                 d.args[0].ftype.kind is TypeKind.DECIMAL:
             vals = vals.astype(np.float64) / \
                 d.args[0].ftype.decimal_multiplier
+        frame = getattr(d, "frame", None)
+        range_key = None
+        if frame is not None and frame[0] == "range":
+            kv, km = d.order[0].eval(ctx)
+            range_key = (np.asarray(kv)[sidx],
+                         np.asarray(km, dtype=bool)[sidx],
+                         bool(d.descs[0]))
         return W.compute(np, d.name, vals, valid, pstart, peerstart,
-                         bool(d.order), d.offset, fill,
-                         frame=getattr(d, "frame", None))
+                         bool(d.order), d.offset, fill, frame=frame,
+                         range_key=range_key)
 
 
 def _sorted_layout(chunk: Chunk, n: int, d):

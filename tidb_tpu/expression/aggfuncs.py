@@ -140,8 +140,16 @@ class SumAgg(AggFunc):
         self._float = self.ftype.kind.is_float
         self._in_scale = desc.args[0].ftype.scale
         self._out_scale = self.ftype.scale
+        # wide result (> 18 digits): EXACT Python-int accumulation on the
+        # numpy side (object arrays; types/mydecimal.go arbitrary-width
+        # analog). The device engine runs these through the base-10⁹ limb
+        # formulation instead (executor/device_emit wide aggs).
+        self._wide = self.ftype.is_wide_decimal or \
+            desc.args[0].ftype.is_wide_decimal
 
     def _acc_dtype(self, xp):
+        if self._wide:
+            return object
         if not self._float:
             return xp.int64
         from tidb_tpu.ops.jax_env import device_float_dtype
@@ -161,9 +169,50 @@ class SumAgg(AggFunc):
             dt = self._acc_dtype(xp)
             return (xp.zeros(n, dtype=dt), xp.zeros(n, dtype=dt),
                     xp.zeros(n, dtype=xp.int64))
-        return (xp.zeros(n, dtype=xp.int64), xp.zeros(n, dtype=xp.int64))
+        if self._wide and xp is not np:
+            return self._init_wide(xp, n)
+        return (xp.zeros(n, dtype=self._acc_dtype(xp)),
+                xp.zeros(n, dtype=xp.int64))
+
+    # -- wide-decimal limb path (device): state = per-limb int64 sums.
+    # Per-limb sums need no carries — Σ state[k]·10^(9k) recombines
+    # exactly on host even when planes exceed 10⁹ (device_cache
+    # wide_decimal_limbs / wide_decimal_unlimb; types/mydecimal.go:236).
+    def _n_limb_planes(self) -> int:
+        aft = self.desc.args[0].ftype
+        return aft.wide_limb_count if aft.is_wide_decimal else 3
+
+    def _init_wide(self, xp, n):
+        planes = self._n_limb_planes()
+        return tuple(xp.zeros(n, dtype=xp.int64)
+                     for _ in range(planes + 1))   # limbs… + counts
+
+    def _input_limbs(self, xp, values):
+        from tidb_tpu.executor.device_cache import WIDE_LIMB_BASE as B
+        if getattr(values, "ndim", 1) == 2:
+            return [values[k] for k in range(values.shape[0])]
+        r = values // B
+        return [values % B, r % B, r // B]   # narrow arg, wide result
+
+    def _update_wide(self, xp, state, gid, n, values, validity):
+        limbs = self._input_limbs(xp, values)
+        out = []
+        for st, limb in zip(state, limbs):
+            lv = xp.where(validity, limb, xp.zeros_like(limb))
+            out.append(st + seg.segment_sum(xp, lv, gid, n))
+        out.extend(state[len(limbs):-1])     # untouched higher planes
+        out.append(state[-1] + seg.segment_count(xp, validity, gid, n))
+        return tuple(out)
+
+    def _merge_wide(self, xp, state, gid, n, partial):
+        out = [st + seg.segment_sum(xp, p, gid, n)
+               for st, p in zip(state[:-1], partial[:-1])]
+        out.append(state[-1] + seg.segment_sum(xp, partial[-1], gid, n))
+        return tuple(out)
 
     def update(self, xp, state, gid, n, values, validity):
+        if self._wide and xp is not np:
+            return self._update_wide(xp, state, gid, n, values, validity)
         if self._float:
             hi, lo, counts = state
             v = self._cast_in(xp, values)
@@ -179,6 +228,11 @@ class SumAgg(AggFunc):
                 counts + seg.segment_count(xp, validity, gid, n))
 
     def merge(self, xp, state, gid, n, partial):
+        if self._wide and len(state) > 2:
+            return self._merge_wide(xp, state, gid, n, partial)
+        return self._merge_narrow(xp, state, gid, n, partial)
+
+    def _merge_narrow(self, xp, state, gid, n, partial):
         if self._float:
             hi, lo, counts = state
             phi, plo, pcounts = partial
@@ -198,6 +252,13 @@ class SumAgg(AggFunc):
         if self._float:
             hi, lo, counts = state
             return hi.astype(np.float64) + lo.astype(np.float64), counts
+        if self._wide and len(state) > 2:
+            from tidb_tpu.executor.device_cache import wide_decimal_unlimb
+            limbs = np.stack([np.asarray(a) for a in state[:-1]])
+            sums = wide_decimal_unlimb(limbs)
+            if self._out_scale > self._in_scale:
+                sums = sums * 10 ** (self._out_scale - self._in_scale)
+            return sums, np.asarray(state[-1])
         return state
 
     def final(self, xp, state):
@@ -239,11 +300,14 @@ class MinMaxAgg(AggFunc):
         super().__init__(desc)
         self.is_min = is_min
         self._is_string = self.ftype.kind.is_string
-        if self._is_string:
+        # wide decimals ride the host-object path too: Python ints have
+        # no scatter identity either, but order totally
+        self._host_obj = self._is_string or self.ftype.is_wide_decimal
+        if self._host_obj:
             self.device_capable = False  # dictionary codes differ per chunk
 
     def _identity(self, xp, n):
-        if self._is_string:
+        if self._host_obj:
             return np.full(n, None, dtype=object)
         dt = self.desc.args[0].ftype.np_dtype
         if xp is not np and np.dtype(dt) == np.dtype(np.float64):
@@ -262,7 +326,7 @@ class MinMaxAgg(AggFunc):
 
     def update(self, xp, state, gid, n, values, validity):
         vals, seen = state
-        if self._is_string:
+        if self._host_obj:
             return self._update_string(state, gid, n, values, validity)
         ident = self._identity(xp, 1)[0]
         v = xp.where(validity, values.astype(vals.dtype),
@@ -273,7 +337,10 @@ class MinMaxAgg(AggFunc):
 
     def _update_string(self, state, gid, n, values, validity):
         vals, seen = state
-        order = np.argsort(values[validity].astype(str), kind="stable")
+        sort_key = values[validity]
+        if self._is_string:
+            sort_key = sort_key.astype(str)
+        order = np.argsort(sort_key, kind="stable")
         if not self.is_min:
             order = order[::-1]
         g = gid[validity][order]
@@ -299,8 +366,9 @@ class MinMaxAgg(AggFunc):
 
     def final(self, xp, state):
         vals, seen = state
-        if self._is_string:
-            return np.array([v if v is not None else ""
+        if self._host_obj:
+            fill = "" if self._is_string else 0
+            return np.array([v if v is not None else fill
                              for v in vals], dtype=object), seen
         return vals, seen
 
@@ -317,7 +385,7 @@ class FirstRowAgg(AggFunc):
     def __init__(self, desc: AggDesc):
         super().__init__(desc)
         self._is_string = self.ftype.kind.is_string
-        if self._is_string:
+        if self._is_string or self.ftype.is_wide_decimal:
             self.device_capable = False
 
     def init(self, xp, n):

@@ -51,17 +51,14 @@ def combine_keys(keys: Sequence[Tuple], live):
     cannot collide while N * product-of-ranks fits int64 — guaranteed by
     re-densifying after every column.
     """
-    from tidb_tpu.ops.factorize import dense_codes
+    from tidb_tpu.ops.factorize import pack_codes
     n = live.shape[0]
-    codes = jnp.zeros(n, dtype=jnp.int64)
     code_valid = jnp.ones(n, dtype=bool)
-    for v, m in keys:
-        m = jnp.asarray(m)
-        code_valid = code_valid & m
-        # dense rank of (codes, v) pairs — one sort per column, stays exact
-        gids = dense_codes([(codes, jnp.ones(n, dtype=bool)),
-                            (jnp.asarray(v), m)], live)
-        codes = gids.astype(jnp.int64)
+    for _, m in keys:
+        code_valid = code_valid & jnp.asarray(m)
+    # dense per-column ranks packed + re-densified (ops/factorize.py
+    # pack_codes) — one NARROW sort per column, stays exact
+    codes = pack_codes(keys, live).astype(jnp.int64)
     return codes, code_valid
 
 

@@ -235,12 +235,119 @@ def rows_frame_agg(xp, name, vals, valid, pstart, pre, post):
     raise AssertionError(f"unsupported framed window aggregate {name}")
 
 
+def _partition_last(xp, pstart):
+    from tidb_tpu.ops import segment as seg
+    n = pstart.shape[0]
+    iota = _iota(xp, n)
+    pid = partition_ids(xp, pstart)
+    last = seg.segment_max(xp, iota, pid.astype(xp.int32)
+                           if xp is not np else pid, n)
+    return xp.take(last, pid)
+
+
+def range_frame_bounds(xp, pstart, peerstart, okey, ovalid, desc: bool,
+                       pre, post):
+    """[lo, hi] positions of a RANGE value frame on the sorted layout
+    (ref: executor/window.go slide frames; MySQL RANGE offset semantics).
+
+    okey/ovalid: the single ORDER BY key, sorted layout. DESC negates it
+    into ascending m-space, so the frame is uniformly [m−pre, m+post]
+    ("n PRECEDING" means key+n under DESC). A 0 offset is CURRENT ROW —
+    in RANGE terms the current PEER edge, where the searches land
+    naturally. NULL keys are each other's peers: an offset bound gives a
+    NULL row exactly its NULL block; an unbounded side still reaches the
+    partition edge; non-NULL frames never include NULLs.
+
+    Vectorized in-partition binary search: log2(n) static rounds of
+    take+where — no extra lax.sort (whose compile cost is the device
+    budget, ops/factorize.py docstring). Comparisons run in the key's own
+    dtype: exact for int/decimal/date keys; float keys compare in the
+    device float dtype."""
+    n = pstart.shape[0]
+    ppos = _pstart_pos(xp, pstart)
+    plast = _partition_last(xp, pstart)
+    m = xp.asarray(okey)
+    if desc:
+        m = -m
+    if m.dtype.kind == "f":
+        sent = xp.asarray(np.inf if desc else -np.inf, dtype=m.dtype)
+    else:
+        big = np.iinfo(np.int64).max // 2
+        sent = xp.asarray(big if desc else -big, dtype=m.dtype)
+    # NULL placement matches the sort order: ASC first, DESC last
+    m = xp.where(ovalid, m, sent)
+    k_rounds = max(int(max(n - 1, 1)).bit_length(), 1)
+
+    def first_pos(target, strict: bool):
+        lo_b = ppos
+        hi_b = plast + 1
+        for _ in range(k_rounds):
+            mid = (lo_b + hi_b) // 2
+            v = xp.take(m, xp.clip(mid, 0, n - 1))
+            hit = (v > target) if strict else (v >= target)
+            hit = hit | (mid > plast)
+            hi_b = xp.where(hit, mid, hi_b)
+            lo_b = xp.where(hit, lo_b, mid + 1)
+        return lo_b
+
+    peer_lo = _pstart_pos(xp, peerstart)
+    peer_hi = _next_peerstart_pos(xp, peerstart)
+    if pre is None:
+        lo = ppos
+    else:
+        off = xp.asarray(pre, dtype=m.dtype)
+        lo = xp.where(ovalid, first_pos(m - off, strict=False), peer_lo)
+    if post is None:
+        hi = plast
+    else:
+        off = xp.asarray(post, dtype=m.dtype)
+        hi = xp.where(ovalid, first_pos(m + off, strict=True) - 1,
+                      peer_hi)
+    return lo, hi
+
+
+def range_frame_agg(xp, name, vals, valid, lo, hi):
+    """COUNT/SUM/AVG over precomputed [lo, hi] frame positions (the
+    prefix-sum formulation of rows_frame_agg, bounds supplied)."""
+    n = vals.shape[0] if vals is not None else lo.shape[0]
+    empty = hi < lo
+    hi_c = xp.clip(hi, 0, n - 1)
+    ccnt = xp.cumsum(valid.astype(xp.int64))
+    base_c = xp.where(lo > 0, xp.take(ccnt, xp.clip(lo - 1, 0, n - 1)),
+                      xp.int64(0))
+    c = xp.where(empty, xp.int64(0), xp.take(ccnt, hi_c) - base_c)
+    if name == "count":
+        return c, xp.ones(n, dtype=bool)
+    if name not in ("sum", "avg"):
+        raise AssertionError(
+            f"unsupported RANGE-framed window aggregate {name}")
+    z = xp.where(valid, vals, xp.zeros_like(vals))
+    acc_dt = (xp.float64 if xp is np else z.dtype) \
+        if z.dtype.kind == "f" else xp.int64
+    cum = xp.cumsum(z.astype(acc_dt))
+    base = xp.where(lo > 0, xp.take(cum, xp.clip(lo - 1, 0, n - 1)),
+                    xp.zeros((), dtype=cum.dtype))
+    st = xp.where(empty, xp.zeros((), dtype=cum.dtype),
+                  xp.take(cum, hi_c) - base)
+    if name == "sum":
+        return st, (c > 0) & ~empty
+    safe = xp.where(c > 0, c, xp.ones_like(c))
+    out = st / safe.astype(st.dtype) if st.dtype.kind == "f" else st / safe
+    return out, (c > 0) & ~empty
+
+
 def frame_value(xp, name, vals, valid, pstart, peerstart, has_order: bool,
-                frame):
+                frame, range_bounds=None):
     """FIRST_VALUE / LAST_VALUE: a gather at the frame edge. The default
     frame with ORDER BY ends at the current PEER group (the classic
     last_value gotcha — MySQL semantics preserved)."""
     n = pstart.shape[0]
+    if range_bounds is not None:
+        lo, hi = range_bounds
+        empty = hi < lo
+        pos = lo if name == "first_value" else hi
+        pos = xp.clip(pos, 0, n - 1)
+        return xp.take(vals, pos), xp.take(valid, pos) & ~empty
     if frame is not None:
         pre, post = frame
         lo, hi, _plast = _frame_bounds(xp, pstart, pre, post)
@@ -298,11 +405,13 @@ def ntile(xp, pstart, n_buckets: int):
 
 
 def nth_value(xp, vals, valid, pstart, peerstart, has_order: bool,
-              frame, nth: int):
+              frame, nth: int, range_bounds=None):
     """NTH_VALUE(v, n): the frame's n-th row, NULL when the frame is
     shorter (frame-aware like first/last value)."""
     n = pstart.shape[0]
-    if frame is not None:
+    if range_bounds is not None:
+        lo, hi = range_bounds
+    elif frame is not None:
         pre, post = frame
         lo, hi, _plast = _frame_bounds(xp, pstart, pre, post)
     else:
@@ -332,14 +441,26 @@ def _partition_rows(xp, pstart):
 
 
 def compute(xp, name, vals, valid, pstart, peerstart, has_order: bool,
-            offset: int = 1, fill=None, frame=None):
+            offset: int = 1, fill=None, frame=None, range_key=None):
     """Shared dispatch for host (numpy) and device (jnp) window columns.
     vals/valid are the function argument in SORTED layout (None for the
     rank family); fill = (fill_vals, fill_valid) for lag/lead; frame =
-    (pre, post) row offsets (None side = unbounded) or None for the
-    default frame."""
+    ('rows'|'range', pre, post) (None side = unbounded) or None for the
+    default frame; range_key = (okey, ovalid, desc) in sorted layout —
+    required for RANGE offset frames."""
     n = pstart.shape[0]
     ones = xp.ones(n, dtype=bool)
+    rows_fr = None
+    range_bounds = None
+    if frame is not None:
+        tag, pre, post = frame
+        if tag == "range":
+            okey, ovalid, desc = range_key
+            range_bounds = range_frame_bounds(xp, pstart, peerstart,
+                                              okey, ovalid, desc,
+                                              pre, post)
+        else:
+            rows_fr = (pre, post)
     if name == "row_number":
         return row_number(xp, pstart), ones
     if name == "rank":
@@ -351,7 +472,7 @@ def compute(xp, name, vals, valid, pstart, peerstart, has_order: bool,
         return shifted(xp, vals, valid, pstart, off, fill[0], fill[1])
     if name in ("first_value", "last_value"):
         return frame_value(xp, name, vals, valid, pstart, peerstart,
-                           has_order, frame)
+                           has_order, rows_fr, range_bounds)
     if name == "percent_rank":
         return percent_rank(xp, pstart, peerstart), ones
     if name == "cume_dist":
@@ -360,10 +481,11 @@ def compute(xp, name, vals, valid, pstart, peerstart, has_order: bool,
         return ntile(xp, pstart, offset), ones
     if name == "nth_value":
         return nth_value(xp, vals, valid, pstart, peerstart, has_order,
-                         frame, offset)
-    if frame is not None:
-        pre, post = frame
-        return rows_frame_agg(xp, name, vals, valid, pstart, pre, post)
+                         rows_fr, offset, range_bounds)
+    if range_bounds is not None:
+        return range_frame_agg(xp, name, vals, valid, *range_bounds)
+    if rows_fr is not None:
+        return rows_frame_agg(xp, name, vals, valid, pstart, *rows_fr)
     if has_order:
         return running_agg(xp, name, vals, valid, pstart, peerstart)
     return full_frame_agg(xp, name, vals, valid, pstart, n)

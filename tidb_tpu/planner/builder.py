@@ -808,12 +808,45 @@ class PlanBuilder:
                 if name == "avg":
                     ftype = T.double(True)   # windowed AVG computes double
             frame = _convert_frame(spec.frame)
+            if frame is not None and frame[0] == "range":
+                frame = self._check_range_frame(frame, name, order)
             wdescs.append(WinDesc(name, args, partition, order, descs,
                                   ftype, offset, default, frame))
             names.append(f"_win_{i}")
             window_map[id(call)] = ColumnRef(base + i, ftype,
                                              f"_win_{i}")
         return LogicalWindow(wdescs, names, plan)
+
+    @staticmethod
+    def _check_range_frame(frame, name: str, order):
+        """RANGE offset frames: exactly one numeric/temporal ORDER BY key
+        (MySQL's rule); offsets are encoded into the key's physical units
+        (DECIMAL scale, DATE days) so bound comparisons run on raw
+        values. MIN/MAX need slide state over dynamic-width frames — not
+        supported (use a ROWS frame)."""
+        _tag, pre, post = frame
+        if len(order) != 1:
+            raise PlanError(
+                "RANGE frame with offsets requires exactly one ORDER BY "
+                "expression")
+        kft = order[0].ftype
+        if not (kft.kind.is_numeric or kft.kind in
+                (TypeKind.DATE, TypeKind.DATETIME, TypeKind.TIMESTAMP,
+                 TypeKind.TIME)):
+            raise PlanError(
+                "RANGE frame with offsets requires a numeric or temporal "
+                "ORDER BY expression")
+        if name in ("min", "max"):
+            raise PlanError(
+                f"windowed {name.upper()}() over a RANGE offset frame is "
+                f"not supported (use a ROWS frame)")
+
+        def enc(off):
+            # negative = a FOLLOWING start / PRECEDING end, legal in
+            # BETWEEN form; range_frame_bounds handles the sign
+            return None if off is None else kft.encode_value(off)
+
+        return ("range", enc(pre), enc(post))
 
     def _resolve_order(self, sel: ast.SelectStmt, items, names,
                        proj_exprs: List[Expression],
@@ -1002,10 +1035,10 @@ class PlanBuilder:
 
 
 def _convert_frame(spec_frame):
-    """Window frame clause → (pre, post) row offsets, None side =
-    unbounded; returns None for the default frame. RANGE frames support
-    only the peers-default and the full-partition forms (the reference's
-    RANGE-with-offset needs order-key arithmetic)."""
+    """Window frame clause → ('rows'|'range', pre, post); None side =
+    unbounded; returns None for the default frame. ROWS offsets count
+    rows; RANGE offsets are ORDER-BY-key value deltas (the slide frames
+    of executor/window.go, evaluated by ops/window.range_frame_bounds)."""
     if spec_frame is None:
         return None
     unit, start, end = spec_frame
@@ -1014,8 +1047,7 @@ def _convert_frame(spec_frame):
             return None                      # the default frame
         if start == ("unbounded", "preceding") and \
                 end == ("unbounded", "following"):
-            return (None, None)
-        raise PlanError("RANGE frames with offsets are not supported")
+            return ("rows", None, None)      # full partition
 
     def pre_of(b):
         if b == ("unbounded", "preceding"):
@@ -1037,7 +1069,7 @@ def _convert_frame(spec_frame):
             raise PlanError("frame end cannot be UNBOUNDED PRECEDING")
         return n if d == "following" else -n
 
-    return (pre_of(start), post_of(end))
+    return (unit, pre_of(start), post_of(end))
 
 
 def _ast_conjuncts(node: ast.ExprNode) -> List[ast.ExprNode]:

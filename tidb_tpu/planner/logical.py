@@ -234,9 +234,13 @@ class WinDesc:
         self.frame = frame
 
     def __repr__(self):
+        # the frame MUST be in the repr: device compile caches key on
+        # wdescs repr (tree_signature) — omitting it would let two
+        # different frames share one compiled program
         return (f"{self.name}({self.args!r}) over(p={self.partition!r}, "
                 f"o={list(zip(self.order, self.descs))!r}, "
-                f"off={self.offset}, dflt={self.default!r})")
+                f"off={self.offset}, dflt={self.default!r}, "
+                f"fr={self.frame!r})")
 
 
 class LogicalWindow(LogicalPlan):

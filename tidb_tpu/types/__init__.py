@@ -87,6 +87,12 @@ class FieldType:
     @property
     def np_dtype(self) -> np.dtype:
         k = self.kind
+        if k is TypeKind.DECIMAL and self.precision > 18:
+            # wide decimals (> int64's ~18.9 digits) hold exact Python
+            # ints host-side; the device path splits them into base-10⁹
+            # limb planes (ref: types/mydecimal.go:236-246 — MyDecimal's
+            # 9-digit word vector, re-laid-out as struct-of-arrays)
+            return np.dtype(object)
         if k.is_integer or k is TypeKind.DECIMAL or k in (
                 TypeKind.DATETIME, TypeKind.TIMESTAMP, TypeKind.TIME,
                 TypeKind.ENUM, TypeKind.SET):
@@ -109,6 +115,17 @@ class FieldType:
     def decimal_multiplier(self) -> int:
         return 10 ** self.scale
 
+    @property
+    def is_wide_decimal(self) -> bool:
+        """DECIMAL wider than int64 (> 18 digits): object host arrays,
+        base-10⁹ limb planes on device (types/mydecimal.go:236)."""
+        return self.kind is TypeKind.DECIMAL and self.precision > 18
+
+    @property
+    def wide_limb_count(self) -> int:
+        """Base-10⁹ limbs covering precision digits (+1 headroom digit)."""
+        return -(-(self.precision + 1) // 9)
+
     def with_nullable(self, nullable: bool) -> "FieldType":
         return replace(self, nullable=nullable)
 
@@ -128,8 +145,13 @@ class FieldType:
                 d = _decimal.Decimal(repr(v))
             else:
                 d = _decimal.Decimal(str(v))
-            return int(d.scaleb(self.scale).to_integral_value(
-                rounding=_decimal.ROUND_HALF_UP))
+            # the DEFAULT decimal context rounds to 28 significant digits
+            # — silently corrupting wide (up to 65-digit) values; scale
+            # inside a high-precision local context
+            with _decimal.localcontext() as c:
+                c.prec = 100
+                return int(d.scaleb(self.scale).to_integral_value(
+                    rounding=_decimal.ROUND_HALF_UP))
         if k.is_integer:
             return int(v)
         if k.is_float:
@@ -206,8 +228,10 @@ class FieldType:
             q = int(raw)
             if self.scale == 0:
                 return q
-            from decimal import Decimal
-            return Decimal(q).scaleb(-self.scale)
+            import decimal as _decimal
+            with _decimal.localcontext() as c:
+                c.prec = 100    # default 28-digit context rounds wide values
+                return _decimal.Decimal(q).scaleb(-self.scale)
         if k.is_integer:
             return int(raw)
         if k.is_float:
