@@ -188,3 +188,70 @@ def test_invalid_create_index_syntax_rejected():
     s.execute("CREATE TABLE z (a BIGINT)")
     with pytest.raises(ParseError):
         s.execute("CREATE UNIQUE FROB zz ON z (a)")
+
+
+# ---- resumable CREATE UNIQUE INDEX backfill (tidb_tpu/ddl.py) --------------
+
+def test_unique_backfill_resumes_from_checkpoint(tmp_path):
+    import numpy as np
+    from tidb_tpu.errors import DuplicateKeyError
+    from tidb_tpu.session import Engine
+    from tidb_tpu.util import failpoint
+    eng = Engine()
+    s = eng.new_session()
+    s.execute("CREATE TABLE rb (a BIGINT, b BIGINT)")
+    # several INSERT batches → several storage regions (backfill units)
+    for lo in range(0, 4000, 1000):
+        s.execute("INSERT INTO rb VALUES " + ",".join(
+            f"({i},{i * 2})" for i in range(lo, lo + 1000)))
+    s.vars["tidb_ddl_reorg_checkpoint_dir"] = str(tmp_path)
+    s.vars["tidb_ddl_reorg_batch_size"] = 1000      # 4 backfill batches
+    # kill the backfill after the SECOND batch
+    hits = [0]
+
+    def boom():
+        hits[0] += 1
+        if hits[0] == 2:
+            raise RuntimeError("injected crash mid-backfill")
+
+    failpoint.enable("index-backfill", hook=boom)
+    try:
+        try:
+            s.execute("CREATE UNIQUE INDEX u_a ON rb (a)")
+            raise AssertionError("failpoint did not fire")
+        except RuntimeError:
+            pass
+    finally:
+        failpoint.disable("index-backfill")
+    # a checkpoint + at least one persisted run survived the crash
+    files = [f.name for f in tmp_path.iterdir()]
+    assert any(f.startswith("reorg_u_a") and f.endswith(".json")
+               for f in files), files
+    assert any(".run" in f for f in files), files
+    # "restart": a fresh session resumes and completes
+    s2 = eng.new_session()
+    s2.vars["tidb_ddl_reorg_checkpoint_dir"] = str(tmp_path)
+    s2.vars["tidb_ddl_reorg_batch_size"] = 1000
+    s2.execute("CREATE UNIQUE INDEX u_a ON rb (a)")
+    info = eng.catalog.info_schema.table("rb")
+    assert any(ix.name == "u_a" and ix.unique for ix in info.indexes)
+    # checkpoint + runs cleaned up after completion
+    assert not list(tmp_path.iterdir()), list(tmp_path.iterdir())
+    # the index enforces uniqueness afterwards
+    import pytest
+    with pytest.raises(DuplicateKeyError):
+        s2.execute("INSERT INTO rb VALUES (5, 99)")
+
+
+def test_unique_backfill_cross_region_duplicate(tmp_path):
+    import pytest
+    from tidb_tpu.errors import DuplicateKeyError
+    from tidb_tpu.session import Engine
+    eng = Engine()
+    s = eng.new_session()
+    s.execute("CREATE TABLE rbd (a BIGINT)")
+    s.execute("INSERT INTO rbd VALUES (1),(2),(3)")
+    s.execute("INSERT INTO rbd VALUES (7),(8),(2)")   # dup spans regions
+    s.vars["tidb_ddl_reorg_checkpoint_dir"] = str(tmp_path)
+    with pytest.raises(DuplicateKeyError, match="Duplicate entry"):
+        s.execute("CREATE UNIQUE INDEX u_d ON rbd (a)")

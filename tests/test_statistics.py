@@ -136,3 +136,80 @@ def test_stats_feed_group_cap(session):
     agg = _find(p, "PhysHashAgg")
     cap = _initial_group_cap(agg, 1 << 16, 1 << 23)
     assert cap == 1024           # small reliable estimate → floor
+
+
+def test_auto_analyze_lifecycle():
+    # statement-boundary auto-analyze (statistics/handle/update.go:939,
+    # domain/domain.go:1249): stats appear without a manual ANALYZE once
+    # enough rows accumulate, refresh after 10x growth, and the plan that
+    # keyed on the stale stats version is replanned
+    eng = Engine()
+    s = eng.new_session()
+    s.execute("CREATE TABLE aa (a BIGINT, b BIGINT)")
+    s.execute("INSERT INTO aa VALUES " +
+              ",".join(f"({i},{i % 7})" for i in range(2000)))
+    tid = eng.catalog.info_schema.table("aa").id
+    assert tid not in eng.table_stats
+    sql = "SELECT b, COUNT(*) FROM aa GROUP BY b"
+    s.query(sql)
+    assert tid in eng.table_stats          # fired with no manual ANALYZE
+    assert eng.table_stats[tid].row_count == 2000
+    plan1 = s._plan(parse(sql)[0])
+    # 10x growth → ratio trigger → fresh stats + replanned estimate
+    s.execute("INSERT INTO aa VALUES " +
+              ",".join(f"({i},{i % 7})" for i in range(2000, 20000)))
+    plan2 = s._plan(parse(sql)[0])
+    assert eng.table_stats[tid].row_count == 20000
+    assert plan2 is not plan1              # stats version keyed the cache
+    assert plan2.est_rows == plan1.est_rows == 7  # NDV(b) stays 7
+    scan2 = plan2
+    while scan2.children:
+        scan2 = scan2.children[0]
+
+
+def test_auto_analyze_disabled_and_small_tables():
+    eng = Engine()
+    s = eng.new_session()
+    s.execute("CREATE TABLE small (a BIGINT)")
+    s.execute("INSERT INTO small VALUES (1),(2),(3)")
+    tid = eng.catalog.info_schema.table("small").id
+    s.query("SELECT COUNT(*) FROM small")
+    assert tid not in eng.table_stats      # under tidb_auto_analyze_min_rows
+    s.execute("CREATE TABLE big (a BIGINT)")
+    s.execute("INSERT INTO big VALUES " +
+              ",".join(f"({i})" for i in range(1500)))
+    bid = eng.catalog.info_schema.table("big").id
+    s.vars["tidb_enable_auto_analyze"] = "off"
+    s.query("SELECT COUNT(*) FROM big")
+    assert bid not in eng.table_stats      # disabled
+    s.vars["tidb_enable_auto_analyze"] = "on"
+    s.query("SELECT COUNT(*) FROM big")
+    assert bid in eng.table_stats
+
+
+def test_auto_analyze_ignores_rolled_back_writes():
+    # modify counts flush at COMMIT: a rolled-back INSERT must not
+    # trigger a spurious re-ANALYZE (statistics/handle/update.go flushes
+    # modifyCount on commit)
+    eng = Engine()
+    s = eng.new_session()
+    s.execute("CREATE TABLE rbk (a BIGINT)")
+    s.execute("INSERT INTO rbk VALUES " +
+              ",".join(f"({i})" for i in range(1500)))
+    s.query("SELECT COUNT(*) FROM rbk")          # baseline auto-analyze
+    tid = eng.catalog.info_schema.table("rbk").id
+    v0 = eng.table_stats[tid].version
+    s.execute("BEGIN")
+    s.execute("INSERT INTO rbk VALUES " +
+              ",".join(f"({i})" for i in range(50000, 70000)))
+    s.execute("ROLLBACK")
+    s.query("SELECT COUNT(*) FROM rbk")
+    assert eng.table_stats[tid].version == v0    # no spurious re-analyze
+    assert eng.modify_counts.get(tid, 0) == 0
+    # committed writes DO count
+    s.execute("BEGIN")
+    s.execute("INSERT INTO rbk VALUES " +
+              ",".join(f"({i})" for i in range(50000, 70000)))
+    s.execute("COMMIT")
+    s.query("SELECT COUNT(*) FROM rbk")
+    assert eng.table_stats[tid].row_count == 21500

@@ -205,8 +205,18 @@ class Engine:
         self.auth = AuthManager()
         # bumped by ANALYZE: plan-cache entries keyed on it go stale
         self.stats_version = 0
+        # table_id → rows modified since its last ANALYZE — feeds the
+        # auto-analyze trigger (statistics/handle/update.go modifyCount)
+        self.modify_counts: Dict[int, int] = {}
         # SET GLOBAL scope, inherited by new sessions (sysvar.go analog)
         self.global_vars: Dict[str, object] = {}
+
+    def note_modified(self, table_id: int, n: int) -> None:
+        if n <= 0:
+            return
+        with self.stats_lock:
+            self.modify_counts[table_id] = \
+                self.modify_counts.get(table_id, 0) + int(n)
 
     def new_session(self) -> "Session":
         return Session(self)
@@ -462,8 +472,13 @@ class Session:
             from tidb_tpu.catalog import IndexInfo as _IdxInfo
             info = self.engine.catalog.info_schema.table(stmt.table)
             if stmt.unique:
-                self._validate_unique_backfill(info, stmt.columns,
-                                               stmt.name)
+                # chunked, checkpoint-resumable validation scan
+                # (ddl/reorg.go:193; tidb_tpu/ddl.py)
+                from tidb_tpu.ddl import unique_backfill
+                ckpt_dir = str(self.vars.get(
+                    "tidb_ddl_reorg_checkpoint_dir", "") or "") or None
+                unique_backfill(self, info, list(stmt.columns),
+                                stmt.name, ckpt_dir)
             self.engine.catalog.add_index(
                 stmt.table, _IdxInfo(stmt.name, tuple(stmt.columns),
                                      stmt.unique))
@@ -518,6 +533,8 @@ class Session:
                             "Information schema is changed during the "
                             "execution of the statement; please retry")
                     self.txn.commit()
+                    for tid, n in self.txn.modified.items():
+                        self.engine.note_modified(tid, n)
                 finally:
                     self.txn = None
             return ok()
@@ -567,7 +584,50 @@ class Session:
 
     PLAN_CACHE_SIZE = 128
 
+    def _note_modified(self, txn, auto: bool, table_id: int,
+                       n: int) -> None:
+        """Auto-analyze row accounting: immediate under autocommit;
+        deferred to COMMIT inside explicit transactions so a ROLLBACK
+        never inflates modify_counts (the reference flushes modifyCount
+        on commit, statistics/handle/update.go)."""
+        if auto or txn is None:
+            self.engine.note_modified(table_id, n)
+        else:
+            txn.modified[table_id] = txn.modified.get(table_id, 0) + n
+
+    def _maybe_auto_analyze(self) -> None:
+        """Statement-boundary auto-analyze — the single-process stand-in
+        for the reference's background loop (statistics/handle/
+        update.go:939 HandleAutoAnalyze, wired at domain/domain.go:1249).
+        Any table whose modified-row count since its last ANALYZE exceeds
+        tidb_auto_analyze_ratio × analyzed rows (or that has accumulated
+        tidb_auto_analyze_min_rows with no stats at all) is re-analyzed
+        here; the stats-version bump invalidates its cached plans."""
+        from tidb_tpu.executor.fragment import _var_bool
+        if not _var_bool(self.vars.get("tidb_enable_auto_analyze", True)):
+            return
+        ratio = float(self.vars.get("tidb_auto_analyze_ratio", 0.5))
+        min_rows = int(self.vars.get("tidb_auto_analyze_min_rows", 1000))
+        eng = self.engine
+        with eng.stats_lock:
+            pending = dict(eng.modify_counts)
+        if not pending:
+            return
+        names = []
+        for tid, mod in pending.items():
+            if mod < min_rows:
+                continue
+            stats = eng.table_stats.get(tid)
+            if stats is not None and mod <= ratio * max(stats.row_count, 1):
+                continue
+            info = eng.catalog.info_schema.table_by_id(tid)
+            if info is not None:
+                names.append(info.name)
+        if names:
+            self._analyze(ast.AnalyzeTable(names))
+
     def _plan(self, stmt):
+        self._maybe_auto_analyze()
         ctx = _PlanContext(self)
         key = self._plan_cache_key(stmt)
         if key is not None:
@@ -772,29 +832,8 @@ class Session:
             if auto:
                 txn.rollback()
             raise
+        self._note_modified(txn, auto, info.id, chunk.num_rows)
         return ok(chunk.num_rows)
-
-    def _validate_unique_backfill(self, info: TableInfo, cols, name):
-        """CREATE UNIQUE INDEX must fail when existing rows collide (the
-        reference's write-reorg backfill checks, ddl/backfilling.go)."""
-        from tidb_tpu.errors import DuplicateKeyError
-        col_of = {c.name.lower(): i for i, c in enumerate(info.columns)}
-        idxs = [col_of[c.lower()] for c in cols]
-        snap = self._read_view_snapshot()
-        if not snap.has_table(info.id):
-            return
-        seen = set()
-        for region, alive in snap.scan(info.id):
-            from tidb_tpu.executor.scan import align_chunk_to_schema
-            ch = align_chunk_to_schema(region.chunk, info)
-            keys = _key_tuples(ch, idxs)
-            for ri in range(ch.num_rows):
-                if alive[ri] and keys[ri] is not None:
-                    if keys[ri] in seen:
-                        raise DuplicateKeyError(
-                            f"Duplicate entry {keys[ri]!r} for key "
-                            f"'{name}'")
-                    seen.add(keys[ri])
 
     def _unique_constraints(self, info: TableInfo):
         out = []
@@ -868,10 +907,14 @@ class Session:
                     else:
                         conflict_masks[region.id] = hit
                 elif ignore:
+                    # hit is chunk-space; ex_keys is candidate-space —
+                    # map through ci (sorted candidate row indices)
                     for ri in np.nonzero(hit)[0]:
-                        keep[seen[ex_keys[ri]]] = False
+                        j = int(np.searchsorted(ci, int(ri)))
+                        keep[seen[ex_keys[j]]] = False
                 else:
-                    k = ex_keys[int(np.nonzero(hit)[0][0])]
+                    ri0 = int(np.nonzero(hit)[0][0])
+                    k = ex_keys[int(np.searchsorted(ci, ri0))]
                     raise DuplicateKeyError(
                         f"Duplicate entry {k!r} for key '{cname}'")
             if replace:
@@ -1055,6 +1098,7 @@ class Session:
                 txn.delete_staged(info.id, np.concatenate(staged_keep))
             if auto:
                 txn.commit()
+            self._note_modified(txn, auto, info.id, n)
             return ok(n)
         except TiDBTPUError:
             if auto:
@@ -1105,6 +1149,7 @@ class Session:
             txn.append(info.id, new_chunk)
             if auto:
                 txn.commit()
+            self._note_modified(txn, auto, info.id, new_chunk.num_rows)
             return ok(new_chunk.num_rows)
         except TiDBTPUError:
             if auto:
@@ -1417,6 +1462,7 @@ class Session:
                 ts.version = snap.version   # version of the analyzed data
                 self.engine.table_stats[info.id] = ts
                 self.engine.stats_version += 1
+                self.engine.modify_counts.pop(info.id, None)
         return ok()
 
 
