@@ -193,7 +193,6 @@ def test_invalid_create_index_syntax_rejected():
 # ---- resumable CREATE UNIQUE INDEX backfill (tidb_tpu/ddl.py) --------------
 
 def test_unique_backfill_resumes_from_checkpoint(tmp_path):
-    import numpy as np
     from tidb_tpu.errors import DuplicateKeyError
     from tidb_tpu.session import Engine
     from tidb_tpu.util import failpoint

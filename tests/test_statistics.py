@@ -162,9 +162,6 @@ def test_auto_analyze_lifecycle():
     assert eng.table_stats[tid].row_count == 20000
     assert plan2 is not plan1              # stats version keyed the cache
     assert plan2.est_rows == plan1.est_rows == 7  # NDV(b) stays 7
-    scan2 = plan2
-    while scan2.children:
-        scan2 = scan2.children[0]
 
 
 def test_auto_analyze_disabled_and_small_tables():

@@ -144,7 +144,43 @@ def test_limb_split_recombine():
                      -987654321012345678901234567], dtype=object)
     limbs = wide_decimal_limbs(vals, 5)
     assert limbs.dtype == np.int64
-    # lower planes in [0, 1e9); recombination is exact
-    assert (limbs[:-1] >= 0).all() and (limbs[:-1] < 10**9).all()
+    # lower planes in [0, 2^30); recombination is exact
+    assert (limbs[:-1] >= 0).all() and (limbs[:-1] < (1 << 30)).all()
     back = wide_decimal_unlimb(limbs)
     assert list(back) == list(vals)
+
+
+def test_device_computed_wide_expression(s):
+    # SUM/AVG over a COMPUTED wide-typed expression (DECIMAL×DECIMAL →
+    # DECIMAL(34,4)) arrives on device as 1-D int64 and must split/
+    # recombine in the SAME limb base as storage planes (round-4 review
+    # catch: a base mismatch here returned silently wrong sums)
+    s.execute("CREATE TABLE cw (a DECIMAL(15,2), c DECIMAL(15,2))")
+    rng = np.random.default_rng(6)
+    s.execute("INSERT INTO cw VALUES " + ",".join(
+        f"({round(float(rng.uniform(1, 99999)), 2)},"
+        f"{round(float(rng.uniform(1, 99999)), 2)})"
+        for _ in range(20000)))
+    s.execute("ANALYZE TABLE cw")
+    sql = "SELECT SUM(a * c), AVG(a * c), COUNT(*) FROM cw"
+    want = s.query(sql).rows
+    s.vars.update(tidb_tpu_engine="on", tidb_tpu_row_threshold=1,
+                  tidb_tpu_strict="on")
+    try:
+        got = s.query(sql).rows
+    finally:
+        s.vars.update(tidb_tpu_engine="off", tidb_tpu_strict="off")
+    assert got == want
+
+
+def test_device_scan_root_fragment_emits_all_columns(s):
+    # a bare filtered-scan fragment must upload EVERY schema column
+    # (round-4 regression: only filter columns uploaded → IndexError)
+    sql = "SELECT * FROM w WHERE g = 3"
+    want = sorted(map(str, s.query(sql).rows))
+    s.vars.update(tidb_tpu_engine="on", tidb_tpu_row_threshold=1)
+    try:
+        got = sorted(map(str, s.query(sql).rows))
+    finally:
+        s.vars.update(tidb_tpu_engine="off")
+    assert got == want

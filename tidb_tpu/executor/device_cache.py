@@ -153,26 +153,31 @@ def _col_bounds(vals: np.ndarray, valid: np.ndarray,
     return int(vv.min()), int(vv.max())
 
 
-WIDE_LIMB_BASE = 10 ** 9
+WIDE_LIMB_BITS = 30
+WIDE_LIMB_BASE = 1 << WIDE_LIMB_BITS
 
 
 def wide_decimal_limbs(vals, n_limbs: int) -> np.ndarray:
     """Arbitrary-precision scaled ints (object array) → (n_limbs, N) int64
-    base-10⁹ limb planes, floor-divmod so only the TOP limb is signed —
-    value == Σ limbs[k]·10^(9k) exactly. The device-side layout of
-    MyDecimal's 9-digit word vector (types/mydecimal.go:236-246), as
-    struct-of-arrays so per-limb segment sums stay exact int64."""
+    base-2³⁰ limb planes via shift/mask, so only the TOP limb is signed —
+    value == Σ limbs[k]·2^(30k) exactly. The device-side layout of
+    MyDecimal's word vector (types/mydecimal.go:236-246) as
+    struct-of-arrays; ONE base everywhere (storage planes, on-device
+    splits of narrow inputs, host recombination) so every producer/
+    consumer pair agrees by construction."""
     out = np.empty((n_limbs, len(vals)), dtype=np.int64)
     cur = np.asarray(vals, dtype=object)
+    mask = WIDE_LIMB_BASE - 1
     for k in range(n_limbs - 1):
-        out[k] = (cur % WIDE_LIMB_BASE).astype(np.int64)
-        cur = cur // WIDE_LIMB_BASE
+        out[k] = (cur & mask).astype(np.int64)
+        cur = cur >> WIDE_LIMB_BITS           # python ints: floor shift
     out[n_limbs - 1] = cur.astype(np.int64)   # top: small, carries sign
     return out
 
 
 def wide_decimal_unlimb(limbs: np.ndarray) -> np.ndarray:
-    """(n_limbs, G) int64 limb sums → object array of exact Python ints."""
+    """(n_limbs, G) int64 limb sums → object array of exact Python ints.
+    Works on UNNORMALIZED limb sums (planes may exceed the base)."""
     n_limbs, g = limbs.shape
     out = np.zeros(g, dtype=object)
     for k in range(n_limbs - 1, -1, -1):
@@ -184,7 +189,7 @@ def _upload_col(ent: CachedTable, col_idx: int, ftype):
     from tidb_tpu.ops.jax_env import jnp
     vals, valid = _materialize_col(ent, col_idx)
     if ftype.is_wide_decimal:
-        # wide decimals upload as base-10⁹ limb planes: (n_limbs, cap)
+        # wide decimals upload as base-2³⁰ limb planes: (n_limbs, cap)
         limbs = wide_decimal_limbs(vals, ftype.wide_limb_count)
         ent.dicts[col_idx] = None
         ent.bounds[col_idx] = None

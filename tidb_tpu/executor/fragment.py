@@ -101,8 +101,10 @@ def _exprs_device_ok(exprs: Sequence[Expression]) -> bool:
         for sub in e.walk():
             if isinstance(sub, ScalarFunc) and sub.op in HOST_ONLY_OPS:
                 return False
-            ft = getattr(sub, "ftype", None)
-            if ft is not None and ft.is_wide_decimal:
+            # wide-decimal COLUMNS arrive as 2-D limb planes no generic
+            # kernel understands; computed wide-typed expressions are
+            # ordinary 1-D scaled int64 and pass
+            if isinstance(sub, ColumnRef) and sub.ftype.is_wide_decimal:
                 return False
     return True
 
@@ -136,12 +138,15 @@ def _fragment_ok(plan: PhysicalPlan, threshold: int) -> bool:
                 if desc.args and desc.args[0].ftype.kind.is_string \
                         and desc.name != "count":
                     return False
-                if any(a.ftype.is_wide_decimal for a in desc.args):
-                    # wide ARGUMENTS arrive as 2-D limb planes: only the
-                    # plain SUM/AVG/COUNT over a bare wide column consumes
-                    # them (SumAgg._update_wide); everything else → CPU.
-                    # A wide RESULT over narrow args needs no gate — the
-                    # device splits the int64 input into limbs itself.
+                if any(isinstance(sub, ColumnRef) and
+                       sub.ftype.is_wide_decimal
+                       for a in desc.args for sub in a.walk()):
+                    # a wide-decimal COLUMN (2-D limb planes) in the args:
+                    # only plain SUM/AVG/COUNT over the bare column
+                    # consumes limbs (SumAgg._update_wide); anything else
+                    # → CPU. Wide RESULT types over narrow/computed args
+                    # need no gate — the device splits its 1-D int64
+                    # input into limbs itself.
                     if desc.name not in ("sum", "avg", "count") or \
                             desc.distinct or \
                             not isinstance(desc.args[0], ColumnRef):
@@ -274,6 +279,12 @@ def _used_column_indices(chain: List[PhysicalPlan]) -> List[int]:
         if isinstance(node, PhysTableScan):
             for f in node.filters:
                 used.update(f.references())
+            if node is chain[0]:
+                # a bare filtered-scan fragment emits EVERY column
+                # (regression: a Scan-root chain uploaded only the filter
+                # columns, then _partial's ctx.column(i) walked the full
+                # schema → IndexError)
+                used.update(range(len(node.schema)))
         elif isinstance(node, PhysSelection):
             for c in node.conditions:
                 used.update(c.references())

@@ -169,11 +169,11 @@ def dist_ok(plan: PhysicalPlan, threshold: int) -> bool:
     if any(isinstance(n, PhysWindow) for n in _walk_nodes(plan)[:-1]):
         return False
     # wide-decimal COLUMNS can't shard (the dist scan encoder is 1-D);
-    # wide RESULTS over narrow args are fine — limb states all_gather as
-    # ordinary 1-D planes
+    # wide RESULTS over narrow/computed args are fine — limb states
+    # all_gather as ordinary 1-D planes
     if isinstance(plan, PhysHashAgg) and any(
-            any(a.ftype.is_wide_decimal for a in d.args)
-            for d in plan.aggs):
+            isinstance(sub, ColumnRef) and sub.ftype.is_wide_decimal
+            for d in plan.aggs for a in d.args for sub in a.walk()):
         return False
     if has_join(plan):
         return tree_ok(plan, threshold)

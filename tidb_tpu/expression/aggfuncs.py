@@ -175,12 +175,18 @@ class SumAgg(AggFunc):
                 xp.zeros(n, dtype=xp.int64))
 
     # -- wide-decimal limb path (device): state = per-limb int64 sums.
-    # Per-limb sums need no carries — Σ state[k]·10^(9k) recombines
-    # exactly on host even when planes exceed 10⁹ (device_cache
+    # Per-limb sums need no carries — Σ state[k]·2^(30k) recombines
+    # exactly on host even when planes exceed the base (device_cache
     # wide_decimal_limbs / wide_decimal_unlimb; types/mydecimal.go:236).
+    # EVERY limb producer uses base 2³⁰: wide COLUMNS arrive as 2-D
+    # storage planes; 1-D int64 inputs (narrow or computed wide-typed
+    # expressions) split into three shift/mask limbs at trace time —
+    # dispatch is on the ARRAY SHAPE, never on the expression's type, so
+    # a computed wide expression can never be recombined in the wrong
+    # base (round-4 review catch).
     def _n_limb_planes(self) -> int:
         aft = self.desc.args[0].ftype
-        return aft.wide_limb_count if aft.is_wide_decimal else 3
+        return max(aft.wide_limb_count if aft.is_wide_decimal else 0, 3)
 
     def _init_wide(self, xp, n):
         planes = self._n_limb_planes()
@@ -188,11 +194,14 @@ class SumAgg(AggFunc):
                      for _ in range(planes + 1))   # limbs… + counts
 
     def _input_limbs(self, xp, values):
-        from tidb_tpu.executor.device_cache import WIDE_LIMB_BASE as B
+        from tidb_tpu.executor.device_cache import (WIDE_LIMB_BASE,
+                                                    WIDE_LIMB_BITS)
         if getattr(values, "ndim", 1) == 2:
             return [values[k] for k in range(values.shape[0])]
-        r = values // B
-        return [values % B, r % B, r // B]   # narrow arg, wide result
+        mask = xp.int64(WIDE_LIMB_BASE - 1)
+        return [values & mask,
+                (values >> WIDE_LIMB_BITS) & mask,
+                values >> (2 * WIDE_LIMB_BITS)]   # 90 bits ⊇ int64
 
     def _update_wide(self, xp, state, gid, n, values, validity):
         limbs = self._input_limbs(xp, values)
@@ -255,7 +264,7 @@ class SumAgg(AggFunc):
         if self._wide and len(state) > 2:
             from tidb_tpu.executor.device_cache import wide_decimal_unlimb
             limbs = np.stack([np.asarray(a) for a in state[:-1]])
-            sums = wide_decimal_unlimb(limbs)
+            sums = wide_decimal_unlimb(limbs)    # one base, all producers
             if self._out_scale > self._in_scale:
                 sums = sums * 10 ** (self._out_scale - self._in_scale)
             return sums, np.asarray(state[-1])

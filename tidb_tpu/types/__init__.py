@@ -89,9 +89,9 @@ class FieldType:
         k = self.kind
         if k is TypeKind.DECIMAL and self.precision > 18:
             # wide decimals (> int64's ~18.9 digits) hold exact Python
-            # ints host-side; the device path splits them into base-10⁹
+            # ints host-side; the device path splits them into base-2³⁰
             # limb planes (ref: types/mydecimal.go:236-246 — MyDecimal's
-            # 9-digit word vector, re-laid-out as struct-of-arrays)
+            # word vector, re-laid-out as struct-of-arrays)
             return np.dtype(object)
         if k.is_integer or k is TypeKind.DECIMAL or k in (
                 TypeKind.DATETIME, TypeKind.TIMESTAMP, TypeKind.TIME,
@@ -118,13 +118,15 @@ class FieldType:
     @property
     def is_wide_decimal(self) -> bool:
         """DECIMAL wider than int64 (> 18 digits): object host arrays,
-        base-10⁹ limb planes on device (types/mydecimal.go:236)."""
+        base-2³⁰ limb planes on device (types/mydecimal.go:236)."""
         return self.kind is TypeKind.DECIMAL and self.precision > 18
 
     @property
     def wide_limb_count(self) -> int:
-        """Base-10⁹ limbs covering precision digits (+1 headroom digit)."""
-        return -(-(self.precision + 1) // 9)
+        """Base-2³⁰ limbs covering precision digits (+1 headroom digit):
+        ceil(bits(10^(p+1)) / 30)."""
+        bits = (10 ** (self.precision + 1)).bit_length()
+        return -(-bits // 30)
 
     def with_nullable(self, nullable: bool) -> "FieldType":
         return replace(self, nullable=nullable)
