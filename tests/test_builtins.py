@@ -208,3 +208,116 @@ def test_env_functions():
     y = s.query("SELECT YEAR(NOW()), YEAR(CURDATE())").rows[0]
     assert y[0] >= 2026 and y[1] >= 2026
     assert s.query("SELECT UNIX_TIMESTAMP()").rows[0][0] > 1_700_000_000
+
+
+# ---- round-4 breadth builtins ----------------------------------------------
+
+def test_breadth_string_builtins():
+    from tidb_tpu.session import Engine
+    s = Engine().new_session()
+    s.execute("CREATE TABLE bb (v VARCHAR(20))")
+    s.execute("INSERT INTO bb VALUES ('Hello')")
+    r = s.query(
+        "SELECT BIT_LENGTH(v), ORD(v), QUOTE(v), SOUNDEX(v), "
+        "TO_BASE64(v), FROM_BASE64(TO_BASE64(v)), "
+        "INSERT(v, 2, 3, 'XX'), FIELD(v, 'x', 'Hello', 'y'), "
+        "ELT(2, 'a', 'b'), CHAR(72, 105) FROM bb").rows[0]
+    assert r == (40, 72, "'Hello'", "H400", "SGVsbG8=", "Hello",
+                 "HXXo", 2, "b", "Hi")
+
+
+def test_breadth_math_misc_builtins():
+    from tidb_tpu.session import Engine
+    s = Engine().new_session()
+    s.execute("CREATE TABLE bm (n BIGINT)")
+    s.execute("INSERT INTO bm VALUES (255)")
+    r = s.query(
+        "SELECT CONV(n, 10, 16), CONV('ff', 16, 10), "
+        "FORMAT(1234567.891, 2), INET_ATON('192.168.0.1'), "
+        "INET_NTOA(3232235521), ATAN2(1, 1) FROM bm").rows[0]
+    assert r[:5] == ("FF", "255", "1,234,567.89", 3232235521,
+                     "192.168.0.1")
+    assert abs(r[5] - 0.7853981634) < 1e-9
+    u = s.query("SELECT UUID() FROM bm").rows[0][0]
+    assert len(u) == 36 and u.count("-") == 4
+
+
+def test_breadth_temporal_builtins():
+    import datetime as dt
+    from tidb_tpu.session import Engine
+    s = Engine().new_session()
+    s.execute("CREATE TABLE bt (d DATE, t DATETIME)")
+    s.execute("INSERT INTO bt VALUES ('2024-03-15', "
+              "'2024-03-15 10:30:45.123456')")
+    r = s.query(
+        "SELECT TO_DAYS(d), FROM_DAYS(TO_DAYS(d)), YEARWEEK(d), "
+        "MAKEDATE(2024, 75), TIME_TO_SEC(t), MICROSECOND(t), "
+        "STR_TO_DATE('15,3,2024', '%d,%m,%Y') FROM bt").rows[0]
+    assert r[0] == 739325                      # MySQL TO_DAYS value
+    assert r[1] == dt.date(2024, 3, 15)
+    assert r[2] == 202411
+    assert r[3] == dt.date(2024, 3, 15)
+    assert r[4] == 10 * 3600 + 30 * 60 + 45
+    assert r[5] == 123456
+    assert r[6] == dt.datetime(2024, 3, 15)
+    r = s.query(
+        "SELECT TIMESTAMPDIFF(day, d, '2024-04-15'), "
+        "TIMESTAMPDIFF(month, '2023-01-31', '2024-03-01'), "
+        "TIMESTAMPDIFF(year, '2020-06-01', '2024-05-31'), "
+        "TIMESTAMPADD(hour, 5, t) FROM bt").rows[0]
+    assert r[0] == 31 and r[1] == 13 and r[2] == 3
+    assert r[3] == dt.datetime(2024, 3, 15, 15, 30, 45, 123456)
+
+
+def test_breadth_error_codes():
+    import pytest
+    from tidb_tpu.errors import (NotNullViolation, SubqueryRowError,
+                                 UnsupportedFunctionError)
+    from tidb_tpu.session import Engine
+    s = Engine().new_session()
+    s.execute("CREATE TABLE ec (a BIGINT NOT NULL, b BIGINT)")
+    s.execute("INSERT INTO ec VALUES (1, 2), (2, 3)")
+    with pytest.raises(NotNullViolation) as e:
+        s.execute("INSERT INTO ec VALUES (NULL, 4)")
+    assert e.value.code == 1048
+    with pytest.raises(UnsupportedFunctionError) as e:
+        s.query("SELECT NO_SUCH_FN(a) FROM ec")
+    assert e.value.code == 1305
+    with pytest.raises(SubqueryRowError) as e:
+        s.query("SELECT * FROM ec WHERE b = (SELECT a FROM ec)")
+    assert e.value.code == 1242
+
+
+def test_set_global_persists_via_backup(tmp_path):
+    from tidb_tpu.session import Engine
+    from tidb_tpu.tools import backup, restore
+    eng = Engine()
+    s = eng.new_session()
+    s.execute("CREATE TABLE gp (a BIGINT)")
+    s.execute("SET GLOBAL tidb_tpu_row_threshold = 777")
+    s.execute("CREATE USER alice IDENTIFIED BY 'pw'")
+    s.execute("GRANT SELECT ON gp TO alice")
+    # SET GLOBAL must NOT touch the CURRENT session (MySQL scoping)
+    assert s.vars.get("tidb_tpu_row_threshold") != 777
+    assert eng.new_session().vars["tidb_tpu_row_threshold"] == 777
+    backup(eng, str(tmp_path))
+    # "restart": a fresh engine restored from the image
+    eng2 = Engine()
+    restore(eng2, str(tmp_path))
+    assert eng2.new_session().vars["tidb_tpu_row_threshold"] == 777
+    assert "alice" in eng2.auth.users      # grant tables survived too
+    eng2.auth.require("alice", "SELECT", "gp")
+
+
+def test_show_grants_requires_privilege():
+    import pytest
+    from tidb_tpu.session import Engine
+    from tidb_tpu.session.auth import PrivilegeError
+    eng = Engine()
+    s = eng.new_session()
+    s.execute("CREATE USER bob IDENTIFIED BY 'x'")
+    s2 = eng.new_session()
+    s2.user = "bob"
+    s2.query("SHOW GRANTS")                 # own grants: fine
+    with pytest.raises(PrivilegeError):
+        s2.query("SHOW GRANTS FOR root")    # other users: SUPER only

@@ -1,0 +1,182 @@
+"""Collations (utf8mb4_general_ci via dictionary/fold normalization, the
+util/collate analog) and time zones (time_zone sysvar at DATETIME↔epoch
+boundaries, types/time.go ConvertTimeZone analog)."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.errors import DuplicateKeyError, PlanError
+from tidb_tpu.session import Engine
+
+
+@pytest.fixture(scope="module")
+def s():
+    eng = Engine()
+    s = eng.new_session()
+    s.execute("CREATE TABLE ci (id BIGINT, name VARCHAR(16) COLLATE "
+              "utf8mb4_general_ci, tag VARCHAR(8), v BIGINT)")
+    rows = [
+        (1, "Alpha", "x", 10), (2, "ALPHA", "y", 20), (3, "alpha", "x", 30),
+        (4, "Beta", "y", 40), (5, "BETA", "x", 50), (6, "gamma", "y", 60),
+        (7, None, "x", 70), (8, "Gamma", None, 80),
+    ]
+    s.execute("INSERT INTO ci VALUES " + ",".join(
+        f"({i},{'NULL' if n is None else repr(n)},"
+        f"{'NULL' if t is None else repr(t)},{v})"
+        for i, n, t, v in rows))
+    return s
+
+
+def test_ci_compare(s):
+    assert s.query("SELECT COUNT(*) FROM ci WHERE name = 'alpha'"
+                   ).rows[0][0] == 3
+    assert s.query("SELECT COUNT(*) FROM ci WHERE name = 'ALPHA'"
+                   ).rows[0][0] == 3
+    # the binary column stays case-sensitive
+    assert s.query("SELECT COUNT(*) FROM ci WHERE tag = 'X'"
+                   ).rows[0][0] == 0
+    assert s.query("SELECT COUNT(*) FROM ci WHERE tag = 'x'"
+                   ).rows[0][0] == 4
+
+
+def test_ci_group_by(s):
+    rows = s.query("SELECT name, COUNT(*), SUM(v) FROM ci "
+                   "GROUP BY name").rows
+    by_fold = {(r[0].upper() if r[0] is not None else None):
+               (r[1], r[2]) for r in rows}
+    assert len(rows) == 4                     # ALPHA, BETA, GAMMA, NULL
+    assert by_fold["ALPHA"] == (3, 60)
+    assert by_fold["BETA"] == (2, 90)
+    assert by_fold["GAMMA"] == (2, 140)
+    assert by_fold[None] == (1, 70)
+
+
+def test_ci_distinct_and_in(s):
+    assert s.query("SELECT COUNT(DISTINCT name) FROM ci").rows[0][0] == 3
+    assert s.query("SELECT COUNT(*) FROM ci WHERE name IN ('ALPHA', 'beta')"
+                   ).rows[0][0] == 5
+
+
+def test_ci_order_by(s):
+    rows = s.query("SELECT name FROM ci WHERE name IS NOT NULL "
+                   "ORDER BY name, id").rows
+    folded = [r[0].upper() for r in rows]
+    assert folded == sorted(folded)
+
+
+def test_ci_join(s):
+    s.execute("CREATE TABLE lookup (lname VARCHAR(16) COLLATE "
+              "utf8mb4_general_ci, score BIGINT)")
+    s.execute("INSERT INTO lookup VALUES ('ALPHA', 1), ('beta', 2)")
+    rows = s.query(
+        "SELECT lname, COUNT(*) FROM ci JOIN lookup ON name = lname "
+        "GROUP BY lname").rows
+    got = {r[0].upper(): r[1] for r in rows}
+    assert got == {"ALPHA": 3, "BETA": 2}
+
+
+def test_ci_min_max(s):
+    mn, mx = s.query("SELECT MIN(name), MAX(name) FROM ci").rows[0]
+    assert mn.upper() == "ALPHA"
+    assert mx.upper() == "GAMMA"
+
+
+def test_ci_unique_constraint(s):
+    s.execute("CREATE TABLE ciu (u VARCHAR(8) COLLATE utf8mb4_general_ci)")
+    s.execute("CREATE UNIQUE INDEX uq ON ciu (u)")
+    s.execute("INSERT INTO ciu VALUES ('abc')")
+    with pytest.raises(DuplicateKeyError):
+        s.execute("INSERT INTO ciu VALUES ('ABC')")   # ci conflict
+
+
+def test_ci_unique_backfill_detects_fold_dup(s):
+    s.execute("CREATE TABLE cib (u VARCHAR(8) COLLATE utf8mb4_general_ci)")
+    s.execute("INSERT INTO cib VALUES ('x1'), ('X1')")
+    with pytest.raises(DuplicateKeyError):
+        s.execute("CREATE UNIQUE INDEX uqb ON cib (u)")
+
+
+def test_ci_device_paths():
+    # device compare/group/join run on fold-normalized dictionary codes
+    eng = Engine()
+    s2 = eng.new_session()
+    s2.execute("CREATE TABLE dci (k BIGINT, name VARCHAR(8) COLLATE "
+               "utf8mb4_general_ci, v BIGINT)")
+    rng = np.random.default_rng(8)
+    names = ["Red", "RED", "red", "Blue", "BLUE", "green"]
+    s2.execute("INSERT INTO dci VALUES " + ",".join(
+        f"({int(rng.integers(0, 9))},'{names[int(rng.integers(0, 6))]}',"
+        f"{int(rng.integers(0, 100))})" for _ in range(50000)))
+    s2.execute("ANALYZE TABLE dci")
+    for sql in [
+        "SELECT COUNT(*) FROM dci WHERE name = 'RED'",
+        "SELECT name, COUNT(*), SUM(v) FROM dci GROUP BY name",
+        "SELECT COUNT(*) FROM dci WHERE name IN ('red', 'BLUE')",
+        "SELECT COUNT(DISTINCT name) FROM dci",
+    ]:
+        want = sorted(str(r[1:]) for r in s2.query(sql).rows)
+        s2.vars.update(tidb_tpu_engine="on", tidb_tpu_row_threshold=1,
+                       tidb_tpu_strict="on")
+        try:
+            got = sorted(str(r[1:]) for r in s2.query(sql).rows)
+        finally:
+            s2.vars.update(tidb_tpu_engine="off", tidb_tpu_strict="off")
+        assert got == want, sql
+
+
+def test_unknown_collation_rejected(s):
+    from tidb_tpu.errors import ParseError
+    with pytest.raises(ParseError, match="Unknown collation"):
+        s.execute("CREATE TABLE bad (a VARCHAR(4) COLLATE klingon_ci_xx)")
+
+
+# ---- time zones -------------------------------------------------------------
+
+
+def test_time_zone_epoch_boundaries():
+    eng = Engine()
+    s = eng.new_session()
+    s.execute("CREATE TABLE tz (d DATETIME)")
+    s.execute("INSERT INTO tz VALUES ('2024-06-01 12:00:00')")
+    utc = s.query("SELECT UNIX_TIMESTAMP(d) FROM tz").rows[0][0]
+    s.vars["time_zone"] = "+08:00"
+    east = s.query("SELECT UNIX_TIMESTAMP(d) FROM tz").rows[0][0]
+    assert utc - east == 8 * 3600      # same wall time, earlier epoch
+    ft = s.query("SELECT FROM_UNIXTIME(0) FROM tz").rows[0][0]
+    assert str(ft) == "1970-01-01 08:00:00"
+    s.vars["time_zone"] = "-05:30"
+    west = s.query("SELECT UNIX_TIMESTAMP(d) FROM tz").rows[0][0]
+    assert west - utc == 5 * 3600 + 1800
+
+
+def test_convert_tz():
+    eng = Engine()
+    s = eng.new_session()
+    s.execute("CREATE TABLE c (d DATETIME)")
+    s.execute("INSERT INTO c VALUES ('2024-01-15 10:00:00')")
+    r = s.query("SELECT CONVERT_TZ(d, '+00:00', '+05:30') FROM c"
+                ).rows[0][0]
+    assert str(r) == "2024-01-15 15:30:00"
+    r = s.query("SELECT CONVERT_TZ(d, '+02:00', '-03:00') FROM c"
+                ).rows[0][0]
+    assert str(r) == "2024-01-15 05:00:00"
+    # named zones resolve through zoneinfo
+    r = s.query("SELECT CONVERT_TZ(d, 'UTC', 'Asia/Shanghai') FROM c"
+                ).rows[0][0]
+    assert str(r) == "2024-01-15 18:00:00"
+    with pytest.raises(PlanError, match="time zone"):
+        s.query("SELECT CONVERT_TZ(d, 'UTC', 'Mars/Olympus') FROM c")
+
+
+def test_now_honors_time_zone():
+    import datetime as dt
+    eng = Engine()
+    s = eng.new_session()
+    s.vars["time_zone"] = "+00:00"
+    a = s.query("SELECT NOW()").rows[0][0]
+    s.vars["time_zone"] = "+09:00"
+    b = s.query("SELECT NOW()").rows[0][0]
+    delta = (b - a).total_seconds()
+    assert 9 * 3600 - 5 <= delta <= 9 * 3600 + 5
+    utcnow = dt.datetime.now(dt.timezone.utc).replace(tzinfo=None)
+    assert abs((a - utcnow).total_seconds()) < 5

@@ -96,9 +96,22 @@ class DeviceChunk:
 def encode_strings(col: Column) -> Tuple[np.ndarray, np.ndarray]:
     """Dictionary-encode a string column → (codes int32, dictionary).
 
-    Codes are dense [0, len(dict)); NULL rows get code 0 (masked by validity).
-    """
+    Codes are dense [0, len(dict)); NULL rows get code 0 (masked by
+    validity). Case-insensitive collations dictionary-normalize (the
+    util/collate analog): values equal under the fold share ONE code, so
+    device compares/groups/joins on codes are collation-correct; the
+    dictionary keeps the first-seen representative per fold class
+    (sorted by fold, so code order = collation order) and decode returns
+    it — which representative a ci group shows is unspecified, as in
+    MySQL."""
     str_vals = np.array([str(v) for v in col.values], dtype=object)
+    if col.ftype.is_ci:
+        from tidb_tpu.types import fold_ci_array
+        folded = fold_ci_array(str_vals)
+        _, first, codes = np.unique(folded, return_index=True,
+                                    return_inverse=True)
+        dictionary = str_vals[first]        # representative per class
+        return codes.astype(np.int32), dictionary
     dictionary, codes = np.unique(str_vals, return_inverse=True)
     return codes.astype(np.int32), dictionary
 

@@ -70,6 +70,34 @@ class DuplicateKeyError(TiDBTPUError):
     code = 1062  # ER_DUP_ENTRY
 
 
+class NotNullViolation(ExecutionError):
+    code = 1048  # ER_BAD_NULL_ERROR
+
+
+class SubqueryRowError(ExecutionError):
+    code = 1242  # ER_SUBQUERY_NO_1_ROW
+
+
+class UnsupportedFunctionError(PlanError):
+    code = 1305  # ER_SP_DOES_NOT_EXIST (MySQL's unknown-function errno)
+
+
+class DataTooLongError(ExecutionError):
+    code = 1406  # ER_DATA_TOO_LONG
+
+
+class WrongValueCountError(PlanError):
+    code = 1136  # ER_WRONG_VALUE_COUNT_ON_ROW
+
+
+class DerivedMustHaveAliasError(PlanError):
+    code = 1248  # ER_DERIVED_MUST_HAVE_ALIAS
+
+
+class OperandColumnsError(PlanError):
+    code = 1241  # ER_OPERAND_COLUMNS
+
+
 class DDLError(TiDBTPUError):
     """Schema-change failure (ref: ddl/ddl error codes)."""
 

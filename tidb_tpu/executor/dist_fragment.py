@@ -269,6 +269,10 @@ def unify_string_join_dicts(root: PhysicalPlan, host_cols) -> None:
         for l, r in node.equi or []:
             if not (l.ftype.kind.is_string or r.ftype.kind.is_string):
                 continue
+            if l.ftype.is_ci or r.ftype.is_ci:
+                raise FragmentFallback(
+                    "ci-collated join keys need fold-aware dictionary "
+                    "unification (single-chip / CPU only)")
             lh = _trace_scan_col(node.children[0], l.index) \
                 if isinstance(l, ColumnRef) else None
             rh = _trace_scan_col(node.children[1], r.index) \

@@ -211,13 +211,30 @@ class HashAggExec(Executor):
             if spill is not None:
                 spill.close()
 
+    def _fold_group_keys(self, key_cols):
+        """Fold ci group-key columns so equal-under-collation values form
+        ONE group; binary columns pass through. Every factorize/partition
+        over group keys (partial, merge, spill routing) MUST go through
+        this, or a group's rows scatter across partitions."""
+        from tidb_tpu.types import fold_ci_array
+        out = []
+        for (v, m), e in zip(key_cols, self.group_exprs):
+            v = np.asarray(v)
+            if e.ftype.is_ci and v.dtype == object:
+                v = fold_ci_array(v)
+            out.append((v, np.asarray(m, dtype=bool)))
+        return out
+
     def _batch_partial(self, ch: Chunk):
         """One batch → (partial keys, states, distinct rows, bytes).
         Pure computation — safe on worker threads."""
         from tidb_tpu.util import memory as M
         ctx = host_context(ch)
         key_cols = [e.eval(ctx) for e in self.group_exprs]
-        gids, n_groups, reps = factorize_columns(key_cols)
+        # ci collations group in FOLD space; outputs keep a raw
+        # representative (reps gather from the unfolded arrays)
+        gids, n_groups, reps = factorize_columns(
+            self._fold_group_keys(key_cols))
         if self.scalar:
             gids = np.zeros(ch.num_rows, dtype=np.int64)
             n_groups, reps = 1, np.zeros(1, dtype=np.int64)
@@ -260,8 +277,9 @@ class HashAggExec(Executor):
     def _spill_batch(self, spill, pk, states, batch_distinct) -> None:
         """Split one batch's partial groups by key hash into partitions."""
         from tidb_tpu.util.memory import hash_partition
+        pk_h = self._fold_group_keys(pk) if pk else pk
         n_groups = len(pk[0][0]) if pk else 0
-        buckets = hash_partition(pk, spill.n)
+        buckets = hash_partition(pk_h, spill.n)
         for p in np.unique(buckets):
             gsel = buckets == p
             keymap = np.full(n_groups, -1, dtype=np.int64)
@@ -328,7 +346,8 @@ class HashAggExec(Executor):
                 vals = np.concatenate([pk[kc][0] for pk in partial_keys])
                 valid = np.concatenate([pk[kc][1] for pk in partial_keys])
                 cat_keys.append((vals, valid))
-            gids_all, n_final, reps = factorize_columns(cat_keys)
+            gids_all, n_final, reps = factorize_columns(
+                self._fold_group_keys(cat_keys))
             final_keys = [(v[reps], m[reps]) for v, m in cat_keys]
             final_gids_per_batch = []
             off = 0
@@ -368,8 +387,15 @@ class HashAggExec(Executor):
         g = g[m]
         vcols = [v[m] for v in vcols]
         ones = np.ones(len(g), dtype=bool)
+        dcols = []
+        for k, v in enumerate(vcols):
+            aft = self.descs[i].args[k].ftype
+            if aft.is_ci and getattr(v, "dtype", None) == np.dtype(object):
+                from tidb_tpu.types import fold_ci_array
+                v = fold_ci_array(v)
+            dcols.append(v)
         _, _, reps = factorize_columns(
-            [(g, ones)] + [(v, ones) for v in vcols])
+            [(g, ones)] + [(v, ones) for v in dcols])
         g = g[reps]
         v0 = vcols[0][reps] if vcols else np.empty(0)
         st = agg.init(np, n_final)

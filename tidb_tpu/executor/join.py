@@ -37,13 +37,24 @@ def _empty_like(ftypes) -> Chunk:
     return _empty_chunk(list(ftypes))
 
 
-def _key_arrays(exprs: List[Expression], chunk: Chunk):
+def _key_arrays(exprs: List[Expression], chunk: Chunk,
+                ci_flags: List[bool] = None):
     ctx = host_context(chunk)
     out = []
-    for e in exprs:
+    for i, e in enumerate(exprs):
         v, m = e.eval(ctx)
-        out.append((np.asarray(v), np.asarray(m, dtype=bool)))
+        v = np.asarray(v)
+        if ci_flags is not None and ci_flags[i] and v.dtype == object:
+            from tidb_tpu.types import fold_ci_array
+            v = fold_ci_array(v)
+        out.append((v, np.asarray(m, dtype=bool)))
     return out
+
+
+def equi_ci_flags(equi) -> List[bool]:
+    """Per equi pair: compare under ci when EITHER side's collation is
+    ci (the stronger collation wins, util/collate coercion)."""
+    return [l.ftype.is_ci or r.ftype.is_ci for l, r in equi]
 
 
 def _normalize(vals: np.ndarray) -> np.ndarray:
@@ -255,14 +266,15 @@ class HashJoinExec(Executor):
                              else chunks[0] if chunks
                              else _empty_like(build_fts))
         build_key_exprs, _ = self._keys()
-        bkeys = _key_arrays(build_key_exprs, self._build_chunk)
+        bkeys = _key_arrays(build_key_exprs, self._build_chunk,
+                            equi_ci_flags(self.equi))
         self._table = _BuildTable(bkeys)
 
     def _spill_side(self, spill, chunk: Chunk, build: bool) -> None:
         from tidb_tpu.util.memory import hash_partition
         build_key_exprs, probe_key_exprs = self._keys()
         exprs = build_key_exprs if build else probe_key_exprs
-        keys = _key_arrays(exprs, chunk)
+        keys = _key_arrays(exprs, chunk, equi_ci_flags(self.equi))
         keys = [(_normalize(v), m) for v, m in keys]
         spill.add_partitioned(chunk, hash_partition(keys, spill.n))
 
@@ -289,7 +301,8 @@ class HashJoinExec(Executor):
                                  _empty_like(self.children[
                                      self._build_idx].schema))
             self._table = _BuildTable(
-                _key_arrays(build_key_exprs, self._build_chunk))
+                _key_arrays(build_key_exprs, self._build_chunk,
+                            equi_ci_flags(self.equi)))
             for probe in probe_spill.read(p):
                 out = self._join_chunk(probe)
                 if out is not None and out.num_rows:
@@ -322,7 +335,8 @@ class HashJoinExec(Executor):
     def _match(self, probe: Chunk):
         if self.equi:
             _, probe_key_exprs = self._keys()
-            pkeys = _key_arrays(probe_key_exprs, probe)
+            pkeys = _key_arrays(probe_key_exprs, probe,
+                                equi_ci_flags(self.equi))
             return self._table.probe(pkeys)
         # no equi keys: full cross expansion, conditions filter later
         nb = self._build_chunk.num_rows

@@ -29,6 +29,9 @@ def rank_keys(by: List[Expression], descs: List[bool],
         m = np.asarray(m, dtype=bool)
         if v.dtype == object:
             v = np.asarray([str(x) for x in v], dtype=object)
+            if e.ftype.is_ci:
+                from tidb_tpu.types import fold_ci_array
+                v = fold_ci_array(v)
         uniq = np.unique(v[m]) if m.any() else v[:0]
         codes = (np.searchsorted(uniq, v) if len(uniq)
                  else np.zeros(len(v), dtype=np.int64)).astype(np.int64) + 1

@@ -61,9 +61,14 @@ def has_join(plan: PhysicalPlan) -> bool:
 
 def _string_key_ok(l: Expression, r: Expression) -> bool:
     """String equi keys must be bare ColumnRefs (so the probe side's codes
-    can be dictionary-remapped into the build side's space)."""
+    can be dictionary-remapped into the build side's space) with MATCHING
+    collation classes — a mixed ci/binary pair would fold one side's
+    dictionary out of sorted order (and can merge two binary codes into
+    one fold class), so it runs on the CPU engine instead."""
     if not (l.ftype.kind.is_string or r.ftype.kind.is_string):
         return True
+    if l.ftype.is_ci != r.ftype.is_ci:
+        return False
     return isinstance(l, ColumnRef) and isinstance(r, ColumnRef)
 
 
@@ -213,6 +218,7 @@ class KeyRemap(Expression):
     child: Expression            # side-local probe key (ColumnRef)
     my_flow_idx: int             # my column's index in the join flow (l++r)
     build_flow_idx: int          # build key column's index in the join flow
+    ci: bool = False             # compare under a ci collation
 
     def __post_init__(self):
         self.ftype = self.child.ftype
@@ -228,6 +234,12 @@ class KeyRemap(Expression):
         if pdict is None or bdict is None or len(bdict) == 0:
             return np.full(max(len(pdict) if pdict is not None else 0, 1),
                            -1, np.int32)
+        if self.ci:
+            # ci dictionaries are representatives sorted by fold
+            # (chunk/device.encode_strings): match in fold space
+            from tidb_tpu.types import fold_ci_array
+            pdict = fold_ci_array(np.asarray(pdict, dtype=object))
+            bdict = fold_ci_array(np.asarray(bdict, dtype=object))
         pos = np.searchsorted(bdict, pdict)
         pos_c = np.clip(pos, 0, len(bdict) - 1)
         hit = bdict[pos_c] == pdict
@@ -268,7 +280,8 @@ def join_key_exprs(node: PhysHashJoin):
                 and isinstance(p, ColumnRef):
             b_flow = (nl if node.build_right else 0) + b.index
             p_flow = (0 if node.build_right else nl) + p.index
-            p = KeyRemap(p, p_flow, b_flow)
+            p = KeyRemap(p, p_flow, b_flow,
+                         ci=b.ftype.is_ci or p.ftype.is_ci)
         bkeys.append(b)
         pkeys.append(p)
     node._dev_join_keys = (bkeys, pkeys)

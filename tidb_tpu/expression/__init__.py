@@ -388,6 +388,10 @@ def _cmp(op):
         if a.ftype.kind.is_string and not ctx.on_device:
             av, am = a.eval(ctx)
             bv, bm = b.eval(ctx)
+            if a.ftype.is_ci or b.ftype.is_ci:
+                from tidb_tpu.types import fold_ci_array
+                av = fold_ci_array(np.asarray(av, dtype=object))
+                bv = fold_ci_array(np.asarray(bv, dtype=object))
             res = np.asarray(_CMP_NUMPY[op](av, bv), dtype=bool)
             return res, am & bm
         av, am, bv, bm, _ = _numeric_common(func, ctx)
@@ -432,6 +436,12 @@ def _prepare_string_cmp(func: ScalarFunc, dictionaries):
     if d is None:
         return None
     s = str(const.value)
+    if col.ftype.is_ci:
+        # ci dictionaries hold representatives SORTED BY their fold
+        # (chunk/device.encode_strings); compare in fold space
+        from tidb_tpu.types import fold_ci_array
+        d = fold_ci_array(np.asarray(d, dtype=object))
+        s = s.upper()
     left = int(np.searchsorted(d, s, side="left"))
     right = int(np.searchsorted(d, s, side="right"))
     present = left < right
@@ -680,6 +690,25 @@ def _host_string_fn(name):
     return _HOST_STRING_FNS[name]
 
 
+def _soundex(s: str) -> str:
+    """MySQL SOUNDEX (builtin_string.go soundex): standard 4+ char code."""
+    codes = {**{c: "1" for c in "BFPV"}, **{c: "2" for c in "CGJKQSXZ"},
+             **{c: "3" for c in "DT"}, "L": "4",
+             **{c: "5" for c in "MN"}, "R": "6"}
+    s = "".join(c for c in s.upper() if c.isalpha())
+    if not s:
+        return ""
+    out = s[0]
+    prev = codes.get(s[0], "")
+    for c in s[1:]:
+        d = codes.get(c, "")
+        if d and d != prev:
+            out += d
+        if c not in "HW":
+            prev = d
+    return (out + "000")[:4] if len(out) < 4 else out
+
+
 _HOST_STRING_FNS = {
     "length": lambda s: len(s.encode("utf-8")),
     "char_length": len,
@@ -691,9 +720,20 @@ _HOST_STRING_FNS = {
     "trim": str.strip,
     "ascii": lambda s: ord(s[0]) if s else 0,
     "hex": lambda s: s.encode("utf-8").hex().upper(),
+    "bit_length": lambda s: len(s.encode("utf-8")) * 8,
+    "ord": lambda s: ord(s[0]) if s else 0,   # BMP = MySQL for utf8 lead
+    "quote": lambda s: "'" + s.replace("\\", "\\\\")
+                       .replace("'", "\\'") + "'",
+    "to_base64": lambda s: __import__("base64")
+                 .b64encode(s.encode("utf-8")).decode("ascii"),
+    "from_base64": lambda s: __import__("base64")
+                   .b64decode(s.encode("ascii"), validate=False)
+                   .decode("utf-8", "replace"),
+    "soundex": _soundex,
 }
 
-_STRING_INT_RESULT = {"length", "char_length", "ascii"}
+_STRING_INT_RESULT = {"length", "char_length", "ascii", "bit_length",
+                      "ord"}
 
 
 def _make_string_fn_kernel(name):
@@ -783,6 +823,14 @@ _STRING_FNS_EXTRA = {
             (str(delim).join(s.split(str(delim))[int(cnt):])
              if int(cnt) < 0 else ""),
         0, "str"),
+    "insert": (lambda s, pos, ln, news:
+               s if int(pos) < 1 or int(pos) > len(s) else
+               s[:int(pos) - 1] + str(news) +
+               (s[int(pos) - 1 + int(ln):] if int(ln) >= 0 else ""),
+               0, "str"),
+    "field": (lambda s, *items: next(
+        (i + 1 for i, it in enumerate(items) if str(it) == s), 0),
+        0, "int"),
     # col is the SET string (arg 1); the needle arrives as the co-arg
     "find_in_set": (
         lambda setstr, needle: (setstr.split(",").index(str(needle)) + 1
@@ -1070,9 +1118,14 @@ def _prepare_in(func: ScalarFunc, dictionaries):
     d = dictionaries[col.index]
     if d is None:
         return None
+    if col.ftype.is_ci:
+        from tidb_tpu.types import fold_ci_array
+        d = fold_ci_array(np.asarray(d, dtype=object))
     codes = []
     for cexpr in func.args[1:]:
         s = str(cexpr.value)
+        if col.ftype.is_ci:
+            s = s.upper()
         left = int(np.searchsorted(d, s, side="left"))
         if left < len(d) and d[left] == s:
             codes.append(np.int32(left))
@@ -1132,6 +1185,277 @@ def _date_fn(func, ctx):
     if ft.kind in (TypeKind.DATETIME, TypeKind.TIMESTAMP):
         return _floor_div_neg(xp, v, 86_400_000_000).astype(xp.int32), m
     return v, m
+
+
+# ---------------------------------------------------------------------------
+# Round-4 breadth builtins (ref: builtin_string.go / builtin_math.go /
+# builtin_time.go / builtin_info.go / builtin_miscellaneous.go) — host
+# kernels; HOST_ONLY_OPS keeps them off device fragments
+# ---------------------------------------------------------------------------
+
+
+def _host_rows(func, ctx, fn, dtype=object):
+    """Row-loop helper: evaluate args, apply fn(row_values) per row.
+    Any-NULL input rows skip fn; fn returning None yields SQL NULL —
+    both come back masked out with a dtype-safe filler in the values."""
+    evals = [a.eval(ctx) for a in func.args]
+    n = ctx.num_rows
+    m = np.ones(n, dtype=bool)
+    for _, am in evals:
+        m = m & np.asarray(am, dtype=bool)
+    out = []
+    for i in range(n):
+        row = [np.asarray(v)[i] if np.ndim(v) else v for v, _ in evals]
+        out.append(fn(*row) if m[i] else None)
+    nulls = np.array([v is None for v in out], dtype=bool)
+    fill = "" if dtype == object else 0
+    vals = np.array([fill if v is None else v for v in out], dtype=dtype)
+    return vals, m & ~nulls
+
+
+@kernel("atan2")
+def _atan2(func, ctx):
+    xp = ctx.xp
+    av, am = func.args[0].eval(ctx)
+    bv, bm = func.args[1].eval(ctx)
+    fdt = _xp_dtype(xp, T.double(), ctx.on_device) or np.float64
+    return xp.arctan2(_to_float(xp, av, func.args[0].ftype, fdt),
+                      _to_float(xp, bv, func.args[1].ftype, fdt)), am & bm
+
+
+@kernel("conv")
+def _conv(func, ctx):
+    def one(v, fb, tb):
+        try:
+            n = int(str(v), int(fb))
+        except ValueError:
+            return "0"
+        tb = int(tb)
+        if n == 0:
+            return "0"
+        digits = "0123456789ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+        neg, n = n < 0, abs(n)
+        out = ""
+        while n:
+            out = digits[n % tb] + out
+            n //= tb
+        return ("-" if neg else "") + out
+    return _host_rows(func, ctx, one)
+
+
+@kernel("format")
+def _format_fn(func, ctx):
+    def one(x, d):
+        d = max(int(d), 0)
+        from decimal import Decimal
+        q = Decimal(str(x)).quantize(Decimal(1).scaleb(-d))
+        return f"{q:,.{d}f}"
+    # DECIMAL args arrive scaled: descale first
+    ft = func.args[0].ftype
+    def one_scaled(x, d):
+        if ft.kind is TypeKind.DECIMAL:
+            from decimal import Decimal
+            x = Decimal(int(x)).scaleb(-ft.scale)
+        return one(x, d)
+    return _host_rows(func, ctx, one_scaled)
+
+
+@kernel("char")
+def _char_fn(func, ctx):
+    def one(*codes):
+        return "".join(chr(int(c) & 0x10FFFF) for c in codes if c)
+    return _host_rows(func, ctx, one)
+
+
+@kernel("elt")
+def _elt(func, ctx):
+    def one(n, *items):
+        n = int(n)
+        return str(items[n - 1]) if 1 <= n <= len(items) else None
+    return _host_rows(func, ctx, one)
+
+
+@kernel("inet_aton")
+def _inet_aton(func, ctx):
+    def one(s):
+        parts = str(s).split(".")
+        if not 1 <= len(parts) <= 4 or \
+                not all(p.isdigit() and int(p) < 256 for p in parts):
+            return 0
+        n = 0
+        for p in parts[:-1]:
+            n = (n << 8) | int(p)
+        return (n << (8 * (4 - len(parts) + 1))) | int(parts[-1]) \
+            if len(parts) < 4 else (n << 8) | int(parts[-1])
+    return _host_rows(func, ctx, one, dtype=np.int64)
+
+
+@kernel("inet_ntoa")
+def _inet_ntoa(func, ctx):
+    def one(n):
+        n = int(n) & 0xFFFFFFFF
+        return ".".join(str((n >> s) & 0xFF) for s in (24, 16, 8, 0))
+    return _host_rows(func, ctx, one)
+
+
+@kernel("uuid")
+def _uuid(func, ctx):
+    import uuid as _uuid_mod
+    n = ctx.num_rows
+    return (np.array([str(_uuid_mod.uuid4()) for _ in range(n)],
+                     dtype=object), np.ones(n, dtype=bool))
+
+
+_DAYS_TO_EPOCH = 719528       # TO_DAYS('1970-01-01') in MySQL
+
+
+@kernel("to_days")
+def _to_days(func, ctx):
+    xp = ctx.xp
+    v, m = func.args[0].eval(ctx)
+    ft = func.args[0].ftype
+    if ft.kind in (TypeKind.DATETIME, TypeKind.TIMESTAMP):
+        v = _floor_div_neg(xp, v, 86_400_000_000)
+    return v.astype(xp.int64) + _DAYS_TO_EPOCH, m
+
+
+@kernel("from_days")
+def _from_days(func, ctx):
+    xp = ctx.xp
+    v, m = func.args[0].eval(ctx)
+    return (v.astype(xp.int64) - _DAYS_TO_EPOCH).astype(xp.int32), m
+
+
+@kernel("makedate")
+def _makedate(func, ctx):
+    def one(y, doy):
+        import datetime as _dt
+        y, doy = int(y), int(doy)
+        if doy < 1:
+            return None
+        d = _dt.date(y, 1, 1) + _dt.timedelta(days=doy - 1)
+        return (d - _dt.date(1970, 1, 1)).days
+    vals, m = _host_rows(func, ctx, one, dtype=np.int64)
+    return vals.astype(np.int32), m
+
+
+@kernel("time_to_sec")
+def _time_to_sec(func, ctx):
+    xp = ctx.xp
+    v, m = func.args[0].eval(ctx)
+    ft = func.args[0].ftype
+    if ft.kind in (TypeKind.DATETIME, TypeKind.TIMESTAMP):
+        day_us = v - _floor_div_neg(xp, v, 86_400_000_000) * 86_400_000_000
+        return _floor_div_neg(xp, day_us, 1_000_000), m
+    return _floor_div_neg(xp, v, 1_000_000), m
+
+
+@kernel("sec_to_time")
+def _sec_to_time(func, ctx):
+    xp = ctx.xp
+    v, m = func.args[0].eval(ctx)
+    return (v.astype(xp.int64) * 1_000_000), m
+
+
+@kernel("microsecond")
+def _microsecond(func, ctx):
+    xp = ctx.xp
+    v, m = func.args[0].eval(ctx)
+    return v.astype(xp.int64) % 1_000_000, m
+
+
+@kernel("yearweek")
+def _yearweek(func, ctx):
+    def one(days):
+        import datetime as _dt
+        d = _dt.date(1970, 1, 1) + _dt.timedelta(days=int(days))
+        iso = d.isocalendar()
+        return iso[0] * 100 + iso[1]
+    xp = ctx.xp
+    v, m = func.args[0].eval(ctx)
+    ft = func.args[0].ftype
+    if ft.kind in (TypeKind.DATETIME, TypeKind.TIMESTAMP):
+        v = _floor_div_neg(xp, v, 86_400_000_000)
+    out = np.fromiter((one(x) for x in np.asarray(v)), dtype=np.int64,
+                      count=len(np.asarray(v)))
+    return out, m
+
+
+_STR_TO_DATE_MAP = {"%Y": "%Y", "%y": "%y", "%m": "%m", "%c": "%m",
+                    "%d": "%d", "%e": "%d", "%H": "%H", "%k": "%H",
+                    "%i": "%M", "%s": "%S", "%S": "%S", "%f": "%f",
+                    "%b": "%b", "%M": "%B", "%a": "%a", "%W": "%A",
+                    "%p": "%p", "%h": "%I", "%I": "%I", "%%": "%%"}
+
+
+@kernel("str_to_date")
+def _str_to_date(func, ctx):
+    import datetime as _dt
+    fmt_c = func.args[1]
+    def one(s, fmt):
+        pyfmt = ""
+        i = 0
+        fmt = str(fmt)
+        while i < len(fmt):
+            if fmt[i] == "%" and i + 1 < len(fmt):
+                tok = fmt[i:i + 2]
+                pyfmt += _STR_TO_DATE_MAP.get(tok, tok[1])
+                i += 2
+            else:
+                pyfmt += fmt[i]
+                i += 1
+        try:
+            dt = _dt.datetime.strptime(str(s), pyfmt)
+        except ValueError:
+            return None
+        return (dt - _dt.datetime(1970, 1, 1)) // _dt.timedelta(
+            microseconds=1)
+    return _host_rows(func, ctx, one, dtype=np.int64)
+
+
+_TS_UNITS_US = {"microsecond": 1, "second": 1_000_000,
+                "minute": 60_000_000, "hour": 3_600_000_000,
+                "day": 86_400_000_000, "week": 7 * 86_400_000_000}
+
+
+def _as_us(xp, v, ft):
+    if ft.kind is TypeKind.DATE:
+        return v.astype(xp.int64) * 86_400_000_000
+    return v.astype(xp.int64)
+
+
+@kernel("timestampdiff")
+def _timestampdiff(func, ctx):
+    # unit rides in the op-constant first arg (builder packs it)
+    xp = ctx.xp
+    unit = func.args[0].value
+    av, am = func.args[1].eval(ctx)
+    bv, bm = func.args[2].eval(ctx)
+    a = _as_us(xp, av, func.args[1].ftype)
+    b = _as_us(xp, bv, func.args[2].ftype)
+    if unit in _TS_UNITS_US:
+        return _floor_div_neg(xp, b - a, _TS_UNITS_US[unit]), am & bm
+    # month/quarter/year: civil arithmetic on host
+    def one(x, y):
+        import datetime as _dt
+        da = _dt.datetime(1970, 1, 1) + _dt.timedelta(microseconds=int(x))
+        db = _dt.datetime(1970, 1, 1) + _dt.timedelta(microseconds=int(y))
+        months = (db.year - da.year) * 12 + (db.month - da.month)
+        # partial months don't count: compare the within-month position
+        # (tuple compare sidesteps invalid replace() days at month ends)
+        pa = (da.day, da.hour, da.minute, da.second, da.microsecond)
+        pb = (db.day, db.hour, db.minute, db.second, db.microsecond)
+        if months > 0 and pb < pa:
+            months -= 1
+        elif months < 0 and pb > pa:
+            months += 1
+        q = months // 3 if months >= 0 else -((-months) // 3)
+        yr = months // 12 if months >= 0 else -((-months) // 12)
+        return {"month": months, "quarter": q, "year": yr}[unit]
+    out = np.fromiter((one(x, y) for x, y in zip(np.asarray(a),
+                                                 np.asarray(b))),
+                      dtype=np.int64, count=len(np.asarray(a)))
+    return out, am & bm
 
 
 # ---------------------------------------------------------------------------
@@ -1885,7 +2209,11 @@ HOST_ONLY_OPS = {"strcmp", "space", "dayname", "monthname", "crc32",
                  "date_format", "json_extract", "json_unquote",
                  "json_valid", "json_type", "json_length", "json_keys",
                  "json_contains", "json_array", "json_object",
-                 "apply_subquery"}
+                 "apply_subquery",
+                 "conv", "format", "char", "elt", "inet_aton", "inet_ntoa",
+                 "uuid", "makedate", "yearweek", "str_to_date",
+                 "timestampdiff", "soundex", "quote", "to_base64",
+                 "from_base64", "insert", "field"}
 
 _BOOL_OPS = {"eq", "ne", "lt", "le", "gt", "ge", "nulleq", "and", "or", "xor",
              "not", "isnull", "like", "in"}
@@ -1970,10 +2298,20 @@ def infer_type(op: str, args: Sequence[Expression]) -> FieldType:
         return T.varchar(nullable=nullable)
     if op in ("date", "last_day"):
         return T.date(nullable)
-    if op in ("unix_timestamp", "crc32"):
+    if op in ("unix_timestamp", "crc32", "inet_aton", "to_days",
+              "time_to_sec", "microsecond", "yearweek", "timestampdiff"):
         return T.bigint(nullable)
-    if op == "from_unixtime":
+    if op in ("from_unixtime", "str_to_date"):
         return T.datetime(nullable)
+    if op in ("from_days", "makedate"):
+        return T.date(True)
+    if op == "sec_to_time":
+        return T.time_type(nullable) if hasattr(T, "time_type") else \
+            FieldType(TypeKind.TIME, nullable)
+    if op == "atan2":
+        return T.double(nullable)
+    if op in ("conv", "format", "char", "elt", "inet_ntoa", "uuid"):
+        return T.varchar(nullable=True)
     if op in ("md5", "sha1", "sha2", "bin", "oct", "unhex",
               "date_format", "json_unquote", "json_type", "json_keys"):
         return T.varchar(nullable=True)

@@ -349,6 +349,10 @@ class MinMaxAgg(AggFunc):
         sort_key = values[validity]
         if self._is_string:
             sort_key = sort_key.astype(str)
+            if self.ftype.is_ci:
+                from tidb_tpu.types import fold_ci_array
+                sort_key = fold_ci_array(
+                    np.asarray(sort_key, dtype=object))
         order = np.argsort(sort_key, kind="stable")
         if not self.is_min:
             order = order[::-1]
@@ -361,12 +365,16 @@ class MinMaxAgg(AggFunc):
             if found[i]:
                 cand = first[i]
                 cur = out[i]
+                if self._is_string and self.ftype.is_ci:
+                    key = (lambda x: str(x).upper())
+                else:
+                    key = (lambda x: x)
                 if cur is None:
                     out[i] = cand
                 elif self.is_min:
-                    out[i] = min(cur, cand)
+                    out[i] = min(cur, cand, key=key)
                 else:
-                    out[i] = max(cur, cand)
+                    out[i] = max(cur, cand, key=key)
         return (out, seen | found)
 
     def merge(self, xp, state, gid, n, partial):

@@ -372,8 +372,11 @@ def frame_value(xp, name, vals, valid, pstart, peerstart, has_order: bool,
 def percent_rank(xp, pstart, peerstart):
     """(rank-1)/(rows-1), 0 for single-row partitions."""
     n = pstart.shape[0]
-    r = rank(xp, pstart, peerstart).astype(xp.float64 if xp is np
-                                           else xp.float32)
+    from tidb_tpu.ops.jax_env import device_float_dtype
+    # float64 wherever the backend supports it (CPU/np); f32 only on the
+    # real TPU, where rank deltas past ~16M rows lose resolution
+    r = rank(xp, pstart, peerstart).astype(
+        xp.float64 if xp is np else device_float_dtype())
     rows = _partition_rows(xp, pstart)
     denom = xp.maximum(rows - 1, 1).astype(r.dtype)
     return xp.where(rows > 1, (r - 1) / denom, xp.zeros_like(r))
@@ -385,7 +388,8 @@ def cume_dist(xp, pstart, peerstart):
     nxt = _next_peerstart_pos(xp, peerstart)
     pp = _pstart_pos(xp, pstart)
     rows = _partition_rows(xp, pstart)
-    fdt = xp.float64 if xp is np else xp.float32
+    from tidb_tpu.ops.jax_env import device_float_dtype
+    fdt = xp.float64 if xp is np else device_float_dtype()
     return (nxt - pp + 1).astype(fdt) / rows.astype(fdt)
 
 

@@ -623,9 +623,23 @@ class Parser:
             elif self.try_kw("comment"):
                 self.advance()  # the comment string
             elif self.at_kw("charset", "collate"):
+                is_collate = str(self.cur.value).lower() == "collate"
                 self.advance()
                 self.try_op("=")
-                self.advance()
+                cname = str(self.advance().value).lower()
+                if is_collate:
+                    from dataclasses import replace as _replace
+
+                    from tidb_tpu.types import (BIN_COLLATIONS,
+                                                CI_COLLATIONS)
+                    if cname in CI_COLLATIONS:
+                        if not ftype.kind.is_string:
+                            raise ParseError(
+                                f"COLLATE is not valid for "
+                                f"{ftype.kind.value} columns")
+                        ftype = _replace(ftype, collation=cname)
+                    elif cname not in BIN_COLLATIONS:
+                        raise ParseError(f"Unknown collation: '{cname}'")
             else:
                 break
         ftype = ftype.with_nullable(nullable)
@@ -1011,7 +1025,8 @@ class Parser:
             s = self.advance().value
             return ast.FuncCall(f"{kw}_literal", [ast.Literal(s, "str")])
         if t.is_kw("replace", "left", "right", "database",
-                   "truncate", "mod", "user", "data"):
+                   "truncate", "mod", "user", "data", "insert", "char",
+                   "format", "set"):
             # keywords that double as function names
             if self.toks[self.i + 1].kind == "op" and \
                     self.toks[self.i + 1].value == "(":

@@ -34,7 +34,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from tidb_tpu.errors import ExecutionError, PlanError
+from tidb_tpu.errors import (ExecutionError, PlanError,
+                             SubqueryRowError)
 from tidb_tpu.expression import (ColumnRef, Constant, CorrelatedRef,
                                  Expression, ScalarFunc, func, lit)
 from tidb_tpu.planner.logical import (LogicalAggregation, LogicalDataSource,
@@ -175,7 +176,7 @@ class ApplySubquery(ScalarFunc):
                 continue
             if scalar:
                 if len(rows) > 1:
-                    raise ExecutionError("Subquery returns more than 1 row")
+                    raise SubqueryRowError("Subquery returns more than 1 row")
                 val = rows[0][0] if rows else None
                 if val is None:
                     continue

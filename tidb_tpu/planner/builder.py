@@ -48,6 +48,12 @@ _SCALAR_FUNCS = {
     "if", "ifnull", "coalesce", "nullif", "isnull",
     "unix_timestamp", "from_unixtime", "crc32", "md5", "sha1", "sha2",
     "bin", "oct", "unhex", "date_format",
+    "bit_length", "ord", "quote", "to_base64", "from_base64", "soundex",
+    "insert", "field", "elt", "char", "format", "conv", "atan2",
+    "inet_aton", "inet_ntoa", "uuid",
+    "to_days", "from_days", "makedate", "time_to_sec", "sec_to_time",
+    "microsecond", "yearweek", "str_to_date", "timestampdiff",
+    "timestampadd", "convert_tz",
     "json_extract", "json_unquote", "json_valid", "json_type",
     "json_length", "json_keys", "json_contains", "json_array",
     "json_object",
@@ -202,14 +208,27 @@ class ExpressionRewriter:
                   "version", "user", "current_user", "database",
                   "connection_id")
 
+    def _tz_offset_us(self) -> int:
+        env = getattr(self, "env", None) or {}
+        from tidb_tpu.types import tz_offset_us
+        try:
+            return tz_offset_us(env.get("time_zone", "SYSTEM"))
+        except ValueError as e:
+            raise PlanError(str(e))
+
     def _env_func(self, name: str, node: ast.FuncCall):
         import datetime as _dt
+        off = _dt.timedelta(microseconds=self._tz_offset_us())
         if name in ("now", "current_timestamp", "localtime",
                     "localtimestamp", "sysdate"):
-            return Constant(_dt.datetime.now().replace(microsecond=0),
-                            T.datetime(False))
+            # session-tz wall clock (time_zone sysvar; types/time.go)
+            wall = _dt.datetime.now(_dt.timezone.utc).replace(
+                tzinfo=None, microsecond=0) + off
+            return Constant(wall, T.datetime(False))
         if name in ("curdate", "current_date"):
-            return Constant(_dt.date.today(), T.date(False))
+            wall = _dt.datetime.now(_dt.timezone.utc).replace(
+                tzinfo=None) + off
+            return Constant(wall.date(), T.date(False))
         if name == "version":
             return lit("8.0.11-tidb-tpu")
         env = getattr(self, "env", None) or {}
@@ -229,11 +248,68 @@ class ExpressionRewriter:
         if name == "unix_timestamp" and not node.args:
             import time as _time_mod
             return lit(int(_time_mod.time()))
+        # time_zone-aware epoch boundaries (types/time.go ConvertTimeZone):
+        # the session offset folds into plain int arithmetic, so the
+        # device path needs no tz kernels
+        if name == "unix_timestamp" and len(node.args) == 1:
+            x = _as_temporal(self.rewrite(node.args[0]))
+            base = ScalarFunc("unix_timestamp", [x], T.bigint(True))
+            off = self._tz_offset_us()
+            if not off:
+                return base
+            return ScalarFunc("minus", [base, lit(off // 1_000_000)],
+                              T.bigint(True))
+        if name == "from_unixtime" and len(node.args) == 1:
+            sec = self.rewrite(node.args[0])
+            base = ScalarFunc("from_unixtime", [sec], T.datetime(True))
+            off = self._tz_offset_us()
+            if not off:
+                return base
+            return ScalarFunc("plus", [base, lit(off)], T.datetime(True))
+        if name in ("timestampdiff", "timestampadd"):
+            if len(node.args) != 3 or not isinstance(node.args[0],
+                                                     ast.Name):
+                raise PlanError(
+                    f"{name} expects (unit, ...) with a bare unit keyword")
+            unit = str(node.args[0].parts[-1]).lower()
+            from tidb_tpu.expression import INTERVAL_UNITS
+            if unit not in INTERVAL_UNITS and unit not in (
+                    "microsecond", "second", "minute"):
+                raise PlanError(f"unsupported {name} unit: {unit}")
+            if name == "timestampadd":
+                n_e = self.rewrite(node.args[1])
+                d_e = _as_temporal(self.rewrite(node.args[2]))
+                return self._date_interval_units(d_e, n_e, unit)
+            a = _as_temporal(self.rewrite(node.args[1]))
+            b = _as_temporal(self.rewrite(node.args[2]))
+            return ScalarFunc("timestampdiff",
+                              [Constant(unit, T.varchar(False)), a, b],
+                              T.bigint(True))
+        if name == "convert_tz":
+            if len(node.args) != 3:
+                raise PlanError("convert_tz expects (dt, from_tz, to_tz)")
+            x = _as_temporal(self.rewrite(node.args[0]))
+            if x.ftype.kind is TypeKind.DATE:
+                x = cast(x, T.datetime(True))
+            f = self.rewrite(node.args[1])
+            t = self.rewrite(node.args[2])
+            if not (isinstance(f, Constant) and isinstance(t, Constant)):
+                raise PlanError("convert_tz time zones must be constants")
+            from tidb_tpu.types import tz_offset_us
+            try:
+                delta = tz_offset_us(str(t.value)) -                     tz_offset_us(str(f.value))
+            except ValueError as e:
+                raise PlanError(str(e))
+            if not delta:
+                return x
+            return ScalarFunc("plus", [x, lit(delta)], T.datetime(True))
         if name in AGG_NAMES:
             raise PlanError(
                 f"aggregate function {name}() in a non-aggregate context")
         if name not in _SCALAR_FUNCS:
-            raise PlanError(f"unsupported function: {node.name}")
+            from tidb_tpu.errors import UnsupportedFunctionError
+            raise UnsupportedFunctionError(
+                f"FUNCTION {node.name} does not exist")
         if name in ("date_add", "date_sub"):
             if len(node.args) != 2 or \
                     not isinstance(node.args[1], ast.IntervalExpr):
@@ -274,6 +350,17 @@ class ExpressionRewriter:
                 ft.kind is TypeKind.DATE:
             from tidb_tpu import types as _T
             ft = _T.datetime(ft.nullable or n.ftype.nullable)
+        return ScalarFunc(f"date_add_{unit}", [d, n],
+                          ft.with_nullable(ft.nullable or n.ftype.nullable))
+
+    def _date_interval_units(self, d: Expression, n: Expression,
+                             unit: str) -> Expression:
+        """TIMESTAMPADD: unit as a bare keyword instead of INTERVAL."""
+        from tidb_tpu.types import TypeKind
+        ft = d.ftype
+        if unit in ("hour", "minute", "second", "microsecond") and \
+                ft.kind is TypeKind.DATE:
+            ft = T.datetime(ft.nullable or n.ftype.nullable)
         return ScalarFunc(f"date_add_{unit}", [d, n],
                           ft.with_nullable(ft.nullable or n.ftype.nullable))
 
@@ -495,7 +582,9 @@ class PlanBuilder:
                       window_map=None) -> "ExpressionRewriter":
         sess = getattr(self.ctx, "session", None)
         env = {"user": getattr(sess, "user", "root"),
-               "connection_id": getattr(sess, "conn_id", 0)} \
+               "connection_id": getattr(sess, "conn_id", 0),
+               "time_zone": str(getattr(sess, "vars", {}).get(
+                   "time_zone", "SYSTEM"))} \
             if sess is not None else {}
         return ExpressionRewriter(schema, self.subq, agg_ctx,
                                   outer_schema=self.outer_schema,

@@ -32,7 +32,7 @@ from __future__ import annotations
 
 from typing import List, Optional, Tuple
 
-from tidb_tpu.errors import PlanError
+from tidb_tpu.errors import PlanError, SubqueryRowError
 from tidb_tpu.expression import (ColumnRef, Constant, CorrelatedRef,
                                  Expression, ScalarFunc, func, lit)
 from tidb_tpu.parser import ast
@@ -267,7 +267,7 @@ def rewrite_scalar_cmp(builder, outer: LogicalPlan, op: str,
         if len(ftypes) != 1:
             raise PlanError("Operand should contain 1 column(s)")
         if len(rows) > 1:
-            raise PlanError("Subquery returns more than 1 row")
+            raise SubqueryRowError("Subquery returns more than 1 row")
         val = Constant(rows[0][0] if rows else None,
                        ftypes[0].with_nullable(True))
         x_rw = builder.make_rewriter(outer.schema).rewrite(x_ast)

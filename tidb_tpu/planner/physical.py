@@ -569,8 +569,12 @@ def _distribute_fragments(plan: PhysicalPlan, n_shards: int,
 
 
 def _indexed_col(table, col_idx: int):
-    """Index name covering exactly this column as its first key, or None."""
+    """Index name covering exactly this column as its first key, or None.
+    ci-collated columns report no index: the sorted views compare raw
+    codepoints, which disagrees with the collation's fold order."""
     if col_idx >= len(table.columns):
+        return None
+    if table.columns[col_idx].ftype.is_ci:
         return None
     name = table.columns[col_idx].name.lower()
     if table.primary_key and table.primary_key[0].lower() == name:
@@ -717,6 +721,8 @@ def _try_index_access(ds: LogicalDataSource, ctx) -> Optional[PhysIndexScan]:
                            if c.name.lower() == col_name.lower())
         except StopIteration:
             continue
+        if ds.table.columns[col_idx].ftype.is_ci:
+            continue     # raw-ordered index view ≠ collation order
         ranges, residual = detach_ranges(ds.filters, col_idx)
         if ranges is None:
             continue
@@ -767,6 +773,8 @@ def _try_multi_col_index(ds: LogicalDataSource, ctx, stats,
             idxs = [col_of[c.lower()] for c in col_names]
         except KeyError:
             continue
+        if any(ds.table.columns[i].ftype.is_ci for i in idxs):
+            continue     # raw-ordered index view ≠ collation order
         prefix, ranges, leftover = detach_prefix_ranges(ds.filters, idxs)
         if ranges is None or (not prefix and len(ranges) == 1
                               and ranges[0].lo is None

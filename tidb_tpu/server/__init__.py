@@ -23,6 +23,7 @@ clientConn.Run without an event loop)."""
 from __future__ import annotations
 
 import datetime
+import math
 import hashlib
 import os
 import socket
@@ -184,6 +185,8 @@ def _sql_literal(v) -> str:
     if isinstance(v, bool):
         return "1" if v else "0"
     if isinstance(v, (int, float)):
+        if isinstance(v, float) and not math.isfinite(v):
+            return "NULL"     # MySQL has no inf/nan literals
         return repr(v)
     if isinstance(v, bytes):
         v = v.decode("utf-8", "replace")
@@ -555,6 +558,12 @@ class _Conn:
 
     # -- prepared statements (ref: server/conn_stmt.go) ----------------------
     def _stmt_prepare(self, sql: str) -> None:
+        """KNOWN LIMITATION: the prepare response reports 0 result
+        columns and types every parameter as VARCHAR — the statement is
+        not planned until EXECUTE, so prepare-time column definitions are
+        unavailable. Standard connectors (mysql-connector, PyMySQL, JDBC)
+        read metadata from the EXECUTE response and work; strict clients
+        that require prepare-time resultset metadata will not."""
         self._next_stmt_id += 1
         st = PreparedStmt(self._next_stmt_id, sql)
         self.stmts[st.stmt_id] = st

@@ -676,6 +676,7 @@ class Session:
                 str(v.get("tidb_tpu_engine")),
                 int(v.get("tidb_tpu_row_threshold", 32768)),
                 str(v.get("tidb_tpu_dist_devices", 0)),
+                str(v.get("time_zone", "SYSTEM")),  # tz folds into plans
                 self.user)
 
     def _run_as_of(self, stmt, as_of_expr) -> ResultSet:
@@ -886,7 +887,11 @@ class Session:
                 # vectorized prefilter on the first key column narrows the
                 # python tuple check to near-candidates (O(batch) not O(n))
                 c0 = ch.columns[idxs[0]]
-                cand = np.isin(c0.values.astype(object), first_vals) & \
+                c0_vals = c0.values.astype(object)
+                if c0.ftype.is_ci:
+                    from tidb_tpu.types import fold_ci_array
+                    c0_vals = fold_ci_array(c0_vals)  # seen keys are folded
+                cand = np.isin(c0_vals, first_vals) & \
                     c0.valid_mask() & alive
                 hit = np.zeros(ch.num_rows, dtype=bool)
                 if cand.any():
@@ -1192,13 +1197,21 @@ class Session:
                         "SET GLOBAL requires ALL on *.*")
                 with self.engine.stats_lock:
                     self.engine.global_vars[key] = value
-            self.vars[key] = value
+                # GLOBAL scope affects only NEW sessions (MySQL scoping);
+                # the current session keeps its value
+            else:
+                self.vars[key] = value
         return ok()
 
     def _show(self, stmt: ast.ShowStmt) -> ResultSet:
         info_schema = self.engine.catalog.info_schema
         if stmt.kind == "grants":
             target = stmt.target or self.user
+            if target.lower() != self.user.lower() and \
+                    not self.engine.auth.is_superuser(self.user):
+                from tidb_tpu.session.auth import PrivilegeError
+                raise PrivilegeError(
+                    "SHOW GRANTS for other users requires SUPER")
             rows = self.engine.auth.show_grants(target)
             return ResultSet([f"Grants for {target}@%"], [T.varchar()],
                              rows)
@@ -1488,17 +1501,19 @@ def _actual(exec_root, flat_index: int) -> str:
 
 
 def _check_not_null(rows, info: TableInfo):
+    from tidb_tpu.errors import NotNullViolation
     for r in rows:
         for v, c in zip(r, info.columns):
             if v is None and not c.ftype.nullable:
-                raise ExecutionError(f"Column '{c.name}' cannot be null")
+                raise NotNullViolation(f"Column '{c.name}' cannot be null")
 
 
 def _check_not_null_chunk(chunk: Chunk, info: TableInfo):
+    from tidb_tpu.errors import NotNullViolation
     for col, c in zip(chunk.columns, info.columns):
         if not c.ftype.nullable and col.validity is not None \
                 and not col.validity.all():
-            raise ExecutionError(f"Column '{c.name}' cannot be null")
+            raise NotNullViolation(f"Column '{c.name}' cannot be null")
 
 
 def _validate_insert_columns(columns: Optional[List[str]],
@@ -1561,8 +1576,14 @@ def _references_table(node, name: str) -> bool:
 
 def _key_tuples(chunk: Chunk, idxs: List[int]):
     """Per-row unique-key tuples; None when any component is NULL (NULL
-    never participates in unique conflicts, MySQL semantics)."""
-    cols = [(chunk.columns[i].values, chunk.columns[i].valid_mask())
+    never participates in unique conflicts, MySQL semantics). ci-collated
+    columns fold, so 'abc' and 'ABC' conflict like MySQL."""
+    from tidb_tpu.types import collation_fold_array
+    cols = [(collation_fold_array(chunk.columns[i].ftype,
+                                  chunk.columns[i].values)
+             if chunk.columns[i].ftype.is_ci
+             else chunk.columns[i].values,
+             chunk.columns[i].valid_mask())
             for i in idxs]
     out = []
     for ri in range(chunk.num_rows):

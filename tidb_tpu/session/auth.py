@@ -62,6 +62,24 @@ class AuthManager:
         self.grants: Dict[str, Dict[Tuple[str, str], Set[str]]] = {
             "root": {("*", "*"): {"ALL"}}}
 
+    # -- persistence (mysql.user / mysql.global_variables analog) ------------
+    def dump_state(self) -> dict:
+        with self._lock:
+            return {
+                "users": {u: pw.hex() for u, pw in self.users.items()},
+                "grants": {u: [[db, tbl, sorted(privs)]
+                               for (db, tbl), privs in g.items()]
+                           for u, g in self.grants.items()},
+            }
+
+    def load_state(self, state: dict) -> None:
+        with self._lock:
+            self.users = {u: bytes.fromhex(pw)
+                          for u, pw in state.get("users", {}).items()}
+            self.grants = {
+                u: {(db, tbl): set(privs) for db, tbl, privs in g}
+                for u, g in state.get("grants", {}).items()}
+
     # -- user admin ----------------------------------------------------------
     def create_user(self, user: str, password: str,
                     if_not_exists: bool = False) -> None:

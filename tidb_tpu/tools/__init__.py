@@ -208,6 +208,15 @@ def backup(engine, out_dir: str,
         _atomic_write(os.path.join(out_dir, f"{info.name}.chunks"), body)
         ckpt.mark(info.name)
         done.append(info.name)
+    # system state: SET GLOBAL variables + users/grants — the
+    # mysql.global_variables / mysql.user tables' analog, so both
+    # survive a restore-into-a-fresh-engine "restart"
+    with engine.stats_lock:
+        gvars = dict(engine.global_vars)
+    sys_state = {"global_vars": gvars,
+                 "auth": engine.auth.dump_state()}
+    _atomic_write(os.path.join(out_dir, "system.meta.json"),
+                  json.dumps(sys_state).encode())
     ckpt.finish()
     return done
 
@@ -219,8 +228,16 @@ def restore(engine, backup_dir: str) -> List[str]:
                       "restore")
     session = engine.new_session()
     restored = []
+    sys_path = os.path.join(backup_dir, "system.meta.json")
+    if os.path.exists(sys_path):
+        with open(sys_path) as f:
+            sys_state = json.load(f)
+        with engine.stats_lock:
+            engine.global_vars.update(sys_state.get("global_vars", {}))
+        if sys_state.get("auth"):
+            engine.auth.load_state(sys_state["auth"])
     metas = sorted(f for f in os.listdir(backup_dir)
-                   if f.endswith(".meta.json"))
+                   if f.endswith(".meta.json") and f != "system.meta.json")
     for mf in metas:
         with open(os.path.join(backup_dir, mf)) as f:
             meta = json.load(f)

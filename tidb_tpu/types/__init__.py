@@ -82,6 +82,9 @@ class FieldType:
     scale: int = 0       # DECIMAL scale / fractional-second precision
     unsigned: bool = False
     elems: tuple = ()    # ENUM/SET member strings (types/etc.go)
+    # string collation: "" = binary (codepoint order); "*_ci" compares
+    # case-insensitively via fold normalization (util/collate/ analog)
+    collation: str = ""
 
     # ---- physical layout -------------------------------------------------
     @property
@@ -114,6 +117,12 @@ class FieldType:
     @property
     def decimal_multiplier(self) -> int:
         return 10 ** self.scale
+
+    @property
+    def is_ci(self) -> bool:
+        """Case-insensitive collation (e.g. utf8mb4_general_ci): every
+        comparison/grouping/join site folds through collation_fold."""
+        return self.collation.endswith("_ci")
 
     @property
     def is_wide_decimal(self) -> bool:
@@ -263,6 +272,8 @@ class FieldType:
             s = f"{self.kind.value}({self.precision})"
         else:
             s = self.kind.value
+        if self.collation:
+            s += f" collate {self.collation}"
         if not self.nullable:
             s += " not null"
         return s
@@ -280,6 +291,63 @@ def int_(nullable: bool = True) -> FieldType:
 
 def double(nullable: bool = True) -> FieldType:
     return FieldType(TypeKind.DOUBLE, nullable)
+
+
+CI_COLLATIONS = ("utf8mb4_general_ci", "utf8mb4_unicode_ci",
+                 "utf8mb4_0900_ai_ci", "utf8_general_ci")
+BIN_COLLATIONS = ("binary", "utf8mb4_bin", "utf8_bin")
+
+
+def collation_fold_value(ftype: FieldType, v):
+    """Normalize one string under the column's collation (general_ci
+    folds via upper(), the reference's util/collate toUpper rule)."""
+    if ftype.is_ci and v is not None:
+        return str(v).upper()
+    return v
+
+
+def fold_ci_array(arr: np.ndarray) -> np.ndarray:
+    """Unconditionally fold an object array (callers decided ci)."""
+    return np.asarray([x.upper() if isinstance(x, str)
+                       else (x if x is None else str(x).upper())
+                       for x in arr], dtype=object)
+
+
+def collation_fold_array(ftype: FieldType, arr: np.ndarray) -> np.ndarray:
+    """Fold an object array of strings for comparison/grouping; identity
+    for binary collations."""
+    return fold_ci_array(arr) if ftype.is_ci else arr
+
+
+def tz_offset_us(tz_name: str, at=None) -> int:
+    """UTC offset of a MySQL time_zone value in microseconds.
+
+    Accepts 'SYSTEM'/'UTC' (0 here — the engine's reference clock is
+    UTC), fixed offsets '+HH:MM'/'-HH:MM' (exact), and IANA names via
+    zoneinfo (resolved at the given/current instant — statement-time
+    resolution, so DST transitions inside one column are approximated;
+    ref: types/time.go ConvertTimeZone)."""
+    import re as _re
+    name = (tz_name or "SYSTEM").strip()
+    if name.upper() in ("SYSTEM", "UTC"):
+        return 0
+    m = _re.match(r"^([+-])(\d{1,2}):(\d{2})$", name)
+    if m:
+        sign = -1 if m.group(1) == "-" else 1
+        h, mi = int(m.group(2)), int(m.group(3))
+        total = h * 60 + mi
+        # MySQL range: '-13:59' … '+14:00'
+        if mi > 59 or (sign > 0 and total > 14 * 60) or \
+                (sign < 0 and total > 13 * 60 + 59):
+            raise ValueError(f"Unknown or incorrect time zone: '{tz_name}'")
+        return sign * total * 60 * 1_000_000
+    try:
+        from zoneinfo import ZoneInfo
+        tz = ZoneInfo(name)
+    except Exception:
+        raise ValueError(f"Unknown or incorrect time zone: '{tz_name}'")
+    at = at or _dt.datetime.now(_dt.timezone.utc)
+    return int(tz.utcoffset(at).total_seconds() * 1_000_000)
 
 
 def decimal(precision: int, scale: int, nullable: bool = True) -> FieldType:
