@@ -321,3 +321,57 @@ def test_show_grants_requires_privilege():
     s2.query("SHOW GRANTS")                 # own grants: fine
     with pytest.raises(PrivilegeError):
         s2.query("SHOW GRANTS FOR root")    # other users: SUPER only
+
+
+def test_regexp_rlike():
+    from tidb_tpu.session import Engine
+    s = Engine().new_session()
+    s.execute("CREATE TABLE rx (v VARCHAR(20))")
+    s.execute("INSERT INTO rx VALUES ('hello42'), ('WORLD'), ('h2o')")
+    assert s.query("SELECT COUNT(*) FROM rx WHERE v REGEXP '[0-9]+'"
+                   ).rows[0][0] == 2
+    assert s.query("SELECT COUNT(*) FROM rx WHERE v RLIKE '^h'"
+                   ).rows[0][0] == 2
+    assert s.query("SELECT COUNT(*) FROM rx WHERE v NOT REGEXP '[0-9]'"
+                   ).rows[0][0] == 1
+    # device path: prepared per-dictionary LUT (like LIKE)
+    import numpy as np
+    rng = np.random.default_rng(2)
+    s.execute("INSERT INTO rx VALUES " + ",".join(
+        f"('w{int(rng.integers(0, 100))}')" for _ in range(50000)))
+    s.execute("ANALYZE TABLE rx")
+    sql = "SELECT COUNT(*) FROM rx WHERE v REGEXP '^w[0-4]'"
+    want = s.query(sql).rows
+    s.vars.update(tidb_tpu_engine="on", tidb_tpu_row_threshold=1,
+                  tidb_tpu_strict="on")
+    try:
+        got = s.query(sql).rows
+    finally:
+        s.vars.update(tidb_tpu_engine="off", tidb_tpu_strict="off")
+    assert got == want
+
+
+def test_batch2_temporal_builtins():
+    import datetime as dt
+    from tidb_tpu.session import Engine
+    s = Engine().new_session()
+    s.execute("CREATE TABLE b2 (t DATETIME)")
+    s.execute("INSERT INTO b2 VALUES ('2024-03-15 10:00:00')")
+    r = s.query(
+        "SELECT WEEKOFYEAR(t), PERIOD_ADD(202411, 3), "
+        "PERIOD_DIFF(202403, 202311), MAKETIME(10, 30, 15), "
+        "ADDTIME(t, MAKETIME(1, 0, 0)), SUBTIME(t, MAKETIME(0, 30, 0)) "
+        "FROM b2").rows[0]
+    assert r[0] == 11 and r[1] == 202502 and r[2] == 4
+    assert r[3] == dt.timedelta(hours=10, minutes=30, seconds=15)
+    assert r[4] == dt.datetime(2024, 3, 15, 11, 0)
+    assert r[5] == dt.datetime(2024, 3, 15, 9, 30)
+    r = s.query("SELECT MAKE_SET(5, 'a', 'b', 'c'), "
+                "EXPORT_SET(5, 'Y', 'N', ',', 4) FROM b2").rows[0]
+    assert r == ("a,c", "Y,N,Y,N")
+    # NULL propagation through the row-loop helpers
+    s.execute("INSERT INTO b2 VALUES (NULL)")
+    rows = s.query("SELECT WEEKOFYEAR(t), MAKETIME(25, 99, 0) FROM b2"
+                   ).rows
+    assert (None, None) in [(r[0], r[1]) for r in rows]  # NULL row + bad
+    assert all(r[1] is None for r in rows)   # invalid maketime everywhere

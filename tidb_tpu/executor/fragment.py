@@ -96,11 +96,18 @@ def _exprs_device_ok(exprs: Sequence[Expression]) -> bool:
     of a traced failure + warning per query). Wide decimals (limb-plane
     representation) are rejected here too: only the SUM/AVG/COUNT agg
     arguments handled by _fragment_ok's special case consume limbs."""
-    from tidb_tpu.expression import HOST_ONLY_OPS, ScalarFunc
+    from tidb_tpu.expression import HOST_ONLY_OPS, Constant, ScalarFunc
     for e in exprs:
         for sub in e.walk():
             if isinstance(sub, ScalarFunc) and sub.op in HOST_ONLY_OPS:
                 return False
+            if isinstance(sub, ScalarFunc) and sub.op in ("like",
+                                                          "regexp_like"):
+                # the device lowering is a prepared per-dictionary LUT:
+                # only column-vs-constant shapes can prepare
+                if not (isinstance(sub.args[0], ColumnRef) and
+                        isinstance(sub.args[1], Constant)):
+                    return False
             # wide-decimal COLUMNS arrive as 2-D limb planes no generic
             # kernel understands; computed wide-typed expressions are
             # ordinary 1-D scaled int64 and pass

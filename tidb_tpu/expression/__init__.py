@@ -1037,6 +1037,145 @@ def _prepare_like(func: ScalarFunc, dictionaries):
                        dtype=bool, count=len(d))
 
 
+@kernel("regexp_like")
+def _regexp_like(func, ctx):
+    """REGEXP / RLIKE (ref: builtin_regexp.go; re2 → python re). Device
+    path = prepared per-dictionary-entry boolean LUT, like LIKE."""
+    xp = ctx.xp
+    v, m = func.args[0].eval(ctx)
+    pat = func.args[1]
+    if ctx.on_device:
+        table = ctx.prepared.get(id(func))
+        assert table is not None, "REGEXP: missing dictionary preparation"
+        return xp.take(table, v.astype(xp.int32), mode="clip"), m
+    pv, pm = pat.eval(ctx)
+    # ci collations match case-insensitively (util/collate semantics) —
+    # and the device's ci dictionary keeps ONE arbitrary-case
+    # representative per fold class, so IGNORECASE is also what keeps
+    # host and device answers identical
+    flags = re.IGNORECASE if func.args[0].ftype.is_ci else 0
+    cache = {}
+    out = np.zeros(len(v), dtype=bool)
+    for i in range(len(v)):
+        p_s = str(np.asarray(pv)[i] if np.ndim(pv) else pv)
+        rx = cache.get(p_s)
+        if rx is None:
+            rx = cache[p_s] = re.compile(p_s, flags)
+        out[i] = rx.search(str(v[i])) is not None
+    return out, m & np.asarray(pm, dtype=bool)
+
+
+@preparer("regexp_like")
+def _prepare_regexp(func: ScalarFunc, dictionaries):
+    col = func.args[0]
+    if not isinstance(col, ColumnRef) or \
+            not isinstance(func.args[1], Constant):
+        return None
+    d = dictionaries[col.index]
+    if d is None:
+        return None
+    flags = re.IGNORECASE if col.ftype.is_ci else 0
+    rx = re.compile(str(func.args[1].value), flags)
+    return np.fromiter((rx.search(str(x)) is not None for x in d),
+                       dtype=bool, count=len(d))
+
+
+@kernel("weekofyear")
+def _weekofyear(func, ctx):
+    ft = func.args[0].ftype
+
+    def one(raw):
+        import datetime as _dt
+        days = int(raw) // 86_400_000_000 \
+            if ft.kind in (TypeKind.DATETIME, TypeKind.TIMESTAMP) \
+            else int(raw)
+        d = _dt.date(1970, 1, 1) + _dt.timedelta(days=days)
+        return d.isocalendar()[1]
+    return _host_rows(func, ctx, one, dtype=np.int64)
+
+
+@kernel("maketime")
+def _maketime(func, ctx):
+    def one(h, mi, sec):
+        if not (0 <= int(mi) < 60 and 0 <= float(sec) < 60):
+            return None
+        sign = -1 if int(h) < 0 else 1
+        return sign * ((abs(int(h)) * 3600 + int(mi) * 60) * 1_000_000
+                       + int(round(float(sec) * 1_000_000)))
+    return _host_rows(func, ctx, one, dtype=np.int64)
+
+
+def _addtime_kernel(sign):
+    def k(func, ctx):
+        xp = ctx.xp
+        av, am = func.args[0].eval(ctx)
+        bv, bm = func.args[1].eval(ctx)
+        return av + sign * bv.astype(xp.int64), am & bm
+    return k
+
+
+kernel("addtime")(_addtime_kernel(1))
+kernel("subtime")(_addtime_kernel(-1))
+
+
+def _period_months(p: int) -> int:
+    """YYMM/YYYYMM → absolute months with MySQL's 2-digit-year rule
+    (00-69 → 2000s, 70-99 → 1900s; types/time.go adjustedYear)."""
+    y, mo = divmod(int(p), 100)
+    if y < 70:
+        y += 2000 if y or mo else 0       # period 0 stays 0
+    elif y < 100:
+        y += 1900
+    return y * 12 + (mo - 1)
+
+
+@kernel("period_add")
+def _period_add(func, ctx):
+    def one(p, n):
+        total = _period_months(p) + int(n)
+        return (total // 12) * 100 + total % 12 + 1
+    return _host_rows(func, ctx, one, dtype=np.int64)
+
+
+@kernel("period_diff")
+def _period_diff(func, ctx):
+    def one(a, b):
+        return _period_months(a) - _period_months(b)
+    return _host_rows(func, ctx, one, dtype=np.int64)
+
+
+@kernel("make_set")
+def _make_set(func, ctx):
+    """MySQL MAKE_SET: NULL ITEMS are skipped (not propagated); only a
+    NULL bits argument makes the result NULL — hand-rolled masking
+    instead of _host_rows' any-NULL-skips-the-row rule."""
+    evals = [a.eval(ctx) for a in func.args]
+    n = ctx.num_rows
+    bits_v, bits_m = evals[0]
+    bits_m = np.asarray(bits_m, dtype=bool)
+    out = np.empty(n, dtype=object)
+    for i in range(n):
+        if not bits_m[i]:
+            out[i] = ""
+            continue
+        b = int(np.asarray(bits_v)[i])
+        parts = []
+        for k, (iv, im) in enumerate(evals[1:]):
+            if b & (1 << k) and np.asarray(im, dtype=bool)[i]:
+                parts.append(str(np.asarray(iv)[i] if np.ndim(iv)
+                                 else iv))
+        out[i] = ",".join(parts)
+    return out, bits_m
+
+
+@kernel("export_set")
+def _export_set(func, ctx):
+    def one(bits, on, off, sep=",", n=64):
+        return str(sep).join(str(on) if int(bits) & (1 << i) else str(off)
+                             for i in range(int(n)))
+    return _host_rows(func, ctx, one)
+
+
 @kernel("in")
 def _in(func, ctx):
     """col IN (c1, c2, ...) — constants only on device (planner guarantees)."""
@@ -2213,7 +2352,9 @@ HOST_ONLY_OPS = {"strcmp", "space", "dayname", "monthname", "crc32",
                  "conv", "format", "char", "elt", "inet_aton", "inet_ntoa",
                  "uuid", "makedate", "yearweek", "str_to_date",
                  "timestampdiff", "soundex", "quote", "to_base64",
-                 "from_base64", "insert", "field"}
+                 "from_base64", "insert", "field", "weekofyear",
+                 "maketime", "period_add", "period_diff", "make_set",
+                 "export_set"}
 
 _BOOL_OPS = {"eq", "ne", "lt", "le", "gt", "ge", "nulleq", "and", "or", "xor",
              "not", "isnull", "like", "in"}
@@ -2310,8 +2451,15 @@ def infer_type(op: str, args: Sequence[Expression]) -> FieldType:
             FieldType(TypeKind.TIME, nullable)
     if op == "atan2":
         return T.double(nullable)
-    if op in ("conv", "format", "char", "elt", "inet_ntoa", "uuid"):
+    if op in ("conv", "format", "char", "elt", "inet_ntoa", "uuid",
+              "make_set", "export_set"):
         return T.varchar(nullable=True)
+    if op in ("regexp_like", "weekofyear", "period_add", "period_diff"):
+        return T.bigint(nullable)
+    if op == "maketime":
+        return FieldType(TypeKind.TIME, True)
+    if op in ("addtime", "subtime"):
+        return args[0].ftype.with_nullable(nullable)
     if op in ("md5", "sha1", "sha2", "bin", "oct", "unhex",
               "date_format", "json_unquote", "json_type", "json_keys"):
         return T.varchar(nullable=True)

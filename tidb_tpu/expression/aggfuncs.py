@@ -146,6 +146,11 @@ class SumAgg(AggFunc):
         # formulation instead (executor/device_emit wide aggs).
         self._wide = self.ftype.is_wide_decimal or \
             desc.args[0].ftype.is_wide_decimal
+        # wide-COLUMN args arrive as Python-int object arrays on host;
+        # narrow args with a wide RESULT take the vectorized int64 limb
+        # path on BOTH engines (numpy bit ops — exact without per-element
+        # Python integer math)
+        self._arg_obj = desc.args[0].ftype.np_dtype == np.dtype(object)
 
     def _acc_dtype(self, xp):
         if self._wide:
@@ -169,7 +174,7 @@ class SumAgg(AggFunc):
             dt = self._acc_dtype(xp)
             return (xp.zeros(n, dtype=dt), xp.zeros(n, dtype=dt),
                     xp.zeros(n, dtype=xp.int64))
-        if self._wide and xp is not np:
+        if self._wide and (xp is not np or not self._arg_obj):
             return self._init_wide(xp, n)
         return (xp.zeros(n, dtype=self._acc_dtype(xp)),
                 xp.zeros(n, dtype=xp.int64))
@@ -220,7 +225,7 @@ class SumAgg(AggFunc):
         return tuple(out)
 
     def update(self, xp, state, gid, n, values, validity):
-        if self._wide and xp is not np:
+        if self._wide and (xp is not np or not self._arg_obj):
             return self._update_wide(xp, state, gid, n, values, validity)
         if self._float:
             hi, lo, counts = state

@@ -917,6 +917,14 @@ class Parser:
             if self.try_kw("like"):
                 left = ast.LikeExpr(left, self.add_expr(), negated)
                 continue
+            if self.at_kw("regexp", "rlike") or (
+                    self.at("ident") and
+                    str(self.cur.value).lower() in ("regexp", "rlike")):
+                self.advance()
+                node = ast.FuncCall("regexp_like",
+                                    [left, self.add_expr()])
+                left = ast.UnaryOp("not", node) if negated else node
+                continue
             if negated:
                 self.i = save
             break

@@ -53,7 +53,10 @@ _SCALAR_FUNCS = {
     "inet_aton", "inet_ntoa", "uuid",
     "to_days", "from_days", "makedate", "time_to_sec", "sec_to_time",
     "microsecond", "yearweek", "str_to_date", "timestampdiff",
-    "timestampadd", "convert_tz",
+    "timestampadd", "convert_tz", "regexp_like", "weekofyear",
+    "maketime", "addtime", "subtime", "period_add", "period_diff",
+    "make_set", "export_set", "curtime", "current_time", "utc_date",
+    "utc_timestamp", "utc_time",
     "json_extract", "json_unquote", "json_valid", "json_type",
     "json_length", "json_keys", "json_contains", "json_array",
     "json_object",
@@ -205,6 +208,8 @@ class ExpressionRewriter:
     # also evaluates these once per statement)
     _ENV_FUNCS = ("now", "current_timestamp", "localtime",
                   "localtimestamp", "sysdate", "curdate", "current_date",
+                  "curtime", "current_time", "utc_date", "utc_timestamp",
+                  "utc_time",
                   "version", "user", "current_user", "database",
                   "connection_id")
 
@@ -229,6 +234,22 @@ class ExpressionRewriter:
             wall = _dt.datetime.now(_dt.timezone.utc).replace(
                 tzinfo=None) + off
             return Constant(wall.date(), T.date(False))
+        if name in ("curtime", "current_time"):
+            wall = _dt.datetime.now(_dt.timezone.utc).replace(
+                tzinfo=None, microsecond=0) + off
+            td = wall - wall.replace(hour=0, minute=0, second=0)
+            return Constant(td, FieldType(TypeKind.TIME, False))
+        if name == "utc_timestamp":
+            return Constant(_dt.datetime.now(_dt.timezone.utc).replace(
+                tzinfo=None, microsecond=0), T.datetime(False))
+        if name == "utc_date":
+            return Constant(_dt.datetime.now(_dt.timezone.utc).date(),
+                            T.date(False))
+        if name == "utc_time":
+            w = _dt.datetime.now(_dt.timezone.utc).replace(
+                tzinfo=None, microsecond=0)
+            return Constant(w - w.replace(hour=0, minute=0, second=0),
+                            FieldType(TypeKind.TIME, False))
         if name == "version":
             return lit("8.0.11-tidb-tpu")
         env = getattr(self, "env", None) or {}
