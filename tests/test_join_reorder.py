@@ -94,3 +94,58 @@ def test_reorder_with_filters_and_cross_edge(s):
             if sm in small and small[sm] == bv:
                 want += 1
     assert got == want
+
+
+# ---- outer-join simplification (rule_predicate_push_down simplifyOuterJoin)
+
+
+def _plan_text(s, sql):
+    from tidb_tpu.parser import parse
+    plan = s._plan(parse(sql)[0])
+    return "\n".join(str(r) for r in plan.explain_lines())
+
+
+def test_outer_join_simplifies_to_inner():
+    from tidb_tpu.session import Engine
+    s = Engine().new_session()
+    s.execute("CREATE TABLE oa (x BIGINT)")
+    s.execute("CREATE TABLE ob (y BIGINT, z BIGINT)")
+    s.execute("INSERT INTO oa VALUES (1),(2),(3)")
+    s.execute("INSERT INTO ob VALUES (1,10),(2,NULL)")
+    # z > 5 rejects null-extended rows → INNER
+    txt = _plan_text(s, "SELECT * FROM oa LEFT JOIN ob ON x = y "
+                        "WHERE z > 5")
+    assert "inner" in txt and "left" not in txt, txt
+    assert s.query("SELECT * FROM oa LEFT JOIN ob ON x = y WHERE z > 5"
+                   ).rows == [(1, 1, 10)]
+    # IS NOT NULL on the inner side rejects too
+    txt = _plan_text(s, "SELECT * FROM oa LEFT JOIN ob ON x = y "
+                        "WHERE y IS NOT NULL")
+    assert "inner" in txt, txt
+    # arithmetic over an inner column still propagates NULL
+    txt = _plan_text(s, "SELECT * FROM oa LEFT JOIN ob ON x = y "
+                        "WHERE z + 1 > 5")
+    assert "inner" in txt, txt
+
+
+def test_outer_join_not_simplified_when_null_safe():
+    from tidb_tpu.session import Engine
+    s = Engine().new_session()
+    s.execute("CREATE TABLE oc (x BIGINT)")
+    s.execute("CREATE TABLE od (y BIGINT, z BIGINT)")
+    s.execute("INSERT INTO oc VALUES (1),(2),(3)")
+    s.execute("INSERT INTO od VALUES (1,10)")
+    # outer-side-only filter keeps LEFT
+    txt = _plan_text(s, "SELECT * FROM oc LEFT JOIN od ON x = y "
+                        "WHERE x > 0")
+    assert "left" in txt, txt
+    rows = s.query("SELECT * FROM oc LEFT JOIN od ON x = y WHERE x > 0"
+                   ).rows
+    assert len(rows) == 3
+    # COALESCE swallows NULL: must NOT convert
+    txt = _plan_text(s, "SELECT * FROM oc LEFT JOIN od ON x = y "
+                        "WHERE COALESCE(z, 99) > 5")
+    assert "left" in txt, txt
+    rows = s.query("SELECT * FROM oc LEFT JOIN od ON x = y "
+                   "WHERE COALESCE(z, 99) > 5").rows
+    assert len(rows) == 3          # null-extended rows pass via 99

@@ -234,3 +234,45 @@ def test_show_processlist_and_indexes():
     assert ("pi", "PRIMARY", "a", "YES") in rows
     assert ("pi", "ia", "a", "NO") in rows
     assert s.query("SHOW PROCESSLIST").rows is not None
+
+
+def test_tls_connection(tmp_path):
+    # TLS upgrade (server/conn.go TLS branch): self-signed cert, client
+    # sends SSLRequest, both sides wrap, auth + queries ride TLS
+    import subprocess
+    from tidb_tpu.client import Client
+    from tidb_tpu.server import Server
+    from tidb_tpu.session import Engine
+    cert = str(tmp_path / "c.pem")
+    key = str(tmp_path / "k.pem")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", cert, "-days", "1",
+         "-subj", "/CN=localhost"],
+        check=True, capture_output=True)
+    eng = Engine()
+    s = eng.new_session()
+    s.execute("CREATE TABLE t (a BIGINT)")
+    s.execute("INSERT INTO t VALUES (42)")
+    srv = Server(eng, port=0, ssl_cert=cert, ssl_key=key).start()
+    try:
+        c = Client(port=srv.port, ssl=True)
+        _names, rows = c.query("SELECT a FROM t")
+        assert rows == [("42",)]
+        c.close()
+        # plaintext clients still work when TLS is optional
+        c2 = Client(port=srv.port)
+        _n, rows = c2.query("SELECT a + 1 FROM t")
+        assert rows == [("43",)]
+        c2.close()
+    finally:
+        srv.stop()
+    # ssl=True against a non-TLS server: clear error, not an SSL panic
+    import pytest
+    from tidb_tpu.client import ClientError
+    srv2 = Server(eng, port=0).start()
+    try:
+        with pytest.raises(ClientError, match="does not support SSL"):
+            Client(port=srv2.port, ssl=True)
+    finally:
+        srv2.stop()

@@ -32,9 +32,12 @@ def _scramble(password: str, salt: bytes) -> bytes:
 class Client:
     def __init__(self, host: str = "127.0.0.1", port: int = 4000,
                  user: str = "root", password: str = "",
-                 timeout: float = 30.0):
+                 timeout: float = 30.0, ssl: bool = False,
+                 ssl_ca: str = None):
         self.sock = socket.create_connection((host, port), timeout=timeout)
         self.seq = 0
+        self._ssl = ssl
+        self._ssl_ca = ssl_ca
         self._handshake(user, password)
 
     # -- framing -------------------------------------------------------------
@@ -78,10 +81,32 @@ class Client:
         i = g.index(b"\x00", 1) + 1
         i += 4
         salt = g[i:i + 8]
+        srv_caps = (g[i + 9] | (g[i + 10] << 8)
+                    | (g[i + 12 + 2] << 16) | (g[i + 12 + 3] << 24)) \
+            if len(g) >= i + 16 else 0
         i += 9 + 2 + 1 + 2 + 2 + 1 + 10
         salt += g[i:i + 12]
         token = _scramble(password, salt)
         caps = 0x0200 | 0x8000 | 0x1
+        if self._ssl and not (srv_caps & 0x800):
+            raise ClientError(2026, "server does not support SSL")
+        if self._ssl:
+            caps |= 0x800                      # CLIENT_SSL
+            # SSLRequest, then upgrade the transport before the real
+            # handshake response (the server mirrors this order)
+            self._write_packet(struct.pack("<I", caps)
+                               + struct.pack("<I", 1 << 24)
+                               + bytes([0xFF]) + b"\x00" * 23)
+            import ssl as _ssl_mod
+            if self._ssl_ca:
+                ctx = _ssl_mod.create_default_context(
+                    cafile=self._ssl_ca)
+                ctx.check_hostname = False
+            else:
+                ctx = _ssl_mod.SSLContext(_ssl_mod.PROTOCOL_TLS_CLIENT)
+                ctx.check_hostname = False
+                ctx.verify_mode = _ssl_mod.CERT_NONE
+            self.sock = ctx.wrap_socket(self.sock)
         resp = (struct.pack("<I", caps) + struct.pack("<I", 1 << 24)
                 + bytes([0xFF]) + b"\x00" * 23
                 + user.encode() + b"\x00"

@@ -106,7 +106,8 @@ def _exprs_device_ok(exprs: Sequence[Expression]) -> bool:
                 # the device lowering is a prepared per-dictionary LUT:
                 # only column-vs-constant shapes can prepare
                 if not (isinstance(sub.args[0], ColumnRef) and
-                        isinstance(sub.args[1], Constant)):
+                        isinstance(sub.args[1], Constant) and
+                        sub.args[1].value is not None):
                     return False
             # wide-decimal COLUMNS arrive as 2-D limb planes no generic
             # kernel understands; computed wide-typed expressions are

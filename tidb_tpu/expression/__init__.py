@@ -1069,7 +1069,8 @@ def _regexp_like(func, ctx):
 def _prepare_regexp(func: ScalarFunc, dictionaries):
     col = func.args[0]
     if not isinstance(col, ColumnRef) or \
-            not isinstance(func.args[1], Constant):
+            not isinstance(func.args[1], Constant) or \
+            func.args[1].value is None:
         return None
     d = dictionaries[col.index]
     if d is None:
@@ -1110,6 +1111,8 @@ def _addtime_kernel(sign):
         xp = ctx.xp
         av, am = func.args[0].eval(ctx)
         bv, bm = func.args[1].eval(ctx)
+        if func.args[0].ftype.kind is TypeKind.DATE:
+            av = av.astype(xp.int64) * 86_400_000_000   # → DATETIME µs
         return av + sign * bv.astype(xp.int64), am & bm
     return k
 
@@ -2459,6 +2462,8 @@ def infer_type(op: str, args: Sequence[Expression]) -> FieldType:
     if op == "maketime":
         return FieldType(TypeKind.TIME, True)
     if op in ("addtime", "subtime"):
+        if args[0].ftype.kind is TypeKind.DATE:
+            return T.datetime(nullable)       # DATE + TIME → DATETIME
         return args[0].ftype.with_nullable(nullable)
     if op in ("md5", "sha1", "sha2", "bin", "oct", "unhex",
               "date_format", "json_unquote", "json_type", "json_keys"):

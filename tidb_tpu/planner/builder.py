@@ -264,7 +264,14 @@ class ExpressionRewriter:
     def _func_call(self, node: ast.FuncCall) -> Expression:
         name = node.name.lower()
         name = _CANON.get(name, name)
-        if name in self._ENV_FUNCS and not node.args:
+        _TEMPORAL_ENV = ("now", "current_timestamp", "localtime",
+                         "localtimestamp", "sysdate", "curtime",
+                         "current_time", "utc_time", "utc_timestamp")
+        if name in self._ENV_FUNCS and (
+                not node.args or
+                (name in _TEMPORAL_ENV and len(node.args) == 1)):
+            # the optional fsp argument is accepted and folded away (our
+            # wall clock is whole-second anyway)
             return self._env_func(name, node)
         if name == "unix_timestamp" and not node.args:
             import time as _time_mod
@@ -287,6 +294,10 @@ class ExpressionRewriter:
             if not off:
                 return base
             return ScalarFunc("plus", [base, lit(off)], T.datetime(True))
+        if name in ("addtime", "subtime") and len(node.args) == 2:
+            a = _as_temporal(self.rewrite(node.args[0]))
+            b = self.rewrite(node.args[1])
+            return func(name, a, b)
         if name in ("timestampdiff", "timestampadd"):
             if len(node.args) != 3 or not isinstance(node.args[0],
                                                      ast.Name):
@@ -1226,7 +1237,8 @@ _CMP_OPS = {"eq", "ne", "lt", "le", "gt", "ge"}
 _DATE_ARG_FUNCS = {"datediff", "dayofweek", "weekday", "dayofyear",
                    "quarter", "week", "last_day", "dayname", "monthname",
                    "year", "month", "dayofmonth", "date", "hour", "minute",
-                   "second"}
+                   "second", "weekofyear", "to_days", "yearweek",
+                   "microsecond", "time_to_sec"}
 
 
 def _as_temporal(e: Expression) -> Expression:

@@ -35,6 +35,8 @@ def logical_optimize(plan: LogicalPlan, ctx=None) -> LogicalPlan:
     tr = getattr(ctx, "tracer", None)   # optimizer trace (opt_trace.go)
     with maybe_span(tr, "rule.constant_folding"):
         plan = fold_constants_plan(plan)
+    with maybe_span(tr, "rule.outer_to_inner"):
+        plan = simplify_outer_joins(plan)
     with maybe_span(tr, "rule.predicate_pushdown"):
         plan = push_predicates(plan)
     with maybe_span(tr, "rule.join_reorder"):
@@ -255,6 +257,67 @@ def _shift_refs(e: Expression, delta: int) -> Expression:
 
 def _clone(e: Expression) -> Expression:
     return _shift_refs(e, 0)
+
+
+# ---------------------------------------------------------------------------
+# 2b. Outer-join simplification (ref: planner/core/rule_predicate_push_down
+# .go simplifyOuterJoin): a WHERE conjunct that REJECTS NULLs from the
+# inner side turns LEFT/RIGHT JOIN into INNER — null-extended rows could
+# never pass it. Inner joins then reorder, push predicates into both
+# sides, and fuse into device trees with a free build-side choice.
+# ---------------------------------------------------------------------------
+
+
+# ops where a NULL input yields a NULL output — a NULL-swallowing
+# wrapper (coalesce/ifnull/if/case/isnull) anywhere disqualifies
+_NULL_PROPAGATING = {"plus", "minus", "mul", "div", "intdiv", "mod",
+                     "unary_minus", "eq", "ne", "lt", "le", "gt", "ge",
+                     "abs", "round", "floor", "ceil", "concat", "upper",
+                     "lower", "length", "char_length", "substr"}
+
+
+def _null_rejecting(cond: Expression, lo: int, hi: int) -> bool:
+    """True when cond is NULL/false whenever every column in [lo, hi) is
+    NULL. Conservative shapes only: comparisons with an operand that (a)
+    references the inner side and (b) is built solely from NULL-
+    propagating ops, plus NOT(ISNULL(inner col))."""
+    def strict_inner(e: Expression) -> bool:
+        refs = False
+        for sub in e.walk():
+            if isinstance(sub, ColumnRef):
+                refs = refs or lo <= sub.index < hi
+            elif isinstance(sub, ScalarFunc):
+                if sub.op not in _NULL_PROPAGATING:
+                    return False
+            elif not isinstance(sub, Constant):
+                return False
+        return refs
+
+    if isinstance(cond, ScalarFunc) and cond.op in (
+            "eq", "ne", "lt", "le", "gt", "ge"):
+        return any(strict_inner(a) for a in cond.args)
+    if isinstance(cond, ScalarFunc) and cond.op == "not":
+        inner = cond.args[0]
+        return isinstance(inner, ScalarFunc) and inner.op == "isnull" \
+            and isinstance(inner.args[0], ColumnRef) \
+            and lo <= inner.args[0].index < hi
+    return False
+
+
+def simplify_outer_joins(plan: LogicalPlan) -> LogicalPlan:
+    plan.children = [simplify_outer_joins(c) for c in plan.children]
+    if not isinstance(plan, LogicalSelection):
+        return plan
+    child = plan.children[0]
+    if not (isinstance(child, LogicalJoin) and
+            child.kind in ("left", "right")):
+        return plan
+    lw = len(child.children[0].schema)
+    n = len(child.schema)
+    lo, hi = (lw, n) if child.kind == "left" else (0, lw)
+    if any(_null_rejecting(c, lo, hi) for c in plan.conditions):
+        child.kind = "inner"
+    return plan
 
 
 # ---------------------------------------------------------------------------

@@ -46,6 +46,7 @@ CLIENT_LONG_FLAG = 1 << 2
 CLIENT_CONNECT_WITH_DB = 1 << 3
 CLIENT_PROTOCOL_41 = 1 << 9
 CLIENT_TRANSACTIONS = 1 << 13
+CLIENT_SSL = 1 << 11
 CLIENT_SECURE_CONNECTION = 1 << 15
 CLIENT_PLUGIN_AUTH = 1 << 19
 CLIENT_MULTI_STATEMENTS = 1 << 16
@@ -356,13 +357,15 @@ def native_password_verify(salt: bytes, token: bytes, stage2: bytes) -> bool:
 class _Conn:
     """One client connection (ref: clientConn in server/conn.go)."""
 
-    def __init__(self, sock: socket.socket, engine, conn_id: int):
+    def __init__(self, sock: socket.socket, engine, conn_id: int,
+                 ssl_ctx=None):
         self.sock = sock
         self.engine = engine
         self.session = engine.new_session()
         self.conn_id = conn_id
         self.seq = 0
-        self.caps = SERVER_CAPS
+        self.ssl_ctx = ssl_ctx
+        self.caps = SERVER_CAPS | (CLIENT_SSL if ssl_ctx else 0)
         self.stmts: Dict[int, PreparedStmt] = {}
         self._next_stmt_id = 0
 
@@ -416,10 +419,10 @@ class _Conn:
             bytes([PROTOCOL_VERSION]) + SERVER_VERSION + b"\x00"
             + struct.pack("<I", self.conn_id)
             + salt[:8] + b"\x00"
-            + struct.pack("<H", SERVER_CAPS & 0xFFFF)
+            + struct.pack("<H", self.caps & 0xFFFF)
             + bytes([0xFF])                        # charset utf8
             + struct.pack("<H", 0x0002)            # status: autocommit
-            + struct.pack("<H", SERVER_CAPS >> 16)
+            + struct.pack("<H", self.caps >> 16)
             + bytes([21])                          # auth data len
             + b"\x00" * 10
             + salt[8:] + b"\x00"
@@ -427,6 +430,13 @@ class _Conn:
         self.seq = 0
         self.write_packet(greeting)
         resp = self.read_packet()
+        if self.ssl_ctx is not None and len(resp) >= 4 and \
+                struct.unpack("<I", resp[:4])[0] & CLIENT_SSL:
+            # SSLRequest: upgrade the transport, then read the real
+            # handshake response over TLS (server/conn.go TLS branch)
+            self.sock = self.ssl_ctx.wrap_socket(self.sock,
+                                                 server_side=True)
+            resp = self.read_packet()
         if len(resp) < 32:
             raise ConnectionError("malformed handshake response")
         self.caps = struct.unpack("<I", resp[:4])[0]
@@ -655,11 +665,17 @@ class Server:
     """TCP front end over one Engine (ref: server/server.go)."""
 
     def __init__(self, engine=None, host: str = "127.0.0.1",
-                 port: int = 4000):
+                 port: int = 4000, ssl_cert: Optional[str] = None,
+                 ssl_key: Optional[str] = None):
         from tidb_tpu.session import Engine
         self.engine = engine or Engine()
         self._next_conn = 0
         self._lock = threading.Lock()
+        self._ssl_ctx = None
+        if ssl_cert and ssl_key:
+            import ssl as _ssl
+            self._ssl_ctx = _ssl.SSLContext(_ssl.PROTOCOL_TLS_SERVER)
+            self._ssl_ctx.load_cert_chain(ssl_cert, ssl_key)
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -667,7 +683,8 @@ class Server:
                 with outer._lock:
                     outer._next_conn += 1
                     cid = outer._next_conn
-                conn = _Conn(self.request, outer.engine, cid)
+                conn = _Conn(self.request, outer.engine, cid,
+                             outer._ssl_ctx)
                 try:
                     conn.run()
                 except (ConnectionError, OSError):
