@@ -436,3 +436,73 @@ def test_update_not_null(tk):
     tk.must_exec("insert into un values (1)")
     with pytest.raises(TiDBTPUError):
         tk.must_exec("update un set a = null")
+
+
+# ---- AUTO_INCREMENT / LAST_INSERT_ID (meta/autoid analog) ------------------
+
+def test_auto_increment_basics():
+    from tidb_tpu.session import Engine
+    eng = Engine()
+    s = eng.new_session()
+    s.execute("CREATE TABLE ai (id BIGINT PRIMARY KEY AUTO_INCREMENT, "
+              "v VARCHAR(8))")
+    s.execute("INSERT INTO ai (v) VALUES ('a'), ('b')")
+    assert s.query("SELECT LAST_INSERT_ID()").rows[0][0] == 1
+    # NULL means allocate; explicit values push the counter MID-statement
+    s.execute("INSERT INTO ai VALUES (NULL,'c'), (100,'d'), (NULL,'e')")
+    assert s.query("SELECT id, v FROM ai ORDER BY id").rows == [
+        (1, "a"), (2, "b"), (3, "c"), (100, "d"), (101, "e")]
+    assert s.query("SELECT LAST_INSERT_ID()").rows[0][0] == 3
+    s.execute("INSERT INTO ai (v) VALUES ('f')")
+    assert s.query("SELECT id FROM ai WHERE v = 'f'").rows[0][0] == 102
+    # SHOW CREATE carries the attribute
+    ddl = s.query("SHOW CREATE TABLE ai").rows[0][1]
+    assert "AUTO_INCREMENT" in ddl
+
+
+def test_auto_increment_survives_restore(tmp_path):
+    from tidb_tpu.session import Engine
+    from tidb_tpu.tools import backup, restore
+    eng = Engine()
+    s = eng.new_session()
+    s.execute("CREATE TABLE ar (id BIGINT PRIMARY KEY AUTO_INCREMENT, "
+              "v BIGINT)")
+    s.execute("INSERT INTO ar (v) VALUES (10), (20), (30)")
+    backup(eng, str(tmp_path))
+    eng2 = Engine()
+    restore(eng2, str(tmp_path))
+    s2 = eng2.new_session()
+    s2.execute("INSERT INTO ar (v) VALUES (40)")
+    # the allocator reseeds from MAX(id), not from 1
+    assert s2.query("SELECT id FROM ar WHERE v = 40").rows[0][0] == 4
+
+
+def test_now_not_cached_stale():
+    import time
+    from tidb_tpu.session import Engine
+    s = Engine().new_session()
+    a = s.query("SELECT NOW()").rows[0][0]
+    time.sleep(1.1)
+    b = s.query("SELECT NOW()").rows[0][0]
+    assert b > a        # a cached plan would freeze the folded constant
+
+
+def test_auto_increment_guardrails():
+    import pytest
+    from tidb_tpu.errors import NotNullViolation
+    from tidb_tpu.session import Engine
+    s = Engine().new_session()
+    s.execute("CREATE TABLE aig (id BIGINT PRIMARY KEY AUTO_INCREMENT, "
+              "v BIGINT)")
+    s.execute("INSERT INTO aig (v) VALUES (10)")
+    # UPDATE keeps the NOT NULL invariant (only INSERT may pass NULL)
+    with pytest.raises(NotNullViolation):
+        s.execute("UPDATE aig SET id = NULL")
+    # LAST_INSERT_ID() usable inside DML (parent-id-into-child pattern)
+    s.execute("CREATE TABLE aich (pid BIGINT)")
+    s.execute("INSERT INTO aich VALUES (LAST_INSERT_ID())")
+    assert s.query("SELECT pid FROM aich").rows == [(1,)]
+    # TRUNCATE restarts the counter at 1 (MySQL)
+    s.execute("TRUNCATE TABLE aig")
+    s.execute("INSERT INTO aig (v) VALUES (99)")
+    assert s.query("SELECT id FROM aig").rows == [(1,)]

@@ -31,6 +31,7 @@ class ColumnInfo:
     primary_key: bool = False
     default: object = None
     has_default: bool = False
+    auto_increment: bool = False
 
 
 @dataclass(frozen=True)

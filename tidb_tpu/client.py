@@ -38,7 +38,11 @@ class Client:
         self.seq = 0
         self._ssl = ssl
         self._ssl_ca = ssl_ca
-        self._handshake(user, password)
+        try:
+            self._handshake(user, password)
+        except BaseException:
+            self.sock.close()     # __init__ never returns: don't leak it
+            raise
 
     # -- framing -------------------------------------------------------------
     def _recv(self, n: int) -> bytes:

@@ -243,6 +243,7 @@ class ColumnDef(Node):
     ftype: FieldType
     primary_key: bool = False
     default: Optional[ExprNode] = None
+    auto_increment: bool = False
 
 
 @dataclass

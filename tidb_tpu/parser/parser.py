@@ -606,6 +606,7 @@ class Parser:
         primary = False
         default = None
         nullable = True
+        auto_inc = False
         while True:
             if self.try_kw("not"):
                 self.expect_kw("null")
@@ -618,7 +619,9 @@ class Parser:
                 nullable = False
             elif self.try_kw("default"):
                 default = self.expr()
-            elif self.try_kw("auto_increment", "unique", "key"):
+            elif self.try_kw("auto_increment"):
+                auto_inc = True
+            elif self.try_kw("unique", "key"):
                 pass
             elif self.try_kw("comment"):
                 self.advance()  # the comment string
@@ -643,7 +646,7 @@ class Parser:
             else:
                 break
         ftype = ftype.with_nullable(nullable)
-        return ast.ColumnDef(name, ftype, primary, default)
+        return ast.ColumnDef(name, ftype, primary, default, auto_inc)
 
     def field_type(self) -> FieldType:
         t = self.advance()

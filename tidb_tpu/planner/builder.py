@@ -211,7 +211,7 @@ class ExpressionRewriter:
                   "curtime", "current_time", "utc_date", "utc_timestamp",
                   "utc_time",
                   "version", "user", "current_user", "database",
-                  "connection_id")
+                  "connection_id", "last_insert_id")
 
     def _tz_offset_us(self) -> int:
         env = getattr(self, "env", None) or {}
@@ -221,8 +221,19 @@ class ExpressionRewriter:
         except ValueError as e:
             raise PlanError(str(e))
 
+    def _note_dynamic(self) -> None:
+        """Mark this statement's plan data/time-dependent: the plan
+        cache must not resurrect yesterday's NOW() or a stale
+        LAST_INSERT_ID."""
+        note = getattr(self.subq, "note_dynamic", None) \
+            if self.subq is not None else None
+        if note is not None:
+            note()
+
     def _env_func(self, name: str, node: ast.FuncCall):
         import datetime as _dt
+        if name in _DYNAMIC_ENV:
+            self._note_dynamic()
         off = _dt.timedelta(microseconds=self._tz_offset_us())
         if name in ("now", "current_timestamp", "localtime",
                     "localtimestamp", "sysdate"):
@@ -259,22 +270,22 @@ class ExpressionRewriter:
             return lit(str(env.get("database", "test")))
         if name == "connection_id":
             return lit(int(env.get("connection_id", 0)))
+        if name == "last_insert_id":
+            return lit(int(env.get("last_insert_id", 0)))
         raise AssertionError(name)
 
     def _func_call(self, node: ast.FuncCall) -> Expression:
         name = node.name.lower()
         name = _CANON.get(name, name)
-        _TEMPORAL_ENV = ("now", "current_timestamp", "localtime",
-                         "localtimestamp", "sysdate", "curtime",
-                         "current_time", "utc_time", "utc_timestamp")
         if name in self._ENV_FUNCS and (
                 not node.args or
-                (name in _TEMPORAL_ENV and len(node.args) == 1)):
+                (name in _FSP_ENV and len(node.args) == 1)):
             # the optional fsp argument is accepted and folded away (our
             # wall clock is whole-second anyway)
             return self._env_func(name, node)
         if name == "unix_timestamp" and not node.args:
             import time as _time_mod
+            self._note_dynamic()
             return lit(int(_time_mod.time()))
         # time_zone-aware epoch boundaries (types/time.go ConvertTimeZone):
         # the session offset folds into plain int arithmetic, so the
@@ -616,7 +627,8 @@ class PlanBuilder:
         env = {"user": getattr(sess, "user", "root"),
                "connection_id": getattr(sess, "conn_id", 0),
                "time_zone": str(getattr(sess, "vars", {}).get(
-                   "time_zone", "SYSTEM"))} \
+                   "time_zone", "SYSTEM")),
+               "last_insert_id": getattr(sess, "last_insert_id", 0)} \
             if sess is not None else {}
         return ExpressionRewriter(schema, self.subq, agg_ctx,
                                   outer_schema=self.outer_schema,
@@ -1233,6 +1245,14 @@ def classify_join_conditions(conds: List[Expression], left_width: int):
 
 _CMP_OPS = {"eq", "ne", "lt", "le", "gt", "ge"}
 
+
+# env functions whose folded value changes per execution (plan-cache
+# poison) and the subset accepting an optional fsp argument
+_FSP_ENV = ("now", "current_timestamp", "localtime", "localtimestamp",
+            "sysdate", "curtime", "current_time", "utc_time",
+            "utc_timestamp")
+_DYNAMIC_ENV = _FSP_ENV + ("curdate", "current_date", "utc_date",
+                           "last_insert_id")
 
 _DATE_ARG_FUNCS = {"datediff", "dayofweek", "weekday", "dayofyear",
                    "quarter", "week", "last_day", "dayname", "monthname",

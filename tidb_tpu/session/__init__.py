@@ -208,8 +208,38 @@ class Engine:
         # table_id → rows modified since its last ANALYZE — feeds the
         # auto-analyze trigger (statistics/handle/update.go modifyCount)
         self.modify_counts: Dict[int, int] = {}
+        # (table_id, col_offset) → next AUTO_INCREMENT value
+        self._auto_ids: Dict[Tuple[int, int], int] = {}
         # SET GLOBAL scope, inherited by new sessions (sysvar.go analog)
         self.global_vars: Dict[str, object] = {}
+
+    def assign_auto_ids(self, table_id: int, col_offset: int,
+                        vals: np.ndarray, valid: np.ndarray,
+                        seed) -> Optional[int]:
+        """Row-ordered AUTO_INCREMENT assignment (the meta/autoid
+        allocator, lock-protected): NULL slots take the counter in row
+        order, and an explicit value ≥ the counter pushes it forward
+        MID-STATEMENT — (NULL, 100, NULL) yields (n, 100, 101) exactly
+        like MySQL. Lazily seeded from `seed` (MAX(col)) so restored or
+        imported tables keep counting past their data. Returns the first
+        generated id (for LAST_INSERT_ID), or None if none."""
+        with self.stats_lock:
+            key = (table_id, col_offset)
+            nxt = self._auto_ids.get(key)
+            if nxt is None:
+                nxt = int(seed or 0) + 1
+            first = None
+            for i in range(len(vals)):
+                if valid[i]:
+                    if int(vals[i]) >= nxt:
+                        nxt = int(vals[i]) + 1
+                else:
+                    vals[i] = nxt
+                    if first is None:
+                        first = nxt
+                    nxt += 1
+            self._auto_ids[key] = nxt
+            return first
 
     def note_modified(self, table_id: int, n: int) -> None:
         if n <= 0:
@@ -296,6 +326,7 @@ class Session:
         self._tracer = None        # set while a TRACE statement runs
         self._stmt_snapshot = None  # pinned read view (AS OF TIMESTAMP)
         self._for_update_snapshot = None
+        self.last_insert_id = 0     # LAST_INSERT_ID() (session.go)
 
     # ---- public API --------------------------------------------------------
     def execute(self, sql: str) -> List[ResultSet]:
@@ -491,10 +522,12 @@ class Session:
                 info = self.engine.catalog.drop_table(name, stmt.if_exists)
                 if info is not None:
                     self.engine.store.drop_table(info.id)
+                    self._reset_auto_ids(info.id)
             return ok()
         if isinstance(stmt, ast.TruncateTable):
             info = self.engine.catalog.info_schema.table(stmt.name)
             self.engine.store.truncate_table(info.id)
+            self._reset_auto_ids(info.id)   # MySQL: TRUNCATE restarts at 1
             return ok()
         if isinstance(stmt, ast.Insert):
             return self._insert(stmt)
@@ -800,9 +833,15 @@ class Session:
                 default = folded.value
                 has_default = True
             nullable = c.ftype.nullable and not c.primary_key
+            auto_inc = getattr(c, "auto_increment", False)
+            if auto_inc and not c.ftype.kind.is_integer:
+                raise PlanError(
+                    "Incorrect column specifier: AUTO_INCREMENT needs an "
+                    "integer column")
             cols.append(ColumnInfo(c.name, c.ftype.with_nullable(nullable),
                                    primary_key=c.primary_key,
-                                   default=default, has_default=has_default))
+                                   default=default, has_default=has_default,
+                                   auto_increment=auto_inc))
         pk = list(stmt.primary_key) or [c.name for c in stmt.columns
                                         if c.primary_key]
         idx = [IndexInfo(i.name, tuple(i.columns), i.unique)
@@ -814,6 +853,56 @@ class Session:
         return ok()
 
     # ---- DML ---------------------------------------------------------------
+    def _fill_auto_increment(self, info: TableInfo, chunk: Chunk) -> Chunk:
+        """Assign AUTO_INCREMENT values to NULL slots (NULL/absent means
+        'allocate', MySQL semantics); explicit values above the counter
+        push it forward. Sets last_insert_id to the FIRST id generated by
+        this statement (ref: meta/autoid + session LastInsertID)."""
+        auto_cols = [c for c in info.columns if c.auto_increment]
+        if not auto_cols or chunk.num_rows == 0:
+            return chunk
+        cols = list(chunk.columns)
+        for c in auto_cols:
+            col = cols[c.offset]
+            valid = col.valid_mask()
+            vals = np.asarray(col.values).astype(np.int64, copy=True)
+            seed = None
+            if (info.id, c.offset) not in self.engine._auto_ids:
+                seed = self._auto_id_seed(info, c)
+            first = self.engine.assign_auto_ids(info.id, c.offset, vals,
+                                                valid, seed)
+            if first is not None:
+                self.last_insert_id = first
+            cols[c.offset] = Column(c.ftype, vals, None)
+        return Chunk(cols)
+
+    def _reset_auto_ids(self, table_id: int) -> None:
+        with self.engine.stats_lock:
+            for key in [k for k in self.engine._auto_ids
+                        if k[0] == table_id]:
+                self.engine._auto_ids.pop(key, None)
+
+    def _auto_id_seed(self, info: TableInfo, c) -> int:
+        """MAX(col) over live + staged rows: restored/imported tables
+        keep counting past their data."""
+        from tidb_tpu.executor.scan import align_chunk_to_schema
+        mx = 0
+        snap = self._read_view_snapshot()
+        if snap.has_table(info.id):
+            for region, alive in snap.scan(info.id):
+                ch = align_chunk_to_schema(region.chunk, info)
+                col = ch.columns[c.offset]
+                m = col.valid_mask() & alive
+                if m.any():
+                    mx = max(mx, int(np.asarray(col.values)[m].max()))
+        if self.txn is not None:
+            for st in self.txn.staged_inserts.get(info.id, []):
+                col = st.columns[c.offset]
+                m = col.valid_mask()
+                if m.any():
+                    mx = max(mx, int(np.asarray(col.values)[m].max()))
+        return mx
+
     def _insert(self, stmt: ast.Insert) -> ResultSet:
         info = self.engine.catalog.info_schema.table(stmt.table)
         names = _validate_insert_columns(stmt.columns, info)
@@ -821,6 +910,7 @@ class Session:
             chunk = self._select_chunk_for_insert(stmt.select, info, names)
         else:
             chunk = self._rows_chunk(stmt, info, names)
+        chunk = self._fill_auto_increment(info, chunk)
         txn, auto = self._write_txn()
         try:
             chunk = self._enforce_unique(info, chunk, txn,
@@ -932,11 +1022,16 @@ class Session:
             return chunk
         return chunk.take(np.nonzero(keep)[0])
 
+    def _session_env(self) -> Dict[str, object]:
+        return {"user": self.user, "connection_id": self.conn_id,
+                "time_zone": str(self.vars.get("time_zone", "SYSTEM")),
+                "last_insert_id": self.last_insert_id}
+
     def _rows_chunk(self, stmt: ast.Insert, info: TableInfo,
                     names: List[str]) -> Chunk:
         from tidb_tpu.expression import Constant
         from tidb_tpu.planner.rules import fold_expr
-        rw = ExpressionRewriter(Schema([]))
+        rw = ExpressionRewriter(Schema([]), env=self._session_env())
         rows = []
         for vals in stmt.rows:
             if len(vals) != len(names):
@@ -982,7 +1077,7 @@ class Session:
             out[0] if out else Chunk.from_rows(info.field_types, []))
         chunk = Chunk([Column(c.ftype, col.values, col.validity)
                        for c, col in zip(info.columns, chunk.columns)])
-        _check_not_null_chunk(chunk, info)
+        _check_not_null_chunk(chunk, info, allow_auto_inc=True)
         return chunk
 
     def _pessimistic_match(self, txn, info, where):
@@ -1114,7 +1209,8 @@ class Session:
         from tidb_tpu.expression import cast as _cast
         info = self.engine.catalog.info_schema.table(stmt.table.name)
         schema = Schema.from_table(info)
-        rw = ExpressionRewriter(schema, self._subquery_evaluator())
+        rw = ExpressionRewriter(schema, self._subquery_evaluator(),
+                                env=self._session_env())
         assigns: Dict[str, Expression] = {}
         for name, expr in stmt.assignments:
             info.column(name)  # validates the column exists
@@ -1501,17 +1597,25 @@ def _actual(exec_root, flat_index: int) -> str:
 
 
 def _check_not_null(rows, info: TableInfo):
+    """INSERT rows: auto-inc NULLs mean 'allocate' and pass."""
     from tidb_tpu.errors import NotNullViolation
     for r in rows:
         for v, c in zip(r, info.columns):
-            if v is None and not c.ftype.nullable:
+            if v is None and not c.ftype.nullable \
+                    and not c.auto_increment:
                 raise NotNullViolation(f"Column '{c.name}' cannot be null")
 
 
-def _check_not_null_chunk(chunk: Chunk, info: TableInfo):
+def _check_not_null_chunk(chunk: Chunk, info: TableInfo,
+                          allow_auto_inc: bool = False):
+    """allow_auto_inc: INSERT paths only — a NULL there means 'allocate'
+    (_fill_auto_increment backfills). UPDATE keeps the NOT NULL
+    invariant for auto-inc columns too."""
     from tidb_tpu.errors import NotNullViolation
     for col, c in zip(chunk.columns, info.columns):
-        if not c.ftype.nullable and col.validity is not None \
+        if not c.ftype.nullable \
+                and not (allow_auto_inc and c.auto_increment) \
+                and col.validity is not None \
                 and not col.validity.all():
             raise NotNullViolation(f"Column '{c.name}' cannot be null")
 
@@ -1543,8 +1647,8 @@ def _assemble_rows(rows: List[List], info: TableInfo,
                 row.append(r[pos])
             elif c.has_default:
                 row.append(c.default)
-            elif c.ftype.nullable:
-                row.append(None)
+            elif c.ftype.nullable or c.auto_increment:
+                row.append(None)      # auto-inc NULLs are assigned later
             else:
                 raise ExecutionError(
                     f"Field '{c.name}' doesn't have a default value")

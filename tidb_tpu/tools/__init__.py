@@ -83,7 +83,12 @@ def _atomic_write(path: str, data: bytes) -> None:
 def create_table_sql(info) -> str:
     cols = []
     for c in info.columns:
-        cols.append(f"`{c.name}` {c.ftype}")
+        spec = f"`{c.name}` {c.ftype}"
+        if getattr(c, "auto_increment", False):
+            spec += " AUTO_INCREMENT"
+        if not c.ftype.nullable and not c.primary_key:
+            spec += " NOT NULL"
+        cols.append(spec)
     if info.primary_key:
         cols.append("PRIMARY KEY (" +
                     ", ".join(f"`{c}`" for c in info.primary_key) + ")")
