@@ -171,3 +171,24 @@ def test_apply_plan_not_cached(s):
     assert b == a + 1
     s.execute("DELETE FROM o WHERE o_id >= 5000")
     s.execute("DELETE FROM l WHERE l_oid >= 5000")
+
+
+def test_select_list_correlated_scalar(s, raw):
+    # correlated scalar subquery as a VALUE expression (SELECT list /
+    # arbitrary operands), not a top-level WHERE conjunct
+    got = s.query(
+        "SELECT o_id, (SELECT MAX(l_qty) FROM l WHERE l_oid = o_id) "
+        "FROM o ORDER BY o_id").rows
+    o, l = raw
+    for oid, mx in got:
+        items = [q for k, q, _t in l if k == oid]
+        assert mx == (max(items) if items else None), (oid, mx)
+    # inside an expression + as a non-conjunct WHERE operand
+    got = s.query(
+        "SELECT COUNT(*) FROM o WHERE "
+        "(SELECT COUNT(*) FROM l WHERE l_oid = o_id) + 1 > 9").rows
+    want = 0
+    for oid, *_ in o:
+        if sum(1 for k, *_x in l if k == oid) + 1 > 9:
+            want += 1
+    assert got[0][0] == want

@@ -375,3 +375,14 @@ def test_batch2_temporal_builtins():
                    ).rows
     assert (None, None) in [(r[0], r[1]) for r in rows]  # NULL row + bad
     assert all(r[1] is None for r in rows)   # invalid maketime everywhere
+
+
+def test_extract():
+    from tidb_tpu.session import Engine
+    s = Engine().new_session()
+    s.execute("CREATE TABLE ex (d DATETIME)")
+    s.execute("INSERT INTO ex VALUES ('2024-03-15 10:30:45.123456')")
+    r = s.query("SELECT EXTRACT(year FROM d), EXTRACT(quarter FROM d), "
+                "EXTRACT(day FROM d), EXTRACT(minute FROM d), "
+                "EXTRACT(microsecond FROM d) FROM ex").rows[0]
+    assert r == (2024, 1, 15, 30, 123456)

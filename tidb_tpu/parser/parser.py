@@ -1021,6 +1021,23 @@ class Parser:
             v = self.add_expr()
             unit = self.ident().lower()
             return ast.IntervalExpr(v, unit)
+        if (t.kind == "ident" and str(t.value).lower() == "extract") and \
+                self.toks[self.i + 1].kind == "op" and \
+                self.toks[self.i + 1].value == "(":
+            # EXTRACT(unit FROM expr) → the matching part function
+            self.advance()
+            self.expect_op("(")
+            unit = str(self.ident()).lower()
+            self.expect_kw("from")
+            e = self.expr()
+            self.expect_op(")")
+            fn = {"year": "year", "month": "month", "day": "dayofmonth",
+                  "hour": "hour", "minute": "minute", "second": "second",
+                  "microsecond": "microsecond", "week": "week",
+                  "quarter": "quarter"}.get(unit)
+            if fn is None:
+                raise ParseError(f"unsupported EXTRACT unit: {unit}")
+            return ast.FuncCall(fn, [e])
         if t.is_kw("if"):  # IF(c, a, b) function form
             self.advance()
             self.expect_op("(")

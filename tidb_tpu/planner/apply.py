@@ -216,25 +216,46 @@ def _eq(a, b) -> bool:
 # ---------------------------------------------------------------------------
 
 
-def _make_apply(builder, outer, inner, mode: str,
-                pre_args: List[Expression], ftype) -> ApplySubquery:
-    from tidb_tpu.planner.decorrelate import (CorrelationError, _plan_exprs,
-                                              is_correlated)
-    runner = getattr(builder.subq, "run_plan", None) \
-        if builder.subq is not None else None
+def _build_apply(subq, outer_schema, inner, mode: str,
+                 pre_args: List[Expression], ftype,
+                 err=PlanError) -> ApplySubquery:
+    """Shared ApplySubquery construction: runner lookup, correlated-ref
+    collection into trailing args, plan-cache bypass marking."""
+    from tidb_tpu.planner.decorrelate import _plan_exprs
+    runner = getattr(subq, "run_plan", None) if subq is not None else None
     if runner is None:
-        raise CorrelationError(
-            "correlated subquery requires a session evaluator")
+        raise err("correlated subquery requires a session evaluator")
     corr_idx = sorted({r.index for e in _plan_exprs(inner)
                        for r in e.walk() if isinstance(r, CorrelatedRef)})
-    if any(is_correlated(a) for a in pre_args):
-        raise CorrelationError("correlated probe expression")
-    refs = [outer.schema.column_ref(i) for i in corr_idx]
-    note = getattr(builder.subq, "note_dynamic", None)
+    refs = [outer_schema.column_ref(i) for i in corr_idx]
+    note = getattr(subq, "note_dynamic", None)
     if note is not None:
         note()      # apply results depend on data: skip the plan cache
     return ApplySubquery("apply_subquery", list(pre_args) + refs, ftype,
                          mode, inner, tuple(corr_idx), runner)
+
+
+def _make_apply(builder, outer, inner, mode: str,
+                pre_args: List[Expression], ftype) -> ApplySubquery:
+    from tidb_tpu.planner.decorrelate import (CorrelationError,
+                                              is_correlated)
+    if any(is_correlated(a) for a in pre_args):
+        raise CorrelationError("correlated probe expression")
+    return _build_apply(builder.subq, outer.schema, inner, mode,
+                        pre_args, ftype, err=CorrelationError)
+
+
+def make_scalar_apply(subq, outer_schema, inner: LogicalPlan
+                      ) -> ApplySubquery:
+    """Correlated scalar subquery as a VALUE expression — usable in any
+    expression position (SELECT list, HAVING, arbitrary WHERE operands),
+    not just top-level WHERE conjuncts. The reference reaches these
+    through the same apply machinery (expression_rewriter.go
+    buildSubquery → parallel_apply)."""
+    if len(inner.schema) != 1:
+        raise PlanError("Operand should contain 1 column(s)")
+    vtype = inner.schema.field_types[0].with_nullable(True)
+    return _build_apply(subq, outer_schema, inner, "scalar", [], vtype)
 
 
 def apply_exists(builder, outer, node):

@@ -18,7 +18,8 @@ from __future__ import annotations
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from tidb_tpu import types as T
-from tidb_tpu.errors import PlanError, UnknownColumnError
+from tidb_tpu.errors import (PlanError, TiDBTPUError,
+                             UnknownColumnError)
 from tidb_tpu.expression import (ColumnRef, Constant, Expression, ScalarFunc,
                                  cast, func, lit)
 from tidb_tpu.expression.aggfuncs import AGG_NAMES, AggDesc
@@ -425,13 +426,39 @@ class ExpressionRewriter:
                 f"correlated subqueries are only supported as top-level "
                 f"WHERE conjuncts)") from e
 
-    def _scalar_subquery(self, node: ast.Subquery) -> Constant:
+    def _scalar_subquery(self, node: ast.Subquery) -> Expression:
         self._require_subq()
+        build_plan = getattr(self.subq, "build_plan", None)
+        if build_plan is not None and len(self.schema):
+            # correlated? build against the CURRENT row schema; outer
+            # references become CorrelatedRefs → a cached Apply value
+            # expression (planner/apply.py). Uncorrelated (or failing to
+            # build at all) falls through to the eager constant path.
+            from tidb_tpu.planner import decorrelate as DC
+            try:
+                inner = build_plan(node.select, self.schema)
+            except TiDBTPUError:
+                inner = None
+            if inner is not None and DC.plan_is_correlated(inner):
+                from tidb_tpu.planner.apply import make_scalar_apply
+                return make_scalar_apply(self.subq, self.schema, inner)
+            if inner is not None:
+                # uncorrelated: execute the plan we just built instead of
+                # re-planning the AST through the eager path
+                ran = DC._run_uncorrelated(self, inner)
+                if ran is not None:
+                    rows, ftypes = ran
+                    return self._scalar_const(rows, ftypes)
         rows, ftypes = self._run_eager(node.select)
+        return self._scalar_const(rows, ftypes)
+
+    @staticmethod
+    def _scalar_const(rows, ftypes) -> Constant:
+        from tidb_tpu.errors import SubqueryRowError
         if len(ftypes) != 1:
             raise PlanError("Operand should contain 1 column(s)")
         if len(rows) > 1:
-            raise PlanError("Subquery returns more than 1 row")
+            raise SubqueryRowError("Subquery returns more than 1 row")
         if not rows:
             return Constant(None, ftypes[0].with_nullable(True))
         return Constant(rows[0][0], ftypes[0].with_nullable(True))

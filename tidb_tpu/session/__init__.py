@@ -604,8 +604,17 @@ class Session:
             rows = [r for ch in chunks for r in ch.rows()]
             return rows, list(phys.schema.field_types)
 
+        def build_plan(sel, outer_schema):
+            # plan a subquery with the caller's row schema visible, so
+            # unresolved names become CorrelatedRefs (apply fallback)
+            from tidb_tpu.planner.builder import PlanBuilder
+            b = PlanBuilder(self.engine.catalog.info_schema,
+                            _PlanContext(self))
+            return b.build_subquery_plan(sel, outer_schema)
+
         ev = SubqueryEvaluator(run)
         ev.run_plan = run_plan
+        ev.build_plan = build_plan
 
         def note_dynamic():
             # apply-fallback plans embed data-dependent row sets; bumping
