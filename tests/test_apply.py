@@ -192,3 +192,45 @@ def test_select_list_correlated_scalar(s, raw):
         if sum(1 for k, *_x in l if k == oid) + 1 > 9:
             want += 1
     assert got[0][0] == want
+
+
+def test_value_position_exists_and_in(s, raw):
+    got = s.query(
+        "SELECT o_id, EXISTS(SELECT 1 FROM l WHERE l_oid = o_id), "
+        "NOT EXISTS(SELECT 1 FROM l WHERE l_oid = o_id) "
+        "FROM o ORDER BY o_id").rows
+    o, l = raw
+    present = {k for k, *_ in l if k is not None}
+    for oid, ex, nex in got:
+        assert ex == int(oid in present) and nex == int(oid not in present)
+    # three-valued IN as a VALUE: no match + NULL in set → NULL
+    got = s.query(
+        "SELECT o_id, o_id + 100000 IN (SELECT l_oid FROM l "
+        "WHERE l_qty > o_prio) FROM o ORDER BY o_id LIMIT 3").rows
+    for _oid, v in got:
+        assert v is None        # never matches; NULL keys exist in l
+
+
+def test_nested_apply_survives_decorrelation(s, raw):
+    # an ApplySubquery riding inside a decorrelated EXISTS's join
+    # condition must survive _shift_inner/_subst_corr (rebuild protocol)
+    got = s.query(
+        "SELECT COUNT(*) FROM o WHERE EXISTS (SELECT 1 FROM l WHERE "
+        "(SELECT MAX(l2.l_qty) FROM l l2 WHERE l2.l_oid = l.l_oid) "
+        "> o_prio)").rows
+    o, l = raw
+    maxq = {}
+    for k, q, _t in l:
+        if k is not None:
+            maxq[k] = max(maxq.get(k, 0), q)
+    want = sum(1 for _oid, prio, _f in o
+               if any(m > prio for m in maxq.values()))
+    assert got[0][0] == want
+
+
+def test_genuine_subquery_errors_surface(s):
+    import pytest
+    from tidb_tpu.errors import TiDBTPUError
+    with pytest.raises(TiDBTPUError, match="bogus"):
+        s.query("SELECT (SELECT MAX(l_qty) FROM l "
+                "WHERE l_oid = o_id AND bogus > 1) FROM o")

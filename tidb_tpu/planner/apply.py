@@ -258,6 +258,17 @@ def make_scalar_apply(subq, outer_schema, inner: LogicalPlan
     return _build_apply(subq, outer_schema, inner, "scalar", [], vtype)
 
 
+def make_in_apply(subq, outer_schema, inner: LogicalPlan,
+                  probe: Expression, negated: bool) -> ApplySubquery:
+    """Correlated [NOT] IN as a VALUE expression (three-valued result)."""
+    from tidb_tpu.expression import lit
+    if len(inner.schema) != 1:
+        raise PlanError("Operand should contain 1 column(s)")
+    mode = "not_in" if negated else "in"
+    return _build_apply(subq, outer_schema, inner, mode, [probe],
+                        lit(1).ftype)
+
+
 def apply_exists(builder, outer, node):
     """EXISTS fallback (ref: parallel_apply.go semi-apply)."""
     inner = builder.build_subquery_plan(node.subquery.select, outer.schema)

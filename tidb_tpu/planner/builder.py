@@ -421,34 +421,21 @@ class ExpressionRewriter:
         try:
             return self.subq.run(sel)
         except UnknownColumnError as e:
-            raise PlanError(
-                f"{e} in subquery (if this references the outer query: "
-                f"correlated subqueries are only supported as top-level "
-                f"WHERE conjuncts)") from e
+            raise PlanError(f"{e} in subquery") from e
 
     def _scalar_subquery(self, node: ast.Subquery) -> Expression:
         self._require_subq()
-        build_plan = getattr(self.subq, "build_plan", None)
-        if build_plan is not None and len(self.schema):
-            # correlated? build against the CURRENT row schema; outer
-            # references become CorrelatedRefs → a cached Apply value
-            # expression (planner/apply.py). Uncorrelated (or failing to
-            # build at all) falls through to the eager constant path.
-            from tidb_tpu.planner import decorrelate as DC
-            try:
-                inner = build_plan(node.select, self.schema)
-            except TiDBTPUError:
-                inner = None
-            if inner is not None and DC.plan_is_correlated(inner):
-                from tidb_tpu.planner.apply import make_scalar_apply
-                return make_scalar_apply(self.subq, self.schema, inner)
-            if inner is not None:
-                # uncorrelated: execute the plan we just built instead of
-                # re-planning the AST through the eager path
-                ran = DC._run_uncorrelated(self, inner)
-                if ran is not None:
-                    rows, ftypes = ran
-                    return self._scalar_const(rows, ftypes)
+        from tidb_tpu.planner import decorrelate as DC
+        inner, correlated = self._build_sub(node.select)
+        if correlated:
+            from tidb_tpu.planner.apply import make_scalar_apply
+            return make_scalar_apply(self.subq, self.schema, inner)
+        if inner is not None:
+            # uncorrelated: execute the plan we just built instead of
+            # re-planning the AST through the eager path
+            ran = DC._run_uncorrelated(self, inner)
+            if ran is not None:
+                return self._scalar_const(*ran)
         rows, ftypes = self._run_eager(node.select)
         return self._scalar_const(rows, ftypes)
 
@@ -467,7 +454,18 @@ class ExpressionRewriter:
         e = self.rewrite(node.expr)
         if node.subquery is not None:
             self._require_subq()
-            rows, ftypes = self._run_eager(node.subquery.select)
+            from tidb_tpu.planner import decorrelate as DC
+            inner, correlated = self._build_sub(node.subquery.select)
+            if correlated:
+                from tidb_tpu.planner.apply import make_in_apply
+                return make_in_apply(self.subq, self.schema, inner, e,
+                                     node.negated)
+            if inner is not None:
+                ran = DC._run_uncorrelated(self, inner)
+            else:
+                ran = None
+            rows, ftypes = ran if ran is not None else \
+                self._run_eager(node.subquery.select)
             if len(ftypes) != 1:
                 raise PlanError("Operand should contain 1 column(s)")
             items = [Constant(r[0], ftypes[0]) for r in rows]
@@ -482,9 +480,35 @@ class ExpressionRewriter:
     def _exists(self, node: ast.ExistsExpr) -> Expression:
         self._require_subq()
         sel = node.subquery.select
+        from tidb_tpu.planner import decorrelate as DC
+        inner, correlated = self._build_sub(sel)
+        if correlated:
+            from tidb_tpu.planner.apply import _build_apply
+            mode = "not_exists" if node.negated else "exists"
+            return _build_apply(self.subq, self.schema, inner, mode, [],
+                                lit(1).ftype)
+        if inner is not None:
+            ran = DC._run_uncorrelated(self, inner)
+            if ran is not None:
+                val = bool(ran[0])
+                return lit(not val if node.negated else val)
         rows, _ = self._run_eager(sel)
         val = bool(rows)
         return lit(not val if node.negated else val)
+
+    def _build_sub(self, sel):
+        """Build `sel` with the current row schema visible → (plan,
+        correlated) or (None, False) when no plan builder is available.
+        Build errors (unknown columns, etc.) PROPAGATE — with the outer
+        schema in scope they are genuine, and swallowing them used to
+        surface as a misleading unknown-outer-column message."""
+        build_plan = getattr(self.subq, "build_plan", None) \
+            if self.subq is not None else None
+        if build_plan is None or not len(self.schema):
+            return None, False
+        from tidb_tpu.planner import decorrelate as DC
+        inner = build_plan(sel, self.schema)
+        return inner, DC.plan_is_correlated(inner)
 
     def _case(self, node: ast.CaseExpr) -> Expression:
         args: List[Expression] = []

@@ -88,7 +88,7 @@ def _subst_corr(e: Expression) -> Expression:
     if isinstance(e, CorrelatedRef):
         return ColumnRef(e.index, e.ftype, e.name)
     if isinstance(e, ScalarFunc):
-        return ScalarFunc(e.op, [_subst_corr(a) for a in e.args], e.ftype)
+        return e.rebuild([_subst_corr(a) for a in e.args])
     return e
 
 
@@ -100,8 +100,7 @@ def _shift_inner(e: Expression, delta: int) -> Expression:
     if isinstance(e, ColumnRef):
         return ColumnRef(e.index + delta, e.ftype, e.name)
     if isinstance(e, ScalarFunc):
-        return ScalarFunc(e.op, [_shift_inner(a, delta) for a in e.args],
-                          e.ftype)
+        return e.rebuild([_shift_inner(a, delta) for a in e.args])
     return e
 
 
@@ -309,7 +308,7 @@ def rewrite_scalar_cmp(builder, outer: LogicalPlan, op: str,
         if isinstance(e, ColumnRef):
             return ColumnRef(e.index + ng, e.ftype, e.name)
         if isinstance(e, ScalarFunc):
-            return ScalarFunc(e.op, [rebase(a) for a in e.args], e.ftype)
+            return e.rebuild([rebase(a) for a in e.args])
         return e
 
     def uses_count(e: Expression) -> bool:
