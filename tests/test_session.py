@@ -506,3 +506,12 @@ def test_auto_increment_guardrails():
     s.execute("TRUNCATE TABLE aig")
     s.execute("INSERT INTO aig (v) VALUES (99)")
     assert s.query("SELECT id FROM aig").rows == [(1,)]
+
+
+def test_show_databases_collation_charset():
+    from tidb_tpu.session import Engine
+    s = Engine().new_session()
+    assert ("test",) in s.query("SHOW DATABASES").rows
+    colls = [r[0] for r in s.query("SHOW COLLATION").rows]
+    assert "utf8mb4_general_ci" in colls and "utf8mb4_bin" in colls
+    assert s.query("SHOW CHARSET").rows[0][0] == "utf8mb4"

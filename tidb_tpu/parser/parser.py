@@ -842,6 +842,12 @@ class Parser:
             if word == "processlist":
                 self.advance()
                 return ast.ShowStmt("processlist")
+            if word == "collation":
+                self.advance()
+                return ast.ShowStmt("collation")
+            if word == "charset":
+                self.advance()
+                return ast.ShowStmt("charset")
             if word == "indexes" or word == "index" or word == "keys":
                 self.advance()
                 self.expect_kw("from")

@@ -265,7 +265,17 @@ def make_in_apply(subq, outer_schema, inner: LogicalPlan,
     if len(inner.schema) != 1:
         raise PlanError("Operand should contain 1 column(s)")
     mode = "not_in" if negated else "in"
+    # three-valued: no match + NULL in set → NULL
     return _build_apply(subq, outer_schema, inner, mode, [probe],
+                        lit(1).ftype.with_nullable(True))
+
+
+def make_exists_apply(subq, outer_schema, inner: LogicalPlan,
+                      negated: bool) -> ApplySubquery:
+    """Correlated [NOT] EXISTS as a VALUE expression (never NULL)."""
+    from tidb_tpu.expression import lit
+    mode = "not_exists" if negated else "exists"
+    return _build_apply(subq, outer_schema, inner, mode, [],
                         lit(1).ftype)
 
 

@@ -483,10 +483,9 @@ class ExpressionRewriter:
         from tidb_tpu.planner import decorrelate as DC
         inner, correlated = self._build_sub(sel)
         if correlated:
-            from tidb_tpu.planner.apply import _build_apply
-            mode = "not_exists" if node.negated else "exists"
-            return _build_apply(self.subq, self.schema, inner, mode, [],
-                                lit(1).ftype)
+            from tidb_tpu.planner.apply import make_exists_apply
+            return make_exists_apply(self.subq, self.schema, inner,
+                                     node.negated)
         if inner is not None:
             ran = DC._run_uncorrelated(self, inner)
             if ran is not None:

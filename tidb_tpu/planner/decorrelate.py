@@ -323,8 +323,9 @@ def rewrite_scalar_cmp(builder, outer: LogicalPlan, op: str,
                 return lit(0, e.ftype)
             return Constant(None, e.ftype.with_nullable(True))
         if isinstance(e, ScalarFunc):
-            return ScalarFunc(e.op, [empty_value(a) for a in e.args],
-                              e.ftype.with_nullable(True))
+            out = e.rebuild([empty_value(a) for a in e.args])
+            out.ftype = e.ftype.with_nullable(True)
+            return out
         return e
 
     value = rebase(value_expr)

@@ -1320,6 +1320,28 @@ class Session:
             rows = self.engine.auth.show_grants(target)
             return ResultSet([f"Grants for {target}@%"], [T.varchar()],
                              rows)
+        if stmt.kind == "databases":
+            return ResultSet(["Database"], [T.varchar()],
+                             [("test",), ("information_schema",),
+                              ("mysql",)])
+        if stmt.kind == "collation":
+            from tidb_tpu.types import BIN_COLLATIONS, CI_COLLATIONS
+            names = sorted((set(CI_COLLATIONS) | set(BIN_COLLATIONS))
+                           - {"binary"})
+            rows = [(c, c.split("_")[0], i + 1,
+                     "Yes" if c == "utf8mb4_bin" else "",
+                     "Yes", 1)
+                    for i, c in enumerate(names)]
+            return ResultSet(
+                ["Collation", "Charset", "Id", "Default", "Compiled",
+                 "Sortlen"],
+                [T.varchar(), T.varchar(), T.bigint(), T.varchar(),
+                 T.varchar(), T.bigint()], rows)
+        if stmt.kind == "charset":
+            return ResultSet(
+                ["Charset", "Description", "Default collation", "Maxlen"],
+                [T.varchar()] * 3 + [T.bigint()],
+                [("utf8mb4", "UTF-8 Unicode", "utf8mb4_bin", 4)])
         if stmt.kind == "tables":
             rows = [(t.name,) for t in info_schema.list_tables()
                     if not t.name.startswith("#")]   # hide CTE temps
