@@ -69,3 +69,26 @@ def test_non_superuser_can_read_infoschema(s):
     s2.user = "viewer"
     assert s2.query("SELECT COUNT(*) FROM information_schema.tables"
                     ).scalar() >= 2
+
+
+def test_statements_endpoint():
+    import json
+    import urllib.request
+    from tidb_tpu.session import Engine
+    from tidb_tpu.util.status_server import StatusServer
+    eng = Engine()
+    s = eng.new_session()
+    s.execute("CREATE TABLE se (a BIGINT)")
+    s.execute("INSERT INTO se VALUES (1)")
+    for _ in range(3):
+        s.query("SELECT COUNT(*) FROM se")
+    srv = StatusServer(eng, port=0).start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}/statements"
+        data = json.load(urllib.request.urlopen(url))
+        assert data == sorted(data, key=lambda r: -r["sum_s"])
+        hit = [r for r in data if "se" in r["digest"].lower()
+               and "count" in r["digest"].lower()]
+        assert hit and any(r["count"] >= 3 for r in hit), data
+    finally:
+        srv.stop()

@@ -1,8 +1,9 @@
 """HTTP status/metrics endpoint (ref: server/http_status.go:193).
 
-Serves the reference's two load-bearing routes:
-  /metrics  — Prometheus text format from util/observability.REGISTRY;
-  /status   — JSON liveness blob (version, connections, ddl history).
+Serves the reference's load-bearing routes:
+  /metrics     — Prometheus text from util/observability.REGISTRY;
+  /status      — JSON liveness blob (version, connections, ddl history);
+  /statements  — per-digest cumulative time, heaviest first (TopSQL-lite).
 """
 
 from __future__ import annotations
@@ -29,6 +30,18 @@ class StatusServer:
                     self.send_response(200)
                     self.send_header("Content-Type",
                                      "text/plain; version=0.0.4")
+                elif self.path == "/statements":
+                    # TopSQL-lite: per-digest cumulative wall time,
+                    # heaviest first (summary_rows already orders by
+                    # -sum_s; util/topsql + statements_summary analog
+                    # over HTTP, server/http_status.go:279)
+                    rows = REGISTRY.summary_rows()
+                    body = json.dumps([
+                        {"digest": d, "count": c, "sum_s": ss,
+                         "avg_s": a, "max_s": mx, "rows": rw}
+                        for d, c, ss, a, mx, rw in rows]).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
                 elif self.path == "/status":
                     payload = {"version": "tidb-tpu", "status": "ok"}
                     if eng is not None:
