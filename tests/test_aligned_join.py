@@ -1,0 +1,124 @@
+"""FK-aligned join cache (executor/device_cache.AlignedJoin): PK-FK joins
+served as pure streams over cached fact-rowspace build columns — the
+coprocessor-cache idea (ref: store/copr/coprocessor_cache.go) applied to
+join structures. Covers: activation, filter independence, all join kinds,
+snowflake chains in both join orders, NULL/missing keys, non-unique
+fallback with negative caching, and DML invalidation."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.executor import device_cache
+from tidb_tpu.session import Engine
+
+
+def _on(s):
+    s.vars.update(tidb_tpu_engine="on", tidb_tpu_row_threshold=1,
+                  tidb_tpu_strict="on")
+
+
+def _off(s):
+    s.vars.update(tidb_tpu_engine="off", tidb_tpu_strict="off")
+
+
+def _check(s, sql):
+    _off(s)
+    want = s.query(sql).rows
+    _on(s)
+    try:
+        got = s.query(sql).rows
+    finally:
+        _off(s)
+    assert sorted(map(str, got)) == sorted(map(str, want)), sql
+    return want
+
+
+@pytest.fixture(scope="module")
+def s():
+    eng = Engine()
+    s = eng.new_session()
+    s.execute("CREATE TABLE c (ck BIGINT PRIMARY KEY, seg VARCHAR(8), "
+              "nation BIGINT)")
+    s.execute("CREATE TABLE o (ok BIGINT PRIMARY KEY, ck BIGINT, d BIGINT, "
+              "prio VARCHAR(4))")
+    s.execute("CREATE TABLE l (lk BIGINT, price BIGINT, sd BIGINT)")
+    rng = np.random.default_rng(11)
+    NC, NO, NL = 300, 3000, 60000
+    s.execute("INSERT INTO c VALUES " + ",".join(
+        f"({i},'s{int(rng.integers(0, 5))}',{int(rng.integers(0, 20))})"
+        for i in range(NC)))
+    s.execute("INSERT INTO o VALUES " + ",".join(
+        f"({i},{int(rng.integers(0, NC))},{int(rng.integers(0, 100))},"
+        f"'p{int(rng.integers(0, 4))}')" for i in range(NO)))
+    vals = []
+    for i in range(NL):
+        k = "NULL" if i % 997 == 0 else (
+            999999 if i % 499 == 0 else int(rng.integers(0, NO)))
+        vals.append(f"({k},{int(rng.integers(0, 1000))},"
+                    f"{int(rng.integers(0, 100))})")
+    s.execute("INSERT INTO l VALUES " + ",".join(vals))
+    for t in ("c", "o", "l"):
+        s.execute(f"ANALYZE TABLE {t}")
+    return s
+
+
+def test_aligned_activates_and_matches_cpu(s):
+    device_cache.clear()
+    _check(s, "SELECT prio, COUNT(*), SUM(price) FROM l JOIN o ON lk = ok "
+              "WHERE sd < 50 AND d < 70 GROUP BY prio ORDER BY prio")
+    assert any(e.unique for e in device_cache._ALIGNED.values()), \
+        "PK-FK join should populate the aligned cache"
+
+
+def test_aligned_filter_independence(s):
+    # one cached structure serves every filter variant (no rebuild)
+    _check(s, "SELECT COUNT(*) FROM l JOIN o ON lk = ok WHERE d < 10")
+    n = len(device_cache._ALIGNED)
+    _check(s, "SELECT COUNT(*) FROM l JOIN o ON lk = ok WHERE d >= 90")
+    _check(s, "SELECT prio, SUM(price) FROM l JOIN o ON lk = ok "
+              "GROUP BY prio")
+    assert len(device_cache._ALIGNED) == n
+
+
+def test_aligned_join_kinds(s):
+    _check(s, "SELECT COUNT(*), SUM(d) FROM l LEFT JOIN o ON lk = ok")
+    _check(s, "SELECT COUNT(*) FROM l WHERE lk IN "
+              "(SELECT ok FROM o WHERE d < 30)")
+    _check(s, "SELECT COUNT(*) FROM l WHERE lk NOT IN (SELECT ok FROM o)")
+
+
+def test_aligned_snowflake_chain(s):
+    # (c ⋈ o) ⋈ l — the dimensions-first order the reorderer prefers:
+    # the inner join re-anchors to the fact row space recursively
+    device_cache.clear()
+    _check(s, "SELECT seg, COUNT(*), SUM(price) FROM l JOIN o ON lk = ok "
+              "JOIN c ON o.ck = c.ck WHERE sd < 80 GROUP BY seg "
+              "ORDER BY seg")
+    kinds = sorted(k[1][0] for k in device_cache._ALIGNED)
+    assert kinds == ["al", "col"], kinds   # chained entry + base entry
+    # deeper filter on the outermost dimension
+    _check(s, "SELECT COUNT(*) FROM l JOIN o ON lk = ok "
+              "JOIN c ON o.ck = c.ck WHERE nation < 5 AND d < 50")
+
+
+def test_aligned_non_unique_falls_back(s):
+    s2 = s
+    _off(s2)
+    s2.execute("CREATE TABLE dup (k BIGINT, v BIGINT)")
+    s2.execute("INSERT INTO dup VALUES " + ",".join(
+        f"({i % 50},{i})" for i in range(200)))
+    s2.execute("ANALYZE TABLE dup")
+    _check(s2, "SELECT COUNT(*), SUM(v) FROM l JOIN dup ON lk = k")
+    neg = [e for e in device_cache._ALIGNED.values() if not e.unique]
+    assert len(neg) == 1, "non-unique build must cache the negative result"
+
+
+def test_aligned_dml_invalidation(s):
+    sql = ("SELECT prio, COUNT(*), SUM(price) FROM l JOIN o ON lk = ok "
+           "WHERE d < 70 GROUP BY prio ORDER BY prio")
+    _check(s, sql)
+    _off(s)
+    s.execute("UPDATE o SET d = 0 WHERE ok < 500")
+    _check(s, sql)                       # fresh data, fresh structures
+    s.execute("DELETE FROM o WHERE ok >= 2900")
+    _check(s, sql)                       # FK rows now missing build matches

@@ -386,3 +386,30 @@ def test_extract():
                 "EXTRACT(day FROM d), EXTRACT(minute FROM d), "
                 "EXTRACT(microsecond FROM d) FROM ex").rows[0]
     assert r == (2024, 1, 15, 30, 123456)
+
+
+def test_advisor_r4_fixes():
+    """Round-4 advisor findings: UUID() not constant-folded (distinct per
+    row), INET_ATON malformed → NULL (builtin_miscellaneous.go)."""
+    from tidb_tpu.session import Engine
+    s = Engine().new_session()
+    s.execute("CREATE TABLE adv (a BIGINT)")
+    s.execute("INSERT INTO adv VALUES (1),(2),(3)")
+    uuids = [r[0] for r in s.query("SELECT UUID() FROM adv").rows]
+    assert len(set(uuids)) == 3
+    # and a second execution (cached plan) yields fresh values
+    uuids2 = [r[0] for r in s.query("SELECT UUID() FROM adv").rows]
+    assert not set(uuids) & set(uuids2)
+    r = s.query("SELECT INET_ATON('256.1.1.1'), INET_ATON('abc'), "
+                "INET_ATON('1.2.3.4') FROM adv LIMIT 1").rows[0]
+    assert r == (None, None, 16909060)
+
+
+def test_nondeterministic_fold_propagates():
+    # wrapping UUID() must not re-enable constant folding (UPPER(UUID()))
+    from tidb_tpu.session import Engine
+    s = Engine().new_session()
+    s.execute("CREATE TABLE nf (a BIGINT)")
+    s.execute("INSERT INTO nf VALUES (1),(2),(3)")
+    got = [r[0] for r in s.query("SELECT UPPER(UUID()) FROM nf").rows]
+    assert len(set(got)) == 3

@@ -180,3 +180,35 @@ def test_now_honors_time_zone():
     assert 9 * 3600 - 5 <= delta <= 9 * 3600 + 5
     utcnow = dt.datetime.now(dt.timezone.utc).replace(tzinfo=None)
     assert abs((a - utcnow).total_seconds()) < 5
+
+
+def test_ci_like(s):
+    # LIKE honors ci collation on the host kernel… (advisor r4, high)
+    assert s.query("SELECT COUNT(*) FROM ci WHERE name LIKE 'alph%'"
+                   ).rows[0][0] == 3
+    assert s.query("SELECT COUNT(*) FROM ci WHERE name LIKE '%ETA'"
+                   ).rows[0][0] == 2
+    # …while binary columns stay case-sensitive
+    assert s.query("SELECT COUNT(*) FROM ci WHERE tag LIKE 'X%'"
+                   ).rows[0][0] == 0
+
+
+def test_ci_like_device():
+    eng = Engine()
+    s2 = eng.new_session()
+    s2.execute("CREATE TABLE dlk (name VARCHAR(8) COLLATE "
+               "utf8mb4_general_ci, v BIGINT)")
+    names = ["Red", "RED", "red", "Blue", "BLUE", "green"]
+    rng = np.random.default_rng(4)
+    s2.execute("INSERT INTO dlk VALUES " + ",".join(
+        f"('{names[int(rng.integers(0, 6))]}',{i})" for i in range(20000)))
+    s2.execute("ANALYZE TABLE dlk")
+    sql = "SELECT COUNT(*), SUM(v) FROM dlk WHERE name LIKE 'red%'"
+    want = s2.query(sql).rows
+    s2.vars.update(tidb_tpu_engine="on", tidb_tpu_row_threshold=1,
+                   tidb_tpu_strict="on")
+    try:
+        got = s2.query(sql).rows
+    finally:
+        s2.vars.update(tidb_tpu_engine="off", tidb_tpu_strict="off")
+    assert got == want and want[0][0] > 0

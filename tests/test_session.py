@@ -458,6 +458,9 @@ def test_auto_increment_basics():
     # SHOW CREATE carries the attribute
     ddl = s.query("SHOW CREATE TABLE ai").rows[0][1]
     assert "AUTO_INCREMENT" in ddl
+    # explicit 0 allocates (NO_AUTO_VALUE_ON_ZERO off — MySQL default)
+    s.execute("INSERT INTO ai VALUES (0,'g')")
+    assert s.query("SELECT id FROM ai WHERE v = 'g'").rows[0][0] == 103
 
 
 def test_auto_increment_survives_restore(tmp_path):

@@ -70,6 +70,12 @@ class DistTreeProgram(TreeProgram):
             out_specs=out_specs,
             check_rep=False))
 
+    def __call__(self, scan_inputs, scan_rows, prep_vals,
+                 aligned_inputs=()):
+        # the dist path keeps the 3-arg shard_map signature (FK-aligned
+        # join structures are a single-chip cache)
+        return self.run(scan_inputs, scan_rows, prep_vals)
+
     # -- traced per-shard body ----------------------------------------------
     def _run(self, scan_inputs, scan_rows, prep_vals):
         from tidb_tpu.ops.jax_env import jnp, lax

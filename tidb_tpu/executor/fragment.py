@@ -44,9 +44,10 @@ from tidb_tpu.chunk import Chunk, Column
 from tidb_tpu.errors import ExecutionError
 from tidb_tpu.expression import EvalContext, Expression, ColumnRef
 from tidb_tpu.expression.aggfuncs import AggFunc, build_agg
-from tidb_tpu.planner.physical import (PhysHashAgg, PhysProjection,
-                                       PhysSelection, PhysSort, PhysTableScan,
-                                       PhysTopN, PhysTpuFragment, PhysWindow,
+from tidb_tpu.planner.physical import (PhysHashAgg, PhysHashJoin,
+                                       PhysProjection, PhysSelection,
+                                       PhysSort, PhysTableScan, PhysTopN,
+                                       PhysTpuFragment, PhysWindow,
                                        PhysicalPlan)
 from tidb_tpu.types import FieldType
 
@@ -601,6 +602,222 @@ def _agg_key_bounds(chain: List[PhysicalPlan], ent) -> Optional[List[Tuple[int, 
 # ---------------------------------------------------------------------------
 
 
+def _plan_aligned_joins(ctx, root, scans, ents):
+    """Serve PK-FK joins from the FK-aligned device cache where possible
+    (device_cache.AlignedJoin — the join-index/coprocessor-cache analog).
+
+    Eligible: single equi key, both sides bare ColumnRefs, the build
+    subtree anchored (through its probe chain) on a scan whose key column
+    has cached (lo, hi) bounds, and the probe key resolving to the fact
+    scan's row space. Chains compose BOTH ways: through earlier aligned
+    joins in the probe subtree ((l⋈o)⋈c — Q5's o_custkey as an aligned
+    column) and through joins nested in the build subtree ((c⋈o)⋈l, the
+    dimensions-first order the join reorderer prefers) — in the latter
+    case every inner join is recursively re-anchored to the fact row
+    space, and the whole attempt aborts unless all of them align (a
+    non-aligned inner join could flip to expand mode at runtime and break
+    the row-space invariant). Build-key uniqueness is VERIFIED at cache
+    build, so aligned joins never lose runtime bets; a non-unique build
+    caches the negative result and keeps the standard LUT/sort modes.
+
+    → {id(join): {entry, build_scan, build_ent, cols}}"""
+    from tidb_tpu.executor import device_cache
+    from tidb_tpu.executor import tree_fragment as TF
+    if getattr(ctx, "txn", None) is not None:
+        return {}
+    if not _var_bool(ctx.vars.get("tidb_tpu_aligned_join", True)):
+        return {}
+    store = getattr(ctx.snapshot, "store", None)
+    if store is None:
+        return {}
+    ents_by_scan = {id(s): e for s, (e, _) in zip(scans, ents)}
+    info_by_join: Dict[int, dict] = {}
+    # id(anchor scan) → (entry, anchor ent): scans substituted by an outer
+    # aligned join — references to their columns resolve to aligned arrays
+    anchor_subs: Dict[int, tuple] = {}
+
+    def aligned_ref(entry, a_ent, idx):
+        """(entry, col) → resolve() result tuple, or None."""
+        if a_ent.dicts.get(idx) is not None:
+            return None
+        slabs = device_cache.aligned_col(entry, a_ent, idx)
+        if any(v.ndim != 1 for v, _ in slabs):
+            return None
+        return ([v for v, _ in slabs], [m for _, m in slabs],
+                ("al", entry.key, idx), dict(entry.tds))
+
+    def resolve(nodeP, idx):
+        """Probe key column → (codes_slabs, valid_slabs, sig, tds) in the
+        fact scan's row space, or None."""
+        while True:
+            if isinstance(nodeP, PhysTableScan):
+                sub = anchor_subs.get(id(nodeP))
+                if sub is not None:
+                    return aligned_ref(sub[0], sub[1], idx)
+                ent = ents_by_scan.get(id(nodeP))
+                if ent is None or idx not in ent.dev:
+                    return None
+                if ent.dicts.get(idx) is not None:
+                    return None        # string probe key: KeyRemap path
+                slabs = ent.dev[idx]
+                if any(v.ndim != 1 for v, _ in slabs):
+                    return None        # wide-decimal planes can't be keys
+                return ([v for v, _ in slabs], [m for _, m in slabs],
+                        ("col", nodeP.table.id, idx),
+                        {nodeP.table.id:
+                         ctx.snapshot.table_data(nodeP.table.id)})
+            if isinstance(nodeP, PhysSelection):
+                nodeP = nodeP.children[0]
+                continue
+            if isinstance(nodeP, PhysProjection):
+                e = nodeP.exprs[idx] if idx < len(nodeP.exprs) else None
+                if not isinstance(e, ColumnRef):
+                    return None
+                idx = e.index
+                nodeP = nodeP.children[0]
+                continue
+            if isinstance(nodeP, PhysHashJoin):
+                j = nodeP
+                bi = 1 if j.build_right else 0
+                if j.kind in ("semi", "anti"):
+                    # semi/anti preserve the probe row space in EVERY mode
+                    nodeP = j.children[1 - bi]
+                    continue
+                if id(j) not in info_by_join:
+                    # a non-aligned inner/outer join may flip to expand
+                    # mode at runtime, breaking the row-space invariant —
+                    # crossing it (either side) is only safe once aligned
+                    return None
+                nl = len(j.children[0].schema)
+                if j.build_right:
+                    if idx < nl:       # probe (left) side column
+                        nodeP = j.children[0]
+                        continue
+                    b_out_idx = idx - nl
+                else:
+                    if idx >= nl:      # probe (right) side column
+                        idx -= nl
+                        nodeP = j.children[1]
+                        continue
+                    b_out_idx = idx
+                info = info_by_join[id(j)]
+                hit = TF._trace_scan_col(j.children[bi], b_out_idx)
+                if hit is None:
+                    return None
+                bscan2, c2 = hit
+                if bscan2 is not info["build_scan"]:
+                    return None
+                return aligned_ref(info["entry"], info["build_ent"], c2)
+            return None
+
+    def trace_col_probewise(node, idx):
+        """Column index → (anchor scan, scan col), crossing joins via
+        their probe side only (semi/anti emit the probe side verbatim)."""
+        while True:
+            if isinstance(node, PhysTableScan):
+                return node, idx
+            if isinstance(node, PhysSelection):
+                node = node.children[0]
+                continue
+            if isinstance(node, PhysProjection):
+                e = node.exprs[idx] if idx < len(node.exprs) else None
+                if not isinstance(e, ColumnRef):
+                    return None
+                idx = e.index
+                node = node.children[0]
+                continue
+            if isinstance(node, PhysHashJoin):
+                bi = 1 if node.build_right else 0
+                if node.kind in ("semi", "anti"):
+                    node = node.children[1 - bi]
+                    continue
+                nl = len(node.children[0].schema)
+                if node.build_right:
+                    if idx >= nl:
+                        return None    # build-side column: not probewise
+                    node = node.children[0]
+                else:
+                    if idx < nl:
+                        return None
+                    idx -= nl
+                    node = node.children[1]
+                continue
+            return None
+
+    def try_align(jnode) -> bool:
+        if len(jnode.equi) != 1:
+            return False
+        bkeys, pkeys = TF.join_key_exprs(jnode)
+        bk, pk = bkeys[0], pkeys[0]
+        if not (isinstance(bk, ColumnRef) and isinstance(pk, ColumnRef)):
+            return False               # casts / KeyRemap: standard modes
+        bi = 1 if jnode.build_right else 0
+        build, probe = jnode.children[bi], jnode.children[1 - bi]
+        # the SAME traversal _emit_join_aligned uses to find the scan to
+        # substitute — planner and trace cannot disagree on the anchor
+        anchor, crossed = TF.aligned_chain(build)
+        if anchor is None:
+            return False
+        bhit = trace_col_probewise(build, bk.index)
+        if bhit is None or bhit[0] is not anchor:
+            return False
+        bcol = bhit[1]
+        build_ent = ents_by_scan.get(id(anchor))
+        if build_ent is None or build_ent.dicts.get(bcol) is not None:
+            return False               # string build key: v1 skips
+        bounds = build_ent.bounds.get(bcol)
+        if bounds is None:
+            return False
+        src = resolve(probe, pk.index)
+        if src is None:
+            return False
+        codes, valids, sig, tds = src
+        slab_cap, n_slabs = int(codes[0].shape[-1]), len(codes)
+        key = (id(store), sig, anchor.table.id, bcol)
+        tds[anchor.table.id] = ctx.snapshot.table_data(anchor.table.id)
+        entry = device_cache.get_aligned(
+            ctx, key, tds, codes, valids, build_ent, bcol, bounds,
+            slab_cap, n_slabs)
+        if entry is None:
+            return False
+        used = anchor.used_columns or list(range(len(anchor.schema)))
+        cols = {i: device_cache.aligned_col(entry, build_ent, i)
+                for i in used}
+        info_by_join[id(jnode)] = {"entry": entry, "build_scan": anchor,
+                                   "build_ent": build_ent, "cols": cols}
+        anchor_subs[id(anchor)] = (entry, build_ent)
+        # every join inside the build subtree must re-anchor to the fact
+        # row space (all-or-nothing: see docstring)
+        for K in crossed:
+            if not try_align(K):
+                return False
+        return True
+
+    # parents first, iterated to a fixpoint: a build-side chain claims its
+    # inner joins in one recursive attempt, while a probe-side chain's
+    # outer join only becomes resolvable after its inner join aligns in a
+    # previous pass
+    changed = True
+    while changed:
+        changed = False
+        for node in reversed(TF._walk_joins(root)):
+            if id(node) in info_by_join:
+                continue
+            saved_info = dict(info_by_join)
+            saved_subs = dict(anchor_subs)
+            if try_align(node):
+                changed = True
+            else:
+                info_by_join.clear()
+                info_by_join.update(saved_info)
+                anchor_subs.clear()
+                anchor_subs.update(saved_subs)
+    if info_by_join:
+        device_cache.aligned_budget_check(
+            ctx, {i["entry"].key for i in info_by_join.values()})
+    return info_by_join
+
+
 class TpuFragmentExec:
     """Volcano leaf running the fused device program (built by executor
     build(), the builder.go:144 seam)."""
@@ -806,6 +1023,22 @@ class TpuFragmentExec:
         flow_list = [flows.get(id(n), []) for n in TF._walk_nodes(root)]
         is_agg = isinstance(root, PhysHashAgg)
         join_cfgs = TF.plan_join_configs(root, scan_bounds)
+        # FK-aligned joins: verified-unique PK-FK joins run as pure streams
+        # over cached fact-rowspace build columns (no per-query gathers)
+        aligned_info = _plan_aligned_joins(self.ctx, root, scans, ents)
+        walk_joins = TF._walk_joins(root)
+        aligned_inputs = []
+        for ji, jn in enumerate(walk_joins):
+            info = aligned_info.get(id(jn))
+            if info is None:
+                aligned_inputs.append(((), {}))
+                continue
+            join_cfgs[ji] = TF.JoinCfg(
+                "aligned", aligned_cols=tuple(sorted(info["cols"])))
+            aligned_inputs.append(
+                (tuple(info["entry"].matched),
+                 {c: tuple(s) for c, s in info["cols"].items()}))
+        aligned_inputs = tuple(aligned_inputs)
         akb = TF.tree_agg_key_bounds(root, scan_bounds, DOMAIN_CAP) \
             if is_agg else None
         if akb is not None:
@@ -820,7 +1053,7 @@ class TpuFragmentExec:
         while True:
             prog = get_tree_program(root, caps, gcap, join_cfgs, akb)
             prep_vals = prog.collect_preps(flow_list)
-            out = prog(scan_inputs, scan_rows, prep_vals)
+            out = prog(scan_inputs, scan_rows, prep_vals, aligned_inputs)
             fetch = {"ju": out["join_unique"], "jt": out["join_totals"]}
             host = None
             if is_agg:

@@ -317,6 +317,34 @@ def _walk_joins(plan: PhysicalPlan) -> List[PhysHashJoin]:
     return [n for n in _walk_nodes(plan) if isinstance(n, PhysHashJoin)]
 
 
+def aligned_chain(build: PhysicalPlan
+                  ) -> Tuple[Optional[PhysTableScan], List[PhysHashJoin]]:
+    """The build subtree's probe-chain anchor scan — the scan an aligned
+    join substitutes with FK-aligned fact-rowspace columns — plus every
+    join crossed on the way (outermost first). Follows Sel/Proj and each
+    nested join's PROBE child (the rowspace-preserving side). The ONE
+    traversal both the planner (fragment._plan_aligned_joins) and the
+    trace (_emit_join_aligned) use, so they cannot disagree on the
+    anchor."""
+    node = build
+    crossed: List[PhysHashJoin] = []
+    while True:
+        if isinstance(node, PhysTableScan):
+            return node, crossed
+        if isinstance(node, (PhysSelection, PhysProjection)):
+            node = node.children[0]
+            continue
+        if isinstance(node, PhysHashJoin):
+            crossed.append(node)
+            node = node.children[0 if node.build_right else 1]
+            continue
+        return None, crossed
+
+
+def aligned_anchor(build: PhysicalPlan) -> Optional[PhysTableScan]:
+    return aligned_chain(build)[0]
+
+
 # ---------------------------------------------------------------------------
 # Per-join execution configuration (planner bet + runtime adaptation)
 # ---------------------------------------------------------------------------
@@ -324,11 +352,14 @@ def _walk_joins(plan: PhysicalPlan) -> List[PhysHashJoin]:
 
 @dataclass(frozen=True)
 class JoinCfg:
-    mode: str                                     # 'unique' | 'expand'
+    mode: str                                # 'unique' | 'expand' | 'aligned'
     out_cap: int = 0                              # expand-mode output shape
     bounds: Optional[Tuple[Tuple[int, int], ...]] = None   # LUT key bounds
     domain: int = 0                               # LUT table size
     est: int = 0                                  # planner output estimate
+    # aligned mode: build-scan columns arriving as FK-aligned device inputs
+    # (executor/device_cache.AlignedJoin) — static, part of the trace
+    aligned_cols: Optional[Tuple[int, ...]] = None
 
 
 def _bounds_list(node: PhysicalPlan, scan_bounds
@@ -483,8 +514,8 @@ def tree_signature(plan: PhysicalPlan, caps: Dict[int, Tuple[int, int]],
             ji += 1
             # est is host-side-only (seeds the retry out_cap) — keep it out
             # of the cache key or estimate drift forces spurious recompiles
-            cfg_s = (f"{cfg.mode},{cfg.out_cap},{cfg.bounds},{cfg.domain}"
-                     if cfg else None)
+            cfg_s = (f"{cfg.mode},{cfg.out_cap},{cfg.bounds},{cfg.domain},"
+                     f"{cfg.aligned_cols}" if cfg else None)
             parts.append(f"Join({node.kind}, build_right={node.build_right},"
                          f" equi={node.equi!r}, "
                          f"other={node.other_conditions!r}, cfg={cfg_s})")
@@ -539,6 +570,7 @@ class TreeProgram:
         if join_cfgs is None:
             join_cfgs = [JoinCfg("unique") for _ in joins]
         self.join_cfgs = {id(n): c for n, c in zip(joins, join_cfgs)}
+        self.join_order = {id(n): i for i, n in enumerate(joins)}
         self.scan_order = _scans(plan)
         if isinstance(plan, PhysHashAgg):
             self.aggs = [build_agg(d) for d in plan.aggs]
@@ -566,12 +598,14 @@ class TreeProgram:
         return vals
 
     # -- trace ---------------------------------------------------------------
-    def _run(self, scan_inputs, scan_rows, prep_vals):
+    def _run(self, scan_inputs, scan_rows, prep_vals, aligned_inputs=()):
         self._prepared = {id(n): v
                           for n, v in zip(self.prep_nodes, prep_vals)
                           if v is not None}
         self._join_unique_flags = []
         self._join_totals = []
+        self._aligned_inputs = aligned_inputs
+        self._scan_sub = {}   # id(scan) → (cols, live0): FK-aligned build
         cols, live = self._emit(self.plan, scan_inputs, scan_rows)
         return self._finish(cols, live)
 
@@ -587,6 +621,16 @@ class TreeProgram:
         aligned (unused columns ride as None)."""
         from tidb_tpu.ops.jax_env import jnp
         if isinstance(node, PhysTableScan):
+            sub = self._scan_sub.get(id(node))
+            if sub is not None:
+                # FK-aligned build scan: columns already live in the fact
+                # row space; live starts from the match mask
+                col_list, live = sub
+                ctx = self._ctx(col_list)
+                for f in node.filters:
+                    v, m = f.eval(ctx)
+                    live = live & (v != 0) & m
+                return list(col_list), live
             slot = next(i for i, s in enumerate(self.scan_order)
                         if s is node)
             in_cols = scan_inputs[slot]
@@ -642,6 +686,9 @@ class TreeProgram:
         from tidb_tpu.ops.jax_env import jnp
         from tidb_tpu.ops import join as J
         cfg = self.join_cfgs[id(node)]
+        if cfg.mode == "aligned":
+            return self._emit_join_aligned(node, cfg, scan_inputs,
+                                           scan_rows)
         lcols, llive = self._emit(node.children[0], scan_inputs, scan_rows)
         rcols, rlive = self._emit(node.children[1], scan_inputs, scan_rows)
         if node.build_right:
@@ -692,6 +739,62 @@ class TreeProgram:
         self._join_unique_flags.append(jnp.bool_(True))
         return self._finish_join_expand(node, cfg, bcols, pcols, plive,
                                         start, count, order)
+
+    def _emit_join_aligned(self, node: PhysHashJoin, cfg: JoinCfg,
+                           scan_inputs, scan_rows):
+        """FK-aligned join: the build side's columns arrive pre-gathered
+        into the fact row space (device_cache.AlignedJoin), so the join is
+        ZERO device work beyond evaluating the build side's filters on the
+        aligned columns. Probe rowspace is preserved exactly — unique-mode
+        semantics with an identity gather."""
+        from tidb_tpu.ops.jax_env import jnp
+        bi = 1 if node.build_right else 0
+        build, probe = node.children[bi], node.children[1 - bi]
+        ji = self.join_order[id(node)]
+        matched_slabs, col_slabs = self._aligned_inputs[ji]
+        matched = (matched_slabs[0] if len(matched_slabs) == 1
+                   else jnp.concatenate(list(matched_slabs)))
+        bscan = aligned_anchor(build)
+        sub_cols = []
+        for i in range(len(bscan.schema)):
+            c = col_slabs.get(i)
+            if c is None:
+                sub_cols.append(None)
+            elif len(c) == 1:
+                sub_cols.append(c[0])
+            else:
+                sub_cols.append(
+                    (jnp.concatenate([s[0] for s in c], axis=-1),
+                     jnp.concatenate([s[1] for s in c])))
+        pcols, plive = self._emit(probe, scan_inputs, scan_rows)
+        self._scan_sub[id(bscan)] = (sub_cols, matched)
+        try:
+            bcols, bmatched = self._emit(build, scan_inputs, scan_rows)
+        finally:
+            del self._scan_sub[id(bscan)]
+        self._join_unique_flags.append(jnp.bool_(True))
+        self._join_totals.append(jnp.int64(0))
+
+        joined = (list(pcols) + list(bcols) if node.build_right
+                  else list(bcols) + list(pcols))
+        if node.other_conditions:
+            jctx = self._ctx(joined)
+            for cond in node.other_conditions:
+                v, m = cond.eval(jctx)
+                bmatched = bmatched & (v != 0) & m
+        if node.kind == "semi":
+            return list(pcols), plive & bmatched
+        if node.kind == "anti":
+            return list(pcols), plive & jnp.logical_not(bmatched)
+        # null-extend build columns wherever the match (or its filters /
+        # conditions) failed — correct for outer, harmless for inner
+        bcols = [None if c is None else (c[0], c[1] & bmatched)
+                 for c in bcols]
+        joined = (list(pcols) + list(bcols) if node.build_right
+                  else list(bcols) + list(pcols))
+        if node.kind == "inner":
+            return joined, plive & bmatched
+        return joined, plive     # left/right outer: probe side preserved
 
     def _finish_join_unique(self, node, bcols, pcols, plive, match_idx,
                             matched):
@@ -840,8 +943,9 @@ class TreeProgram:
         return {"cols": [(jnp.asarray(v), jnp.asarray(m))
                          for v, m in cols], "live": live, **out_flags}
 
-    def __call__(self, scan_inputs, scan_rows, prep_vals):
-        return self.run(scan_inputs, scan_rows, prep_vals)
+    def __call__(self, scan_inputs, scan_rows, prep_vals,
+                 aligned_inputs=()):
+        return self.run(scan_inputs, scan_rows, prep_vals, aligned_inputs)
 
 
 def dictionary_flows(plan: PhysicalPlan,

@@ -1014,8 +1014,12 @@ def _like(func, ctx):
     v, m = func.args[0].eval(ctx)
     pat = func.args[1]
     assert isinstance(pat, Constant), "LIKE pattern must be a constant"
+    # ci collations match case-insensitively; the device ci dictionary
+    # keeps one arbitrary-case representative per fold class, so
+    # IGNORECASE is also what keeps host/device answers identical
+    ci = re.IGNORECASE if getattr(func.args[0].ftype, "is_ci", False) else 0
     if not ctx.on_device:
-        rx = re.compile(_like_to_regex(str(pat.value)), re.DOTALL)
+        rx = re.compile(_like_to_regex(str(pat.value)), re.DOTALL | ci)
         out = np.fromiter((rx.match(str(x)) is not None for x in v),
                           dtype=bool, count=len(v))
         return out, m
@@ -1032,7 +1036,8 @@ def _prepare_like(func: ScalarFunc, dictionaries):
     d = dictionaries[col.index]
     if d is None:
         return None
-    rx = re.compile(_like_to_regex(str(func.args[1].value)), re.DOTALL)
+    ci = re.IGNORECASE if getattr(col.ftype, "is_ci", False) else 0
+    rx = re.compile(_like_to_regex(str(func.args[1].value)), re.DOTALL | ci)
     return np.fromiter((rx.match(str(s)) is not None for s in d),
                        dtype=bool, count=len(d))
 
@@ -1423,7 +1428,7 @@ def _inet_aton(func, ctx):
         parts = str(s).split(".")
         if not 1 <= len(parts) <= 4 or \
                 not all(p.isdigit() and int(p) < 256 for p in parts):
-            return 0
+            return None  # MySQL: malformed address → NULL, not 0
         n = 0
         for p in parts[:-1]:
             n = (n << 8) | int(p)

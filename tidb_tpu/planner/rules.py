@@ -53,13 +53,23 @@ def logical_optimize(plan: LogicalPlan, ctx=None) -> LogicalPlan:
 # ---------------------------------------------------------------------------
 
 
+_NONFOLDABLE = frozenset(("uuid", "rand", "random_bytes", "uuid_short"))
+
+
 def fold_expr(e: Expression) -> Expression:
     if isinstance(e, Constant) or isinstance(e, ColumnRef):
         return e
     if isinstance(e, ScalarFunc):
         args = [fold_expr(a) for a in e.args]
         e = e.rebuild(args)
-        if e.is_constant() and e.op not in ("like",):
+        # nondeterministic ops must re-evaluate per row / per execution —
+        # anywhere in the subtree, not just at the top (UPPER(UUID())):
+        # folding would repeat one value for every row and bake it into
+        # any cached plan (ref: expression/constant_fold.go propagates
+        # unFoldableFunctions up through ancestors)
+        if e.is_constant() and e.op != "like" and not any(
+                getattr(sub, "op", None) in _NONFOLDABLE
+                for sub in e.walk()):
             try:
                 ctx = EvalContext(np, [], on_device=False, n_rows=1)
                 v, m = e.eval(ctx)

@@ -230,7 +230,9 @@ class Engine:
                 nxt = int(seed or 0) + 1
             first = None
             for i in range(len(vals)):
-                if valid[i]:
+                # explicit 0 allocates too (MySQL default, i.e.
+                # NO_AUTO_VALUE_ON_ZERO off)
+                if valid[i] and int(vals[i]) != 0:
                     if int(vals[i]) >= nxt:
                         nxt = int(vals[i]) + 1
                 else:

@@ -322,15 +322,19 @@ def collation_fold_array(ftype: FieldType, arr: np.ndarray) -> np.ndarray:
 def tz_offset_us(tz_name: str, at=None) -> int:
     """UTC offset of a MySQL time_zone value in microseconds.
 
-    Accepts 'SYSTEM'/'UTC' (0 here — the engine's reference clock is
-    UTC), fixed offsets '+HH:MM'/'-HH:MM' (exact), and IANA names via
-    zoneinfo (resolved at the given/current instant — statement-time
+    Accepts 'UTC' (0), 'SYSTEM' (the server OS time zone, like MySQL's
+    system_time_zone), fixed offsets '+HH:MM'/'-HH:MM' (exact), and IANA
+    names via zoneinfo (resolved at the given/current instant — statement-time
     resolution, so DST transitions inside one column are approximated;
     ref: types/time.go ConvertTimeZone)."""
     import re as _re
     name = (tz_name or "SYSTEM").strip()
-    if name.upper() in ("SYSTEM", "UTC"):
+    if name.upper() == "UTC":
         return 0
+    if name.upper() == "SYSTEM":
+        # SYSTEM means the server OS time zone (sysvar system_time_zone)
+        off = _dt.datetime.now().astimezone().utcoffset()
+        return int(off.total_seconds() * 1_000_000) if off else 0
     m = _re.match(r"^([+-])(\d{1,2}):(\d{2})$", name)
     if m:
         sign = -1 if m.group(1) == "-" else 1
