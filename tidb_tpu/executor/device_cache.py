@@ -290,7 +290,8 @@ def get_table(ctx, scan, used_cols, max_slab: int) -> CachedTable:
     return ent
 
 
-def _evict_to_budget(budget: int, keep, keep_aligned=frozenset()) -> None:
+def _evict_to_budget(budget: int, keep, keep_aligned=frozenset(),
+                     keep_tables=frozenset()) -> None:
     """Drop LRU cached entries until resident bytes fit the HBM budget
     (never the entries in active use). Aligned join structures evict
     first — they are derived data, rebuildable from the tables."""
@@ -302,10 +303,22 @@ def _evict_to_budget(budget: int, keep, keep_aligned=frozenset()) -> None:
             break
         total -= _ALIGNED.pop(victim).hbm_bytes()
     while total > budget and len(_CACHE) > 1:
-        victim = next((k for k in _CACHE if k != keep), None)
+        victim = next((k for k in _CACHE
+                       if k != keep and k not in keep_tables), None)
         if victim is None:
             return
         total -= _CACHE.pop(victim).hbm_bytes()
+
+
+def aligned_budget_check(ctx, keep_keys=frozenset(),
+                         keep_tables=frozenset()) -> None:
+    """Enforce the HBM budget after aligned planning, never evicting the
+    entries the in-flight query is about to execute with."""
+    budget = int(ctx.vars.get("tidb_tpu_hbm_budget",
+                              DEFAULT_HBM_BUDGET_BYTES))
+    _evict_to_budget(budget, keep=None,
+                     keep_aligned=frozenset(keep_keys),
+                     keep_tables=frozenset(keep_tables))
 
 
 # ---------------------------------------------------------------------------
@@ -473,9 +486,3 @@ def aligned_col(ent: AlignedJoin, build_ent: CachedTable, col: int):
     return slabs
 
 
-def aligned_budget_check(ctx, keep_keys=frozenset()) -> None:
-    """Enforce the HBM budget after aligned builds, never evicting the
-    entries the in-flight query is about to execute with."""
-    budget = int(ctx.vars.get("tidb_tpu_hbm_budget",
-                              DEFAULT_HBM_BUDGET_BYTES))
-    _evict_to_budget(budget, keep=None, keep_aligned=frozenset(keep_keys))

@@ -360,6 +360,10 @@ class JoinCfg:
     # aligned mode: build-scan columns arriving as FK-aligned device inputs
     # (executor/device_cache.AlignedJoin) — static, part of the trace
     aligned_cols: Optional[Tuple[int, ...]] = None
+    # blocked expand: this join's probe anchor scan is row-range masked and
+    # the tree runs in K passes whose root agg states merge host-side —
+    # a many-to-many fan-out beyond JOIN_OUT_CAP never leaves the device
+    blocked: bool = False
 
 
 def _bounds_list(node: PhysicalPlan, scan_bounds
@@ -515,7 +519,7 @@ def tree_signature(plan: PhysicalPlan, caps: Dict[int, Tuple[int, int]],
             # est is host-side-only (seeds the retry out_cap) — keep it out
             # of the cache key or estimate drift forces spurious recompiles
             cfg_s = (f"{cfg.mode},{cfg.out_cap},{cfg.bounds},{cfg.domain},"
-                     f"{cfg.aligned_cols}" if cfg else None)
+                     f"{cfg.aligned_cols},{cfg.blocked}" if cfg else None)
             parts.append(f"Join({node.kind}, build_right={node.build_right},"
                          f" equi={node.equi!r}, "
                          f"other={node.other_conditions!r}, cfg={cfg_s})")
@@ -572,6 +576,15 @@ class TreeProgram:
         self.join_cfgs = {id(n): c for n, c in zip(joins, join_cfgs)}
         self.join_order = {id(n): i for i, n in enumerate(joins)}
         self.scan_order = _scans(plan)
+        # blocked expand: the probe anchor scans whose rows are range-
+        # masked per pass (derived from plan structure — deterministic)
+        self.ranged_scans = set()
+        for n, c in zip(joins, join_cfgs):
+            if c.blocked:
+                bi = 1 if n.build_right else 0
+                anchor = aligned_anchor(n.children[1 - bi])
+                if anchor is not None:
+                    self.ranged_scans.add(id(anchor))
         if isinstance(plan, PhysHashAgg):
             self.aggs = [build_agg(d) for d in plan.aggs]
         self.prep_nodes: List[Expression] = []
@@ -598,13 +611,15 @@ class TreeProgram:
         return vals
 
     # -- trace ---------------------------------------------------------------
-    def _run(self, scan_inputs, scan_rows, prep_vals, aligned_inputs=()):
+    def _run(self, scan_inputs, scan_rows, prep_vals, aligned_inputs=(),
+             ranges=None):
         self._prepared = {id(n): v
                           for n, v in zip(self.prep_nodes, prep_vals)
                           if v is not None}
         self._join_unique_flags = []
         self._join_totals = []
         self._aligned_inputs = aligned_inputs
+        self._ranges = ranges         # (start, stop) for ranged scans
         self._scan_sub = {}   # id(scan) → (cols, live0): FK-aligned build
         cols, live = self._emit(self.plan, scan_inputs, scan_rows)
         return self._finish(cols, live)
@@ -659,6 +674,9 @@ class TreeProgram:
                 live = iota < rows
             else:
                 live = (iota % slab_cap) < jnp.take(rows, iota // slab_cap)
+            if id(node) in self.ranged_scans:
+                start, stop = self._ranges
+                live = live & (iota >= start) & (iota < stop)
             ctx = self._ctx(col_list)
             for f in node.filters:
                 v, m = f.eval(ctx)
@@ -944,8 +962,12 @@ class TreeProgram:
                          for v, m in cols], "live": live, **out_flags}
 
     def __call__(self, scan_inputs, scan_rows, prep_vals,
-                 aligned_inputs=()):
-        return self.run(scan_inputs, scan_rows, prep_vals, aligned_inputs)
+                 aligned_inputs=(), ranges=None):
+        if ranges is None:
+            return self.run(scan_inputs, scan_rows, prep_vals,
+                            aligned_inputs)
+        return self.run(scan_inputs, scan_rows, prep_vals, aligned_inputs,
+                        ranges)
 
 
 def dictionary_flows(plan: PhysicalPlan,
