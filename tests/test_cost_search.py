@@ -1,0 +1,125 @@
+"""Cost-based alternative-shape physical search (ref: planner/core/
+find_best_task.go:285, exhaust_physical_plans.go): stream agg vs hash agg
+by group cardinality, sort elimination via index order, and index-lookup
+vs hash join flipping with outer-side stats. Each choice is pinned in
+BOTH directions, and every alternative shape is checked for result parity
+with the baseline shape."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.session import Engine
+
+
+def _explain(s, sql):
+    return "\n".join(str(r) for r in s.query("EXPLAIN " + sql).rows)
+
+
+@pytest.fixture()
+def s():
+    return Engine().new_session()
+
+
+def test_stream_agg_flips_on_group_cardinality(s):
+    # near-unique indexed key → stream agg; low-cardinality key → hash agg
+    s.execute("CREATE TABLE hi (k BIGINT, v BIGINT, INDEX ik (k))")
+    s.execute("CREATE TABLE lo (k BIGINT, v BIGINT, INDEX ik (k))")
+    rows_hi = ",".join(f"({i},{i % 97})" for i in range(20000))
+    rows_lo = ",".join(f"({i % 5},{i % 97})" for i in range(20000))
+    s.execute("INSERT INTO hi VALUES " + rows_hi)
+    s.execute("INSERT INTO lo VALUES " + rows_lo)
+    s.execute("ANALYZE TABLE hi")
+    s.execute("ANALYZE TABLE lo")
+    sql_hi = "SELECT k, COUNT(*), SUM(v) FROM hi GROUP BY k"
+    sql_lo = "SELECT k, COUNT(*), SUM(v) FROM lo GROUP BY k"
+    assert "StreamAgg" in _explain(s, sql_hi)
+    assert "HashAgg" in _explain(s, sql_lo)
+    assert "StreamAgg" not in _explain(s, sql_lo)
+    # parity: stream agg result == hash agg result (incl. NULL group)
+    s.execute("INSERT INTO hi VALUES (NULL, 7), (NULL, 8)")
+    got = s.query(sql_hi + " ").rows
+    s.vars["tidb_tpu_engine"] = "off"
+    want = {}
+    for k, v in [(None, 7), (None, 8)] + [(i, i % 97)
+                                          for i in range(20000)]:
+        c, t = want.get(k, (0, 0))
+        want[k] = (c + 1, t + v)
+    assert len(got) == len(want)
+    for k, c, t in got:
+        assert want[k] == (c, t), k
+
+
+def test_stream_agg_respects_filters(s):
+    s.execute("CREATE TABLE fa (k BIGINT, v BIGINT, INDEX ik (k))")
+    s.execute("INSERT INTO fa VALUES " + ",".join(
+        f"({i},{i % 10})" for i in range(20000)))
+    s.execute("ANALYZE TABLE fa")
+    # weakly selective filter: stream agg still wins and must apply it
+    sql = ("SELECT k, COUNT(*) FROM fa WHERE v < 8 GROUP BY k "
+           "ORDER BY k LIMIT 5")
+    plan = _explain(s, sql)
+    assert "StreamAgg" in plan
+    assert s.query(sql).rows == [(0, 1), (1, 1), (2, 1), (3, 1), (4, 1)]
+    # heavily selective filter: full-table index gather is overpriced,
+    # the hash path over the filtered scan wins
+    assert "HashAgg" in _explain(
+        s, "SELECT k, COUNT(*) FROM fa WHERE v = 1 GROUP BY k")
+
+
+def test_sort_elimination_flips_on_size(s):
+    s.execute("CREATE TABLE big (k BIGINT, v BIGINT, INDEX ik (k))")
+    s.execute("CREATE TABLE small (k BIGINT, v BIGINT, INDEX ik (k))")
+    s.execute("INSERT INTO big VALUES " + ",".join(
+        f"({(i * 37) % 50000},{i})" for i in range(50000)))
+    s.execute("INSERT INTO small VALUES (3,1),(1,2),(2,3),(NULL,4)")
+    s.execute("ANALYZE TABLE big")
+    s.execute("ANALYZE TABLE small")
+    assert "IndexOrderedScan" in _explain(
+        s, "SELECT * FROM big ORDER BY k")
+    assert "Sort" in _explain(s, "SELECT * FROM small ORDER BY k")
+    # order parity incl. NULLs-first asc / NULLs-last desc
+    s.execute("INSERT INTO big VALUES (NULL, -1), (NULL, -2)")
+    asc = [r[0] for r in s.query("SELECT k FROM big ORDER BY k").rows]
+    assert asc[0] is None and asc[1] is None
+    assert asc[2:] == sorted(a for a in asc if a is not None)
+    desc = [r[0] for r in
+            s.query("SELECT k FROM big ORDER BY k DESC").rows]
+    assert desc[-1] is None and desc[-2] is None
+    assert desc[:-2] == sorted((a for a in desc if a is not None),
+                               reverse=True)
+
+
+def test_index_join_flips_on_outer_stats(s):
+    s.execute("CREATE TABLE inner_t (k BIGINT PRIMARY KEY, v BIGINT)")
+    s.execute("CREATE TABLE outer_t (k BIGINT, w BIGINT)")
+    s.execute("INSERT INTO inner_t VALUES " + ",".join(
+        f"({i},{i % 7})" for i in range(50000)))
+    s.execute("INSERT INTO outer_t VALUES " + ",".join(
+        f"({i * 101 % 50000},{i})" for i in range(40)))
+    s.execute("ANALYZE TABLE inner_t")
+    s.execute("ANALYZE TABLE outer_t")
+    sql = ("SELECT COUNT(*), SUM(v) FROM outer_t "
+           "JOIN inner_t ON outer_t.k = inner_t.k")
+    assert "IndexLookupJoin" in _explain(s, sql)
+    small_result = s.query(sql).rows
+    assert small_result[0][0] == 40
+    # grow the outer side past the cost crossover; stats flip the plan
+    s.execute("INSERT INTO outer_t VALUES " + ",".join(
+        f"({i % 50000},{i})" for i in range(60000)))
+    s.execute("ANALYZE TABLE outer_t")
+    assert "HashJoin" in _explain(s, sql)
+    assert "IndexLookupJoin" not in _explain(s, sql)
+
+
+def test_merge_join_still_chosen_for_large_indexed(s):
+    s.execute("CREATE TABLE a (k BIGINT, v BIGINT, INDEX ik (k))")
+    s.execute("CREATE TABLE b (k BIGINT, w BIGINT, INDEX ik (k))")
+    s.execute("INSERT INTO a VALUES " + ",".join(
+        f"({i},{i % 5})" for i in range(20000)))
+    s.execute("INSERT INTO b VALUES " + ",".join(
+        f"({i},{i % 3})" for i in range(20000)))
+    s.execute("ANALYZE TABLE a")
+    s.execute("ANALYZE TABLE b")
+    sql = "SELECT COUNT(*) FROM a JOIN b ON a.k = b.k"
+    assert "MergeJoin" in _explain(s, sql)
+    assert s.query(sql).rows == [(20000,)]

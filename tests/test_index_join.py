@@ -32,15 +32,19 @@ def s():
 
 
 def oracle(s, sql):
-    # force the hash-join path as the semantic oracle
-    import tidb_tpu.planner.physical as P
-    saved = P.INDEX_JOIN_OUTER_CAP
-    P.INDEX_JOIN_OUTER_CAP = -1
+    # force the hash-join path as the semantic oracle by pricing every
+    # index-backed shape out of reach of the cost chooser
+    from tidb_tpu.planner import cost as C
+    saved = C.INDEX_STARTUP
+    C.INDEX_STARTUP = 1e18
     try:
         s._plan_cache.clear()
+        plan = "\n".join(str(r) for r in
+                         s.query("EXPLAIN " + sql).rows)
+        assert "IndexLookupJoin" not in plan, plan   # oracle must differ
         return s.query(sql).rows
     finally:
-        P.INDEX_JOIN_OUTER_CAP = saved
+        C.INDEX_STARTUP = saved
         s._plan_cache.clear()
 
 

@@ -28,14 +28,15 @@ def s():
 
 
 def oracle(s, sql):
-    import tidb_tpu.planner.physical as P
-    saved = P.MERGE_JOIN_MIN_ROWS
-    P.MERGE_JOIN_MIN_ROWS = 1 << 60      # force the hash path
+    # force the hash path by pricing index startup out of reach
+    from tidb_tpu.planner import cost as C
+    saved = C.INDEX_STARTUP
+    C.INDEX_STARTUP = 1e18
     try:
         s._plan_cache.clear()
         return s.query(sql).rows
     finally:
-        P.MERGE_JOIN_MIN_ROWS = saved
+        C.INDEX_STARTUP = saved
         s._plan_cache.clear()
 
 

@@ -353,6 +353,14 @@ def build(plan: PhysicalPlan) -> Executor:
     if isinstance(plan, PhysMergeJoin):
         from tidb_tpu.executor.merge_join import MergeJoinExec
         return MergeJoinExec(plan)
+    from tidb_tpu.planner.physical import (PhysIndexOrderedScan,
+                                           PhysStreamAgg)
+    if isinstance(plan, PhysStreamAgg):
+        from tidb_tpu.executor.stream_agg import StreamAggExec
+        return StreamAggExec(plan)
+    if isinstance(plan, PhysIndexOrderedScan):
+        from tidb_tpu.executor.index_scan import IndexOrderedScanExec
+        return IndexOrderedScanExec(plan)
     if isinstance(plan, PhysIndexLookupJoin):
         from tidb_tpu.executor.index_join import IndexLookupJoinExec
         return IndexLookupJoinExec(plan, build(plan.children[0]))

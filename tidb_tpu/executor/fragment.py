@@ -1287,16 +1287,10 @@ class TpuFragmentExec:
                     for parts in key_parts]
         if n_keys:
             n_rows = key_cols[0][0].shape[0]
-            # group index over host key tuples (NULLs group together)
-            index: Dict[tuple, int] = {}
-            gids = np.empty(n_rows, dtype=np.int64)
-            for i in range(n_rows):
-                t = tuple(
-                    None if not key_cols[kc][1][i]
-                    else key_cols[kc][0][i].item()
-                    for kc in range(n_keys))
-                gids[i] = index.setdefault(t, len(index))
-            n_final = len(index)
+            # vectorized cross-pass group index (NULLs group together) —
+            # the same sort-based factorize the CPU hash agg uses
+            from tidb_tpu.executor.hash_agg import factorize_columns
+            gids, n_final, rep = factorize_columns(key_cols)
         else:
             # global agg: every pass contributes exactly one state row
             n_rows = sum(p[0].shape[0] for p in state_parts[0]) \
@@ -1314,12 +1308,9 @@ class TpuFragmentExec:
             st = agg.init(np, n_final)
             merged_states.append(
                 agg.merge(np, st, gids, n_final, partial))
-        # representative key row per group
+        # representative key row per group (factorize's first occurrence)
         keys_out = []
         if n_keys:
-            rep = np.zeros(n_final, dtype=np.int64)
-            for i in range(n_rows - 1, -1, -1):
-                rep[gids[i]] = i
             for kc in range(n_keys):
                 v, m = key_cols[kc]
                 keys_out.append((v[rep], m[rep]))

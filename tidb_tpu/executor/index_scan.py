@@ -262,3 +262,34 @@ class IndexScanExec(MaterializingExec):
             if not keep.all():
                 out = out.take(np.nonzero(keep)[0])
         return out
+
+
+class IndexOrderedScanExec(MaterializingExec):
+    """Full scan emitted in index-key order — the executor behind ORDER BY
+    elimination (plan: PhysIndexOrderedScan). NULLs first ascending, last
+    descending (MySQL sort order); ties keep the index's stable order."""
+
+    def __init__(self, plan):
+        super().__init__(plan.schema.field_types, [])
+        self.plan = plan
+
+    def runtime_info(self) -> str:
+        return (f"index_ordered:{self.plan.table.name}."
+                f"{self.plan.index_name}"
+                + (" desc" if self.plan.desc else ""))
+
+    def _materialize(self) -> Chunk:
+        plan = self.plan
+        si = get_index(self.ctx, plan.table.id, plan.key_col, plan.table)
+        if plan.desc:
+            pos = np.concatenate([si.sorted_pos[::-1], si.null_pos])
+        else:
+            pos = np.concatenate([si.null_pos, si.sorted_pos])
+        if not len(pos):
+            return _empty_chunk(self.schema)
+        out = si.view.take(pos)
+        for pred in plan.filters:
+            keep = filter_mask(pred, out)
+            if not keep.all():
+                out = out.take(np.nonzero(keep)[0])
+        return out

@@ -1,0 +1,91 @@
+"""Physical-plan cost model (ref: planner/core/cost_model.go factors,
+find_best_task.go candidate costing).
+
+Unit: abstract "row visits" — every factor is relative to streaming one
+row through one vectorized operator. The reference's model (cpuFactor,
+scanFactor, seekFactor…) prices row handling in Go loops; this engine's
+CPU path is numpy-vectorized and the device path is one fused program,
+so the factors below price MEMORY TRAFFIC and STRUCTURE BUILDS instead:
+
+  * hash structures pay a build factor per build row and a probe factor
+    per probe row (factorize sort + searchsorted);
+  * index-backed operators (merge join, index-lookup join, stream agg,
+    index-ordered scan) read the cached SortedIndex views
+    (executor/index_scan.py get_index) — key order is FREE at query time,
+    but gathering rows through the permutation costs more per row than a
+    sequential scan, and every index operator pays a startup constant so
+    tiny inputs keep the simpler hash/sort operators (the role of the
+    reference's seekFactor);
+  * grouped aggregation pays per input row plus per GROUP (hash-table /
+    result-materialization traffic) — which is exactly what makes stream
+    agg over an index win at high group cardinality and lose at low.
+
+The enumeration happens in planner/physical.py (`_to_physical` join
+candidates, agg candidates, sort elimination); this module only prices.
+"""
+
+from __future__ import annotations
+
+import math
+
+# per-row factors
+SCAN = 1.0            # stream one row's columns sequentially
+HASH_BUILD = 3.0      # factorize/sort the build side, write table
+HASH_PROBE = 1.5      # code + search per probe row
+MERGE_ROW = 0.8       # merge-step per row over pre-sorted views
+INDEX_GATHER = 1.6    # gather a row through a sorted-index permutation
+SEEK = 2.0            # binary-search per probed key (× log2 inner)
+AGG_ROW = 1.0         # per input row into any grouped aggregation
+AGG_GROUP = 6.0       # per distinct group: table slot + result traffic
+STREAM_AGG_ROW = 1.2  # boundary-compare per row (input already ordered)
+SORT_ROW = 1.0        # × log2(n) comparison-ish per row
+OUT_ROW = 0.5         # materialize one output row
+
+# index-backed operators amortize their cached view, but a query on tiny
+# inputs should not pay view residency/validity checks — the startup
+# constant keeps hash/sort shapes below this scale (MERGE_JOIN_MIN_ROWS'
+# old role, now priced instead of hard-gated)
+INDEX_STARTUP = 4096.0
+
+
+def scan(rows: float) -> float:
+    return rows * SCAN
+
+
+def hash_join(build_rows: float, probe_rows: float, out_rows: float) -> float:
+    return (build_rows * HASH_BUILD + probe_rows * HASH_PROBE +
+            out_rows * OUT_ROW)
+
+
+def merge_join(left_rows: float, right_rows: float,
+               out_rows: float) -> float:
+    # output materialization gathers through the index permutations, but
+    # the hash path pays comparable traffic building its output — price
+    # them the same (OUT_ROW) so the structural terms decide
+    return (2 * INDEX_STARTUP +
+            (left_rows + right_rows) * MERGE_ROW +
+            out_rows * OUT_ROW)
+
+
+def index_join(outer_rows: float, inner_rows: float,
+               out_rows: float) -> float:
+    per_probe = SEEK * max(math.log2(max(inner_rows, 2.0)), 1.0)
+    return (INDEX_STARTUP + outer_rows * per_probe +
+            out_rows * (OUT_ROW + INDEX_GATHER))
+
+
+def hash_agg(rows: float, groups: float) -> float:
+    return rows * AGG_ROW + groups * AGG_GROUP
+
+
+def stream_agg(rows: float, groups: float) -> float:
+    return (INDEX_STARTUP + rows * (STREAM_AGG_ROW + INDEX_GATHER) +
+            groups * OUT_ROW)
+
+
+def sort(rows: float) -> float:
+    return rows * SORT_ROW * max(math.log2(max(rows, 2.0)), 1.0)
+
+
+def index_ordered_scan(rows: float) -> float:
+    return INDEX_STARTUP + rows * INDEX_GATHER

@@ -221,6 +221,52 @@ class PhysMergeJoin(PhysicalPlan):
                 f"{self.right_index}")
 
 
+class PhysStreamAgg(PhysicalPlan):
+    """Grouped aggregation streamed over a sorted-index view: the group
+    key arrives in key order from the cached SortedIndex, so grouping is
+    run-boundary detection — no hash table, no factorize sort (ref:
+    executor/aggregate.go StreamAggExec over index readers; chosen by
+    cost in exhaust_physical_plans.go when a child supplies the order).
+    Cost-picked over hash agg when the group count is a large fraction of
+    the input (planner/cost.py stream_agg vs hash_agg)."""
+
+    def __init__(self, group_exprs, aggs, schema, table, key_col: int,
+                 index_name: str, filters):
+        super().__init__(schema)
+        self.group_exprs = group_exprs
+        self.aggs = aggs
+        self.table = table
+        self.key_col = key_col
+        self.index_name = index_name
+        self.filters = filters          # scan-level filters, pre-agg
+
+    def describe(self):
+        return (f"stream over {self.table.name}.{self.index_name}, "
+                f"group:[{self.group_exprs!r}] "
+                f"funcs:{[(d.name, repr(d.args)) for d in self.aggs]}")
+
+
+class PhysIndexOrderedScan(PhysicalPlan):
+    """Full scan emitted in index-key order — ORDER BY elimination via an
+    index supplying the order (ref: planner/core/find_best_task.go
+    getOriginalPhysicalIndexScan keep-order path). NULLs first ascending,
+    last descending (MySQL sort order)."""
+
+    def __init__(self, table, key_col: int, index_name: str, desc: bool,
+                 filters, schema):
+        super().__init__(schema)
+        self.table = table
+        self.key_col = key_col
+        self.index_name = index_name
+        self.desc = desc
+        self.filters = filters
+
+    def describe(self):
+        return (f"table:{self.table.name}, order:{self.index_name}"
+                f"{' desc' if self.desc else ''}"
+                + (f", filters:{self.filters}" if self.filters else ""))
+
+
 class PhysWindow(PhysicalPlan):
     """Window functions over sorted partitions (ref: executor/window.go:31;
     computed whole-column via ops/window.py instead of streamed frames)."""
@@ -442,6 +488,23 @@ def estimate(plan: PhysicalPlan, ctx) -> float:
             n *= filters_selectivity(plan.filters, stats)
         plan.est_rows = max(n, 1.0)
         return plan.est_rows
+    if isinstance(plan, PhysIndexOrderedScan):
+        n = float(_table_rows(plan.table, ctx))
+        if plan.filters:
+            from tidb_tpu.statistics import filters_selectivity
+            stats = _table_stats(plan.table, ctx)
+            n *= filters_selectivity(plan.filters, stats)
+        plan.est_rows = max(n, 1.0)
+        return plan.est_rows
+    if isinstance(plan, PhysStreamAgg):
+        from tidb_tpu.statistics import column_ndv
+        stats = _table_stats(plan.table, ctx)
+        ndv = column_ndv(stats, plan.key_col, -1.0) \
+            if stats is not None else -1.0
+        n = float(_table_rows(plan.table, ctx))
+        plan.est_rows = max(ndv if ndv and ndv > 0 else n / AGG_REDUCTION,
+                            1.0)
+        return plan.est_rows
     if isinstance(plan, PhysMemTable):
         plan.est_rows = 64.0
         return plan.est_rows
@@ -585,16 +648,15 @@ def _indexed_col(table, col_idx: int):
     return None
 
 
-MERGE_JOIN_MIN_ROWS = 8192        # both sides must be at least this big
-
-
 def _try_merge_join(join: LogicalJoin, left: PhysicalPlan,
                     right: PhysicalPlan, lrows: float, rrows: float,
                     ctx) -> Optional["PhysMergeJoin"]:
     """Merge join when BOTH sides are table scans indexed on their
-    (uncast, non-string-mixed) join keys and both are large — the
-    key-ordered-inputs case of exhaust_physical_plans.go's merge-join
-    enumeration. Inner only; other kinds keep the hash path."""
+    (uncast, non-string-mixed) join keys — the key-ordered-inputs case of
+    exhaust_physical_plans.go's merge-join enumeration. Inner only; other
+    kinds keep the hash path. Applicability only: the size trade-off is
+    priced by planner/cost.py (the old MERGE_JOIN_MIN_ROWS hard gate is
+    now the INDEX_STARTUP cost term)."""
     if getattr(ctx, "use_tpu", False):
         # large indexed joins fuse into device LUT-join trees instead;
         # the merge join is the CPU engine's answer to this shape
@@ -603,8 +665,6 @@ def _try_merge_join(join: LogicalJoin, left: PhysicalPlan,
         return None
     if not isinstance(left, PhysTableScan) or \
             not isinstance(right, PhysTableScan):
-        return None
-    if min(lrows, rrows) < MERGE_JOIN_MIN_ROWS:
         return None
     from tidb_tpu.executor.join import coerce_key_pair
     le, re = join.equi[0]
@@ -632,18 +692,18 @@ INDEX_JOIN_RATIO = 16.0           # inner must be ≥ this × outer
 def _try_index_join(join: LogicalJoin, left: PhysicalPlan,
                     right: PhysicalPlan, lrows: float, rrows: float,
                     ctx) -> Optional[PhysIndexLookupJoin]:
-    """Index nested-loop join when the outer side is tiny and the inner
-    side is a scan with an index on the (uncast) join key — probing beats
-    a full inner scan (find_best_task.go's index-join enumeration,
-    cost-gated on the outer estimate)."""
+    """Index nested-loop join when the inner side is a scan with an index
+    on the (uncast) join key — probing beats a full inner scan for small
+    outers (find_best_task.go's index-join enumeration). Applicability
+    only on the CPU path: the outer-size trade-off is priced by
+    planner/cost.py index_join vs hash_join (the device path still
+    applies the legacy hard gate at the call site)."""
     if join.kind not in ("inner", "left", "semi", "anti"):
         return None
     if len(join.equi) != 1 or join.other_conditions and \
             any(is_corr(c) for c in join.other_conditions or []):
         return None
     if not isinstance(right, PhysTableScan):
-        return None
-    if lrows > INDEX_JOIN_OUTER_CAP or rrows < lrows * INDEX_JOIN_RATIO:
         return None
     from tidb_tpu.executor.join import coerce_key_pair
     le, re = join.equi[0]
@@ -685,6 +745,65 @@ def _try_index_join(join: LogicalJoin, left: PhysicalPlan,
 def is_corr(e) -> bool:
     from tidb_tpu.expression import CorrelatedRef
     return any(isinstance(s, CorrelatedRef) for s in e.walk())
+
+
+def _try_stream_agg(agg: LogicalAggregation, child: PhysicalPlan,
+                    ctx) -> Optional[PhysStreamAgg]:
+    """Stream-agg candidate: single bare-ColumnRef group key directly
+    over a table scan with an index supplying the key order, no DISTINCT
+    aggs (ref: exhaust_physical_plans.go getStreamAggs — property-driven
+    there, index-view-driven here). Cost decides at the call site."""
+    if getattr(ctx, "use_tpu", False):
+        return None                 # device agg is the fused fragment
+    if len(agg.group_exprs) != 1 or not isinstance(agg.group_exprs[0],
+                                                   ColumnRef):
+        return None
+    if any(d.distinct for d in agg.aggs):
+        return None
+    if not isinstance(child, PhysTableScan):
+        return None
+    key = agg.group_exprs[0]
+    ix = _indexed_col(child.table, key.index)
+    if ix is None:
+        return None
+    return PhysStreamAgg(agg.group_exprs, agg.aggs, agg.schema,
+                         child.table, key.index, ix,
+                         list(child.filters))
+
+
+def _try_index_order(sort: LogicalSort, child: PhysicalPlan,
+                     ctx) -> Optional[PhysIndexOrderedScan]:
+    """Sort elimination: ORDER BY a single bare indexed column directly
+    over a table scan — the index supplies the order (ref:
+    find_best_task.go keep-order index paths / planner/core/
+    rule_eliminate_sort). Cost decides at the call site."""
+    if getattr(ctx, "use_tpu", False):
+        return None                 # device sorts fuse into the fragment
+    if len(sort.by) != 1 or not isinstance(sort.by[0], ColumnRef):
+        return None
+    # projections are 1:1 and order-preserving: trace the key through
+    # them to the scan column, then rebuild them over the ordered scan
+    idx = sort.by[0].index
+    node = child
+    wrappers: List[PhysProjection] = []
+    while isinstance(node, PhysProjection):
+        e = node.exprs[idx] if idx < len(node.exprs) else None
+        if not isinstance(e, ColumnRef):
+            return None
+        idx = e.index
+        wrappers.append(node)
+        node = node.children[0]
+    if not isinstance(node, PhysTableScan):
+        return None
+    ix = _indexed_col(node.table, idx)
+    if ix is None:
+        return None
+    out: PhysicalPlan = PhysIndexOrderedScan(
+        node.table, idx, ix, bool(sort.descs[0]), list(node.filters),
+        node.schema)
+    for w in reversed(wrappers):
+        out = PhysProjection(w.exprs, w.schema, out)
+    return out
 
 
 INDEX_SELECTIVITY_GATE = 0.15     # index path only below this fraction
@@ -837,29 +956,76 @@ def _to_physical(plan: LogicalPlan, ctx) -> PhysicalPlan:
     if isinstance(plan, LogicalProjection):
         return PhysProjection(plan.exprs, plan.schema, kids[0])
     if isinstance(plan, LogicalAggregation):
-        return PhysHashAgg(plan.group_exprs, plan.aggs, plan.schema, kids[0])
+        ha = PhysHashAgg(plan.group_exprs, plan.aggs, plan.schema, kids[0])
+        sa = _try_stream_agg(plan, kids[0], ctx)
+        if sa is None:
+            return ha
+        from tidb_tpu.planner import cost as C
+        rows = estimate(kids[0], ctx)
+        groups = estimate(ha, ctx)
+        sa.est_rows = groups
+        # the stream path gathers the WHOLE table through the index
+        # permutation before filtering — price the full row count, while
+        # the hash path streams only the filtered scan
+        full = float(_table_rows(sa.table, ctx))
+        if C.stream_agg(full, groups) < C.hash_agg(rows, groups):
+            return sa
+        return ha
     if isinstance(plan, LogicalJoin):
         left, right = kids
         lrows = estimate(left, ctx)
         rrows = estimate(right, ctx)
-        ilj = _try_index_join(plan, left, right, lrows, rrows, ctx)
-        if ilj is not None:
-            return ilj
-        mj = _try_merge_join(plan, left, right, lrows, rrows, ctx)
-        if mj is not None:
-            return mj
         if plan.kind in ("left", "semi", "anti"):
             build_right = True    # probe the outer side
         elif plan.kind == "right":
             build_right = False
         else:
             build_right = rrows <= lrows
-        return PhysHashJoin(plan.kind, left, right, plan.equi,
-                            plan.other_conditions, plan.schema, build_right)
+        hj = PhysHashJoin(plan.kind, left, right, plan.equi,
+                          plan.other_conditions, plan.schema, build_right)
+        if getattr(ctx, "use_tpu", False):
+            # large joins fuse into the device tree engine; the only
+            # alternative shape worth taking off it is the tiny-outer
+            # index probe (the old hard gate)
+            ilj = _try_index_join(plan, left, right, lrows, rrows, ctx)
+            if ilj is not None and lrows <= INDEX_JOIN_OUTER_CAP and \
+                    rrows >= lrows * INDEX_JOIN_RATIO:
+                return ilj
+            return hj
+        # CPU engine: enumerate applicable shapes, pick by cost
+        # (find_best_task.go:285 / exhaust_physical_plans.go, collapsed
+        # to a candidates-per-op comparison — no memo needed at this
+        # operator count)
+        from tidb_tpu.planner import cost as C
+        brows, prows = (rrows, lrows) if build_right else (lrows, rrows)
+        cands = [(C.hash_join(brows, prows, estimate(hj, ctx)), hj)]
+        ilj = _try_index_join(plan, left, right, lrows, rrows, ctx)
+        if ilj is not None:
+            inner_n = float(_table_rows(ilj.inner_table, ctx))
+            cands.append((C.index_join(lrows, inner_n,
+                                       estimate(ilj, ctx)), ilj))
+        mj = _try_merge_join(plan, left, right, lrows, rrows, ctx)
+        if mj is not None:
+            ln = float(_table_rows(mj.left_table, ctx))
+            rn = float(_table_rows(mj.right_table, ctx))
+            cands.append((C.merge_join(ln, rn, estimate(mj, ctx)), mj))
+        return min(cands, key=lambda t: t[0])[1]
     if isinstance(plan, LogicalWindow):
         return PhysWindow(plan.wdescs, plan.schema, kids[0])
     if isinstance(plan, LogicalSort):
-        return PhysSort(plan.by, plan.descs, kids[0])
+        ps = PhysSort(plan.by, plan.descs, kids[0])
+        alt = _try_index_order(plan, kids[0], ctx)
+        if alt is not None:
+            from tidb_tpu.planner import cost as C
+            rows = estimate(kids[0], ctx)
+            # the ordered scan gathers the whole table pre-filter
+            node = alt
+            while not isinstance(node, PhysIndexOrderedScan):
+                node = node.children[0]
+            full = float(_table_rows(node.table, ctx))
+            if C.index_ordered_scan(full) < C.sort(rows):
+                return alt
+        return ps
     if isinstance(plan, LogicalTopN):
         return PhysTopN(plan.by, plan.descs, plan.offset, plan.count, kids[0])
     if isinstance(plan, LogicalLimit):
