@@ -1,0 +1,246 @@
+"""Partitioned tables (RANGE/HASH) + partition pruning (ref:
+table/tables/partition.go locatePartition, planner/core/
+rule_partition_processor.go). TPU-first layout: partitions are region
+colocation tags in the one columnar store table — INSERT routes rows so a
+region never mixes partitions, and pruning skips whole regions (and thus
+whole device slabs)."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.errors import PartitionError, PlanError
+from tidb_tpu.session import Engine
+
+
+def _explain(s, sql):
+    return "\n".join(str(r) for r in s.query("EXPLAIN " + sql).rows)
+
+
+@pytest.fixture()
+def s():
+    return Engine().new_session()
+
+
+def _mk_range(s):
+    s.execute("CREATE TABLE r (id BIGINT, v BIGINT) "
+              "PARTITION BY RANGE (id) ("
+              "PARTITION p0 VALUES LESS THAN (100), "
+              "PARTITION p1 VALUES LESS THAN (200), "
+              "PARTITION p2 VALUES LESS THAN (MAXVALUE))")
+    s.execute("INSERT INTO r VALUES " + ",".join(
+        f"({i},{i * 2})" for i in range(0, 300, 3)) + ",(NULL, -1)")
+
+
+def test_range_routing_and_regions(s):
+    _mk_range(s)
+    info = s.engine.catalog.info_schema.table("r")
+    td = s.engine.store.snapshot().table_data(info.id)
+    parts_seen = {r.part for r in td.regions}
+    assert parts_seen == {0, 1, 2}
+    for r in td.regions:          # a region never mixes partitions
+        vals = r.chunk.columns[0].values
+        valid = r.chunk.columns[0].valid_mask()
+        enc = vals[valid]
+        if r.part == 0:
+            assert (enc < 100).all()
+        elif r.part == 1:
+            assert ((enc >= 100) & (enc < 200)).all()
+        else:
+            assert (enc >= 200).all()
+    # NULL routes to the first partition
+    assert s.query("SELECT COUNT(*) FROM r WHERE id IS NULL").rows == [(1,)]
+
+
+def test_range_no_partition_for_value(s):
+    s.execute("CREATE TABLE rn (id BIGINT) PARTITION BY RANGE (id) ("
+              "PARTITION p0 VALUES LESS THAN (10))")
+    with pytest.raises(PartitionError):
+        s.execute("INSERT INTO rn VALUES (10)")
+    s.execute("INSERT INTO rn VALUES (9)")   # boundary-1 fits
+
+
+def test_range_pruning_in_explain(s):
+    _mk_range(s)
+    s.execute("ANALYZE TABLE r")
+    plan = _explain(s, "SELECT COUNT(*) FROM r WHERE id < 100")
+    assert "partition:p0" in plan and "p1" not in plan
+    plan = _explain(s, "SELECT COUNT(*) FROM r WHERE id >= 150")
+    assert "partition:p1,p2" in plan
+    plan = _explain(s, "SELECT COUNT(*) FROM r WHERE id = 150 AND v > 0")
+    assert "partition:p1" in plan and "p0" not in plan
+    plan = _explain(s, "SELECT COUNT(*) FROM r")
+    assert "partition:all" in plan
+    # pruned results are correct
+    assert s.query("SELECT COUNT(*) FROM r WHERE id < 100").rows == \
+        [(34,)]
+    assert s.query("SELECT COUNT(*) FROM r WHERE id >= 150 AND id < 210"
+                   ).rows == [(20,)]
+
+
+def test_hash_partition_routing_and_pruning(s):
+    s.execute("CREATE TABLE h (id BIGINT, v BIGINT) "
+              "PARTITION BY HASH (id) PARTITIONS 4")
+    s.execute("INSERT INTO h VALUES " + ",".join(
+        f"({i},{i})" for i in range(100)))
+    info = s.engine.catalog.info_schema.table("h")
+    td = s.engine.store.snapshot().table_data(info.id)
+    assert {r.part for r in td.regions} == {0, 1, 2, 3}
+    plan = _explain(s, "SELECT COUNT(*) FROM h WHERE id = 7")
+    assert "partition:p3" in plan
+    assert s.query("SELECT COUNT(*) FROM h WHERE id = 7").rows == [(1,)]
+    assert s.query("SELECT SUM(v) FROM h").rows == [(4950,)]
+
+
+def test_partition_dml_and_cross_partition_update(s):
+    _mk_range(s)
+    # UPDATE moving a row across partitions (delete + re-routed insert)
+    s.execute("UPDATE r SET id = 250 WHERE id = 0")
+    assert s.query("SELECT COUNT(*) FROM r WHERE id >= 200").rows == \
+        [(34,)]
+    info = s.engine.catalog.info_schema.table("r")
+    td = s.engine.store.snapshot().table_data(info.id)
+    for r in td.regions:
+        vals = r.chunk.columns[0].values
+        alive = ~r.deleted & r.chunk.columns[0].valid_mask()
+        if r.part == 0 and alive.any():
+            assert (vals[alive] < 100).all()
+    s.execute("DELETE FROM r WHERE id >= 200")
+    assert s.query("SELECT COUNT(*) FROM r WHERE id >= 200").rows == [(0,)]
+
+
+def test_partition_txn_staged_rows(s):
+    _mk_range(s)
+    s.execute("BEGIN")
+    s.execute("INSERT INTO r VALUES (50, 1), (150, 2)")
+    # staged rows visible through the pruned scan
+    assert s.query("SELECT COUNT(*) FROM r WHERE id = 50").rows == [(1,)]
+    s.execute("ROLLBACK")
+    assert s.query("SELECT COUNT(*) FROM r WHERE id = 50").rows == [(0,)]
+
+
+def test_partition_device_engine_parity(s):
+    s.execute("CREATE TABLE dp (id BIGINT, g VARCHAR(4), v BIGINT) "
+              "PARTITION BY RANGE (id) ("
+              "PARTITION p0 VALUES LESS THAN (10000), "
+              "PARTITION p1 VALUES LESS THAN (MAXVALUE))")
+    rng = np.random.default_rng(6)
+    s.execute("INSERT INTO dp VALUES " + ",".join(
+        f"({int(rng.integers(0, 20000))},'g{int(rng.integers(0, 4))}',"
+        f"{int(rng.integers(0, 100))})" for _ in range(40000)))
+    s.execute("ANALYZE TABLE dp")
+    sql = ("SELECT g, COUNT(*), SUM(v) FROM dp WHERE id < 10000 "
+           "GROUP BY g ORDER BY g")
+    want = s.query(sql).rows
+    s.vars.update(tidb_tpu_engine="on", tidb_tpu_row_threshold=1,
+                  tidb_tpu_strict="on")
+    try:
+        got = s.query(sql).rows
+        # different pruning must not reuse the cached pruned slabs
+        got_all = s.query("SELECT COUNT(*) FROM dp").rows
+    finally:
+        s.vars.update(tidb_tpu_engine="off", tidb_tpu_strict="off")
+    assert got == want
+    assert got_all == s.query("SELECT COUNT(*) FROM dp").rows
+
+
+def test_partition_show_create_roundtrip(s):
+    _mk_range(s)
+    ddl = s.query("SHOW CREATE TABLE r").rows[0][1]
+    assert "PARTITION BY RANGE" in ddl and "MAXVALUE" in ddl
+    s2 = Engine().new_session()
+    s2.execute(ddl.replace("`r`", "`r2`", 1))
+    info2 = s2.engine.catalog.info_schema.table("r2")
+    assert info2.partition is not None
+    assert info2.partition.names == ("p0", "p1", "p2")
+
+
+def test_partition_validation(s):
+    with pytest.raises(PlanError):
+        s.execute("CREATE TABLE bad (a VARCHAR(4)) "
+                  "PARTITION BY HASH (a) PARTITIONS 4")
+    with pytest.raises(PlanError):
+        s.execute("CREATE TABLE bad2 (a BIGINT) PARTITION BY RANGE (a) ("
+                  "PARTITION p0 VALUES LESS THAN (10), "
+                  "PARTITION p1 VALUES LESS THAN (5))")
+
+
+def test_partition_by_date_range(s):
+    s.execute("CREATE TABLE ev (d DATE, v BIGINT) "
+              "PARTITION BY RANGE (d) ("
+              "PARTITION p2023 VALUES LESS THAN ('2024-01-01'), "
+              "PARTITION p2024 VALUES LESS THAN ('2025-01-01'))")
+    s.execute("INSERT INTO ev VALUES ('2023-06-01', 1), ('2024-06-01', 2)")
+    plan = _explain(s, "SELECT * FROM ev WHERE d < '2024-01-01'")
+    assert "partition:p2023" in plan
+    assert s.query("SELECT SUM(v) FROM ev WHERE d >= '2024-01-01'"
+                   ).rows == [(2,)]
+    with pytest.raises(PartitionError):
+        s.execute("INSERT INTO ev VALUES ('2025-06-01', 3)")
+
+
+def test_alter_partition_management(s):
+    _mk_range(s)
+    from tidb_tpu.errors import DDLError
+    # TRUNCATE PARTITION drops the region set wholesale
+    s.execute("ALTER TABLE r TRUNCATE PARTITION p1")
+    assert s.query("SELECT COUNT(*) FROM r WHERE id >= 100 AND id < 200"
+                   ).rows == [(0,)]
+    assert s.query("SELECT COUNT(*) FROM r WHERE id < 100").rows == [(34,)]
+    # ADD PARTITION only extends past the last bound (and never MAXVALUE)
+    with pytest.raises(DDLError):
+        s.execute("ALTER TABLE r ADD PARTITION "
+                  "(PARTITION p3 VALUES LESS THAN (400))")
+    # DROP a middle partition: later ordinals shift, rows reroute next
+    s.execute("ALTER TABLE r DROP PARTITION p1")
+    info = s.engine.catalog.info_schema.table("r")
+    assert info.partition.names == ("p0", "p2")
+    s.execute("INSERT INTO r VALUES (150, 7)")   # lands in old p2 range
+    assert s.query("SELECT COUNT(*) FROM r WHERE id = 150").rows == [(1,)]
+    plan = _explain(s, "SELECT * FROM r WHERE id < 50")
+    assert "partition:p0" in plan
+    # a bounded table can ADD past its last bound
+    s.execute("CREATE TABLE ra (a BIGINT) PARTITION BY RANGE (a) ("
+              "PARTITION q0 VALUES LESS THAN (10))")
+    s.execute("ALTER TABLE ra ADD PARTITION "
+              "(PARTITION q1 VALUES LESS THAN (20))")
+    s.execute("INSERT INTO ra VALUES (15)")
+    assert s.query("SELECT COUNT(*) FROM ra").rows == [(1,)]
+
+
+def test_partition_error_never_half_applies_dml(s):
+    """Review r5: a routing failure must not leave the delete half of an
+    UPDATE (or REPLACE's conflict delete) staged."""
+    s.execute("CREATE TABLE hp (id BIGINT PRIMARY KEY, v BIGINT) "
+              "PARTITION BY RANGE (id) ("
+              "PARTITION p0 VALUES LESS THAN (100))")
+    s.execute("INSERT INTO hp VALUES (5, 1)")
+    s.execute("BEGIN")
+    with pytest.raises(PartitionError):
+        s.execute("UPDATE hp SET id = 500 WHERE id = 5")
+    s.execute("COMMIT")
+    assert s.query("SELECT * FROM hp").rows == [(5, 1)]
+    with pytest.raises(PartitionError):
+        s.execute("REPLACE INTO hp VALUES (5, 999), (500, 2)")
+    assert s.query("SELECT * FROM hp").rows == [(5, 1)]
+
+
+def test_partition_restore_keeps_tags(tmp_path, s):
+    from tidb_tpu.tools import backup, restore
+    _mk_range(s)
+    backup(s.engine, str(tmp_path / "bk"))
+    eng2 = Engine()
+    restore(eng2, str(tmp_path / "bk"))
+    s2 = eng2.new_session()
+    assert s2.query("SELECT COUNT(*) FROM r WHERE id < 100").rows == [(34,)]
+    n = s2.execute("ALTER TABLE r TRUNCATE PARTITION p0")
+    assert s2.query("SELECT COUNT(*) FROM r WHERE id < 100 AND "
+                    "id IS NOT NULL").rows == [(0,)]
+    assert s2.query("SELECT COUNT(*) FROM r WHERE id >= 100").rows == [(66,)]
+
+
+def test_alter_add_partition_bad_bound(s):
+    s.execute("CREATE TABLE ab (a BIGINT) PARTITION BY RANGE (a) ("
+              "PARTITION p0 VALUES LESS THAN (10))")
+    with pytest.raises(PlanError):
+        s.execute("ALTER TABLE ab ADD PARTITION "
+                  "(PARTITION p1 VALUES LESS THAN ('abc'))")

@@ -44,6 +44,26 @@ class IndexInfo:
 
 
 @dataclass(frozen=True)
+class PartitionInfo:
+    """RANGE/HASH partition metadata (ref: parser/model/model.go
+    PartitionInfo). `bounds` holds ENCODED upper bounds per range
+    partition (None = MAXVALUE); physically, partitions are region
+    colocation tags in the one columnar store table — the slab-native
+    unit the device cache and dist sharding already consume."""
+
+    kind: str                             # range | hash
+    column: str
+    col_offset: int
+    names: Tuple[str, ...]
+    bounds: Tuple[Optional[int], ...] = ()   # range: encoded, ascending
+    num: int = 0                             # hash partition count
+
+    @property
+    def n_parts(self) -> int:
+        return len(self.names)
+
+
+@dataclass(frozen=True)
 class TableInfo:
     """Ref: parser/model/model.go TableInfo."""
 
@@ -52,6 +72,7 @@ class TableInfo:
     columns: Tuple[ColumnInfo, ...]
     primary_key: Tuple[str, ...] = ()
     indexes: Tuple[IndexInfo, ...] = ()
+    partition: Optional["PartitionInfo"] = None
 
     def column(self, name: str) -> ColumnInfo:
         lname = name.lower()
@@ -136,7 +157,9 @@ class Catalog:
     def create_table(self, name: str, columns: Sequence[ColumnInfo],
                      primary_key: Sequence[str] = (),
                      indexes: Sequence[IndexInfo] = (),
-                     if_not_exists: bool = False) -> Optional[TableInfo]:
+                     if_not_exists: bool = False,
+                     partition: Optional[PartitionInfo] = None
+                     ) -> Optional[TableInfo]:
         with self._lock:
             key = name.lower()
             if key in self._snapshot._tables:
@@ -145,12 +168,27 @@ class Catalog:
                 raise TableExistsError(f"Table '{name}' already exists")
             cols = tuple(replace(c, offset=i) for i, c in enumerate(columns))
             info = TableInfo(next(self._ids), name, cols,
-                             tuple(primary_key), tuple(indexes))
+                             tuple(primary_key), tuple(indexes),
+                             partition)
             tables = dict(self._snapshot._tables)
             tables[key] = info
             self._bump(tables, f"create table {name}",
                        temp=name.startswith("#"))
             return info
+
+    def set_partition(self, table: str,
+                      pinfo: Optional[PartitionInfo]) -> TableInfo:
+        """ALTER partition-metadata update (ADD/DROP PARTITION)."""
+        with self._lock:
+            key = table.lower()
+            info = self._snapshot._tables.get(key)
+            if info is None:
+                raise UnknownTableError(f"Unknown table '{table}'")
+            new = replace(info, partition=pinfo)
+            tables = dict(self._snapshot._tables)
+            tables[key] = new
+            self._bump(tables, f"alter table {table} partitions")
+            return new
 
     def add_index(self, table: str, index: IndexInfo) -> TableInfo:
         """CREATE INDEX (ref: ddl/ddl_api.go CreateIndex; synchronous —

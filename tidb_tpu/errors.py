@@ -107,3 +107,7 @@ class DDLError(TiDBTPUError):
         super().__init__(msg)
         if code is not None:
             self.code = code
+
+
+class PartitionError(ExecutionError):
+    code = 1526  # ER_NO_PARTITION_FOR_GIVEN_VALUE

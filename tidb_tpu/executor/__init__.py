@@ -56,12 +56,13 @@ class ExecContext:
         if self.killed:
             raise QueryKilledError("Query execution was interrupted")
 
-    def scan_table(self, table_id: int):
-        """Yield (region_or_None, chunk, alive_mask) honoring txn staging."""
+    def scan_table(self, table_id: int, parts=None):
+        """Yield (region_or_None, chunk, alive_mask) honoring txn staging.
+        `parts` = pruned partition ordinals (None = all)."""
         if self.txn is not None:
-            yield from self.txn.scan(table_id)
+            yield from self.txn.scan(table_id, parts)
         else:
-            for region, alive in self.snapshot.scan(table_id):
+            for region, alive in self.snapshot.scan(table_id, parts):
                 yield region, region.chunk, alive
 
 

@@ -109,7 +109,9 @@ def _collect_parts(ctx, scan):
     from tidb_tpu.executor.scan import align_chunk_to_schema
     parts = []
     total = 0
-    for _region, chunk, alive in ctx.scan_table(scan.table.id):
+    pruned = getattr(scan, "partitions", None)
+    for _region, chunk, alive in ctx.scan_table(
+            scan.table.id, None if pruned is None else set(pruned)):
         chunk = align_chunk_to_schema(chunk, scan.table)
         mask = None if alive.all() else alive
         n = chunk.num_rows if mask is None else int(mask.sum())
@@ -251,7 +253,9 @@ def get_table(ctx, scan, used_cols, max_slab: int) -> CachedTable:
     # key by owning store too: distinct engines may reuse table ids; a
     # finalizer evicts a dead engine's entries so its HBM isn't pinned
     store = getattr(ctx.snapshot, "store", None) if cacheable else None
-    key = (id(store), table_id) if cacheable else None
+    parts = getattr(scan, "partitions", None)
+    key = (id(store), table_id,
+           None if parts is None else tuple(parts)) if cacheable else None
     if store is not None and id(store) not in _STORE_FINALIZERS:
         import weakref
         _STORE_FINALIZERS[id(store)] = weakref.finalize(

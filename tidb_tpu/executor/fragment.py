@@ -257,7 +257,8 @@ def _chain_signature(chain: List[PhysicalPlan], used_cols: Sequence[int],
                                 zip(used_cols, in_types))]
     for node in chain:
         if isinstance(node, PhysTableScan):
-            parts.append(f"Scan(filters={node.filters!r})")
+            parts.append(f"Scan(filters={node.filters!r}, "
+                         f"parts={getattr(node, 'partitions', None)})")
         elif isinstance(node, PhysSelection):
             parts.append(f"Sel({node.conditions!r})")
         elif isinstance(node, PhysProjection):

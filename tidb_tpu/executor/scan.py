@@ -24,11 +24,13 @@ class TableScanExec(Executor):
         super().__init__(plan.schema.field_types)
         self.table = plan.table
         self.filters = plan.filters
+        self.partitions = getattr(plan, "partitions", None)
         self._iter = None
 
     def open(self, ctx):
         super().open(ctx)
-        self._iter = ctx.scan_table(self.table.id)
+        parts = None if self.partitions is None else set(self.partitions)
+        self._iter = ctx.scan_table(self.table.id, parts)
 
     def next(self) -> Optional[Chunk]:
         while True:

@@ -512,7 +512,8 @@ def tree_signature(plan: PhysicalPlan, caps: Dict[int, Tuple[int, int]],
             parts.append(
                 f"Scan(id={node.table.id}, cap={cap[0]}x{cap[1]}, "
                 f"types={[str(ft) for ft in node.schema.field_types]}, "
-                f"filters={node.filters!r})")
+                f"filters={node.filters!r}, "
+                f"parts={getattr(node, 'partitions', None)})")
         elif isinstance(node, PhysHashJoin):
             cfg = join_cfgs[ji] if join_cfgs else None
             ji += 1

@@ -254,12 +254,29 @@ class IndexDef(Node):
 
 
 @dataclass
+class PartitionDef(Node):
+    name: str
+    less_than: Optional[object] = None    # literal bound; None = MAXVALUE
+
+
+@dataclass
+class PartitionSpec(Node):
+    """PARTITION BY RANGE (col) (...) | PARTITION BY HASH (col)
+    PARTITIONS n (ref: parser/model/model.go PartitionInfo)."""
+    kind: str                             # range | hash
+    column: str
+    defs: List[PartitionDef] = field(default_factory=list)
+    num: int = 0                          # hash partition count
+
+
+@dataclass
 class CreateTable(StmtNode):
     name: str
     columns: List[ColumnDef]
     primary_key: List[str] = field(default_factory=list)
     indexes: List[IndexDef] = field(default_factory=list)
     if_not_exists: bool = False
+    partition: Optional[PartitionSpec] = None
 
 
 @dataclass
@@ -279,10 +296,13 @@ class DropIndex(StmtNode):
 @dataclass
 class AlterTable(StmtNode):
     table: str
-    action: str                     # add_column | drop_column | rename
+    action: str     # add_column | drop_column | rename | add_partition |
+    #                 drop_partition | truncate_partition
     column: Optional[ColumnDef] = None
     column_name: Optional[str] = None
     new_name: Optional[str] = None
+    partition_def: Optional[PartitionDef] = None
+    partition_name: Optional[str] = None
 
 
 @dataclass

@@ -570,7 +570,12 @@ class Parser:
             if not self.try_op(","):
                 break
         self.expect_op(")")
-        # swallow table options: ENGINE=x CHARSET=y COMMENT 'z' ...
+        # swallow table options (ENGINE=x CHARSET=y …) up to an optional
+        # PARTITION BY clause
+        while not self.at("eof") and not self.at_op(";") \
+                and not self.at_kw("partition"):
+            self.advance()
+        part = self._partition_spec() if self.at_kw("partition") else None
         while not self.at("eof") and not self.at_op(";"):
             self.advance()
         for c in columns:
@@ -580,20 +585,107 @@ class Parser:
             for c in columns:
                 if c.name in pk:
                     c.ftype = c.ftype.with_nullable(False)
-        return ast.CreateTable(name, columns, pk, indexes, if_not_exists)
+        return ast.CreateTable(name, columns, pk, indexes, if_not_exists,
+                               part)
+
+    def _word(self, w: str) -> bool:
+        """Match a non-reserved word token (ident or kw) by value."""
+        if (self.cur.kind in ("ident", "kw")
+                and str(self.cur.value).lower() == w):
+            self.advance()
+            return True
+        return False
+
+    def _partition_spec(self) -> ast.PartitionSpec:
+        """PARTITION BY RANGE [COLUMNS] (col) (PARTITION p VALUES LESS
+        THAN (bound|MAXVALUE), …) | PARTITION BY HASH (col) PARTITIONS n
+        (ref: parser/parser.y PartitionOpt)."""
+        self.expect_kw("partition")
+        self.expect_kw("by")
+        if self._word("range"):
+            self._word("columns")
+            self.expect_op("(")
+            col = self.ident()
+            self.expect_op(")")
+            self.expect_op("(")
+            defs = []
+            while True:
+                self.expect_kw("partition")
+                pname = self.ident()
+                self.expect_kw("values")
+                if not self._word("less") or not self._word("than"):
+                    raise ParseError(
+                        f"expected VALUES LESS THAN near {self._near()}")
+                self.expect_op("(")
+                if self._word("maxvalue"):
+                    bound = None
+                else:
+                    bound = self.expr()
+                self.expect_op(")")
+                defs.append(ast.PartitionDef(pname, bound))
+                if not self.try_op(","):
+                    break
+            self.expect_op(")")
+            if not defs:
+                raise ParseError("RANGE partitioning needs partitions")
+            return ast.PartitionSpec("range", col, defs)
+        if self._word("hash"):
+            self.expect_op("(")
+            col = self.ident()
+            self.expect_op(")")
+            if not self._word("partitions"):
+                raise ParseError(
+                    f"expected PARTITIONS near {self._near()}")
+            tok = self.advance()
+            try:
+                num = int(tok.value)
+            except (TypeError, ValueError):
+                raise ParseError("PARTITIONS requires an integer")
+            if num < 1:
+                raise ParseError("PARTITIONS must be at least 1")
+            return ast.PartitionSpec(
+                "hash", col,
+                [ast.PartitionDef(f"p{i}") for i in range(num)], num)
+        raise ParseError(
+            f"unsupported PARTITION BY near {self._near()} "
+            f"(RANGE and HASH are supported)")
 
     def alter_table(self) -> ast.AlterTable:
         self.expect_kw("alter")
         self.expect_kw("table")
         name = self.ident()
         if self.try_kw("add"):
+            if self.at_kw("partition"):
+                self.advance()
+                self.expect_op("(")
+                self.expect_kw("partition")
+                pname = self.ident()
+                self.expect_kw("values")
+                if not self._word("less") or not self._word("than"):
+                    raise ParseError(
+                        f"expected VALUES LESS THAN near {self._near()}")
+                self.expect_op("(")
+                bound = None if self._word("maxvalue") else self.expr()
+                self.expect_op(")")
+                self.expect_op(")")
+                return ast.AlterTable(name, "add_partition",
+                                      partition_def=ast.PartitionDef(
+                                          pname, bound))
             self.try_kw("column")
             return ast.AlterTable(name, "add_column",
                                   column=self.column_def())
         if self.try_kw("drop"):
+            if self.at_kw("partition"):
+                self.advance()
+                return ast.AlterTable(name, "drop_partition",
+                                      partition_name=self.ident())
             self.try_kw("column")
             return ast.AlterTable(name, "drop_column",
                                   column_name=self.ident())
+        if self.try_kw("truncate"):
+            self.expect_kw("partition")
+            return ast.AlterTable(name, "truncate_partition",
+                                  partition_name=self.ident())
         if self.try_kw("rename"):
             self.try_kw("to")
             return ast.AlterTable(name, "rename",

@@ -1,0 +1,131 @@
+"""Partition routing and pruning (ref: table/tables/partition.go
+locatePartition; planner/core/rule_partition_processor.go).
+
+TPU-first layout: partitions are REGION COLOCATION TAGS inside the one
+columnar store table — INSERT routes each row batch so a region never
+mixes partitions, making region skip the pruning unit (the slab-native
+analog of per-partition region sets). One sorted-index view still covers
+the whole table (global-index semantics), so every index path keeps
+working unmodified."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from tidb_tpu.catalog import PartitionInfo, TableInfo
+from tidb_tpu.chunk import Chunk
+from tidb_tpu.errors import PartitionError
+from tidb_tpu.expression import ColumnRef, Constant, Expression, ScalarFunc
+
+
+def row_partitions(pinfo: PartitionInfo, values: np.ndarray,
+                   valid: np.ndarray) -> np.ndarray:
+    """Partition ordinal per row over the ENCODED key column.
+
+    RANGE: first partition whose bound exceeds the value; a value beyond
+    the last bound raises ER 1526 (unless MAXVALUE). HASH: MOD(v, n)
+    (floored, always non-negative). NULL routes to partition 0 both ways
+    (MySQL: NULL < any range value; NULL hashes as 0)."""
+    n = len(values)
+    if pinfo.kind == "hash":
+        v = np.asarray(values).astype(np.int64, copy=False)
+        ords = np.mod(v, pinfo.num)
+        return np.where(valid, ords, 0).astype(np.int64)
+    bounds = np.array([(np.iinfo(np.int64).max if b is None else b)
+                       for b in pinfo.bounds], dtype=np.int64)
+    v = np.asarray(values).astype(np.int64, copy=False)
+    ords = np.searchsorted(bounds, v, side="right")
+    ords = np.where(valid, ords, 0).astype(np.int64)
+    over = ords >= len(bounds)
+    if over.any():
+        bad = v[over][0]
+        raise PartitionError(
+            f"Table has no partition for value {int(bad)}")
+    return ords
+
+
+def split_chunk(pinfo: PartitionInfo, chunk: Chunk
+                ) -> List[Tuple[int, Chunk]]:
+    """→ [(ordinal, sub-chunk)] preserving row order within each part."""
+    col = chunk.columns[pinfo.col_offset]
+    ords = row_partitions(pinfo, col.values, col.valid_mask())
+    out = []
+    for k in np.unique(ords):
+        m = ords == k
+        out.append((int(k), chunk.filter(m) if not m.all() else chunk))
+    return out
+
+
+def _const_cmp(cond: Expression, col_offset: int):
+    """cond as (op, encoded-const) against the partition column, or None."""
+    if not isinstance(cond, ScalarFunc) or len(cond.args) != 2:
+        return None
+    swap = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le", "eq": "eq"}
+    op = cond.op
+    a, b = cond.args
+    if isinstance(b, ColumnRef) and isinstance(a, Constant):
+        a, b = b, a
+        op = swap.get(op)
+    if op not in ("lt", "le", "gt", "ge", "eq"):
+        return None
+    if not (isinstance(a, ColumnRef) and a.index == col_offset
+            and isinstance(b, Constant) and b.value is not None):
+        return None
+    try:
+        enc = a.ftype.encode_value(b.value)
+    except Exception:  # noqa: BLE001 — unencodable constant: no pruning
+        return None
+    if not isinstance(enc, (int, np.integer)):
+        return None
+    return op, int(enc)
+
+
+def prune_partitions(info: TableInfo, filters) -> Optional[Tuple[int, ...]]:
+    """Partition ordinals a scan with `filters` can touch; None when the
+    table is unpartitioned (ref: rule_partition_processor.go:59 — the
+    same conjunct-interval narrowing, over encoded values)."""
+    p = info.partition
+    if p is None:
+        return None
+    n = p.n_parts
+    if p.kind == "hash":
+        keep = set(range(n))
+        for cond in filters or []:
+            cc = _const_cmp(cond, p.col_offset)
+            if cc and cc[0] == "eq":
+                keep &= {int(np.mod(cc[1], p.num))}
+        return tuple(sorted(keep))
+    # RANGE: narrow a [lo_val, hi_val] interval over encoded values, then
+    # map to the partition ordinal interval
+    lo_v, hi_v = None, None     # inclusive value interval
+    for cond in filters or []:
+        cc = _const_cmp(cond, p.col_offset)
+        if cc is None:
+            continue
+        op, v = cc
+        if op == "eq":
+            lo_v = v if lo_v is None else max(lo_v, v)
+            hi_v = v if hi_v is None else min(hi_v, v)
+        elif op in ("lt", "le"):
+            u = v - 1 if op == "lt" else v
+            hi_v = u if hi_v is None else min(hi_v, u)
+        elif op in ("gt", "ge"):
+            u = v + 1 if op == "gt" else v
+            lo_v = u if lo_v is None else max(lo_v, u)
+    bounds = np.array([(np.iinfo(np.int64).max if b is None else b)
+                       for b in p.bounds], dtype=np.int64)
+    first = 0
+    last = n - 1
+    if lo_v is not None:
+        first = int(np.searchsorted(bounds, lo_v, side="right"))
+        # NULL rows live in partition 0 and no comparison matches NULL,
+        # so raising `first` is safe
+    if hi_v is not None:
+        last = int(np.searchsorted(bounds, hi_v, side="right"))
+    if lo_v is not None and hi_v is not None and lo_v > hi_v:
+        return ()
+    first = min(first, n)
+    last = min(last, n - 1)
+    return tuple(range(first, last + 1)) if first <= last else ()

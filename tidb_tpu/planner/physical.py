@@ -65,9 +65,19 @@ class PhysTableScan(PhysicalPlan):
         self.alias = ds.alias
         self.filters = ds.filters
         self.used_columns = ds.used_columns
+        # pruned partition ordinals (None = unpartitioned table); set by
+        # _to_physical from the pushed-down filters
+        self.partitions = None
 
     def describe(self):
         s = f"table:{self.table.name}"
+        p = getattr(self.table, "partition", None)
+        if p is not None and self.partitions is not None:
+            if len(self.partitions) == p.n_parts:
+                s += ", partition:all"
+            else:
+                s += ", partition:" + ",".join(
+                    p.names[i] for i in self.partitions)
         if self.filters:
             s += f", filters:{self.filters}"
         return s
@@ -482,6 +492,10 @@ def estimate(plan: PhysicalPlan, ctx) -> float:
         return plan.est_rows
     if isinstance(plan, PhysTableScan):
         n = float(_table_rows(plan.table, ctx))
+        p = getattr(plan.table, "partition", None)
+        if p is not None and plan.partitions is not None and p.n_parts:
+            # partition pruning removes whole region sets up front
+            n *= len(plan.partitions) / p.n_parts
         if plan.filters:
             from tidb_tpu.statistics import filters_selectivity
             stats = _table_stats(plan.table, ctx)
@@ -945,7 +959,11 @@ def _to_physical(plan: LogicalPlan, ctx) -> PhysicalPlan:
         idx = _try_index_access(plan, ctx)
         if idx is not None:
             return idx
-        return PhysTableScan(plan)
+        scan = PhysTableScan(plan)
+        if getattr(plan.table, "partition", None) is not None:
+            from tidb_tpu.planner.partition import prune_partitions
+            scan.partitions = prune_partitions(plan.table, plan.filters)
+        return scan
     if isinstance(plan, LogicalMemTable):
         return PhysMemTable(plan)
     if isinstance(plan, LogicalDual):

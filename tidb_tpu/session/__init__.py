@@ -23,8 +23,8 @@ import numpy as np
 from tidb_tpu import types as T
 from tidb_tpu.catalog import Catalog, ColumnInfo, IndexInfo, TableInfo
 from tidb_tpu.chunk import Chunk, Column
-from tidb_tpu.errors import (ExecutionError, PlanError, TiDBTPUError,
-                             TxnError, UnknownColumnError)
+from tidb_tpu.errors import (DDLError, ExecutionError, PlanError,
+                             TiDBTPUError, TxnError, UnknownColumnError)
 from tidb_tpu.executor import ExecContext, build, run_to_completion
 from tidb_tpu.expression import Expression
 from tidb_tpu.expression.runner import eval_on_chunk, filter_mask
@@ -857,11 +857,91 @@ class Session:
                                         if c.primary_key]
         idx = [IndexInfo(i.name, tuple(i.columns), i.unique)
                for i in stmt.indexes]
+        pinfo = None
+        if stmt.partition is not None:
+            pinfo = self._build_partition_info(stmt, cols)
         info = self.engine.catalog.create_table(stmt.name, cols, pk, idx,
-                                                stmt.if_not_exists)
+                                                stmt.if_not_exists, pinfo)
         if info is not None:
             self.engine.store.create_table(info.id)
         return ok()
+
+    def _build_partition_info(self, stmt: ast.CreateTable, cols):
+        """Validate and encode a PARTITION BY spec (ref: ddl/ddl_api.go
+        buildTablePartitionInfo): the key column must exist and be
+        integer-encodable; RANGE bounds fold to constants, encode in the
+        column's value space, and must ascend strictly."""
+        from tidb_tpu.catalog import PartitionInfo
+        from tidb_tpu.expression import Constant
+        from tidb_tpu.planner.rules import fold_expr
+        spec = stmt.partition
+        offset = next((i for i, c in enumerate(cols)
+                       if c.name.lower() == spec.column.lower()), None)
+        if offset is None:
+            raise PlanError(f"Unknown column '{spec.column}' in "
+                            f"partition function")
+        ft = cols[offset].ftype
+        if ft.kind.is_string or ft.is_wide_decimal or \
+                ft.np_dtype.kind == "f":
+            raise PlanError(
+                "Partition key must be an integer-valued column "
+                "(INT/BIGINT/DATE/DATETIME family)")
+        names = tuple(d.name for d in spec.defs)
+        if len(set(n.lower() for n in names)) != len(names):
+            raise PlanError("Duplicate partition name")
+        if spec.kind == "hash":
+            return PartitionInfo("hash", spec.column, offset, names,
+                                 num=spec.num)
+        bounds = [self._encode_partition_bound(ft, d.less_than)
+                  for d in spec.defs]
+        for a, b in zip(bounds, bounds[1:]):
+            if a is None or (b is not None and b <= a):
+                raise PlanError(
+                    "VALUES LESS THAN value must be strictly increasing "
+                    "for each partition")
+        return PartitionInfo("range", spec.column, offset, names,
+                             tuple(bounds))
+
+    @staticmethod
+    def _encode_partition_bound(ft, expr) -> Optional[int]:
+        """Fold + encode one VALUES LESS THAN bound (None = MAXVALUE) —
+        the ONE validation path for CREATE TABLE and ADD PARTITION."""
+        from tidb_tpu.expression import Constant
+        from tidb_tpu.planner.rules import fold_expr
+        if expr is None:
+            return None
+        rw = ExpressionRewriter(Schema([]))
+        folded = fold_expr(rw.rewrite(expr))
+        if not isinstance(folded, Constant) or folded.value is None:
+            raise PlanError("VALUES LESS THAN must be a constant")
+        try:
+            enc = ft.encode_value(folded.value)
+        except (ValueError, TiDBTPUError):
+            enc = None
+        if not isinstance(enc, (int, np.integer)):
+            raise PlanError("VALUES LESS THAN must encode to an "
+                            "integer for this column type")
+        return int(enc)
+
+    def _validate_routing(self, info: TableInfo, chunk: Chunk) -> None:
+        """Raise ER 1526 BEFORE any delete is staged: a routing failure
+        mid-statement must not leave half the DML applied."""
+        if info.partition is None or chunk.num_rows == 0:
+            return
+        from tidb_tpu.planner.partition import row_partitions
+        col = chunk.columns[info.partition.col_offset]
+        row_partitions(info.partition, col.values, col.valid_mask())
+
+    def _append_routed(self, target, info: TableInfo, chunk: Chunk) -> None:
+        """Append through partition routing: each sub-chunk lands in its
+        partition's own regions (table/tables/partition.go
+        locatePartition — here a vectorized split)."""
+        if info.partition is None or chunk.num_rows == 0:
+            target.append(info.id, chunk)
+            return
+        from tidb_tpu.planner.partition import split_chunk
+        for ordinal, sub in split_chunk(info.partition, chunk):
+            target.append(info.id, sub, part=ordinal)
 
     # ---- DML ---------------------------------------------------------------
     def _fill_auto_increment(self, info: TableInfo, chunk: Chunk) -> Chunk:
@@ -907,7 +987,7 @@ class Session:
                 if m.any():
                     mx = max(mx, int(np.asarray(col.values)[m].max()))
         if self.txn is not None:
-            for st in self.txn.staged_inserts.get(info.id, []):
+            for st, _part in self.txn.staged_inserts.get(info.id, []):
                 col = st.columns[c.offset]
                 m = col.valid_mask()
                 if m.any():
@@ -924,10 +1004,13 @@ class Session:
         chunk = self._fill_auto_increment(info, chunk)
         txn, auto = self._write_txn()
         try:
+            # route-validate BEFORE REPLACE stages conflicting-row deletes
+            # (a superset of the post-enforce rows, so validity carries)
+            self._validate_routing(info, chunk)
             chunk = self._enforce_unique(info, chunk, txn,
                                          ignore=stmt.ignore,
                                          replace=stmt.replace)
-            txn.append(info.id, chunk)
+            self._append_routed(txn, info, chunk)
             if auto:
                 txn.commit()
         except TiDBTPUError:
@@ -1254,11 +1337,14 @@ class Session:
                                for c, col in zip(info.columns,
                                                  new_chunk.columns)])
             _check_not_null_chunk(new_chunk, info)
+            # route-validate BEFORE staging deletes: a PartitionError must
+            # not leave the delete half of the update applied
+            self._validate_routing(info, new_chunk)
             if region_masks:
                 txn.delete(info.id, region_masks)
             if staged_keep:
                 txn.delete_staged(info.id, np.concatenate(staged_keep))
-            txn.append(info.id, new_chunk)
+            self._append_routed(txn, info, new_chunk)
             if auto:
                 txn.commit()
             self._note_modified(txn, auto, info.id, new_chunk.num_rows)
@@ -1403,6 +1489,15 @@ class Session:
         align_chunk_to_schema); DROP COLUMN rewrites storage eagerly
         because regions hold positional layouts."""
         cat = self.engine.catalog
+        info0 = cat.info_schema.table(stmt.table)
+        if info0.partition is not None and stmt.action in ("add_column",
+                                                          "drop_column"):
+            # column offsets anchor the partition function and region
+            # layouts carry colocation tags; rewriting both online is
+            # out of scope (the reference also restricts many ALTERs on
+            # partitioned tables, ddl/ddl_api.go)
+            raise DDLError("Unsupported ALTER on a partitioned table",
+                           code=8200)
         if stmt.action == "add_column":
             c = stmt.column
             default = None
@@ -1451,7 +1546,74 @@ class Session:
         if stmt.action == "rename":
             cat.rename_table(stmt.table, stmt.new_name)
             return ok()
+        if stmt.action in ("add_partition", "drop_partition",
+                           "truncate_partition"):
+            return self._alter_partition(stmt, info0)
         raise PlanError(f"unsupported ALTER action {stmt.action}")
+
+    def _alter_partition(self, stmt: ast.AlterTable,
+                         info: TableInfo) -> ResultSet:
+        """ADD/DROP/TRUNCATE PARTITION (ref: ddl/partition.go
+        onAddTablePartition / onDropTablePartition; storage side is a
+        wholesale region-set operation — the partition IS its regions)."""
+        from dataclasses import replace as d_replace
+
+        from tidb_tpu.expression import Constant
+        from tidb_tpu.planner.rules import fold_expr
+        p = info.partition
+        if p is None:
+            raise DDLError("Partition management on a not partitioned "
+                           "table", code=1505)
+        if stmt.action == "add_partition":
+            if p.kind != "range":
+                raise DDLError("ADD PARTITION is for RANGE partitioning",
+                               code=1492)
+            d = stmt.partition_def
+            if d.name.lower() in (n.lower() for n in p.names):
+                raise DDLError(f"Duplicate partition name {d.name}",
+                               code=1517)
+            if p.bounds and p.bounds[-1] is None:
+                raise DDLError(
+                    "MAXVALUE can only be used in last partition "
+                    "definition", code=1481)
+            enc = self._encode_partition_bound(
+                info.columns[p.col_offset].ftype, d.less_than)
+            if enc is not None and p.bounds \
+                    and p.bounds[-1] is not None and enc <= p.bounds[-1]:
+                raise DDLError(
+                    "VALUES LESS THAN value must be strictly "
+                    "increasing for each partition", code=1493)
+            new_p = d_replace(p, names=p.names + (d.name,),
+                              bounds=p.bounds + (enc,))
+            self.engine.catalog.set_partition(info.name, new_p)
+            return ok()
+        # DROP / TRUNCATE need the ordinal
+        try:
+            ordinal = next(i for i, n in enumerate(p.names)
+                           if n.lower() == stmt.partition_name.lower())
+        except StopIteration:
+            raise DDLError(f"Unknown partition "
+                           f"'{stmt.partition_name}'", code=1735)
+        if stmt.action == "truncate_partition":
+            n = self.engine.store.drop_partition_rows(info.id, ordinal)
+            self.engine.note_modified(info.id, n)
+            return ok(n)
+        if p.kind != "range":
+            raise DDLError("DROP PARTITION is for RANGE partitioning",
+                           code=1512)
+        if p.n_parts == 1:
+            raise DDLError("Cannot remove all partitions", code=1508)
+        remap = {i: (i - 1 if i > ordinal else i)
+                 for i in range(p.n_parts) if i != ordinal}
+        n = self.engine.store.drop_partition_rows(info.id, ordinal, remap)
+        new_p = d_replace(
+            p,
+            names=tuple(x for i, x in enumerate(p.names) if i != ordinal),
+            bounds=tuple(x for i, x in enumerate(p.bounds)
+                         if i != ordinal))
+        self.engine.catalog.set_partition(info.name, new_p)
+        self.engine.note_modified(info.id, n)
+        return ok(n)
 
     # ---- WITH / CTE (ref: executor/cte.go — materialized CTE storage) ----
     _cte_seq = itertools.count(1)

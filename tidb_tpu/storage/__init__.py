@@ -37,11 +37,16 @@ REGION_ROWS = 1 << 16  # region split threshold (ref: TiKV region ~96MB)
 @dataclass(frozen=True)
 class Region:
     """One immutable slab of rows. `deleted` is copy-on-write: never mutated
-    after publication, so snapshot readers are race-free."""
+    after publication, so snapshot readers are race-free. `part` tags the
+    table partition every row of this region belongs to (INSERT routes
+    rows so regions never mix partitions — region-level colocation is the
+    pruning unit, the slab-native analog of a partition's own region set
+    in table/tables/partition.go)."""
 
     id: int
     chunk: Chunk
     deleted: np.ndarray  # bool (n_rows,)
+    part: Optional[int] = None
 
     @property
     def num_rows(self) -> int:
@@ -79,9 +84,16 @@ class Snapshot:
     def has_table(self, table_id: int) -> bool:
         return table_id in self._tables
 
-    def scan(self, table_id: int) -> Iterable[Tuple[Region, np.ndarray]]:
-        """Yield (region, alive_mask) pairs — the coprocessor-task stream."""
+    def scan(self, table_id: int, parts=None
+             ) -> Iterable[Tuple[Region, np.ndarray]]:
+        """Yield (region, alive_mask) pairs — the coprocessor-task stream.
+        `parts` (a set of partition ordinals) SKIPS non-matching regions:
+        region-level partition pruning, zero bytes touched for pruned
+        partitions."""
         for r in self.table_data(table_id).regions:
+            if parts is not None and r.part is not None \
+                    and r.part not in parts:
+                continue
             yield r, ~r.deleted
 
 
@@ -237,33 +249,41 @@ class Store:
             return Snapshot(dict(self._tables), self._version, self)
 
     # ---- writes (autocommit fast path) -----------------------------------
-    def append(self, table_id: int, chunk: Chunk) -> None:
+    def append(self, table_id: int, chunk: Chunk,
+               part: Optional[int] = None) -> None:
         """Append rows, splitting into REGION_ROWS regions."""
         with self._lock:
-            self._append_locked(table_id, chunk)
+            self._append_locked(table_id, chunk, part)
             self._bump_locked()
 
-    def _append_locked(self, table_id: int, chunk: Chunk) -> None:
+    def _append_locked(self, table_id: int, chunk: Chunk,
+                       part: Optional[int] = None) -> None:
         td = self._tables.get(table_id)
         if td is None:
             raise UnknownTableError(f"no storage for table id {table_id}")
         regions = list(td.regions)
         # top off the last region if it has headroom and is undeleted-pure
         for start in range(0, chunk.num_rows, REGION_ROWS):
-            part = chunk.slice(start, min(start + REGION_ROWS, chunk.num_rows))
-            if (regions and regions[-1].num_rows + part.num_rows <= REGION_ROWS
+            piece = chunk.slice(start, min(start + REGION_ROWS,
+                                           chunk.num_rows))
+            if (regions and regions[-1].num_rows + piece.num_rows
+                    <= REGION_ROWS
                     and not regions[-1].deleted.any()
-                    and regions[-1].chunk.num_cols == part.num_cols):
+                    and regions[-1].part == part
+                    and regions[-1].chunk.num_cols == piece.num_cols):
                 # layouts must match: a region written before ADD COLUMN
                 # keeps its narrow layout (padded at read); new rows with
-                # the wider layout start a fresh region
+                # the wider layout start a fresh region — and regions
+                # never mix partitions
                 last = regions[-1]
-                merged = Chunk.concat([last.chunk, part])
+                merged = Chunk.concat([last.chunk, piece])
                 regions[-1] = Region(last.id, merged,
-                                     np.zeros(merged.num_rows, dtype=bool))
+                                     np.zeros(merged.num_rows, dtype=bool),
+                                     part)
             else:
-                regions.append(Region(next(self._region_ids), part,
-                                      np.zeros(part.num_rows, dtype=bool)))
+                regions.append(Region(next(self._region_ids), piece,
+                                      np.zeros(piece.num_rows, dtype=bool),
+                                      part))
         self._tables[table_id] = TableData(tuple(regions))
 
     GC_DEAD_RATIO = 0.5     # compact when half a table is tombstones
@@ -306,8 +326,34 @@ class Store:
                 continue            # fully dead region vanishes
             kept = r.chunk.take(np.nonzero(alive)[0])
             regions.append(Region(next(self._region_ids), kept,
-                                  np.zeros(kept.num_rows, dtype=bool)))
+                                  np.zeros(kept.num_rows, dtype=bool),
+                                  r.part))
         self._tables[table_id] = TableData(tuple(regions))
+
+    def drop_partition_rows(self, table_id: int, ordinal: int,
+                            remap=None) -> int:
+        """TRUNCATE/DROP PARTITION: remove every region tagged `ordinal`
+        wholesale (no tombstones — the partition IS the region set), and
+        optionally re-tag surviving regions (DROP shifts later ordinals).
+        Returns rows removed."""
+        with self._lock:
+            td = self._tables.get(table_id)
+            if td is None:
+                raise UnknownTableError(f"no storage for table {table_id}")
+            kept = []
+            removed = 0
+            for r in td.regions:
+                if r.part == ordinal:
+                    removed += r.live_rows
+                    continue
+                if remap is not None and r.part is not None:
+                    new_part = remap.get(r.part, r.part)
+                    if new_part != r.part:
+                        r = Region(r.id, r.chunk, r.deleted, new_part)
+                kept.append(r)
+            self._tables[table_id] = TableData(tuple(kept))
+            self._bump_locked()
+            return removed
 
     def gc_stats(self, table_id: int):
         """(live_rows, dead_rows, regions) — observability hook."""
@@ -390,9 +436,9 @@ class Store:
                     raise TxnError("write conflict: table dropped")
             for tid, masks in txn.staged_deletes.items():
                 self._delete_locked(tid, masks)
-            for tid, chunks in txn.staged_inserts.items():
-                for ch in chunks:
-                    self._append_locked(tid, ch)
+            for tid, items in txn.staged_inserts.items():
+                for ch, part in items:
+                    self._append_locked(tid, ch, part)
             for tid in txn.staged_deletes:
                 self._maybe_compact_locked(tid, closing=1)
             self._bump_locked()
@@ -413,7 +459,8 @@ class Transaction:
     def __init__(self, store: Store, snapshot: Snapshot):
         self._store = store
         self.snapshot = snapshot
-        self.staged_inserts: Dict[int, List[Chunk]] = {}
+        # table_id → [(chunk, partition ordinal or None)]
+        self.staged_inserts: Dict[int, List[Tuple[Chunk, Optional[int]]]] = {}
         self.staged_deletes: Dict[int, Dict[int, np.ndarray]] = {}
         self.active = True
         self.txn_id = next(store._txn_seq)
@@ -427,8 +474,9 @@ class Transaction:
         return bool(self.staged_inserts) or bool(self.staged_deletes)
 
     # ---- writes ----------------------------------------------------------
-    def append(self, table_id: int, chunk: Chunk) -> None:
-        self.staged_inserts.setdefault(table_id, []).append(chunk)
+    def append(self, table_id: int, chunk: Chunk,
+               part: Optional[int] = None) -> None:
+        self.staged_inserts.setdefault(table_id, []).append((chunk, part))
 
     def delete(self, table_id: int, region_masks: Dict[int, np.ndarray]) -> int:
         staged = self.staged_deletes.setdefault(table_id, {})
@@ -446,20 +494,30 @@ class Transaction:
     def delete_staged(self, table_id: int, keep_mask: np.ndarray) -> None:
         """Remove rows from this txn's own staged inserts (delete-after-insert
         inside one txn)."""
-        chunks = self.staged_inserts.get(table_id)
-        if not chunks:
+        items = self.staged_inserts.get(table_id)
+        if not items:
             return
-        merged = Chunk.concat(chunks) if len(chunks) > 1 else chunks[0]
-        kept = merged.filter(keep_mask)
-        self.staged_inserts[table_id] = [kept] if kept.num_rows else []
+        # keep_mask follows scan order (chunks in list order); filter each
+        # piece separately so partition tags survive
+        kept_items = []
+        off = 0
+        for ch, part in items:
+            m = keep_mask[off:off + ch.num_rows]
+            off += ch.num_rows
+            k = ch.filter(m)
+            if k.num_rows:
+                kept_items.append((k, part))
+        self.staged_inserts[table_id] = kept_items
 
     # ---- reads (UnionScan merge) -----------------------------------------
-    def scan(self, table_id: int) -> Iterable[Tuple[Optional[Region], Chunk, np.ndarray]]:
+    def scan(self, table_id: int, parts=None
+             ) -> Iterable[Tuple[Optional[Region], Chunk, np.ndarray]]:
         """Yield (region_or_None, chunk, alive_mask): committed regions with
-        staged deletes applied, then staged-insert chunks."""
+        staged deletes applied, then staged-insert chunks (both honoring
+        partition pruning via `parts`)."""
         staged_del = self.staged_deletes.get(table_id, {})
         if self.snapshot.has_table(table_id):
-            for r, alive in self.snapshot.scan(table_id):
+            for r, alive in self.snapshot.scan(table_id, parts):
                 mask = alive
                 sd = staged_del.get(r.id)
                 if sd is not None:
@@ -468,10 +526,11 @@ class Transaction:
         elif self._store.snapshot().has_table(table_id):
             # table created AFTER this txn began (session-private CTE
             # temp materialization): read it from the current store view
-            for r, alive in self._store.snapshot().scan(table_id):
+            for r, alive in self._store.snapshot().scan(table_id, parts):
                 yield r, r.chunk, alive
-        for ch in self.staged_inserts.get(table_id, []):
-            if ch.num_rows:
+        for ch, part in self.staged_inserts.get(table_id, []):
+            if ch.num_rows and (parts is None or part is None
+                                or part in parts):
                 yield None, ch, np.ones(ch.num_rows, dtype=bool)
 
     # ---- lifecycle -------------------------------------------------------

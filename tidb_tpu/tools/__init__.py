@@ -93,6 +93,24 @@ def create_table_sql(info) -> str:
         cols.append("PRIMARY KEY (" +
                     ", ".join(f"`{c}`" for c in info.primary_key) + ")")
     ddl = f"CREATE TABLE `{info.name}` (\n  " + ",\n  ".join(cols) + "\n)"
+    p = getattr(info, "partition", None)
+    if p is not None:
+        if p.kind == "hash":
+            ddl += (f"\nPARTITION BY HASH (`{p.column}`) "
+                    f"PARTITIONS {p.num}")
+        else:
+            ft = info.columns[p.col_offset].ftype
+            defs = []
+            for name, b in zip(p.names, p.bounds):
+                if b is None:
+                    lit = "MAXVALUE"
+                else:
+                    val = ft.decode_value(b)
+                    lit = (str(val) if isinstance(val, (int, float))
+                           else "'" + str(val) + "'")
+                defs.append(f"PARTITION `{name}` VALUES LESS THAN ({lit})")
+            ddl += (f"\nPARTITION BY RANGE (`{p.column}`) (\n  " +
+                    ",\n  ".join(defs) + "\n)")
     extra = []
     for ix in info.indexes:
         u = "UNIQUE " if ix.unique else ""
@@ -267,7 +285,14 @@ def restore(engine, backup_dir: str) -> List[str]:
             pos += 8
             chunk = decode_chunk(buf[pos:pos + ln], ftypes)
             pos += ln
-            txn.append(info.id, chunk)
+            if info.partition is not None:
+                # restored rows must re-acquire their region partition
+                # tags or partition DDL/pruning would miss them
+                from tidb_tpu.planner.partition import split_chunk
+                for ordinal, sub in split_chunk(info.partition, chunk):
+                    txn.append(info.id, sub, part=ordinal)
+            else:
+                txn.append(info.id, chunk)
         txn.commit()
         ckpt.mark(name)
         restored.append(name)
