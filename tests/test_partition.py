@@ -244,3 +244,16 @@ def test_alter_add_partition_bad_bound(s):
     with pytest.raises(PlanError):
         s.execute("ALTER TABLE ab ADD PARTITION "
                   "(PARTITION p1 VALUES LESS THAN ('abc'))")
+
+
+def test_review_r5_partition_findings(s):
+    # inexact constants must not prune away satisfying rows
+    s.execute("CREATE TABLE px (id BIGINT) PARTITION BY RANGE (id) ("
+              "PARTITION p0 VALUES LESS THAN (99), "
+              "PARTITION p1 VALUES LESS THAN (MAXVALUE))")
+    s.execute("INSERT INTO px VALUES (98), (99), (100)")
+    assert s.query("SELECT COUNT(*) FROM px WHERE id < 99.5").rows == \
+        [(2,)]
+    # int64-max lands in the MAXVALUE partition (no sentinel edge)
+    s.execute(f"INSERT INTO px VALUES ({2**63 - 1})")
+    assert s.query("SELECT COUNT(*) FROM px").rows == [(4,)]

@@ -94,16 +94,38 @@ class TableInfo:
         return [c.name for c in self.columns]
 
 
+@dataclass(frozen=True)
+class ViewInfo:
+    """A stored SELECT (ref: parser/model/model.go ViewInfo). Expansion
+    happens in the plan builder — a view is a named derived table."""
+
+    name: str
+    sql: str                        # the definition's SELECT text
+    columns: Tuple[str, ...] = ()   # optional explicit column names
+
+
 class InfoSchema:
     """One immutable schema snapshot (ref: infoschema/infoschema.go:60)."""
 
-    def __init__(self, version: int, tables: Dict[str, TableInfo]):
+    def __init__(self, version: int, tables: Dict[str, TableInfo],
+                 views: Optional[Dict[str, ViewInfo]] = None):
         self.version = version
         self._tables = tables  # lower-name → TableInfo
+        self._views: Dict[str, ViewInfo] = views or {}
+
+    def view(self, name: str) -> Optional[ViewInfo]:
+        return self._views.get(name.lower())
+
+    def list_views(self) -> List[ViewInfo]:
+        return sorted(self._views.values(), key=lambda v: v.name.lower())
 
     def table(self, name: str) -> TableInfo:
         t = self._tables.get(name.lower())
         if t is None:
+            if name.lower() in self._views:
+                # views resolve in the plan builder; reaching here means
+                # a base-table-only operation (DML/DDL) targeted a view
+                raise DDLError(f"'{name}' is not BASE TABLE", code=1347)
             raise UnknownTableError(f"Table '{name}' doesn't exist")
         return t
 
@@ -145,11 +167,42 @@ class Catalog:
         return self._snapshot
 
     def _bump(self, tables: Dict[str, TableInfo], job: str,
-              temp: bool = False) -> None:
-        self._snapshot = InfoSchema(self._snapshot.version + 1, tables)
+              temp: bool = False, views=None) -> None:
+        self._snapshot = InfoSchema(
+            self._snapshot.version + 1, tables,
+            self._snapshot._views if views is None else views)
         if not temp:
             self.user_version += 1
         self._history.append(job)
+
+    def create_view(self, name: str, sql: str, columns=(),
+                    or_replace: bool = False) -> ViewInfo:
+        """Ref: ddl/ddl_api.go:2186 CreateView — one namespace with
+        tables (ER 1050 on conflict unless OR REPLACE over a view)."""
+        with self._lock:
+            key = name.lower()
+            if key in self._snapshot._tables:
+                raise TableExistsError(f"Table '{name}' already exists")
+            if key in self._snapshot._views and not or_replace:
+                raise TableExistsError(f"Table '{name}' already exists")
+            views = dict(self._snapshot._views)
+            v = ViewInfo(name, sql, tuple(columns or ()))
+            views[key] = v
+            self._bump(dict(self._snapshot._tables),
+                       f"create view {name}", views=views)
+            return v
+
+    def drop_view(self, name: str, if_exists: bool = False) -> None:
+        with self._lock:
+            key = name.lower()
+            if key not in self._snapshot._views:
+                if if_exists:
+                    return
+                raise UnknownTableError(f"Unknown view '{name}'")
+            views = dict(self._snapshot._views)
+            views.pop(key)
+            self._bump(dict(self._snapshot._tables),
+                       f"drop view {name}", views=views)
 
     def ddl_history(self) -> List[str]:
         return list(self._history)
@@ -165,6 +218,9 @@ class Catalog:
             if key in self._snapshot._tables:
                 if if_not_exists:
                     return None
+                raise TableExistsError(f"Table '{name}' already exists")
+            if key in self._snapshot._views:
+                # one namespace: a table may not shadow a view
                 raise TableExistsError(f"Table '{name}' already exists")
             cols = tuple(replace(c, offset=i) for i, c in enumerate(columns))
             info = TableInfo(next(self._ids), name, cols,

@@ -210,6 +210,8 @@ class SelectStmt(StmtNode):
     limit: Optional[Tuple[int, int]] = None   # (offset, count)
     distinct: bool = False
     for_update: bool = False
+    # optimizer hints from /*+ ... */: [(name_lower, [args])]
+    hints: List[Tuple[str, List[str]]] = field(default_factory=list)
 
 
 @dataclass
@@ -277,6 +279,23 @@ class CreateTable(StmtNode):
     indexes: List[IndexDef] = field(default_factory=list)
     if_not_exists: bool = False
     partition: Optional[PartitionSpec] = None
+
+
+@dataclass
+class CreateView(StmtNode):
+    """CREATE [OR REPLACE] VIEW v [(cols)] AS select (ref:
+    ddl/ddl_api.go:2186 CreateView)."""
+    name: str
+    select: StmtNode
+    columns: Optional[List[str]] = None
+    or_replace: bool = False
+    text: str = ""                  # the definition's SELECT source text
+
+
+@dataclass
+class DropView(StmtNode):
+    names: List[str]
+    if_exists: bool = False
 
 
 @dataclass

@@ -61,6 +61,10 @@ def tokenize(sql: str) -> List[Token]:
             j = sql.find("*/", i + 2)
             if j < 0:
                 raise ParseError(f"unterminated comment at {i}")
+            if sql.startswith("/*+", i):
+                # optimizer hint comment: preserved as one token
+                # (ref: parser/hintparser.y — /*+ ... */ after SELECT)
+                toks.append(Token("hint", sql[i + 3:j].strip(), i))
             i = j + 2
             continue
         # strings

@@ -18,7 +18,7 @@ from tidb_tpu.types import FieldType, TypeKind
 
 def parse(sql: str) -> List[ast.StmtNode]:
     """Parse a semicolon-separated script → statement list."""
-    p = Parser(tokenize(sql))
+    p = Parser(tokenize(sql), sql)
     stmts = []
     while not p.at("eof"):
         if p.try_op(";"):
@@ -33,7 +33,7 @@ def parse_with_text(sql: str) -> List[Tuple[ast.StmtNode, str]]:
     """Like parse(), but pairs each statement with its own source slice
     (for per-statement logging/digests in multi-statement scripts)."""
     toks = tokenize(sql)
-    p = Parser(toks)
+    p = Parser(toks, sql)
     out = []
     while not p.at("eof"):
         if p.try_op(";"):
@@ -55,8 +55,17 @@ def parse_one(sql: str) -> ast.StmtNode:
 
 
 class Parser:
-    def __init__(self, tokens: List[Token]):
-        self.toks = tokens
+    def __init__(self, tokens: List[Token], src: str = ""):
+        # hint comments are only meaningful right after SELECT; anywhere
+        # else they behave like ordinary comments (dropped), so e.g.
+        # INSERT /*+ x() */ INTO keeps parsing
+        kept: List[Token] = []
+        for t in tokens:
+            if t.kind == "hint" and not (kept and kept[-1].is_kw("select")):
+                continue
+            kept.append(t)
+        self.toks = kept
+        self.src = src
         self.i = 0
 
     # ---- token plumbing --------------------------------------------------
@@ -126,10 +135,18 @@ class Parser:
         if self.at_kw("create"):
             if self.toks[self.i + 1].is_kw("user"):
                 return self.create_user()
+            nxt = [str(self.toks[self.i + k].value).lower()
+                   for k in (1, 2, 3)
+                   if self.i + k < len(self.toks)]
+            if nxt and (nxt[0] == "view" or nxt[:1] == ["or"]
+                        and "view" in nxt):
+                return self.create_view()
             return self.create_table()
         if self.at_kw("drop"):
             if self.toks[self.i + 1].is_kw("user"):
                 return self.drop_user()
+            if str(self.toks[self.i + 1].value).lower() == "view":
+                return self.drop_view()
             return self.drop_table()
         if self.at_kw("load"):
             return self.load_data()
@@ -358,6 +375,7 @@ class Parser:
             self.expect_op(")")
             return s
         self.expect_kw("select")
+        hints = self._parse_hints() if self.at("hint") else []
         distinct = bool(self.try_kw("distinct"))
         self.try_kw("all")
         items = [self.select_item()]
@@ -382,7 +400,20 @@ class Parser:
             for_update = True
         return ast.SelectStmt(items, from_, where, group_by, having,
                                order_by, limit, distinct,
-                               for_update=for_update)
+                               for_update=for_update, hints=hints)
+
+    def _parse_hints(self) -> List:
+        """/*+ NAME(arg, ...) NAME2() ... */ → [(name_lower, [args])]
+        (ref: parser/hintparser.y; unknown hints are kept — the planner
+        ignores what it doesn't steer)."""
+        import re as _re
+        text = str(self.advance().value)
+        out = []
+        for m in _re.finditer(r"([A-Za-z_]\w*)\s*\(([^()]*)\)", text):
+            args = [a.strip().strip("`").lower()
+                    for a in m.group(2).split(",") if a.strip()]
+            out.append((m.group(1).lower(), args))
+        return out
 
     def select_item(self) -> ast.SelectItem:
         if self.at_op("*"):
@@ -587,6 +618,41 @@ class Parser:
                     c.ftype = c.ftype.with_nullable(False)
         return ast.CreateTable(name, columns, pk, indexes, if_not_exists,
                                part)
+
+    def create_view(self) -> ast.CreateView:
+        self.expect_kw("create")
+        or_replace = False
+        if self.try_kw("or"):
+            self.expect_kw("replace")
+            or_replace = True
+        if not self._word("view"):
+            raise ParseError(f"expected VIEW near {self._near()}")
+        name = self.ident()
+        cols = None
+        if self.try_op("("):
+            cols = [self.ident()]
+            while self.try_op(","):
+                cols.append(self.ident())
+            self.expect_op(")")
+        self.expect_kw("as")
+        start = self.cur.pos
+        sel = self.select_with_setops()
+        end = self.cur.pos if not self.at("eof") else len(self.src)
+        text = self.src[start:end].strip().rstrip(";").strip()
+        return ast.CreateView(name, sel, cols, or_replace, text)
+
+    def drop_view(self) -> ast.DropView:
+        self.expect_kw("drop")
+        if not self._word("view"):
+            raise ParseError(f"expected VIEW near {self._near()}")
+        if_exists = False
+        if self.try_kw("if"):
+            self.expect_kw("exists")
+            if_exists = True
+        names = [self.ident()]
+        while self.try_op(","):
+            names.append(self.ident())
+        return ast.DropView(names, if_exists)
 
     def _word(self, w: str) -> bool:
         """Match a non-reserved word token (ident or kw) by value."""
@@ -916,6 +982,8 @@ class Parser:
             self.expect_kw("from")
             return ast.ShowStmt("columns", target=self.ident())
         if self.try_kw("create"):
+            if self._word("view"):
+                return ast.ShowStmt("create_view", target=self.ident())
             self.expect_kw("table")
             return ast.ShowStmt("create_table", target=self.ident())
         if self.at("ident") or self.at("kw"):

@@ -15,6 +15,8 @@ WHERE-clause subqueries decorrelate into semi/anti/left joins
 
 from __future__ import annotations
 
+import threading
+
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from tidb_tpu import types as T
@@ -654,6 +656,9 @@ def _has_agg(node: ast.Node) -> bool:
     return False
 
 
+_VIEW_DEPTH = threading.local()
+
+
 class PlanBuilder:
     """Ref: planner/core/planbuilder.go PlanBuilder."""
 
@@ -664,8 +669,11 @@ class PlanBuilder:
         self.ctx = ctx
         self.subq = subq or getattr(ctx, "subquery_evaluator", None)
         # CTE name (lower) → materialized temp table (session-provided;
-        # ref: executor/cte.go materializes into cteutil storage)
-        self.cte_map = cte_map or getattr(ctx, "cte_map", None) or {}
+        # ref: executor/cte.go materializes into cteutil storage).
+        # An explicit {} means ISOLATION (view bodies must not see the
+        # outer query's CTE names) — distinguish it from None
+        self.cte_map = cte_map if cte_map is not None else (
+            getattr(ctx, "cte_map", None) or {})
         # set on nested builders for correlated subqueries: the enclosing
         # query's schema (expression_rewriter.go outerSchemas analog)
         self.outer_schema: Optional[Schema] = None
@@ -713,6 +721,10 @@ class PlanBuilder:
             if mapped is not None:
                 info = self.info_schema.table(mapped)
                 return LogicalDataSource(info, ref.alias or ref.name)
+            view = self.info_schema.view(ref.name) \
+                if hasattr(self.info_schema, "view") else None
+            if view is not None:
+                return self._expand_view(view, ref)
             info = self.info_schema.table(ref.name)
             return LogicalDataSource(info, ref.alias)
         if isinstance(ref, ast.SubqueryTable):
@@ -725,6 +737,46 @@ class PlanBuilder:
         if isinstance(ref, ast.JoinExpr):
             return self.build_join(ref)
         raise PlanError(f"unsupported table reference {ref!r}")
+
+    MAX_VIEW_DEPTH = 16
+
+    def _expand_view(self, view, ref: ast.TableName) -> LogicalPlan:
+        """View expansion: build the stored SELECT as a derived table
+        under the reference's alias (ref: planner/core/
+        logical_plan_builder.go:4376 BuildDataSourceFromView). A fresh
+        builder with an EMPTY cte_map isolates the view body from the
+        outer query's CTE names; nesting is capped via a thread-local so
+        the count survives subquery evaluators' fresh builders (a
+        circular view through a scalar subquery must hit the cap, not
+        Python's recursion limit)."""
+        from tidb_tpu.parser import parse
+        depth = getattr(_VIEW_DEPTH, "d", 0)
+        if depth >= self.MAX_VIEW_DEPTH:
+            raise PlanError(
+                f"View nesting exceeds {self.MAX_VIEW_DEPTH} levels "
+                f"(circular view reference?)")
+        try:
+            stmts = parse(view.sql)
+        except Exception as e:  # noqa: BLE001
+            raise PlanError(f"View '{view.name}' definition is invalid: "
+                            f"{e}")
+        vb = PlanBuilder(self.info_schema, self.ctx, self.subq,
+                         cte_map={})
+        _VIEW_DEPTH.d = depth + 1
+        try:
+            sub = vb.build(stmts[0])
+        finally:
+            _VIEW_DEPTH.d = depth
+        alias = ref.alias or view.name
+        names = view.columns or None
+        if names is not None and len(names) != len(sub.schema):
+            raise PlanError(
+                f"View '{view.name}' column list does not match the "
+                f"definition")
+        cols = [SchemaColumn(names[i] if names else c.name, c.ftype, alias)
+                for i, c in enumerate(sub.schema.columns)]
+        sub.schema = Schema(cols)
+        return sub
 
     def _build_memtable(self, ref: ast.TableName) -> LogicalPlan:
         """information_schema.<name> → virtual memtable over live state
@@ -813,6 +865,14 @@ class PlanBuilder:
 
     # -- SELECT --------------------------------------------------------------
     def build_select(self, sel: ast.SelectStmt) -> LogicalPlan:
+        if sel.hints and self.ctx is not None:
+            # /*+ ... */ optimizer hints: collected statement-wide (block
+            # scoping simplified; ref: planner/optimize.go:138)
+            bag = getattr(self.ctx, "hints", None)
+            if bag is None:
+                bag = []
+                self.ctx.hints = bag
+            bag.extend(sel.hints)
         # FROM
         if sel.from_ is None:
             plan: LogicalPlan = LogicalDual()

@@ -28,21 +28,24 @@ def row_partitions(pinfo: PartitionInfo, values: np.ndarray,
     the last bound raises ER 1526 (unless MAXVALUE). HASH: MOD(v, n)
     (floored, always non-negative). NULL routes to partition 0 both ways
     (MySQL: NULL < any range value; NULL hashes as 0)."""
-    n = len(values)
     if pinfo.kind == "hash":
         v = np.asarray(values).astype(np.int64, copy=False)
         ords = np.mod(v, pinfo.num)
         return np.where(valid, ords, 0).astype(np.int64)
-    bounds = np.array([(np.iinfo(np.int64).max if b is None else b)
-                       for b in pinfo.bounds], dtype=np.int64)
+    # a trailing MAXVALUE partition catches EVERYTHING past the finite
+    # bounds (including int64-max itself — no sentinel comparisons)
+    has_max = pinfo.bounds and pinfo.bounds[-1] is None
+    finite = np.array([b for b in pinfo.bounds if b is not None],
+                      dtype=np.int64)
     v = np.asarray(values).astype(np.int64, copy=False)
-    ords = np.searchsorted(bounds, v, side="right")
+    ords = np.searchsorted(finite, v, side="right")
     ords = np.where(valid, ords, 0).astype(np.int64)
-    over = ords >= len(bounds)
-    if over.any():
-        bad = v[over][0]
-        raise PartitionError(
-            f"Table has no partition for value {int(bad)}")
+    if not has_max:
+        over = ords >= len(finite)
+        if over.any():
+            bad = v[over][0]
+            raise PartitionError(
+                f"Table has no partition for value {int(bad)}")
     return ords
 
 
@@ -79,6 +82,14 @@ def _const_cmp(cond: Expression, col_offset: int):
         return None
     if not isinstance(enc, (int, np.integer)):
         return None
+    # encode_value may TRUNCATE a numeric constant (99.5 → 99): pruning
+    # on an inexact bound would drop partitions whose rows satisfy the
+    # predicate — bail and let the filter do the work (date strings
+    # encode exactly or raise, so only numerics need the check)
+    import decimal as _d
+    if isinstance(b.value, (int, float, _d.Decimal)) \
+            and float(b.value) != float(enc):
+        return None
     return op, int(enc)
 
 
@@ -114,16 +125,16 @@ def prune_partitions(info: TableInfo, filters) -> Optional[Tuple[int, ...]]:
         elif op in ("gt", "ge"):
             u = v + 1 if op == "gt" else v
             lo_v = u if lo_v is None else max(lo_v, u)
-    bounds = np.array([(np.iinfo(np.int64).max if b is None else b)
-                       for b in p.bounds], dtype=np.int64)
+    finite = np.array([b for b in p.bounds if b is not None],
+                      dtype=np.int64)
     first = 0
     last = n - 1
     if lo_v is not None:
-        first = int(np.searchsorted(bounds, lo_v, side="right"))
+        first = int(np.searchsorted(finite, lo_v, side="right"))
         # NULL rows live in partition 0 and no comparison matches NULL,
         # so raising `first` is safe
     if hi_v is not None:
-        last = int(np.searchsorted(bounds, hi_v, side="right"))
+        last = int(np.searchsorted(finite, hi_v, side="right"))
     if lo_v is not None and hi_v is not None and lo_v > hi_v:
         return ()
     first = min(first, n)

@@ -664,16 +664,17 @@ def _indexed_col(table, col_idx: int):
 
 def _try_merge_join(join: LogicalJoin, left: PhysicalPlan,
                     right: PhysicalPlan, lrows: float, rrows: float,
-                    ctx) -> Optional["PhysMergeJoin"]:
+                    ctx, force: bool = False) -> Optional["PhysMergeJoin"]:
     """Merge join when BOTH sides are table scans indexed on their
     (uncast, non-string-mixed) join keys — the key-ordered-inputs case of
     exhaust_physical_plans.go's merge-join enumeration. Inner only; other
     kinds keep the hash path. Applicability only: the size trade-off is
     priced by planner/cost.py (the old MERGE_JOIN_MIN_ROWS hard gate is
     now the INDEX_STARTUP cost term)."""
-    if getattr(ctx, "use_tpu", False):
+    if getattr(ctx, "use_tpu", False) and not force:
         # large indexed joins fuse into device LUT-join trees instead;
         # the merge join is the CPU engine's answer to this shape
+        # (a MERGE_JOIN hint overrides — the user's escape hatch)
         return None
     if join.kind != "inner" or len(join.equi) != 1:
         return None
@@ -759,6 +760,60 @@ def _try_index_join(join: LogicalJoin, left: PhysicalPlan,
 def is_corr(e) -> bool:
     from tidb_tpu.expression import CorrelatedRef
     return any(isinstance(s, CorrelatedRef) for s in e.walk())
+
+
+# ---------------------------------------------------------------------------
+# Optimizer hints (ref: planner/optimize.go:138, hint.ParseHintsSet at
+# planbuilder.go:865) — the escape hatch when the cost model picks wrong
+# ---------------------------------------------------------------------------
+
+_JOIN_HINTS = {"hash_join": "hash", "merge_join": "merge",
+               "sm_join": "merge", "inl_join": "inl",
+               "index_join": "inl", "inl_lookup_join": "inl"}
+
+
+def _subtree_names(p: PhysicalPlan) -> set:
+    """Table names + aliases appearing under a physical subtree."""
+    out = set()
+    stack = [p]
+    while stack:
+        n = stack.pop()
+        t = getattr(n, "table", None)
+        if t is not None:
+            out.add(t.name.lower())
+            a = getattr(n, "alias", None)
+            if a:
+                out.add(str(a).lower())
+        stack.extend(n.children)
+    return out
+
+
+def _join_hint(ctx, left: PhysicalPlan, right: PhysicalPlan):
+    """→ 'hash' | 'merge' | 'inl' when a join hint names a table on
+    either side of THIS join, else None. Last matching hint wins."""
+    hints = getattr(ctx, "hints", None)
+    if not hints:
+        return None
+    names = _subtree_names(left) | _subtree_names(right)
+    forced = None
+    for hname, args in hints:
+        algo = _JOIN_HINTS.get(hname)
+        if algo and (not args or names & set(args)):
+            forced = algo
+    return forced
+
+
+def _agg_hint(ctx):
+    hints = getattr(ctx, "hints", None)
+    if not hints:
+        return None
+    forced = None
+    for hname, _args in hints:
+        if hname == "hash_agg":
+            forced = "hash"
+        elif hname == "stream_agg":
+            forced = "stream"
+    return forced
 
 
 def _try_stream_agg(agg: LogicalAggregation, child: PhysicalPlan,
@@ -978,6 +1033,9 @@ def _to_physical(plan: LogicalPlan, ctx) -> PhysicalPlan:
         sa = _try_stream_agg(plan, kids[0], ctx)
         if sa is None:
             return ha
+        hint = _agg_hint(ctx)
+        if hint is not None:
+            return sa if hint == "stream" else ha
         from tidb_tpu.planner import cost as C
         rows = estimate(kids[0], ctx)
         groups = estimate(ha, ctx)
@@ -1001,6 +1059,23 @@ def _to_physical(plan: LogicalPlan, ctx) -> PhysicalPlan:
             build_right = rrows <= lrows
         hj = PhysHashJoin(plan.kind, left, right, plan.equi,
                           plan.other_conditions, plan.schema, build_right)
+        forced = _join_hint(ctx, left, right)
+        if forced is not None:
+            # the hint is the escape hatch: it overrides cost AND engine
+            # steering (a hinted merge join comes off the device path)
+            if forced == "merge":
+                mj = _try_merge_join(plan, left, right, lrows, rrows, ctx,
+                                     force=True)
+                if mj is not None:
+                    return mj
+            elif forced == "inl":
+                ilj = _try_index_join(plan, left, right, lrows, rrows,
+                                      ctx)
+                if ilj is not None:
+                    return ilj
+            else:
+                return hj
+            return hj              # hinted shape inapplicable: hash
         if getattr(ctx, "use_tpu", False):
             # large joins fuse into the device tree engine; the only
             # alternative shape worth taking off it is the tiny-outer

@@ -24,7 +24,8 @@ from tidb_tpu import types as T
 from tidb_tpu.catalog import Catalog, ColumnInfo, IndexInfo, TableInfo
 from tidb_tpu.chunk import Chunk, Column
 from tidb_tpu.errors import (DDLError, ExecutionError, PlanError,
-                             TiDBTPUError, TxnError, UnknownColumnError)
+                             TiDBTPUError, TxnError, UnknownColumnError,
+                             UnknownTableError)
 from tidb_tpu.executor import ExecContext, build, run_to_completion
 from tidb_tpu.expression import Expression
 from tidb_tpu.expression.runner import eval_on_chunk, filter_mask
@@ -499,6 +500,18 @@ class Session:
             return self._run_with(stmt)
         if isinstance(stmt, ast.CreateTable):
             return self._create_table(stmt)
+        if isinstance(stmt, ast.CreateView):
+            # plan the body once now: an invalid definition must fail at
+            # CREATE time (ddl/ddl_api.go CreateView builds the plan)
+            self._plan(stmt.select)
+            self.engine.catalog.create_view(
+                stmt.name, stmt.text, stmt.columns or (),
+                stmt.or_replace)
+            return ok()
+        if isinstance(stmt, ast.DropView):
+            for n in stmt.names:
+                self.engine.catalog.drop_view(n, stmt.if_exists)
+            return ok()
         if isinstance(stmt, ast.AlterTable):
             return self._alter_table(stmt)
         if isinstance(stmt, ast.CreateIndex):
@@ -703,8 +716,15 @@ class Session:
             return None
         info_schema = self.engine.catalog.info_schema
         snap = self._read_view_snapshot()
+        names = self._expand_view_tables(sorted(set(_stmt_tables(stmt))),
+                                         info_schema)
+        if names is None:
+            return None
         sizes = []
-        for t in sorted(set(_stmt_tables(stmt))):
+        for t in sorted(set(names)):
+            if info_schema.view(t) is not None:
+                sizes.append((t, -1))  # definition changes bump version
+                continue
             try:
                 info = info_schema.table(t)
             except TiDBTPUError:
@@ -722,6 +742,37 @@ class Session:
                 str(v.get("tidb_tpu_dist_devices", 0)),
                 str(v.get("time_zone", "SYSTEM")),  # tz folds into plans
                 self.user)
+
+    _VIEW_TABLES_CACHE: Dict[Tuple[str, str], List[str]] = {}
+
+    def _expand_view_tables(self, names, info_schema, depth=0):
+        """Referenced names with views transitively expanded to their
+        base tables (so view plans stay cacheable with the base tables'
+        sizes in the key), or None when unresolvable."""
+        if depth > 16:
+            return None
+        out = []
+        for t in names:
+            v = info_schema.view(t)
+            if v is None:
+                out.append(t)
+                continue
+            key = (v.name.lower(), v.sql)
+            sub = self._VIEW_TABLES_CACHE.get(key)
+            if sub is None:
+                from tidb_tpu.parser import parse as _parse
+                try:
+                    sub = _stmt_tables(_parse(v.sql)[0])
+                except Exception:  # noqa: BLE001
+                    return None
+                self._VIEW_TABLES_CACHE[key] = sub
+            expanded = self._expand_view_tables(sub, info_schema,
+                                                depth + 1)
+            if expanded is None:
+                return None
+            out.append(t)
+            out.extend(expanded)
+        return out
 
     def _run_as_of(self, stmt, as_of_expr) -> ResultSet:
         """Historical read (AS OF TIMESTAMP ...): resolve the timestamp,
@@ -872,8 +923,6 @@ class Session:
         integer-encodable; RANGE bounds fold to constants, encode in the
         column's value space, and must ascend strictly."""
         from tidb_tpu.catalog import PartitionInfo
-        from tidb_tpu.expression import Constant
-        from tidb_tpu.planner.rules import fold_expr
         spec = stmt.partition
         offset = next((i for i, c in enumerate(cols)
                        if c.name.lower() == spec.column.lower()), None)
@@ -1433,6 +1482,8 @@ class Session:
         if stmt.kind == "tables":
             rows = [(t.name,) for t in info_schema.list_tables()
                     if not t.name.startswith("#")]   # hide CTE temps
+            rows += [(v.name,) for v in info_schema.list_views()]
+            rows.sort()
             return ResultSet(["Tables"], [T.varchar()], rows)
         if stmt.kind == "columns":
             t = info_schema.table(stmt.target)
@@ -1447,6 +1498,14 @@ class Session:
             rows = sorted((k, str(v)) for k, v in self.vars.items())
             return ResultSet(["Variable_name", "Value"],
                              [T.varchar(), T.varchar()], rows)
+        if stmt.kind == "create_view":
+            v = info_schema.view(stmt.target)
+            if v is None:
+                raise UnknownTableError(f"Unknown view '{stmt.target}'")
+            cols = f" ({', '.join(v.columns)})" if v.columns else ""
+            ddl = f"CREATE VIEW `{v.name}`{cols} AS {v.sql}"
+            return ResultSet(["View", "Create View"], [T.varchar()] * 2,
+                             [(v.name, ddl)])
         if stmt.kind == "create_table":
             t = info_schema.table(stmt.target)
             from tidb_tpu.tools import create_table_sql
@@ -1557,9 +1616,6 @@ class Session:
         onAddTablePartition / onDropTablePartition; storage side is a
         wholesale region-set operation — the partition IS its regions)."""
         from dataclasses import replace as d_replace
-
-        from tidb_tpu.expression import Constant
-        from tidb_tpu.planner.rules import fold_expr
         p = info.partition
         if p is None:
             raise DDLError("Partition management on a not partitioned "
