@@ -56,7 +56,10 @@ def factorize_columns(cols: Sequence[Tuple[np.ndarray, np.ndarray]]
     for values, validity in cols:
         vals = values
         if vals.dtype == object:
-            vals = np.asarray([str(v) for v in vals], dtype=object)
+            # fixed-width unicode sorts at C speed; object arrays fall
+            # back to per-element Python compares (~30x slower argsort)
+            vals = np.asarray(vals, dtype="U") if n else \
+                np.asarray([], dtype="U1")
         uniq, inv = np.unique(vals, return_inverse=True)
         inv = inv.astype(np.int64) + 1
         if validity is not None and not validity.all():
@@ -72,6 +75,165 @@ def factorize_columns(cols: Sequence[Tuple[np.ndarray, np.ndarray]]
     uniq, first_idx, gids = np.unique(combined, return_index=True,
                                       return_inverse=True)
     return gids.astype(np.int64), len(uniq), first_idx.astype(np.int64)
+
+
+def _fold_group_key_cols(key_cols, group_exprs):
+    """Fold ci group-key columns so equal-under-collation values form ONE
+    group; binary columns pass through (util/collate semantics)."""
+    from tidb_tpu.types import fold_ci_array
+    out = []
+    for (v, m), e in zip(key_cols, group_exprs):
+        v = np.asarray(v)
+        if e.ftype.is_ci and v.dtype == object:
+            v = fold_ci_array(v)
+        out.append((v, np.asarray(m, dtype=bool)))
+    return out
+
+
+def batch_partial(group_exprs, descs, aggs, scalar: bool, ch: Chunk):
+    """One batch → (partial keys, states, distinct rows, bytes). Pure
+    computation over picklable inputs — runs on worker threads AND in
+    spawned worker processes (the UpdatePartialResult body of the
+    reference's partial workers, executor/aggregate.go:127)."""
+    from tidb_tpu.util import memory as M
+    ctx = host_context(ch)
+    key_cols = [e.eval(ctx) for e in group_exprs]
+    # ci collations group in FOLD space; outputs keep a raw
+    # representative (reps gather from the unfolded arrays)
+    gids, n_groups, reps = factorize_columns(
+        _fold_group_key_cols(key_cols, group_exprs))
+    if scalar:
+        gids = np.zeros(ch.num_rows, dtype=np.int64)
+        n_groups, reps = 1, np.zeros(1, dtype=np.int64)
+    states = []
+    batch_distinct = [None] * len(aggs)
+    for i, (agg, desc) in enumerate(zip(aggs, descs)):
+        if desc.args:
+            # multi-arg only for COUNT(DISTINCT a, b): row counts
+            # iff every arg is non-NULL (MySQL semantics)
+            vs, ms = [], []
+            for a in desc.args:
+                v, m = a.eval(ctx)
+                vs.append(np.asarray(v))
+                ms.append(np.asarray(m, dtype=bool))
+            m = ms[0]
+            for extra in ms[1:]:
+                m = m & extra
+            v = vs[0]
+        else:  # COUNT(*)
+            vs = [np.zeros(ch.num_rows, dtype=np.int64)]
+            v = vs[0]
+            m = np.ones(ch.num_rows, dtype=bool)
+        if desc.distinct:
+            batch_distinct[i] = (gids, vs, m)
+            states.append(None)
+        else:
+            st = agg.init(np, n_groups)
+            states.append(agg.update(np, st, gids, n_groups, v, m))
+    pk = [(np.asarray(v)[reps], np.asarray(m, dtype=bool)[reps])
+          for v, m in key_cols]
+    batch_bytes = sum(M.array_bytes(v, m) for v, m in pk)
+    for st in states:
+        if st is not None:
+            batch_bytes += M.array_bytes(*st)
+    for bd in batch_distinct:
+        if bd is not None:
+            batch_bytes += M.array_bytes(bd[0], bd[2], *bd[1])
+    return pk, states, batch_distinct, batch_bytes
+
+
+def _pack_chunk(ch: Chunk):
+    """Wire form for the worker pipe: STRING object columns convert to
+    fixed-width unicode (pickles as ONE raw buffer instead of a
+    per-element Python loop — the transfer cost is what makes or breaks
+    process-level scaling). Non-string object columns (wide-decimal
+    Python ints, JSON) must keep their dtype — stringifying them would
+    corrupt worker-side arithmetic."""
+    cols = []
+    for c in ch.columns:
+        v = c.values
+        obj = v.dtype == object and c.ftype.is_varlen
+        if obj:
+            v = np.asarray(v, dtype="U") if len(v) else \
+                np.asarray([], dtype="U1")
+        cols.append((c.ftype, v, c.validity, obj))
+    return cols
+
+
+def _unpack_chunk(cols) -> Chunk:
+    out = []
+    for ftype, v, validity, obj in cols:
+        if obj:
+            v = v.astype(object)
+        out.append(Column(ftype, v, validity))
+    return Chunk(out)
+
+
+def _mp_batch_partial(spec, packed):
+    """Spawned-worker entry: rebuild aggs from descs (AggFunc instances
+    carry no state worth shipping) and run the partial."""
+    group_exprs, descs, scalar = spec
+    aggs = [build_agg(d) for d in descs]
+    return batch_partial(group_exprs, descs, aggs, scalar,
+                         _unpack_chunk(packed))
+
+
+_MP_POOL = None
+_MP_POOL_SIZE = 0
+_MP_POOL_LOCK = None
+
+
+def _worker_init():
+    """Runs in every worker before any task: pin the worker to the CPU
+    backend so a partial can NEVER grab the real TPU, without touching
+    the parent's environment (workers only run numpy, but belt and
+    braces)."""
+    import os
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+
+def _noop():
+    return 0
+
+
+def _get_pool(conc: int):
+    """Lazy process pool (shared engine-wide): fork is unsafe with a live
+    TPU client and server threads, so workers come from a forkserver and
+    pin themselves to the CPU backend in an initializer. The pool is
+    GROW-ONLY under a lock: resizing never cancels another session's
+    in-flight partials. Standard multiprocessing caveat applies: a
+    script driving concurrency > 1 needs the `if __name__ ==
+    "__main__"` guard."""
+    global _MP_POOL, _MP_POOL_SIZE, _MP_POOL_LOCK
+    import threading
+    if _MP_POOL_LOCK is None:
+        _MP_POOL_LOCK = threading.Lock()
+    with _MP_POOL_LOCK:
+        if _MP_POOL is not None and _MP_POOL_SIZE >= conc:
+            return _MP_POOL
+        old = _MP_POOL
+        import multiprocessing
+        from concurrent.futures import ProcessPoolExecutor, wait
+        pool = ProcessPoolExecutor(
+            conc, mp_context=multiprocessing.get_context("forkserver"),
+            initializer=_worker_init)
+        wait([pool.submit(_noop) for _ in range(conc * 2)])
+        _MP_POOL = pool
+        _MP_POOL_SIZE = conc
+        if old is not None:
+            # no new submits; in-flight futures complete undisturbed
+            old.shutdown(wait=False)
+        import atexit
+        atexit.register(_shutdown_pool)
+        return _MP_POOL
+
+
+def _shutdown_pool():
+    global _MP_POOL
+    if _MP_POOL is not None:
+        _MP_POOL.shutdown(wait=False, cancel_futures=True)
+        _MP_POOL = None
 
 
 class HashAggExec(Executor):
@@ -142,13 +304,15 @@ class HashAggExec(Executor):
             tracker.consume(batch_bytes)
 
         # intra-operator parallelism (the partial-worker graph of
-        # executor/aggregate.go:127-164): per-batch partials are pure, so
-        # a bounded thread pipeline can compute them concurrently.
-        # Measured on this engine the gain is ~nil — Python-level kernel
-        # dispatch holds the GIL between numpy cores — so the default is
-        # sequential; the worker graph exists for API parity and for
-        # interpreters with real parallelism (the TPU engine is the
-        # intended parallel path, SURVEY §2.4.4's "deliberate bet")
+        # executor/aggregate.go:127-164): per-batch partials are pure AND
+        # picklable, so they run on a forkserver PROCESS pool — numpy
+        # sorts and scatter-adds hold the GIL, so threads cannot scale
+        # this; processes can. Honest caveat, measured: on wide Q1-shaped
+        # batches the parent-side pack/pickle of each 64K-row batch costs
+        # about what the partial itself costs, so wall-clock gains only
+        # appear when per-row compute is heavy relative to row width
+        # (many exprs, wide decimals); the graph is the reference's
+        # architecture, the single-thread path is the fast default here.
         conc = max(int(self.ctx.vars.get("tidb_tpu_cpu_concurrency", 1)),
                    1)
         try:
@@ -161,45 +325,48 @@ class HashAggExec(Executor):
                         continue
                     collect(self._batch_partial(ch))
             else:
-                from concurrent.futures import ThreadPoolExecutor
                 from collections import deque
 
-                def in_flight_bytes(ch: Chunk) -> int:
-                    # reservation for an un-collected batch: its input
-                    # chunk (the partial is the same order of magnitude);
+                def in_flight_bytes(packed) -> int:
+                    # reservation for an un-collected batch: the PACKED
+                    # payload actually in flight (fixed-width unicode can
+                    # be much larger than the object array it replaces);
                     # keeps the pipeline visible to the quota so spill
                     # still engages under pressure
-                    return sum(
-                        c.values.nbytes + (c.validity.nbytes
-                                           if c.validity is not None
-                                           else 0)
-                        for c in ch.columns)
+                    total = 0
+                    for _ft, v, validity, _obj in packed:
+                        total += v.nbytes
+                        if validity is not None:
+                            total += validity.nbytes
+                    return total
 
-                with ThreadPoolExecutor(conc) as pool:
-                    pending = deque()
+                pool = _get_pool(conc)
+                spec = (self.group_exprs, self.descs, self.scalar)
+                pending = deque()
 
-                    def drain_one():
-                        fut, reserved = pending.popleft()
-                        try:
-                            collect(fut.result())
-                        finally:
-                            tracker.release(reserved)
+                def drain_one():
+                    fut, reserved = pending.popleft()
+                    try:
+                        collect(fut.result())
+                    finally:
+                        tracker.release(reserved)
 
-                    while True:
-                        ch = self.child_next()
-                        if ch is None:
-                            break
-                        if ch.num_rows == 0:
-                            continue
-                        reserve = in_flight_bytes(ch)
-                        tracker.consume(reserve)
-                        pending.append(
-                            (pool.submit(self._batch_partial, ch),
-                             reserve))
-                        if len(pending) >= conc * 2:
-                            drain_one()
-                    while pending:
+                while True:
+                    ch = self.child_next()
+                    if ch is None:
+                        break
+                    if ch.num_rows == 0:
+                        continue
+                    packed = _pack_chunk(ch)
+                    reserve = in_flight_bytes(packed)
+                    tracker.consume(reserve)
+                    pending.append(
+                        (pool.submit(_mp_batch_partial, spec, packed),
+                         reserve))
+                    if len(pending) >= conc * 2:
                         drain_one()
+                while pending:
+                    drain_one()
 
             if spill is None:
                 return self._merge_partials(partial_keys, partial_states,
@@ -212,67 +379,14 @@ class HashAggExec(Executor):
                 spill.close()
 
     def _fold_group_keys(self, key_cols):
-        """Fold ci group-key columns so equal-under-collation values form
-        ONE group; binary columns pass through. Every factorize/partition
-        over group keys (partial, merge, spill routing) MUST go through
-        this, or a group's rows scatter across partitions."""
-        from tidb_tpu.types import fold_ci_array
-        out = []
-        for (v, m), e in zip(key_cols, self.group_exprs):
-            v = np.asarray(v)
-            if e.ftype.is_ci and v.dtype == object:
-                v = fold_ci_array(v)
-            out.append((v, np.asarray(m, dtype=bool)))
-        return out
+        """Every factorize/partition over group keys (partial, merge,
+        spill routing) MUST go through the fold, or a ci group's rows
+        scatter across partitions."""
+        return _fold_group_key_cols(key_cols, self.group_exprs)
 
     def _batch_partial(self, ch: Chunk):
-        """One batch → (partial keys, states, distinct rows, bytes).
-        Pure computation — safe on worker threads."""
-        from tidb_tpu.util import memory as M
-        ctx = host_context(ch)
-        key_cols = [e.eval(ctx) for e in self.group_exprs]
-        # ci collations group in FOLD space; outputs keep a raw
-        # representative (reps gather from the unfolded arrays)
-        gids, n_groups, reps = factorize_columns(
-            self._fold_group_keys(key_cols))
-        if self.scalar:
-            gids = np.zeros(ch.num_rows, dtype=np.int64)
-            n_groups, reps = 1, np.zeros(1, dtype=np.int64)
-        states = []
-        batch_distinct = [None] * len(self.aggs)
-        for i, (agg, desc) in enumerate(zip(self.aggs, self.descs)):
-            if desc.args:
-                # multi-arg only for COUNT(DISTINCT a, b): row counts
-                # iff every arg is non-NULL (MySQL semantics)
-                vs, ms = [], []
-                for a in desc.args:
-                    v, m = a.eval(ctx)
-                    vs.append(np.asarray(v))
-                    ms.append(np.asarray(m, dtype=bool))
-                m = ms[0]
-                for extra in ms[1:]:
-                    m = m & extra
-                v = vs[0]
-            else:  # COUNT(*)
-                vs = [np.zeros(ch.num_rows, dtype=np.int64)]
-                v = vs[0]
-                m = np.ones(ch.num_rows, dtype=bool)
-            if desc.distinct:
-                batch_distinct[i] = (gids, vs, m)
-                states.append(None)
-            else:
-                st = agg.init(np, n_groups)
-                states.append(agg.update(np, st, gids, n_groups, v, m))
-        pk = [(np.asarray(v)[reps], np.asarray(m, dtype=bool)[reps])
-              for v, m in key_cols]
-        batch_bytes = sum(M.array_bytes(v, m) for v, m in pk)
-        for st in states:
-            if st is not None:
-                batch_bytes += M.array_bytes(*st)
-        for bd in batch_distinct:
-            if bd is not None:
-                batch_bytes += M.array_bytes(bd[0], bd[2], *bd[1])
-        return pk, states, batch_distinct, batch_bytes
+        return batch_partial(self.group_exprs, self.descs, self.aggs,
+                             self.scalar, ch)
 
     def _spill_batch(self, spill, pk, states, batch_distinct) -> None:
         """Split one batch's partial groups by key hash into partitions."""

@@ -503,7 +503,11 @@ class Session:
         if isinstance(stmt, ast.CreateView):
             # plan the body once now: an invalid definition must fail at
             # CREATE time (ddl/ddl_api.go CreateView builds the plan)
-            self._plan(stmt.select)
+            body = self._plan(stmt.select)
+            if stmt.columns and len(stmt.columns) != len(body.schema):
+                raise PlanError(
+                    "View's SELECT and view's field list have different "
+                    "column counts")   # ER 1353
             self.engine.catalog.create_view(
                 stmt.name, stmt.text, stmt.columns or (),
                 stmt.or_replace)
@@ -765,6 +769,8 @@ class Session:
                     sub = _stmt_tables(_parse(v.sql)[0])
                 except Exception:  # noqa: BLE001
                     return None
+                if len(self._VIEW_TABLES_CACHE) >= 256:
+                    self._VIEW_TABLES_CACHE.clear()   # bound, not LRU
                 self._VIEW_TABLES_CACHE[key] = sub
             expanded = self._expand_view_tables(sub, info_schema,
                                                 depth + 1)
