@@ -2479,9 +2479,52 @@ def infer_type(op: str, args: Sequence[Expression]) -> FieldType:
         return T.json_type(False)
     if op in ("json_valid", "json_length", "json_contains"):
         return T.bigint(True)
+    if op in _BATCH3_INT_FNS:
+        return T.bigint(True)
+    if op in _BATCH3_STR_FNS:
+        return T.varchar(nullable=True)
+    if op in _BATCH3_JSON_FNS:
+        return T.json_type(True)
+    if op == "rand":
+        return T.double(False)
+    if op == "any_value":
+        return args[0].ftype
+    if op == "name_const":
+        return args[1].ftype
+    if op in ("timediff", "time"):
+        return FieldType(TypeKind.TIME, nullable)
+    if op == "timestamp":
+        return T.datetime(nullable)
     if op == "cast":
         raise AssertionError("cast requires explicit target type")
     raise TypeError_(f"cannot infer type for {op}")
+
+
+_BATCH3_INT_FNS = frozenset((
+    "gtid_subset", "ps_thread_id", "ps_current_thread_id",
+    "release_all_locks",
+    "is_ipv4", "is_ipv6", "is_ipv4_compat", "is_ipv4_mapped", "is_uuid",
+    "bit_count", "octet_length", "uncompressed_length", "sleep",
+    "interval", "benchmark", "get_lock", "release_lock", "is_free_lock",
+    "is_used_lock", "coercibility", "tidb_shard", "tidb_is_ddl_owner",
+    "regexp_instr",
+    "validate_password_strength", "uuid_short", "to_seconds",
+    "json_depth", "json_storage_size", "json_contains_path",
+    "json_overlaps", "json_member_of"))
+_BATCH3_STR_FNS = frozenset((
+    "gtid_subtract", "roles_graphml",
+    "inet6_aton", "inet6_ntoa", "uuid_to_bin", "bin_to_uuid",
+    "concat_ws", "format_bytes", "format_pico_time", "weight_string",
+    "load_file", "regexp_substr", "regexp_replace", "compress",
+    "uncompress", "random_bytes", "aes_encrypt", "aes_decrypt",
+    "password", "statement_digest", "statement_digest_text", "charset",
+    "collation", "extractvalue", "updatexml", "json_quote",
+    "json_pretty", "json_search", "json_value", "time_format",
+    "get_format"))
+_BATCH3_JSON_FNS = frozenset((
+    "json_set", "json_insert", "json_replace", "json_remove",
+    "json_array_append", "json_array_insert", "json_merge_patch",
+    "json_merge_preserve"))
 
 
 def _merge_branch(a: FieldType, b: FieldType) -> FieldType:
@@ -2526,3 +2569,1377 @@ def lit(value, ftype: Optional[FieldType] = None) -> Constant:
             else:
                 raise TypeError_(f"cannot infer literal type: {value!r}")
     return Constant(value, ftype)
+
+
+# ---------------------------------------------------------------------------
+# Builtin batch 3 (round 5): info/IP/UUID/JSON-mutation/crypto/misc breadth
+# (ref: expression/builtin_info.go, builtin_miscellaneous.go,
+#  builtin_json.go, builtin_encryption.go — host row-loop kernels; the
+#  device allowlist is unchanged, these run on the CPU engine)
+# ---------------------------------------------------------------------------
+
+
+def _ip4_parse(s):
+    parts = str(s).split(".")
+    if len(parts) != 4 or not all(p.isdigit() and len(p) <= 3
+                                  and int(p) < 256 for p in parts):
+        return None
+    return [int(p) for p in parts]
+
+
+def _ip6_bytes(s):
+    import ipaddress
+    try:
+        return ipaddress.ip_address(str(s)).packed
+    except ValueError:
+        return None
+
+
+@kernel("is_ipv4")
+def _is_ipv4(func, ctx):
+    return _host_rows(func, ctx,
+                      lambda s: 1 if _ip4_parse(s) else 0,
+                      dtype=np.int64)
+
+
+@kernel("is_ipv6")
+def _is_ipv6(func, ctx):
+    def one(s):
+        b = _ip6_bytes(s)
+        return 1 if (b is not None and len(b) == 16) else 0
+    return _host_rows(func, ctx, one, dtype=np.int64)
+
+
+def _ip6_raw(s):
+    """Accept the hex transport INET6_ATON emits, then address text."""
+    try:
+        raw = bytes.fromhex(str(s))
+        if len(raw) in (4, 16):
+            return raw
+    except ValueError:
+        pass
+    return _ip6_bytes(s)
+
+
+@kernel("is_ipv4_compat")
+def _is_ipv4_compat(func, ctx):
+    def one(s):
+        b = _ip6_raw(s)
+        return 1 if (b is not None and len(b) == 16
+                     and b[:12] == b"\x00" * 12
+                     and b[12:] != b"\x00" * 4) else 0
+    return _host_rows(func, ctx, one, dtype=np.int64)
+
+
+@kernel("is_ipv4_mapped")
+def _is_ipv4_mapped(func, ctx):
+    def one(s):
+        b = _ip6_raw(s)
+        return 1 if (b is not None and len(b) == 16
+                     and b[:12] == b"\x00" * 10 + b"\xff\xff") else 0
+    return _host_rows(func, ctx, one, dtype=np.int64)
+
+
+@kernel("inet6_aton")
+def _inet6_aton(func, ctx):
+    def one(s):
+        b = _ip6_bytes(s)
+        return b.hex() if b is not None else None   # hex text transport
+    return _host_rows(func, ctx, one)
+
+
+@kernel("inet6_ntoa")
+def _inet6_ntoa(func, ctx):
+    import ipaddress
+
+    def one(s):
+        try:
+            raw = bytes.fromhex(str(s))
+            if len(raw) == 4:
+                return str(ipaddress.IPv4Address(raw))
+            if len(raw) == 16:
+                return str(ipaddress.IPv6Address(raw))
+        except ValueError:
+            pass
+        return None
+    return _host_rows(func, ctx, one)
+
+
+@kernel("is_uuid")
+def _is_uuid(func, ctx):
+    import uuid as _u
+
+    def one(s):
+        try:
+            _u.UUID(str(s))
+            return 1
+        except ValueError:
+            return 0
+    return _host_rows(func, ctx, one, dtype=np.int64)
+
+
+@kernel("uuid_to_bin")
+def _uuid_to_bin(func, ctx):
+    import uuid as _u
+
+    def one(s, swap=0):
+        try:
+            h = _u.UUID(str(s)).hex
+        except ValueError:
+            return None
+        if int(swap):       # time-swapped layout (MySQL 8 optimization)
+            h = h[12:16] + h[8:12] + h[:8] + h[16:]
+        return h
+    return _host_rows(func, ctx, one)
+
+
+@kernel("bin_to_uuid")
+def _bin_to_uuid(func, ctx):
+    import uuid as _u
+
+    def one(s, swap=0):
+        h = str(s)
+        if len(h) != 32:
+            return None
+        if int(swap):
+            h = h[8:16] + h[4:8] + h[:4] + h[16:]
+        try:
+            return str(_u.UUID(hex=h))
+        except ValueError:
+            return None
+    return _host_rows(func, ctx, one)
+
+
+@kernel("concat_ws")
+def _concat_ws(func, ctx):
+    """CONCAT_WS skips NULL args (unlike CONCAT) — evaluate manually."""
+    evals = [a.eval(ctx) for a in func.args]
+    n = ctx.num_rows
+    sep_v, sep_m = evals[0]
+    out = np.empty(n, dtype=object)
+    valid = np.asarray(sep_m, dtype=bool).copy()
+    for i in range(n):
+        if not valid[i]:
+            out[i] = ""
+            continue
+        sep = str(np.asarray(sep_v)[i] if np.ndim(sep_v) else sep_v)
+        parts = []
+        for v, m in evals[1:]:
+            if np.asarray(m)[i]:
+                parts.append(str(np.asarray(v)[i] if np.ndim(v) else v))
+        out[i] = sep.join(parts)
+    return out, valid
+
+
+@kernel("bit_count")
+def _bit_count(func, ctx):
+    return _host_rows(func, ctx,
+                      lambda v: bin(int(v) & ((1 << 64) - 1)).count("1"),
+                      dtype=np.int64)
+
+
+@kernel("octet_length")
+def _octet_length(func, ctx):
+    return _host_rows(func, ctx,
+                      lambda s: len(str(s).encode("utf-8")),
+                      dtype=np.int64)
+
+
+@kernel("format_bytes")
+def _format_bytes(func, ctx):
+    def one(v):
+        x = float(v)
+        for unit in ("bytes", "KiB", "MiB", "GiB", "TiB", "PiB", "EiB"):
+            if abs(x) < 1024 or unit == "EiB":
+                return (f"{x:4.0f} {unit}".strip() if unit == "bytes"
+                        else f"{x:.2f} {unit}")
+            x /= 1024
+    return _host_rows(func, ctx, one)
+
+
+def _regex_flags(ftype):
+    import re as _re
+    return _re.IGNORECASE if getattr(ftype, "is_ci", False) else 0
+
+
+@kernel("regexp_instr")
+def _regexp_instr(func, ctx):
+    import re as _re
+    flags = _regex_flags(func.args[0].ftype)
+
+    def one(s, pat, pos=1, occ=1):
+        s = str(s)
+        it = list(_re.finditer(str(pat), s[int(pos) - 1:], flags))
+        k = int(occ) - 1
+        return (it[k].start() + int(pos)) if 0 <= k < len(it) else 0
+    return _host_rows(func, ctx, one, dtype=np.int64)
+
+
+@kernel("regexp_substr")
+def _regexp_substr(func, ctx):
+    import re as _re
+    flags = _regex_flags(func.args[0].ftype)
+
+    def one(s, pat, pos=1, occ=1):
+        it = list(_re.finditer(str(pat), str(s)[int(pos) - 1:], flags))
+        k = int(occ) - 1
+        return it[k].group(0) if 0 <= k < len(it) else None
+    return _host_rows(func, ctx, one)
+
+
+@kernel("regexp_replace")
+def _regexp_replace(func, ctx):
+    import re as _re
+    flags = _regex_flags(func.args[0].ftype)
+
+    def one(s, pat, repl, pos=1, occ=0):
+        head = str(s)[:int(pos) - 1]
+        tail = str(s)[int(pos) - 1:]
+        rtxt = str(repl).replace("\\", "\\\\")
+        if int(occ) == 0:          # 0 = replace every occurrence
+            return head + _re.sub(str(pat), rtxt, tail, flags=flags)
+        hits = list(_re.finditer(str(pat), tail, flags))
+        k = int(occ) - 1
+        if not 0 <= k < len(hits):
+            return head + tail
+        hit = hits[k]
+        return (head + tail[:hit.start()] + hit.expand(rtxt)
+                + tail[hit.end():])
+    return _host_rows(func, ctx, one)
+
+
+@kernel("compress")
+def _compress(func, ctx):
+    import zlib
+
+    def one(s):
+        raw = str(s).encode("utf-8")
+        if not raw:
+            return ""
+        out = len(raw).to_bytes(4, "little") + zlib.compress(raw)
+        return out.hex()            # hex text transport (BLOB-less)
+    return _host_rows(func, ctx, one)
+
+
+@kernel("uncompress")
+def _uncompress(func, ctx):
+    import zlib
+
+    def one(s):
+        if str(s) == "":
+            return ""
+        try:
+            raw = bytes.fromhex(str(s))
+            return zlib.decompress(raw[4:]).decode("utf-8")
+        except Exception:  # noqa: BLE001 — malformed input → NULL
+            return None
+    return _host_rows(func, ctx, one)
+
+
+@kernel("uncompressed_length")
+def _uncompressed_length(func, ctx):
+    def one(s):
+        if str(s) == "":
+            return 0
+        try:
+            return int.from_bytes(bytes.fromhex(str(s))[:4], "little")
+        except ValueError:
+            return None
+    return _host_rows(func, ctx, one, dtype=np.int64)
+
+
+@kernel("random_bytes")
+def _random_bytes(func, ctx):
+    import os as _os
+
+    def one(n):
+        n = int(n)
+        if not 1 <= n <= 1024:
+            return None
+        return _os.urandom(n).hex()
+    return _host_rows(func, ctx, one)
+
+
+@kernel("statement_digest")
+def _statement_digest(func, ctx):
+    import hashlib
+
+    from tidb_tpu.util.observability import normalize_sql
+
+    def one(s):
+        return hashlib.sha256(
+            normalize_sql(str(s)).encode()).hexdigest()
+    return _host_rows(func, ctx, one)
+
+
+@kernel("statement_digest_text")
+def _statement_digest_text(func, ctx):
+    from tidb_tpu.util.observability import normalize_sql
+    return _host_rows(func, ctx, lambda s: normalize_sql(str(s)))
+
+
+@kernel("validate_password_strength")
+def _validate_password_strength(func, ctx):
+    def one(s):
+        s = str(s)
+        if len(s) < 4:
+            return 0
+        if len(s) < 8:
+            return 25
+        score = 25
+        if any(c.isdigit() for c in s):
+            score += 25
+        if any(c.islower() for c in s) and any(c.isupper() for c in s):
+            score += 25
+        if any(not c.isalnum() for c in s):
+            score += 25
+        return score
+    return _host_rows(func, ctx, one, dtype=np.int64)
+
+
+@kernel("sleep")
+def _sleep(func, ctx):
+    import time as _t
+
+    def one(sec):
+        _t.sleep(min(max(float(sec), 0.0), 10.0))   # capped: DoS guard
+        return 0
+    return _host_rows(func, ctx, one, dtype=np.int64)
+
+
+@kernel("any_value")
+def _any_value(func, ctx):
+    return func.args[0].eval(ctx)
+
+
+@kernel("name_const")
+def _name_const(func, ctx):
+    return func.args[1].eval(ctx)
+
+
+@kernel("interval")
+def _interval_fn(func, ctx):
+    """INTERVAL(N, N1, N2, ...) → index of last Ni <= N (builtin_compare)."""
+    evals = [a.eval(ctx) for a in func.args]
+    n = ctx.num_rows
+    out = np.zeros(n, dtype=np.int64)
+    v0, m0 = evals[0]
+    for i in range(n):
+        if not np.asarray(m0)[i]:
+            out[i] = -1
+            continue
+        x = float(np.asarray(v0)[i])
+        k = 0
+        for v, m in evals[1:]:
+            if np.asarray(m)[i] and x >= float(np.asarray(v)[i]):
+                k += 1
+            elif not np.asarray(m)[i]:
+                k += 1          # MySQL: NULL bounds count as below
+            else:
+                break
+        out[i] = k
+    return out, np.ones(n, dtype=bool)
+
+
+@kernel("tidb_shard")
+def _tidb_shard(func, ctx):
+    """TiDB's shard-index hash (expression/builtin_info.go tidbShard)."""
+    return _host_rows(func, ctx, lambda v: (int(v) % (2 ** 64)) % 256,
+                      dtype=np.int64)
+
+
+# -- session user-level locks (GET_LOCK family; ref: builtin_miscellaneous
+# .go + the server's lock table) — engine-global registry keyed by name
+_USER_LOCKS: dict = {}
+_USER_LOCKS_GUARD = None
+
+
+def _locks_guard():
+    global _USER_LOCKS_GUARD
+    if _USER_LOCKS_GUARD is None:
+        import threading
+        _USER_LOCKS_GUARD = threading.Lock()
+    return _USER_LOCKS_GUARD
+
+
+def _lock_owner(ctx):
+    # MySQL user locks are per-CONNECTION; the server runs one thread
+    # per connection, so the thread is the stable owner identity the
+    # expression context can see across statements
+    import threading
+    return threading.get_ident()
+
+
+@kernel("get_lock")
+def _get_lock(func, ctx):
+    owner = _lock_owner(ctx)
+
+    def one(name, _timeout):
+        with _locks_guard():
+            cur = _USER_LOCKS.get(str(name))
+            if cur is None or cur == owner:
+                _USER_LOCKS[str(name)] = owner
+                return 1
+            return 0            # held elsewhere: no blocking wait
+    return _host_rows(func, ctx, one, dtype=np.int64)
+
+
+@kernel("release_lock")
+def _release_lock(func, ctx):
+    owner = _lock_owner(ctx)
+
+    def one(name):
+        with _locks_guard():
+            cur = _USER_LOCKS.get(str(name))
+            if cur is None:
+                return None
+            if cur == owner:
+                del _USER_LOCKS[str(name)]
+                return 1
+            return 0
+    return _host_rows(func, ctx, one, dtype=np.int64)
+
+
+@kernel("is_free_lock")
+def _is_free_lock(func, ctx):
+    def one(name):
+        with _locks_guard():
+            return 1 if str(name) not in _USER_LOCKS else 0
+    return _host_rows(func, ctx, one, dtype=np.int64)
+
+
+@kernel("is_used_lock")
+def _is_used_lock(func, ctx):
+    def one(name):
+        with _locks_guard():
+            return _USER_LOCKS.get(str(name))
+    return _host_rows(func, ctx, one, dtype=np.int64)
+
+
+@kernel("benchmark")
+def _benchmark(func, ctx):
+    def one(n, _expr_result):
+        return 0        # the expr arg was already evaluated (vectorized)
+    return _host_rows(func, ctx, one, dtype=np.int64)
+
+
+@kernel("rand")
+def _rand(func, ctx):
+    import random as _r
+    n = ctx.num_rows
+    if func.args:
+        v, m = func.args[0].eval(ctx)
+        seed = int(np.asarray(v)[0]) if np.ndim(v) else int(v)
+        rng = _r.Random(seed)
+    else:
+        rng = _r.Random()
+    return (np.array([rng.random() for _ in range(n)], dtype=np.float64),
+            np.ones(n, dtype=bool))
+
+
+# -- JSON mutation / inspection family (ref: expression/builtin_json.go;
+# documents transport as text, paths via _json_path_steps — wildcard-free
+# paths only, like the reference's modify functions) ------------------------
+
+
+def _json_coerce(v):
+    """SQL value → JSON value for modify/append functions. Numbers stay
+    numbers; strings that ARE serialized JSON docs stay text (MySQL wraps
+    SQL strings as JSON strings — callers pass JSON via CAST or nested
+    calls, which arrive here already serialized; detecting that is the
+    pragmatic middle)."""
+    import json as _json
+    if isinstance(v, (int, np.integer)):
+        return int(v)
+    if isinstance(v, (float, np.floating)):
+        return float(v)
+    s = str(v)
+    try:
+        return _json.loads(s)
+    except ValueError:
+        return s
+
+
+_JSON_MISSING = object()
+
+
+def _json_modify(doc, steps, value, mode):
+    """Set/insert/replace at a simple path; returns the new doc. MySQL
+    semantics: intermediate path members must EXIST (only a single
+    missing leaf may be created), and a JSON null value is present —
+    not a missing key (builtin_json.go jsonModify)."""
+    import copy
+    d = copy.deepcopy(doc)
+    if not steps:
+        return value if mode in ("set", "replace") else d
+    cur = d
+    for st in steps[:-1]:
+        if isinstance(st, str) and isinstance(cur, dict):
+            nxt = cur.get(st, _JSON_MISSING)
+        elif isinstance(st, int) and isinstance(cur, list) \
+                and st < len(cur):
+            nxt = cur[st]
+        else:
+            return d                 # missing intermediate: no-op
+        if nxt is _JSON_MISSING or not isinstance(nxt, (dict, list)):
+            return d
+        cur = nxt
+    last = steps[-1]
+    if isinstance(last, str) and isinstance(cur, dict):
+        exists = last in cur
+        if (exists and mode in ("set", "replace")) or \
+                (not exists and mode in ("set", "insert")):
+            cur[last] = value
+    elif isinstance(last, int) and isinstance(cur, list):
+        if last < len(cur):
+            if mode in ("set", "replace"):
+                cur[last] = value
+        elif mode in ("set", "insert"):
+            cur.append(value)
+    return d
+
+
+def _json_modify_kernel(name, mode):
+    @kernel(name)
+    def _fn(func, ctx):
+        import json as _json
+
+        def one(doc, *pv):
+            d = _json.loads(str(doc))
+            for i in range(0, len(pv), 2):
+                steps = _json_path_steps(str(pv[i]))
+                d = _json_modify(d, steps, _json_coerce(pv[i + 1]), mode)
+            return _json.dumps(d, separators=(", ", ": "))
+        return _host_rows(func, ctx, one)
+    return _fn
+
+
+_json_modify_kernel("json_set", "set")
+_json_modify_kernel("json_insert", "insert")
+_json_modify_kernel("json_replace", "replace")
+
+
+@kernel("json_remove")
+def _json_remove(func, ctx):
+    import json as _json
+
+    def one(doc, *paths):
+        d = _json.loads(str(doc))
+        for p in paths:
+            steps = _json_path_steps(str(p))
+            if not steps:
+                continue
+            cur = d
+            ok = True
+            for st in steps[:-1]:
+                if isinstance(st, str) and isinstance(cur, dict) \
+                        and st in cur:
+                    cur = cur[st]
+                elif isinstance(st, int) and isinstance(cur, list) \
+                        and st < len(cur):
+                    cur = cur[st]
+                else:
+                    ok = False
+                    break
+            if not ok:
+                continue
+            last = steps[-1]
+            if isinstance(last, str) and isinstance(cur, dict):
+                cur.pop(last, None)
+            elif isinstance(last, int) and isinstance(cur, list) \
+                    and last < len(cur):
+                cur.pop(last)
+        return _json.dumps(d, separators=(", ", ": "))
+    return _host_rows(func, ctx, one)
+
+
+@kernel("json_quote")
+def _json_quote(func, ctx):
+    import json as _json
+    return _host_rows(func, ctx,
+                      lambda s: _json.dumps(str(s)))
+
+
+@kernel("json_depth")
+def _json_depth(func, ctx):
+    import json as _json
+
+    def depth(v):
+        if isinstance(v, dict):
+            return 1 + max([depth(x) for x in v.values()] or [0])
+        if isinstance(v, list):
+            return 1 + max([depth(x) for x in v] or [0])
+        return 1
+    return _host_rows(func, ctx,
+                      lambda s: depth(_json.loads(str(s))),
+                      dtype=np.int64)
+
+
+@kernel("json_storage_size")
+def _json_storage_size(func, ctx):
+    import json as _json
+    return _host_rows(
+        func, ctx,
+        lambda s: len(_json.dumps(_json.loads(str(s)))), dtype=np.int64)
+
+
+@kernel("json_pretty")
+def _json_pretty(func, ctx):
+    import json as _json
+    return _host_rows(
+        func, ctx,
+        lambda s: _json.dumps(_json.loads(str(s)), indent=2))
+
+
+def _json_append_kernel(name, insert: bool):
+    @kernel(name)
+    def _fn(func, ctx):
+        import json as _json
+
+        def one(doc, *pv):
+            d = _json.loads(str(doc))
+            for i in range(0, len(pv), 2):
+                steps = _json_path_steps(str(pv[i]))
+                val = _json_coerce(pv[i + 1])
+                if insert and steps and isinstance(steps[-1], int):
+                    # ARRAY_INSERT: shift at the index
+                    cur, ok = _json_get(d, steps[:-1])
+                    if ok and isinstance(cur, list):
+                        cur.insert(min(steps[-1], len(cur)), val)
+                    continue
+                cur, ok = _json_get(d, steps)
+                if not ok:
+                    continue
+                if isinstance(cur, list) and not insert:
+                    cur.append(val)
+                elif not insert:
+                    # appending to a scalar wraps it (MySQL semantics);
+                    # only expressible at the root without a parent ref
+                    if not steps:
+                        d = [d, val]
+                    else:
+                        parent, pok = _json_get(d, steps[:-1])
+                        last = steps[-1]
+                        if pok and isinstance(parent, dict) \
+                                and isinstance(last, str):
+                            parent[last] = [cur, val]
+                        elif pok and isinstance(parent, list) \
+                                and isinstance(last, int) \
+                                and last < len(parent):
+                            parent[last] = [cur, val]
+            return _json.dumps(d, separators=(", ", ": "))
+        return _host_rows(func, ctx, one)
+    return _fn
+
+
+_json_append_kernel("json_array_append", False)
+_json_append_kernel("json_array_insert", True)
+
+
+def _json_merge(a, b, patch: bool):
+    if patch:
+        if not isinstance(b, dict):
+            return b
+        if not isinstance(a, dict):
+            a = {}
+        out = dict(a)
+        for k, v in b.items():
+            if v is None:
+                out.pop(k, None)
+            else:
+                out[k] = _json_merge(out.get(k), v, True)
+        return out
+    # MERGE_PRESERVE
+    if isinstance(a, dict) and isinstance(b, dict):
+        out = dict(a)
+        for k, v in b.items():
+            out[k] = _json_merge(out[k], v, False) if k in out else v
+        return out
+    la = a if isinstance(a, list) else [a]
+    lb = b if isinstance(b, list) else [b]
+    return la + lb
+
+
+def _json_merge_kernel(name, patch: bool):
+    @kernel(name)
+    def _fn(func, ctx):
+        import json as _json
+
+        def one(*docs):
+            cur = _json.loads(str(docs[0]))
+            for d in docs[1:]:
+                cur = _json_merge(cur, _json.loads(str(d)), patch)
+            return _json.dumps(cur, separators=(", ", ": "))
+        return _host_rows(func, ctx, one)
+    return _fn
+
+
+_json_merge_kernel("json_merge_patch", True)
+_json_merge_kernel("json_merge_preserve", False)
+
+
+@kernel("json_contains_path")
+def _json_contains_path(func, ctx):
+    import json as _json
+
+    def one(doc, mode, *paths):
+        d = _json.loads(str(doc))
+        hits = [(_json_get(d, _json_path_steps(str(p)))[1]) for p in paths]
+        return int(all(hits) if str(mode).lower() == "all" else any(hits))
+    return _host_rows(func, ctx, one, dtype=np.int64)
+
+
+@kernel("json_search")
+def _json_search(func, ctx):
+    import fnmatch
+    import json as _json
+
+    def walk(v, path):
+        if isinstance(v, dict):
+            for k, x in v.items():
+                yield from walk(x, path + [k])
+        elif isinstance(v, list):
+            for i, x in enumerate(v):
+                yield from walk(x, path + [i])
+        elif isinstance(v, str):
+            yield v, path
+
+    def one(doc, mode, pat):
+        d = _json.loads(str(doc))
+        glob = str(pat).replace("%", "*").replace("_", "?")
+        out = []
+        for s, path in walk(d, []):
+            if fnmatch.fnmatchcase(s, glob):
+                p = "$" + "".join(
+                    f"[{x}]" if isinstance(x, int) else f".{x}"
+                    for x in path)
+                out.append(p)
+                if str(mode).lower() == "one":
+                    break
+        if not out:
+            return None
+        if len(out) == 1:
+            return _json.dumps(out[0])
+        return _json.dumps(out, separators=(", ", ": "))
+    return _host_rows(func, ctx, one)
+
+
+@kernel("json_overlaps")
+def _json_overlaps(func, ctx):
+    import json as _json
+
+    def one(a, b):
+        da, db = _json.loads(str(a)), _json.loads(str(b))
+        la = da if isinstance(da, list) else [da]
+        lb = db if isinstance(db, list) else [db]
+        return int(any(x in lb for x in la))
+    return _host_rows(func, ctx, one, dtype=np.int64)
+
+
+@kernel("json_member_of")
+def _json_member_of(func, ctx):
+    import json as _json
+
+    def one(val, arr):
+        d = _json.loads(str(arr))
+        v = _json_coerce(val)
+        if isinstance(d, list):
+            return int(v in d)
+        return int(v == d)
+    return _host_rows(func, ctx, one, dtype=np.int64)
+
+
+@kernel("json_value")
+def _json_value(func, ctx):
+    import json as _json
+
+    def one(doc, path):
+        hit, found = _json_get(_json.loads(str(doc)),
+                               _json_path_steps(str(path)))
+        if not found or hit is None:
+            return None
+        if isinstance(hit, (dict, list)):
+            return _json.dumps(hit, separators=(", ", ": "))
+        return str(hit) if not isinstance(hit, bool) else \
+            ("1" if hit else "0")
+    return _host_rows(func, ctx, one)
+
+
+# -- temporal additions -------------------------------------------------------
+
+
+def _parse_time_us(s):
+    """'[-]HH:MM:SS[.ffffff]' or 'YYYY-MM-DD HH:MM:SS' → microseconds."""
+    import datetime as _dt
+    s = str(s).strip()
+    try:
+        d = _dt.datetime.fromisoformat(s)
+        return int(d.timestamp() * 1_000_000) if False else             (d - _dt.datetime(1970, 1, 1)).total_seconds() * 0 +             int((d - _dt.datetime(1970, 1, 1)).total_seconds() * 1e6)
+    except ValueError:
+        pass
+    neg = s.startswith("-")
+    if neg:
+        s = s[1:]
+    parts = s.split(":")
+    if not 1 <= len(parts) <= 3:
+        return None
+    try:
+        h = int(parts[0])
+        mi = int(parts[1]) if len(parts) > 1 else 0
+        sec = float(parts[2]) if len(parts) > 2 else 0.0
+    except ValueError:
+        return None
+    us = int(((h * 60 + mi) * 60 + sec) * 1_000_000)
+    return -us if neg else us
+
+
+def _parse_dt_us(s):
+    """Datetime/date string → epoch microseconds, or None."""
+    import datetime as _dt
+    try:
+        d = _dt.datetime.fromisoformat(str(s).strip())
+        return int((d - _dt.datetime(1970, 1, 1)).total_seconds()
+                   * 1_000_000)
+    except ValueError:
+        return None
+
+
+def _temporal_us(func, ctx, idx):
+    """Arg `idx` as epoch-µs (datetime-ish) regardless of arg type."""
+    ft = func.args[idx].ftype
+    if ft.kind.is_string:
+        e = func.args[idx]
+        v, m = e.eval(ctx)
+        out = np.empty(len(v), dtype=np.int64)
+        ok = np.asarray(m, dtype=bool).copy()
+        for i, x in enumerate(v):
+            if not ok[i]:
+                out[i] = 0
+                continue
+            us = _parse_dt_us(x)
+            if us is None:
+                us = _parse_time_us(x)
+            if us is None:
+                ok[i] = False
+                out[i] = 0
+            else:
+                out[i] = us
+        return out, ok
+    v, m = func.args[idx].eval(ctx)
+    if ft.kind is TypeKind.DATE:
+        return np.asarray(v).astype(np.int64) * 86_400_000_000, m
+    return np.asarray(v).astype(np.int64), m
+
+
+@kernel("to_seconds")
+def _to_seconds(func, ctx):
+    xp = ctx.xp
+    ft = func.args[0].ftype
+    if ft.kind.is_string and not ctx.on_device:
+        v, m = _temporal_us(func, ctx, 0)
+        return v // 1_000_000 + _DAYS_TO_EPOCH * 86_400, m
+    v, m = func.args[0].eval(ctx)
+    if ft.kind in (TypeKind.DATETIME, TypeKind.TIMESTAMP):
+        secs = _floor_div_neg(xp, v, 1_000_000)
+        return secs.astype(xp.int64) + _DAYS_TO_EPOCH * 86_400, m
+    return (v.astype(xp.int64) + _DAYS_TO_EPOCH) * 86_400, m
+
+
+@kernel("timediff")
+def _timediff(func, ctx):
+    if ctx.on_device:
+        xp = ctx.xp
+        av, am = func.args[0].eval(ctx)
+        bv, bm = func.args[1].eval(ctx)
+        return av.astype(xp.int64) - bv.astype(xp.int64), am & bm
+    av, am = _temporal_us(func, ctx, 0)
+    bv, bm = _temporal_us(func, ctx, 1)
+    return av - bv, am & bm
+
+
+@kernel("time_format")
+def _time_format(func, ctx):
+    def one(us, fmt):
+        us = int(us)
+        sign = "-" if us < 0 else ""
+        us = abs(us)
+        h, rem = divmod(us, 3_600_000_000)
+        mi, rem = divmod(rem, 60_000_000)
+        se, micro = divmod(rem, 1_000_000)
+        out = str(fmt)
+        for pat, val in (("%H", f"{sign}{h:02d}"), ("%i", f"{mi:02d}"),
+                         ("%s", f"{se:02d}"), ("%S", f"{se:02d}"),
+                         ("%f", f"{micro:06d}"), ("%h", f"{h % 12:02d}"),
+                         ("%k", f"{sign}{h}")):
+            out = out.replace(pat, val)
+        return out
+    return _host_rows(func, ctx, one)
+
+
+@kernel("get_format")
+def _get_format(func, ctx):
+    _FORMATS = {
+        ("date", "usa"): "%m.%d.%Y", ("date", "jis"): "%Y-%m-%d",
+        ("date", "iso"): "%Y-%m-%d", ("date", "eur"): "%d.%m.%Y",
+        ("date", "internal"): "%Y%m%d",
+        ("datetime", "usa"): "%Y-%m-%d %H.%i.%s",
+        ("datetime", "jis"): "%Y-%m-%d %H:%i:%s",
+        ("datetime", "iso"): "%Y-%m-%d %H:%i:%s",
+        ("datetime", "eur"): "%Y-%m-%d %H.%i.%s",
+        ("datetime", "internal"): "%Y%m%d%H%i%s",
+        ("time", "usa"): "%h:%i:%s %p", ("time", "jis"): "%H:%i:%s",
+        ("time", "iso"): "%H:%i:%s", ("time", "eur"): "%H.%i.%s",
+        ("time", "internal"): "%H%i%s",
+    }
+
+    def one(kind, region):
+        return _FORMATS.get((str(kind).lower(), str(region).lower()))
+    return _host_rows(func, ctx, one)
+
+
+@kernel("timestamp")
+def _timestamp_fn(func, ctx):
+    ft = func.args[0].ftype
+    if ft.kind.is_string and not ctx.on_device:
+        return _temporal_us(func, ctx, 0)
+    xp = ctx.xp
+    v, m = func.args[0].eval(ctx)
+    if ft.kind is TypeKind.DATE:
+        v = v.astype(xp.int64) * 86_400_000_000
+    return v, m
+
+
+# -- AES (MySQL AES_ENCRYPT/AES_DECRYPT: AES-128-ECB, PKCS7, with MySQL's
+# key folding — XOR the key bytes cyclically into 16 bytes). Pure-python
+# table AES (ref: expression/builtin_encryption.go; stdlib has no AES) --
+
+
+_AES_SBOX = None
+_AES_INV = None
+
+
+def _aes_tables():
+    """The FIPS-197 S-box built from GF(2^8) inversion + affine map —
+    computed via discrete logs over the generator 3 (a few lines beats a
+    256-literal table and is checked by the FIPS known-answer test)."""
+    global _AES_SBOX, _AES_INV
+    if _AES_SBOX is not None:
+        return _AES_SBOX, _AES_INV
+    # log/antilog tables over generator 3
+    exp = [0] * 256
+    log = [0] * 256
+    x = 1
+    for i in range(255):
+        exp[i] = x
+        log[x] = i
+        # x *= 3  (x ^ xtime(x))
+        x ^= _xtime(x)
+    sbox = [0] * 256
+    for a in range(256):
+        inv_a = 0 if a == 0 else exp[(255 - log[a]) % 255]
+        b = inv_a
+        s = 0x63
+        for k in range(8):
+            bit = (b >> k) & 1
+            for dst in (k, (k + 1) % 8, (k + 2) % 8, (k + 3) % 8,
+                        (k + 4) % 8):
+                s ^= bit << dst
+        sbox[a] = s & 0xFF
+    inv = [0] * 256
+    for i, v in enumerate(sbox):
+        inv[v] = i
+    _AES_SBOX, _AES_INV = sbox, inv
+    return sbox, inv
+
+
+def _xtime(a):
+    a <<= 1
+    return (a ^ 0x1B) & 0xFF if a & 0x100 else a
+
+
+def _aes_expand_key(key):
+    sbox, _ = _aes_tables()
+    rcon = 1
+    w = list(key)
+    while len(w) < 176:
+        t = w[-4:]
+        if len(w) % 16 == 0:
+            t = [sbox[t[1]] ^ rcon, sbox[t[2]], sbox[t[3]], sbox[t[0]]]
+            rcon = _xtime(rcon)
+        base = [w[len(w) - 16 + i] for i in range(4)]
+        w.extend(base[i] ^ t[i] for i in range(4))
+    return w
+
+
+def _aes_block(block, rk, enc: bool):
+    sbox, inv = _aes_tables()
+    s = list(block)
+
+    def add_rk(r):
+        for i in range(16):
+            s[i] ^= rk[16 * r + i]
+
+    def sub(box):
+        for i in range(16):
+            s[i] = box[s[i]]
+
+    def shift(enc_):
+        for r in range(1, 4):
+            row = [s[r + 4 * c] for c in range(4)]
+            k = r if enc_ else -r
+            row = row[k:] + row[:k]
+            for c in range(4):
+                s[r + 4 * c] = row[c]
+
+    def mix(enc_):
+        for c in range(4):
+            col = s[4 * c:4 * c + 4]
+            if enc_:
+                t = col[0] ^ col[1] ^ col[2] ^ col[3]
+                u = col[0]
+                s[4 * c + 0] ^= t ^ _xtime(col[0] ^ col[1])
+                s[4 * c + 1] ^= t ^ _xtime(col[1] ^ col[2])
+                s[4 * c + 2] ^= t ^ _xtime(col[2] ^ col[3])
+                s[4 * c + 3] ^= t ^ _xtime(col[3] ^ u)
+            else:
+                def mul(a, b):
+                    out = 0
+                    while b:
+                        if b & 1:
+                            out ^= a
+                        a = _xtime(a)
+                        b >>= 1
+                    return out
+                a0, a1, a2, a3 = col
+                s[4 * c + 0] = mul(a0, 14) ^ mul(a1, 11) ^ \
+                    mul(a2, 13) ^ mul(a3, 9)
+                s[4 * c + 1] = mul(a0, 9) ^ mul(a1, 14) ^ \
+                    mul(a2, 11) ^ mul(a3, 13)
+                s[4 * c + 2] = mul(a0, 13) ^ mul(a1, 9) ^ \
+                    mul(a2, 14) ^ mul(a3, 11)
+                s[4 * c + 3] = mul(a0, 11) ^ mul(a1, 13) ^ \
+                    mul(a2, 9) ^ mul(a3, 14)
+
+    if enc:
+        add_rk(0)
+        for r in range(1, 10):
+            sub(sbox)
+            shift(True)
+            mix(True)
+            add_rk(r)
+        sub(sbox)
+        shift(True)
+        add_rk(10)
+    else:
+        add_rk(10)
+        for r in range(9, 0, -1):
+            shift(False)
+            sub(inv)
+            add_rk(r)
+            mix(False)
+        shift(False)
+        sub(inv)
+        add_rk(0)
+    return bytes(s)
+
+
+def _mysql_aes_key(key):
+    out = bytearray(16)
+    for i, b in enumerate(key.encode("utf-8") if isinstance(key, str)
+                          else key):
+        out[i % 16] ^= b
+    return bytes(out)
+
+
+@kernel("aes_encrypt")
+def _aes_encrypt(func, ctx):
+    def one(s, key):
+        rk = _aes_expand_key(_mysql_aes_key(str(key)))
+        raw = str(s).encode("utf-8")
+        pad = 16 - len(raw) % 16
+        raw += bytes([pad]) * pad
+        out = b"".join(_aes_block(raw[i:i + 16], rk, True)
+                       for i in range(0, len(raw), 16))
+        return out.hex()            # hex text transport
+    return _host_rows(func, ctx, one)
+
+
+@kernel("aes_decrypt")
+def _aes_decrypt(func, ctx):
+    def one(s, key):
+        try:
+            raw = bytes.fromhex(str(s))
+            if not raw or len(raw) % 16:
+                return None
+            rk = _aes_expand_key(_mysql_aes_key(str(key)))
+            out = b"".join(_aes_block(raw[i:i + 16], rk, False)
+                           for i in range(0, len(raw), 16))
+            pad = out[-1]
+            if not 1 <= pad <= 16:
+                return None
+            return out[:-pad].decode("utf-8")
+        except Exception:  # noqa: BLE001 — wrong key/garbage → NULL
+            return None
+    return _host_rows(func, ctx, one)
+
+
+@kernel("extractvalue")
+def _extractvalue(func, ctx):
+    import xml.etree.ElementTree as ET
+
+    def one(xml, xpath):
+        try:
+            root = ET.fromstring(str(xml))
+        except ET.ParseError:
+            return None
+        p = str(xpath).strip("/")
+        parts = p.split("/")
+        # root tag consumes the first step
+        if parts and parts[0] == root.tag:
+            parts = parts[1:]
+        nodes = [root]
+        for step in parts:
+            if step in ("text()",):
+                break
+            nxt = []
+            for nd in nodes:
+                nxt.extend(nd.findall(step))
+            nodes = nxt
+        return " ".join((nd.text or "").strip() for nd in nodes)
+    return _host_rows(func, ctx, one)
+
+
+@kernel("updatexml")
+def _updatexml(func, ctx):
+    import re as _re
+
+    def one(xml, xpath, repl):
+        # MySQL semantics: replace the single matched ELEMENT text-wise;
+        # a non-matching path returns the original document
+        tag = str(xpath).strip("/").split("/")[-1]
+        pat = f"<{tag}(\\s[^>]*)?>.*?</{tag}>"
+        s = str(xml)
+        if _re.search(pat, s, _re.S):
+            return _re.sub(pat, str(repl), s, count=1, flags=_re.S)
+        return s
+    return _host_rows(func, ctx, one)
+
+
+@kernel("charset")
+def _charset_fn(func, ctx):
+    ft = func.args[0].ftype
+    val = "utf8mb4" if ft.kind.is_string else "binary"
+    n = ctx.num_rows
+    return np.array([val] * n, dtype=object), np.ones(n, dtype=bool)
+
+
+@kernel("collation")
+def _collation_fn(func, ctx):
+    ft = func.args[0].ftype
+    val = ("utf8mb4_general_ci" if getattr(ft, "is_ci", False)
+           else "utf8mb4_bin") if ft.kind.is_string else "binary"
+    n = ctx.num_rows
+    return np.array([val] * n, dtype=object), np.ones(n, dtype=bool)
+
+
+@kernel("coercibility")
+def _coercibility_fn(func, ctx):
+    from tidb_tpu.expression import Constant as _C
+    e = func.args[0]
+    val = 4 if isinstance(e, _C) else (2 if e.ftype.kind.is_string else 5)
+    n = ctx.num_rows
+    return np.full(n, val, dtype=np.int64), np.ones(n, dtype=bool)
+
+
+@kernel("load_file")
+def _load_file(func, ctx):
+    # secure_file_priv defaults to restricted: always NULL (MySQL parity
+    # for the common locked-down configuration)
+    return _host_rows(func, ctx, lambda s: None)
+
+
+_UUID_SHORT_STATE = [0]
+
+
+@kernel("uuid_short")
+def _uuid_short(func, ctx):
+    import time as _t
+    n = ctx.num_rows
+    base = (int(_t.time()) & 0xFFFFFFF) << 24
+    out = np.empty(n, dtype=np.int64)
+    for i in range(n):
+        _UUID_SHORT_STATE[0] += 1
+        out[i] = base | (_UUID_SHORT_STATE[0] & 0xFFFFFF)
+    return out, np.ones(n, dtype=bool)
+
+
+@kernel("format_pico_time")
+def _format_pico_time(func, ctx):
+    def one(v):
+        x = float(v)
+        for unit, div in (("ps", 1.0), ("ns", 1e3), ("us", 1e6),
+                          ("ms", 1e9), ("s", 1e12), ("min", 60e12),
+                          ("h", 3.6e15), ("d", 86.4e15)):
+            nxt = {"ps": 1e3, "ns": 1e6, "us": 1e9, "ms": 1e12,
+                   "s": 60e12, "min": 3.6e15, "h": 86.4e15,
+                   "d": float("inf")}[unit]
+            if abs(x) < nxt:
+                val = x / div
+                return (f"{val:.0f} {unit}" if unit == "ps"
+                        else f"{val:.2f} {unit}")
+    return _host_rows(func, ctx, one)
+
+
+@kernel("weight_string")
+def _weight_string(func, ctx):
+    def one(s):
+        ft = func.args[0].ftype
+        t = str(s)
+        if getattr(ft, "is_ci", False):
+            import numpy as _np
+
+            from tidb_tpu.types import fold_ci_array
+            t = str(fold_ci_array(_np.array([t], dtype=object))[0])
+        return t.encode("utf-8").hex().upper()
+    return _host_rows(func, ctx, one)
+
+
+@kernel("time")
+def _time_extract(func, ctx):
+    ft = func.args[0].ftype
+    if ft.kind.is_string and not ctx.on_device:
+        e = func.args[0]
+        v, m = e.eval(ctx)
+        out = np.empty(len(v), dtype=np.int64)
+        ok = np.asarray(m, dtype=bool).copy()
+        for i, x in enumerate(v):
+            us = _parse_time_us(x) if ok[i] else None
+            if us is None:
+                ok[i] = False
+                out[i] = 0
+            else:
+                out[i] = us
+        return out, ok
+    xp = ctx.xp
+    v, m = func.args[0].eval(ctx)
+    if ft.kind in (TypeKind.DATETIME, TypeKind.TIMESTAMP):
+        day_us = xp.int64(86_400_000_000)
+        return v.astype(xp.int64) % day_us, m
+    if ft.kind is TypeKind.DATE:
+        return xp.zeros_like(v.astype(xp.int64)), m
+    return v, m
+
+
+@kernel("tidb_is_ddl_owner")
+def _tidb_is_ddl_owner(func, ctx):
+    n = ctx.num_rows
+    return np.ones(n, dtype=np.int64), np.ones(n, dtype=bool)
+
+
+@kernel("password")
+def _password_fn(func, ctx):
+    import hashlib
+
+    def one(s):
+        if str(s) == "":
+            return ""
+        inner = hashlib.sha1(str(s).encode()).digest()
+        return "*" + hashlib.sha1(inner).hexdigest().upper()
+    return _host_rows(func, ctx, one)
+
+
+def _gtid_sets(s):
+    out = {}
+    for part in str(s).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        bits = part.split(":")
+        uuid, ranges = bits[0].lower(), bits[1:]
+        ivals = out.setdefault(uuid, [])
+        for r in ranges:
+            if "-" in r:
+                a, b = r.split("-")
+                ivals.append((int(a), int(b)))
+            else:
+                ivals.append((int(r), int(r)))
+    return out
+
+
+def _gtid_contains(sup, a, b):
+    return any(lo <= a and b <= hi for lo, hi in sup)
+
+
+@kernel("gtid_subset")
+def _gtid_subset(func, ctx):
+    def one(sub, sup):
+        subs, sups = _gtid_sets(sub), _gtid_sets(sup)
+        for uuid, ivals in subs.items():
+            have = sups.get(uuid, [])
+            if not all(_gtid_contains(have, a, b) for a, b in ivals):
+                return 0
+        return 1
+    return _host_rows(func, ctx, one, dtype=np.int64)
+
+
+@kernel("gtid_subtract")
+def _gtid_subtract(func, ctx):
+    def one(a, b):
+        A, B = _gtid_sets(a), _gtid_sets(b)
+        out = []
+        for uuid, ivals in A.items():
+            cut = B.get(uuid, [])
+            pieces = []
+            for lo, hi in ivals:
+                segs = [(lo, hi)]
+                for clo, chi in cut:
+                    nxt = []
+                    for slo, shi in segs:
+                        if chi < slo or clo > shi:
+                            nxt.append((slo, shi))
+                            continue
+                        if slo < clo:
+                            nxt.append((slo, clo - 1))
+                        if chi < shi:
+                            nxt.append((chi + 1, shi))
+                    segs = nxt
+                pieces.extend(segs)
+            if pieces:
+                rs = ":".join(f"{lo}-{hi}" if hi > lo else str(lo)
+                              for lo, hi in sorted(pieces))
+                out.append(f"{uuid}:{rs}")
+        return ",".join(out)
+    return _host_rows(func, ctx, one)
+
+
+@kernel("ps_thread_id")
+def _ps_thread_id(func, ctx):
+    return _host_rows(func, ctx, lambda v: int(v), dtype=np.int64)
+
+
+@kernel("ps_current_thread_id")
+def _ps_current_thread_id(func, ctx):
+    import threading
+    n = ctx.num_rows
+    return (np.full(n, threading.get_ident() % (1 << 31), dtype=np.int64),
+            np.ones(n, dtype=bool))
+
+
+@kernel("release_all_locks")
+def _release_all_locks(func, ctx):
+    owner = _lock_owner(ctx)
+    n = ctx.num_rows
+    with _locks_guard():
+        mine = [k for k, v in _USER_LOCKS.items() if v == owner]
+        for k in mine:
+            del _USER_LOCKS[k]
+    return np.full(n, len(mine), dtype=np.int64), np.ones(n, dtype=bool)
+
+
+@kernel("roles_graphml")
+def _roles_graphml(func, ctx):
+    n = ctx.num_rows
+    xml = ('<?xml version="1.0" encoding="UTF-8"?><graphml '
+           'xmlns="http://graphml.graphdrawing.org/xmlns"><graph '
+           'id="roles" edgedefault="directed"/></graphml>')
+    return np.array([xml] * n, dtype=object), np.ones(n, dtype=bool)

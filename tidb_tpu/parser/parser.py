@@ -1183,6 +1183,29 @@ class Parser:
             self.expect_op(")")
             return ast.CastExpr(e, ftype)
         if t.is_kw("interval"):
+            # INTERVAL(N, N1, ...) the comparison FUNCTION vs
+            # INTERVAL expr UNIT the temporal literal: a comma at paren
+            # depth 1 decides (MySQL's own disambiguation rule)
+            if self.toks[self.i + 1].kind == "op" and \
+                    self.toks[self.i + 1].value == "(":
+                depth = 0
+                is_fn = False
+                for k in range(self.i + 1, len(self.toks)):
+                    tk = self.toks[k]
+                    if tk.kind != "op":
+                        continue
+                    if tk.value == "(":
+                        depth += 1
+                    elif tk.value == ")":
+                        depth -= 1
+                        if depth == 0:
+                            break
+                    elif tk.value == "," and depth == 1:
+                        is_fn = True
+                        break
+                if is_fn:
+                    self.advance()
+                    return self._call("interval")
             self.advance()
             v = self.add_expr()
             unit = self.ident().lower()
@@ -1220,7 +1243,8 @@ class Parser:
             return ast.FuncCall(f"{kw}_literal", [ast.Literal(s, "str")])
         if t.is_kw("replace", "left", "right", "database",
                    "truncate", "mod", "user", "data", "insert", "char",
-                   "format", "set"):
+                   "format", "set", "charset", "collate",
+                   "values", "default", "analyze"):
             # keywords that double as function names
             if self.toks[self.i + 1].kind == "op" and \
                     self.toks[self.i + 1].value == "(":

@@ -63,8 +63,40 @@ _SCALAR_FUNCS = {
     "json_extract", "json_unquote", "json_valid", "json_type",
     "json_length", "json_keys", "json_contains", "json_array",
     "json_object",
+    # batch 3 (round 5): info / IP / UUID / JSON-mutation / crypto / misc
+    "is_ipv4", "is_ipv6", "is_ipv4_compat", "is_ipv4_mapped",
+    "inet6_aton", "inet6_ntoa", "is_uuid", "uuid_to_bin", "bin_to_uuid",
+    "uuid_short",
+    "concat_ws", "bit_count", "octet_length", "format_bytes",
+    "format_pico_time", "weight_string", "load_file",
+    "regexp_instr", "regexp_substr", "regexp_replace",
+    "compress", "uncompress", "uncompressed_length", "random_bytes",
+    "aes_encrypt", "aes_decrypt", "password",
+    "statement_digest", "statement_digest_text",
+    "validate_password_strength",
+    "sleep", "any_value", "name_const", "interval", "benchmark", "rand",
+    "get_lock", "release_lock", "is_free_lock", "is_used_lock",
+    "charset", "collation", "coercibility",
+    "tidb_shard", "tidb_is_ddl_owner",
+    "extractvalue", "updatexml",
+    "json_set", "json_insert", "json_replace", "json_remove",
+    "json_quote", "json_depth", "json_storage_size", "json_pretty",
+    "json_array_append", "json_array_insert", "json_merge_patch",
+    "json_merge_preserve", "json_contains_path", "json_search",
+    "json_overlaps", "json_member_of", "json_value",
+    "to_seconds", "timediff", "time", "time_format", "get_format",
+    "timestamp",
+    # env-evaluated builtins (folded once per statement in _env_func;
+    # listed here because they ARE supported SQL builtins)
+    "now", "current_timestamp", "localtime", "localtimestamp",
+    "sysdate", "curdate", "current_date", "current_user",
+    "last_insert_id", "version", "connection_id",
+    "schema", "session_user", "system_user", "found_rows", "row_count",
+    "tidb_version", "current_role", "icu_version",
+    "gtid_subset", "gtid_subtract", "ps_thread_id",
+    "ps_current_thread_id", "release_all_locks", "roles_graphml", "sha",
 }
-_CANON = {"ceiling": "ceil", "power": "pow", "ucase": "upper",
+_CANON = {"ceiling": "ceil", "power": "pow", "ucase": "upper", "sha": "sha1",
           "lcase": "lower", "character_length": "char_length",
           "day": "dayofmonth", "substring": "substr", "mid": "substr",
           "position": "locate", "adddate": "date_add",
@@ -214,7 +246,10 @@ class ExpressionRewriter:
                   "curtime", "current_time", "utc_date", "utc_timestamp",
                   "utc_time",
                   "version", "user", "current_user", "database",
-                  "connection_id", "last_insert_id")
+                  "connection_id", "last_insert_id",
+                  "schema", "session_user", "system_user", "found_rows",
+                  "row_count", "tidb_version", "current_role",
+                  "icu_version")
 
     def _tz_offset_us(self) -> int:
         env = getattr(self, "env", None) or {}
@@ -266,15 +301,26 @@ class ExpressionRewriter:
                             FieldType(TypeKind.TIME, False))
         if name == "version":
             return lit("8.0.11-tidb-tpu")
+        if name == "tidb_version":
+            return lit("Release Version: tidb-tpu\nEdition: TPU-native")
+        if name == "icu_version":
+            return lit("73.1")
+        if name == "current_role":
+            return lit("NONE")
         env = getattr(self, "env", None) or {}
-        if name in ("user", "current_user"):
+        if name in ("user", "current_user", "session_user",
+                    "system_user"):
             return lit(str(env.get("user", "root")) + "@%")
-        if name == "database":
+        if name in ("database", "schema"):
             return lit(str(env.get("database", "test")))
         if name == "connection_id":
             return lit(int(env.get("connection_id", 0)))
         if name == "last_insert_id":
             return lit(int(env.get("last_insert_id", 0)))
+        if name == "found_rows":
+            return lit(int(env.get("found_rows", 0)))
+        if name == "row_count":
+            return lit(int(env.get("row_count", -1)))
         raise AssertionError(name)
 
     def _func_call(self, node: ast.FuncCall) -> Expression:

@@ -53,7 +53,10 @@ def logical_optimize(plan: LogicalPlan, ctx=None) -> LogicalPlan:
 # ---------------------------------------------------------------------------
 
 
-_NONFOLDABLE = frozenset(("uuid", "rand", "random_bytes", "uuid_short"))
+_NONFOLDABLE = frozenset((
+    "uuid", "rand", "random_bytes", "uuid_short", "sleep", "benchmark",
+    "get_lock", "release_lock", "release_all_locks", "is_free_lock",
+    "is_used_lock", "ps_current_thread_id", "found_rows", "row_count"))
 
 
 def fold_expr(e: Expression) -> Expression:
