@@ -138,50 +138,62 @@ def test_stats_feed_group_cap(session):
     assert cap == 1024           # small reliable estimate → floor
 
 
+def _wait_stats(eng, tid, pred=lambda st: True, timeout=5.0):
+    import time as _t
+    deadline = _t.time() + timeout
+    while _t.time() < deadline:
+        st = eng.table_stats.get(tid)
+        if st is not None and pred(st):
+            return st
+        _t.sleep(0.02)
+    raise AssertionError("auto-analyze did not fire in time")
+
+
 def test_auto_analyze_lifecycle():
-    # statement-boundary auto-analyze (statistics/handle/update.go:939,
-    # domain/domain.go:1249): stats appear without a manual ANALYZE once
-    # enough rows accumulate, refresh after 10x growth, and the plan that
-    # keyed on the stale stats version is replanned
+    # BACKGROUND auto-analyze (statistics/handle/update.go:939 on the
+    # domain loop, domain/domain.go:1249): stats appear with NO query at
+    # all after a write burst — the triggering statement pays nothing —
+    # refresh after 10x growth, and the plan keyed on the stale stats
+    # version is replanned
     eng = Engine()
     s = eng.new_session()
     s.execute("CREATE TABLE aa (a BIGINT, b BIGINT)")
     s.execute("INSERT INTO aa VALUES " +
               ",".join(f"({i},{i % 7})" for i in range(2000)))
     tid = eng.catalog.info_schema.table("aa").id
-    assert tid not in eng.table_stats
+    # no SELECT issued: the background worker alone produces the stats
+    _wait_stats(eng, tid, lambda st: st.row_count == 2000)
     sql = "SELECT b, COUNT(*) FROM aa GROUP BY b"
-    s.query(sql)
-    assert tid in eng.table_stats          # fired with no manual ANALYZE
-    assert eng.table_stats[tid].row_count == 2000
     plan1 = s._plan(parse(sql)[0])
     # 10x growth → ratio trigger → fresh stats + replanned estimate
     s.execute("INSERT INTO aa VALUES " +
               ",".join(f"({i},{i % 7})" for i in range(2000, 20000)))
+    _wait_stats(eng, tid, lambda st: st.row_count == 20000)
     plan2 = s._plan(parse(sql)[0])
-    assert eng.table_stats[tid].row_count == 20000
     assert plan2 is not plan1              # stats version keyed the cache
     assert plan2.est_rows == plan1.est_rows == 7  # NDV(b) stays 7
 
 
 def test_auto_analyze_disabled_and_small_tables():
+    import time as _t
     eng = Engine()
     s = eng.new_session()
+    # disable GLOBALLY first: the analyzer is engine-wide (global scope,
+    # like the reference's tidb_enable_auto_analyze)
+    s.execute("SET GLOBAL tidb_enable_auto_analyze = 'off'")
     s.execute("CREATE TABLE small (a BIGINT)")
     s.execute("INSERT INTO small VALUES (1),(2),(3)")
     tid = eng.catalog.info_schema.table("small").id
-    s.query("SELECT COUNT(*) FROM small")
-    assert tid not in eng.table_stats      # under tidb_auto_analyze_min_rows
     s.execute("CREATE TABLE big (a BIGINT)")
     s.execute("INSERT INTO big VALUES " +
               ",".join(f"({i})" for i in range(1500)))
     bid = eng.catalog.info_schema.table("big").id
-    s.vars["tidb_enable_auto_analyze"] = "off"
-    s.query("SELECT COUNT(*) FROM big")
+    _t.sleep(0.6)                          # > one worker lease
     assert bid not in eng.table_stats      # disabled
-    s.vars["tidb_enable_auto_analyze"] = "on"
-    s.query("SELECT COUNT(*) FROM big")
-    assert bid in eng.table_stats
+    s.execute("SET GLOBAL tidb_enable_auto_analyze = 'on'")
+    eng._kick_analyze()
+    _wait_stats(eng, bid)
+    assert tid not in eng.table_stats      # under min_rows, never fires
 
 
 def test_auto_analyze_ignores_rolled_back_writes():
@@ -193,14 +205,14 @@ def test_auto_analyze_ignores_rolled_back_writes():
     s.execute("CREATE TABLE rbk (a BIGINT)")
     s.execute("INSERT INTO rbk VALUES " +
               ",".join(f"({i})" for i in range(1500)))
-    s.query("SELECT COUNT(*) FROM rbk")          # baseline auto-analyze
     tid = eng.catalog.info_schema.table("rbk").id
-    v0 = eng.table_stats[tid].version
+    v0 = _wait_stats(eng, tid).version           # baseline auto-analyze
     s.execute("BEGIN")
     s.execute("INSERT INTO rbk VALUES " +
               ",".join(f"({i})" for i in range(50000, 70000)))
     s.execute("ROLLBACK")
-    s.query("SELECT COUNT(*) FROM rbk")
+    import time as _t
+    _t.sleep(0.6)                                # > one worker lease
     assert eng.table_stats[tid].version == v0    # no spurious re-analyze
     assert eng.modify_counts.get(tid, 0) == 0
     # committed writes DO count
@@ -208,5 +220,55 @@ def test_auto_analyze_ignores_rolled_back_writes():
     s.execute("INSERT INTO rbk VALUES " +
               ",".join(f"({i})" for i in range(50000, 70000)))
     s.execute("COMMIT")
-    s.query("SELECT COUNT(*) FROM rbk")
-    assert eng.table_stats[tid].row_count == 21500
+    _wait_stats(eng, tid, lambda st: st.row_count == 21500)
+
+
+def test_cmsketch_skew_plan_choice():
+    """CM-sketch point estimates (statistics/cmsketch.go:46): on a
+    skewed column, equality against a hot mid-tail value (outside TopN's
+    reach in a wide-key table) estimates high and keeps the table scan,
+    while a rare value estimates low and flips to the index path —
+    pinned via EXPLAIN in both directions."""
+    eng = Engine()
+    s = eng.new_session()
+    s.execute("CREATE TABLE sk (k BIGINT, v BIGINT, INDEX ik (k))")
+    rows = []
+    # values 0..39 hot (1000 rows each = beyond TOPN_SIZE=32 slots),
+    # values 1000..10999 rare (1 row each)
+    for hot in range(40):
+        rows.extend(f"({hot},{i})" for i in range(1000))
+    rows.extend(f"({1000 + i},0)" for i in range(10000))
+    s.execute("INSERT INTO sk VALUES " + ",".join(rows))
+    s.execute("ANALYZE TABLE sk")
+    st = eng.table_stats[eng.catalog.info_schema.table("sk").id]
+    cs = st.columns[0]
+    assert cs.cms is not None
+    # hot mid-tail value (39 may fall outside the 32-slot TopN):
+    # estimate must be ~1000 rows, not the uniform ~5
+    hot_est = cs.eq_selectivity(39) * st.row_count
+    rare_est = cs.eq_selectivity(5000) * st.row_count
+    assert hot_est > 200, hot_est
+    assert rare_est < 50, rare_est
+    plan_hot = "\n".join(str(r) for r in s.query(
+        "EXPLAIN SELECT SUM(v) FROM sk WHERE k = 39").rows)
+    plan_rare = "\n".join(str(r) for r in s.query(
+        "EXPLAIN SELECT SUM(v) FROM sk WHERE k = 5000").rows)
+    # the sketch's 1000x estimate difference is visible in EXPLAIN
+    import re as _re
+    est_hot = int(_re.search(r"IndexScan', '(\d+)'", plan_hot).group(1))
+    est_rare = int(_re.search(r"IndexScan', '(\d+)'", plan_rare).group(1))
+    assert est_hot > 500 and est_rare <= 50, (est_hot, est_rare)
+    # ...and flips a real operator choice: the join build side (the
+    # smaller side builds; a TopN-missed hot key must not look small)
+    s.execute("CREATE TABLE mid (k BIGINT, w BIGINT)")
+    s.execute("INSERT INTO mid VALUES " + ",".join(
+        f"({i},{i})" for i in range(100)))
+    s.execute("ANALYZE TABLE mid")
+    jh = "\n".join(str(r) for r in s.query(
+        "EXPLAIN SELECT COUNT(*) FROM sk JOIN mid ON sk.v = mid.w "
+        "WHERE sk.k = 39").rows)
+    jr = "\n".join(str(r) for r in s.query(
+        "EXPLAIN SELECT COUNT(*) FROM sk JOIN mid ON sk.v = mid.w "
+        "WHERE sk.k = 5000").rows)
+    assert "build:right" in jh     # hot side is BIG: build the 100-row mid
+    assert "build:left" in jr      # rare side is tiny: it builds

@@ -3374,7 +3374,7 @@ def _parse_time_us(s):
     s = str(s).strip()
     try:
         d = _dt.datetime.fromisoformat(s)
-        return int(d.timestamp() * 1_000_000) if False else             (d - _dt.datetime(1970, 1, 1)).total_seconds() * 0 +             int((d - _dt.datetime(1970, 1, 1)).total_seconds() * 1e6)
+        return int((d - _dt.datetime(1970, 1, 1)).total_seconds() * 1_000_000)
     except ValueError:
         pass
     neg = s.startswith("-")

@@ -213,6 +213,11 @@ class Engine:
         self._auto_ids: Dict[Tuple[int, int], int] = {}
         # SET GLOBAL scope, inherited by new sessions (sysvar.go analog)
         self.global_vars: Dict[str, object] = {}
+        # background auto-analyze worker state (_kick_analyze)
+        self._analyze_event = threading.Event()
+        self._analyze_thread = None
+        self._analyze_stop = False
+        self._bg_session = None
 
     def assign_auto_ids(self, table_id: int, col_offset: int,
                         vals: np.ndarray, valid: np.ndarray,
@@ -250,9 +255,87 @@ class Engine:
         with self.stats_lock:
             self.modify_counts[table_id] = \
                 self.modify_counts.get(table_id, 0) + int(n)
+        self._kick_analyze()
+
+    # ---- background auto-analyze (ref: statistics/handle/update.go:939
+    # HandleAutoAnalyze on the domain's loop, domain/domain.go:1249) ------
+    ANALYZE_LEASE_S = 0.25        # worker poll lease (3s in the reference)
+
+    def _kick_analyze(self) -> None:
+        """Wake the background analyzer — the ONLY cost a write statement
+        pays (an Event.set); the analyze itself runs off-path."""
+        if self._analyze_thread is None:
+            with self.stats_lock:
+                if self._analyze_thread is None:
+                    import weakref
+                    t = threading.Thread(
+                        target=_analyze_worker_loop,
+                        args=(weakref.ref(self), self._analyze_event),
+                        name="auto-analyze", daemon=True)
+                    self._analyze_thread = t
+                    t.start()
+        self._analyze_event.set()
+
+    def close(self) -> None:
+        """Stop the background analyzer (tests/embedders; GC also ends
+        it via the worker's weakref)."""
+        self._analyze_stop = True
+        self._analyze_event.set()
+
+    def _auto_analyze_pass(self) -> None:
+        """One trigger sweep: any table whose modified-row count since
+        its last ANALYZE exceeds tidb_auto_analyze_ratio x analyzed rows
+        (or that accumulated tidb_auto_analyze_min_rows with no stats)
+        re-analyzes on THIS thread. Config reads GLOBAL scope — the
+        analyzer serves every session."""
+        from tidb_tpu.executor.fragment import _var_bool
+        from tidb_tpu.parser import ast as _ast
+        gv = self.global_vars
+        if not _var_bool(gv.get("tidb_enable_auto_analyze", True)):
+            return
+        ratio = float(gv.get("tidb_auto_analyze_ratio", 0.5))
+        min_rows = int(gv.get("tidb_auto_analyze_min_rows", 1000))
+        with self.stats_lock:
+            pending = dict(self.modify_counts)
+        if not pending:
+            return
+        names = []
+        for tid, mod in pending.items():
+            if mod < min_rows:
+                continue
+            stats = self.table_stats.get(tid)
+            if stats is not None and mod <= ratio * max(stats.row_count,
+                                                        1):
+                continue
+            info = self.catalog.info_schema.table_by_id(tid)
+            if info is not None:
+                names.append(info.name)
+        if names:
+            if self._bg_session is None:
+                self._bg_session = self.new_session()
+            self._bg_session._analyze(_ast.AnalyzeTable(names))
 
     def new_session(self) -> "Session":
         return Session(self)
+
+
+def _analyze_worker_loop(engine_ref, event) -> None:
+    """Auto-analyze daemon body: holds the Engine only through a weakref,
+    so a dropped Engine is collectable and ends this thread; wakes on the
+    event (a write committed) or the lease timeout."""
+    import logging
+    log_ = logging.getLogger("tidb_tpu.autoanalyze")
+    while True:
+        event.wait(timeout=Engine.ANALYZE_LEASE_S)
+        event.clear()
+        eng = engine_ref()
+        if eng is None or eng._analyze_stop:
+            return
+        try:
+            eng._auto_analyze_pass()
+        except Exception:  # noqa: BLE001 — the loop must survive
+            log_.warning("auto-analyze pass failed", exc_info=True)
+        del eng            # don't pin the engine across the wait
 
 
 class _PlanContext:
@@ -656,39 +739,7 @@ class Session:
         else:
             txn.modified[table_id] = txn.modified.get(table_id, 0) + n
 
-    def _maybe_auto_analyze(self) -> None:
-        """Statement-boundary auto-analyze — the single-process stand-in
-        for the reference's background loop (statistics/handle/
-        update.go:939 HandleAutoAnalyze, wired at domain/domain.go:1249).
-        Any table whose modified-row count since its last ANALYZE exceeds
-        tidb_auto_analyze_ratio × analyzed rows (or that has accumulated
-        tidb_auto_analyze_min_rows with no stats at all) is re-analyzed
-        here; the stats-version bump invalidates its cached plans."""
-        from tidb_tpu.executor.fragment import _var_bool
-        if not _var_bool(self.vars.get("tidb_enable_auto_analyze", True)):
-            return
-        ratio = float(self.vars.get("tidb_auto_analyze_ratio", 0.5))
-        min_rows = int(self.vars.get("tidb_auto_analyze_min_rows", 1000))
-        eng = self.engine
-        with eng.stats_lock:
-            pending = dict(eng.modify_counts)
-        if not pending:
-            return
-        names = []
-        for tid, mod in pending.items():
-            if mod < min_rows:
-                continue
-            stats = eng.table_stats.get(tid)
-            if stats is not None and mod <= ratio * max(stats.row_count, 1):
-                continue
-            info = eng.catalog.info_schema.table_by_id(tid)
-            if info is not None:
-                names.append(info.name)
-        if names:
-            self._analyze(ast.AnalyzeTable(names))
-
     def _plan(self, stmt):
-        self._maybe_auto_analyze()
         ctx = _PlanContext(self)
         key = self._plan_cache_key(stmt)
         if key is not None:
@@ -1797,11 +1848,18 @@ class Session:
         executor/analyze.go → statistics/histogram.go:49)."""
         from tidb_tpu.executor.scan import align_chunk_to_schema
         from tidb_tpu.statistics import analyze_columns
+        # counts pending BEFORE the snapshot are certainly covered by it;
+        # later-arriving counts must survive the subtraction (the
+        # background worker races concurrent writers — the reference
+        # subtracts, statistics/handle/update.go)
+        with self.engine.stats_lock:
+            pending0 = dict(self.engine.modify_counts)
         snap = self._read_view_snapshot()
         for name in stmt.names:
             info = self.engine.catalog.info_schema.table(name)
             if not snap.has_table(info.id):
                 continue
+            covered = pending0.get(info.id, 0)
             parts = []
             for region, alive in snap.scan(info.id):
                 chunk = align_chunk_to_schema(region.chunk, info)
@@ -1828,7 +1886,11 @@ class Session:
                 ts.version = snap.version   # version of the analyzed data
                 self.engine.table_stats[info.id] = ts
                 self.engine.stats_version += 1
-                self.engine.modify_counts.pop(info.id, None)
+                left = self.engine.modify_counts.get(info.id, 0) - covered
+                if left > 0:
+                    self.engine.modify_counts[info.id] = left
+                else:
+                    self.engine.modify_counts.pop(info.id, None)
         return ok()
 
 

@@ -30,6 +30,22 @@ import numpy as np
 HIST_SIZE = 256          # quantile sample points per column
 TOPN_SIZE = 32           # most-common values tracked exactly
 SAMPLE_CAP = 1 << 20     # rows scanned per column before sampling kicks in
+CMS_DEPTH = 3            # count-min sketch rows (statistics/cmsketch.go:46)
+CMS_WIDTH = 1024         # counters per sketch row
+_CMS_SEEDS = ((0x9E3779B97F4A7C15, 0xC2B2AE3D27D4EB4F),
+              (0xFF51AFD7ED558CCD, 0xC4CEB9FE1A85EC53),
+              (0x87C37B91114253D5, 0x4CF5AD432745937F))
+
+
+def _cms_slots(raw) -> tuple:
+    """The sketch column for one value in each of CMS_DEPTH rows."""
+    h = hash(raw if not hasattr(raw, "item") else raw.item())
+    out = []
+    for a, b in _CMS_SEEDS:
+        x = (h * a + b) & 0xFFFFFFFFFFFFFFFF
+        x ^= x >> 33
+        out.append(x % CMS_WIDTH)
+    return tuple(out)
 
 
 @dataclass
@@ -45,6 +61,13 @@ class ColumnStats:
     topn_vals: Optional[np.ndarray] = None     # most common raw values
     topn_counts: Optional[np.ndarray] = None   # exact sample counts, scaled
     quantiles: Optional[np.ndarray] = None     # sorted sample (HIST_SIZE,)
+    # equal-depth bucket boundary repeat counts (scaled rows equal to each
+    # quantiles[i] — statistics/histogram.go:49's Repeat column)
+    bucket_repeats: Optional[np.ndarray] = None
+    # count-min sketch over the scanned sample, counts scaled to table
+    # rows (statistics/cmsketch.go:46) — point estimates for values
+    # outside TopN
+    cms: Optional[np.ndarray] = None           # (CMS_DEPTH, CMS_WIDTH)
 
     @property
     def non_null(self) -> int:
@@ -68,7 +91,26 @@ class ColumnStats:
             rest_ndv = max(self.ndv - len(self.topn_vals), 1)
             if rest_rows <= 0:
                 return 0.0   # all mass is in TopN and raw isn't there
-            return max(rest_rows / rest_ndv, 1.0) / self.total_rows
+            uniform = max(rest_rows / rest_ndv, 1.0)
+            if self.cms is not None:
+                # the sketch only OVERcounts (collision noise is bounded
+                # by tail_mass / CMS_WIDTH), so its min-row estimate is a
+                # trustworthy upper bound — this is exactly what catches
+                # hot values the TopN list missed. Floor at one row: a
+                # value the sample missed can still exist
+                est = min(int(self.cms[d][s]) for d, s in
+                          enumerate(_cms_slots(raw)))
+                return max(min(est, rest_rows), 1.0) / self.total_rows
+            if self.bucket_repeats is not None and \
+                    self.quantiles is not None and len(self.quantiles):
+                # histogram boundary Repeat column: exact-ish count when
+                # the value IS a bucket boundary (histogram.go:49)
+                pos = int(np.searchsorted(self.quantiles, raw))
+                if pos < len(self.quantiles) and \
+                        self.quantiles[pos] == raw:
+                    rep = float(self.bucket_repeats[pos])
+                    return max(min(rep, rest_rows), 1.0) / self.total_rows
+            return uniform / self.total_rows
         return 1.0 / max(self.ndv, 1) * (self.non_null / self.total_rows)
 
     def range_selectivity(self, lo=None, hi=None, lo_incl=True,
@@ -140,13 +182,32 @@ def build_column_stats(vals: np.ndarray, valid: np.ndarray,
         quantiles = srt[pick]
     else:
         quantiles = srt
+    # bucket-boundary repeats: rows equal to each quantile value (the
+    # histogram Repeat column; exact over the sample, scaled)
+    lo_pos = np.searchsorted(srt, quantiles, side="left")
+    hi_pos = np.searchsorted(srt, quantiles, side="right")
+    bucket_repeats = ((hi_pos - lo_pos)
+                      * (len(nn) / len(sample))).astype(np.int64)
+    # count-min sketch over the sample (scaled): point estimates for the
+    # long tail TopN misses. Skipped at very high NDV — the tail is
+    # near-uniform there and the per-value build loop would dominate
+    # ANALYZE (the reference also caps sketch build work)
+    if d_sample <= 100_000:
+        cms = np.zeros((CMS_DEPTH, CMS_WIDTH), dtype=np.int64)
+        cnt_scaled = (counts * count_scale).astype(np.int64)
+        for u, c in zip(uniq, cnt_scaled):
+            for d, s in enumerate(_cms_slots(u)):
+                cms[d][s] += int(c)
+    else:
+        cms = None
     kind = getattr(vals.dtype, "kind", "O")
     as_scalar = (lambda v: v) if kind == "O" else \
         (lambda v: v.item() if hasattr(v, "item") else v)
     return ColumnStats(
         total_rows=total_rows, null_count=null_scaled, ndv=max(ndv, 1),
         min_val=as_scalar(srt[0]), max_val=as_scalar(srt[-1]),
-        topn_vals=topn_vals, topn_counts=topn_counts, quantiles=quantiles)
+        topn_vals=topn_vals, topn_counts=topn_counts, quantiles=quantiles,
+        bucket_repeats=bucket_repeats, cms=cms)
 
 
 def analyze_columns(columns: List[Tuple[np.ndarray, np.ndarray]],
