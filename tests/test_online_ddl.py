@@ -1,0 +1,120 @@
+"""Online unique-index build: write_only → public state walk (ref:
+ddl/index.go:519-527, ddl/ddl_worker.go:493). A writer racing CREATE
+UNIQUE INDEX must either be rejected by the write-time check (the index
+is write-only from the start of the build) or abort — never slip a
+duplicate under a published unique index."""
+
+import threading
+import time
+
+import pytest
+
+from tidb_tpu.errors import DuplicateKeyError, DDLError
+from tidb_tpu.session import Engine
+from tidb_tpu.util import failpoint
+
+
+@pytest.fixture()
+def eng():
+    return Engine()
+
+
+def test_concurrent_writer_cannot_slip_a_duplicate(eng):
+    s = eng.new_session()
+    s.execute("CREATE TABLE ou (k BIGINT, v BIGINT)")
+    s.execute("INSERT INTO ou VALUES " + ",".join(
+        f"({i},{i})" for i in range(5000)))
+
+    writer_err = []
+    started = threading.Event()
+
+    def racing_writer():
+        w = eng.new_session()
+        started.wait(5)
+        try:
+            # k=7 already exists: under the write-only index this must
+            # raise ER 1062 even though the index is not public yet
+            w.execute("INSERT INTO ou VALUES (7, 999)")
+        except Exception as e:  # noqa: BLE001
+            writer_err.append(e)
+
+    t = threading.Thread(target=racing_writer)
+    t.start()
+
+    fired = []
+
+    def pause_mid_backfill():
+        if not fired:
+            fired.append(1)
+            started.set()
+            time.sleep(0.4)      # writer races while validation runs
+
+    failpoint.enable("index-backfill", hook=pause_mid_backfill)
+    try:
+        s.vars["tidb_ddl_reorg_batch_size"] = 512
+        s.execute("CREATE UNIQUE INDEX uk ON ou (k)")
+    finally:
+        failpoint.disable("index-backfill")
+        t.join(10)
+
+    # invariant: the index is public AND no duplicate exists
+    info = eng.catalog.info_schema.table("ou")
+    ix = next(i for i in info.indexes if i.name == "uk")
+    assert ix.state == "public"
+    assert len(writer_err) == 1 and \
+        isinstance(writer_err[0], DuplicateKeyError)
+    assert s.query("SELECT COUNT(*) FROM ou WHERE k = 7").rows == [(1,)]
+    # post-build writes keep enforcing
+    with pytest.raises(DuplicateKeyError):
+        s.execute("INSERT INTO ou VALUES (7, 1000)")
+
+
+def test_write_only_index_invisible_to_readers(eng):
+    s = eng.new_session()
+    s.execute("CREATE TABLE wo (k BIGINT, v BIGINT, INDEX pub (v))")
+    s.execute("INSERT INTO wo VALUES " + ",".join(
+        f"({i},{i % 100})" for i in range(20000)))
+    s.execute("ANALYZE TABLE wo")
+    from tidb_tpu.catalog import IndexInfo
+    eng.catalog.add_index("wo", IndexInfo("hidden", ("k",), True,
+                                          state="write_only"))
+    plan = "\n".join(str(r) for r in s.query(
+        "EXPLAIN SELECT * FROM wo WHERE k = 5").rows)
+    assert "hidden" not in plan          # readers must not use it
+    # but the write path enforces it
+    with pytest.raises(DuplicateKeyError):
+        s.execute("INSERT INTO wo VALUES (5, 1)")
+
+
+def test_failed_backfill_leaves_no_index(eng):
+    s = eng.new_session()
+    s.execute("CREATE TABLE fb (k BIGINT)")
+    s.execute("INSERT INTO fb VALUES (1), (2), (2)")
+    with pytest.raises(DuplicateKeyError):
+        s.execute("CREATE UNIQUE INDEX uk ON fb (k)")
+    info = eng.catalog.info_schema.table("fb")
+    assert not any(i.name == "uk" for i in info.indexes)
+    s.execute("INSERT INTO fb VALUES (1)")    # no phantom enforcement
+
+
+def test_autocommit_writer_schema_lease(eng):
+    """Review r5 #1: an AUTOCOMMIT statement that captured its TableInfo
+    before the index published must abort at commit (the schema lease
+    covers autocommit too), never slip an unchecked duplicate."""
+    import numpy as np
+    s = eng.new_session()
+    s.execute("CREATE TABLE al (k BIGINT)")
+    s.execute("INSERT INTO al VALUES (1), (2), (3)")
+    w = eng.new_session()
+    txn, auto = w._write_txn()
+    assert auto
+    # stage a duplicate the pre-publication way (no index seen)
+    from tidb_tpu.chunk import Chunk
+    info = eng.catalog.info_schema.table("al")
+    txn.append(info.id, Chunk.from_rows(info.field_types, [(2,)]))
+    # DDL lands while the statement is "in flight"
+    s.execute("CREATE UNIQUE INDEX uk ON al (k)")
+    from tidb_tpu.errors import TxnError
+    with pytest.raises(TxnError, match="schema is changed"):
+        w._commit_auto(txn)
+    assert s.query("SELECT COUNT(*) FROM al WHERE k = 2").rows == [(1,)]

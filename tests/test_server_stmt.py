@@ -430,3 +430,49 @@ def test_drop_user(setup):
     s.execute("DROP USER carol")
     with pytest.raises(PermissionError):
         StmtClient(srv.port, "carol", "x")
+
+
+def _lenenc_str(b, i):
+    ln = b[i]
+    i += 1
+    return b[i:i + ln].decode(), i + ln
+
+
+def test_prepare_reports_result_metadata(setup):
+    """COM_STMT_PREPARE sends true column count + definitions (ref:
+    server/conn_stmt.go writePrepare) — strict binary clients read the
+    result shape before EXECUTE (round-4 advisor weak #5)."""
+    eng, srv = setup
+    c = StmtClient(srv.port)
+    c.seq = 0
+    c.write_packet(b"\x16" + b"SELECT a, b AS label FROM ps WHERE a > ?")
+    resp = c.read_packet()
+    assert resp[0] == 0x00
+    stmt_id, n_cols, n_params = struct.unpack("<IHH", resp[1:9])
+    assert n_cols == 2 and n_params == 1
+    for _ in range(n_params):
+        c.read_packet()
+    assert c.read_packet()[0] == 0xFE
+    names = []
+    for _ in range(n_cols):
+        pkt = c.read_packet()
+        i = 0
+        for _field in range(4):            # catalog, schema, table, org_t
+            _, i = _lenenc_str(pkt, i)
+        nm, i = _lenenc_str(pkt, i)
+        names.append(nm)
+    assert c.read_packet()[0] == 0xFE
+    assert names == ["a", "label"]
+    # the statement still executes fine afterwards
+    r = c.execute(stmt_id, [1])
+    assert len(r["rows"]) == 2
+    # DML prepares report 0 columns
+    c.seq = 0
+    c.write_packet(b"\x16" + b"INSERT INTO ps (a) VALUES (?)")
+    resp = c.read_packet()
+    _, n_cols2, n_params2 = struct.unpack("<IHH", resp[1:9])
+    assert n_cols2 == 0 and n_params2 == 1
+    for _ in range(n_params2):
+        c.read_packet()
+    assert c.read_packet()[0] == 0xFE
+    c.close()

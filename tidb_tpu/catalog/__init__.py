@@ -36,11 +36,16 @@ class ColumnInfo:
 
 @dataclass(frozen=True)
 class IndexInfo:
-    """Ref: parser/model/model.go IndexInfo."""
+    """Ref: parser/model/model.go IndexInfo. `state` follows the F1
+    online-schema-change ladder collapsed to the two states this
+    engine's lazy sorted-view indexes need: "write_only" (DML enforces
+    and maintains the key; readers must not use it — its uniqueness is
+    not yet validated) and "public" (ddl/index.go:519's state walk)."""
 
     name: str
     columns: Tuple[str, ...]
     unique: bool = False
+    state: str = "public"          # write_only | public
 
 
 @dataclass(frozen=True)
@@ -244,6 +249,25 @@ class Catalog:
             tables = dict(self._snapshot._tables)
             tables[key] = new
             self._bump(tables, f"alter table {table} partitions")
+            return new
+
+    def set_index_state(self, table: str, index_name: str,
+                        state: str) -> TableInfo:
+        """One step of the online-DDL state walk (ddl/ddl_worker.go:493
+        schema-version bump per transition)."""
+        with self._lock:
+            key = table.lower()
+            info = self._snapshot._tables.get(key)
+            if info is None:
+                raise UnknownTableError(f"Unknown table '{table}'")
+            idxs = tuple(replace(ix, state=state)
+                         if ix.name.lower() == index_name.lower() else ix
+                         for ix in info.indexes)
+            new = replace(info, indexes=idxs)
+            tables = dict(self._snapshot._tables)
+            tables[key] = new
+            self._bump(tables,
+                       f"alter index {index_name} on {table} -> {state}")
             return new
 
     def add_index(self, table: str, index: IndexInfo) -> TableInfo:

@@ -45,7 +45,7 @@ def unique_backfill(session, info, cols: List[str], name: str,
     idxs = [col_of[c.lower()] for c in cols]
     snap = session._read_view_snapshot()
     if not snap.has_table(info.id):
-        return
+        return None
     batch = int(session.vars.get("tidb_ddl_reorg_batch_size",
                                  DEFAULT_REORG_BATCH))
     ck = None
@@ -115,3 +115,6 @@ def unique_backfill(session, info, cols: List[str], name: str,
             raise DuplicateKeyError(
                 f"Duplicate entry {a!r} for key '{name}'")
     cleanup()
+    # the TableData identity this pass validated — the caller loops
+    # until it matches the live table (online-DDL quiescence check)
+    return snap.table_data(info.id)

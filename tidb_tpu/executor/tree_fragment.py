@@ -435,7 +435,10 @@ def _build_unique_hint(node: PhysHashJoin) -> bool:
                     return True
                 for ix in getattr(table, "indexes", []):
                     if ix.unique and len(ix.columns) == 1 and \
-                            ix.columns[0].lower() == name:
+                            ix.columns[0].lower() == name and \
+                            getattr(ix, "state", "public") == "public":
+                        # write-only uniqueness is not yet VALIDATED —
+                        # the PK-FK bet may only trust public indexes
                         return True
     probe = node.children[1 - bi]
     return node.est_rows <= probe.est_rows * 1.05 + 16

@@ -657,7 +657,8 @@ def _indexed_col(table, col_idx: int):
     if table.primary_key and table.primary_key[0].lower() == name:
         return "PRIMARY"
     for ix in getattr(table, "indexes", []):
-        if ix.columns[0].lower() == name:
+        if ix.columns[0].lower() == name and \
+                getattr(ix, "state", "public") == "public":
             return ix.name
     return None
 
@@ -740,7 +741,8 @@ def _try_index_join(join: LogicalJoin, left: PhysicalPlan,
         idx_name = "PRIMARY"
     else:
         for ix in getattr(table, "indexes", []):
-            if ix.columns[0].lower() == col_name:
+            if ix.columns[0].lower() == col_name and \
+                    getattr(ix, "state", "public") == "public":
                 idx_name = ix.name
                 break
     if idx_name is None:
@@ -885,6 +887,8 @@ def _index_candidates(table) -> List:
         out.append((table.primary_key[0], "PRIMARY",
                     len(table.primary_key) == 1))
     for ix in table.indexes:
+        if getattr(ix, "state", "public") != "public":
+            continue               # write-only: invisible to readers
         out.append((ix.columns[0], ix.name,
                     ix.unique and len(ix.columns) == 1))
     return out

@@ -568,23 +568,40 @@ class _Conn:
 
     # -- prepared statements (ref: server/conn_stmt.go) ----------------------
     def _stmt_prepare(self, sql: str) -> None:
-        """KNOWN LIMITATION: the prepare response reports 0 result
-        columns and types every parameter as VARCHAR — the statement is
-        not planned until EXECUTE, so prepare-time column definitions are
-        unavailable. Standard connectors (mysql-connector, PyMySQL, JDBC)
-        read metadata from the EXECUTE response and work; strict clients
-        that require prepare-time resultset metadata will not."""
+        """COM_STMT_PREPARE with REAL result-set metadata (ref:
+        server/conn_stmt.go writePrepare): the statement is planned once
+        at prepare time with parameters bound to NULL, so strict binary-
+        protocol clients get true column count and definitions up front.
+        Parameters still type as VARCHAR (the reference also defers
+        param inference to EXECUTE for most types). Statements that only
+        plan with concrete values fall back to 0 columns."""
         self._next_stmt_id += 1
         st = PreparedStmt(self._next_stmt_id, sql)
         self.stmts[st.stmt_id] = st
-        # response: [OK, stmt_id, n_cols(unknown→0), n_params, 0, warnings]
-        self.write_packet(b"\x00" + struct.pack("<IHH", st.stmt_id, 0,
-                                                st.n_params)
+        names, ftypes = [], []
+        try:
+            from tidb_tpu.parser import ast as _ast
+            from tidb_tpu.parser import parse as _parse
+            probe = substitute_placeholders(sql, [None] * st.n_params)
+            stmt = _parse(probe)[0]
+            if isinstance(stmt, (_ast.SelectStmt, _ast.SetOpStmt)):
+                plan = self.session._plan(stmt)
+                names = [c.name for c in plan.schema.columns]
+                ftypes = list(plan.schema.field_types)
+        except Exception:  # noqa: BLE001 — metadata is best-effort
+            names, ftypes = [], []
+        # response: [OK, stmt_id, n_cols, n_params, 0, warnings]
+        self.write_packet(b"\x00" + struct.pack("<IHH", st.stmt_id,
+                                                len(names), st.n_params)
                           + b"\x00" + struct.pack("<H", 0))
         if st.n_params:
             from tidb_tpu import types as T
             for p in range(st.n_params):
                 self.write_packet(self._coldef(f"?{p}", T.varchar()))
+            self.write_eof()
+        if names:
+            for nm, ft in zip(names, ftypes):
+                self.write_packet(self._coldef(nm, ft))
             self.write_eof()
 
     def _stmt_execute(self, data: bytes) -> None:

@@ -277,10 +277,13 @@ class Engine:
         self._analyze_event.set()
 
     def close(self) -> None:
-        """Stop the background analyzer (tests/embedders; GC also ends
-        it via the worker's weakref)."""
+        """Stop the background analyzer and WAIT for an in-flight pass —
+        close() is a barrier (GC also ends the worker via its weakref)."""
         self._analyze_stop = True
         self._analyze_event.set()
+        t = self._analyze_thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=10.0)
 
     def _auto_analyze_pass(self) -> None:
         """One trigger sweep: any table whose modified-row count since
@@ -475,10 +478,29 @@ class Session:
 
     def _write_txn(self) -> Tuple[Transaction, bool]:
         """→ (txn, autocommit): DML inside BEGIN uses the session txn;
-        otherwise a single-statement txn committed at the end."""
+        otherwise a single-statement txn committed at the end. The txn
+        remembers the schema version its statement planned against —
+        _commit_auto enforces the schema lease at commit."""
         if self.txn is not None:
             return self.txn, False
-        return self.engine.store.begin(), True
+        txn = self.engine.store.begin()
+        txn.schema_version0 = self.engine.catalog.user_version
+        return txn, True
+
+    def _commit_auto(self, txn: Transaction) -> None:
+        """Autocommit with the SAME schema-lease check explicit txns get
+        at COMMIT: a statement that captured its TableInfo before a
+        concurrent DDL (e.g. a unique index going write-only) must abort
+        rather than commit rows that skipped the new constraint
+        (domain/schema_validator.go — the lease covers autocommit too)."""
+        if getattr(txn, "schema_version0", None) is not None and \
+                self.engine.catalog.user_version != txn.schema_version0 \
+                and txn.has_staged_writes():
+            txn.rollback()
+            raise TxnError(
+                "Information schema is changed during the execution of "
+                "the statement; please retry")
+        txn.commit()
 
     _DDL_STMTS = (ast.CreateTable, ast.DropTable, ast.TruncateTable,
                   ast.AlterTable, ast.CreateIndex, ast.DropIndex)
@@ -604,17 +626,49 @@ class Session:
         if isinstance(stmt, ast.CreateIndex):
             from tidb_tpu.catalog import IndexInfo as _IdxInfo
             info = self.engine.catalog.info_schema.table(stmt.table)
-            if stmt.unique:
-                # chunked, checkpoint-resumable validation scan
-                # (ddl/reorg.go:193; tidb_tpu/ddl.py)
+            if not stmt.unique:
+                self.engine.catalog.add_index(
+                    stmt.table, _IdxInfo(stmt.name, tuple(stmt.columns)))
+                return ok()
+            # online unique-index build, the F1 state walk collapsed to
+            # write_only → public (ddl/index.go:519-527):
+            # 1. publish WRITE-ONLY first — from here every concurrent
+            #    writer enforces the key (readers still ignore it);
+            #    racing explicit txns abort at commit via the schema
+            #    lease check
+            self.engine.catalog.add_index(
+                stmt.table, _IdxInfo(stmt.name, tuple(stmt.columns),
+                                     True, state="write_only"))
+            try:
+                # 2. chunked, checkpoint-resumable validation of the
+                #    COMMITTED data (ddl/reorg.go:193; tidb_tpu/ddl.py),
+                #    re-run until the table is quiescent: a straggler
+                #    statement that began before publication may commit
+                #    unchecked rows after our snapshot — new data means
+                #    another (checkpoint-incremental) pass
                 from tidb_tpu.ddl import unique_backfill
                 ckpt_dir = str(self.vars.get(
                     "tidb_ddl_reorg_checkpoint_dir", "") or "") or None
-                unique_backfill(self, info, list(stmt.columns),
-                                stmt.name, ckpt_dir)
-            self.engine.catalog.add_index(
-                stmt.table, _IdxInfo(stmt.name, tuple(stmt.columns),
-                                     stmt.unique))
+                for _attempt in range(5):
+                    seen_td = unique_backfill(self, info,
+                                              list(stmt.columns),
+                                              stmt.name, ckpt_dir)
+                    snap_now = self.engine.store.snapshot()
+                    now_td = snap_now.table_data(info.id) \
+                        if snap_now.has_table(info.id) else None
+                    if seen_td is now_td:
+                        break
+                else:
+                    raise DDLError(
+                        "Cancelled DDL job: table kept changing during "
+                        "unique validation", code=8214)
+            except BaseException:
+                self.engine.catalog.drop_index(stmt.table, stmt.name)
+                raise
+            # 3. flip public: readers may now use it, and the PK-FK
+            #    uniqueness bet may trust it
+            self.engine.catalog.set_index_state(stmt.table, stmt.name,
+                                                "public")
             return ok()
         if isinstance(stmt, ast.DropIndex):
             self.engine.catalog.drop_index(stmt.table, stmt.name)
@@ -1118,7 +1172,7 @@ class Session:
                                          replace=stmt.replace)
             self._append_routed(txn, info, chunk)
             if auto:
-                txn.commit()
+                self._commit_auto(txn)
         except TiDBTPUError:
             if auto:
                 txn.rollback()
@@ -1397,7 +1451,7 @@ class Session:
             if staged_keep:
                 txn.delete_staged(info.id, np.concatenate(staged_keep))
             if auto:
-                txn.commit()
+                self._commit_auto(txn)
             self._note_modified(txn, auto, info.id, n)
             return ok(n)
         except TiDBTPUError:
@@ -1452,7 +1506,7 @@ class Session:
                 txn.delete_staged(info.id, np.concatenate(staged_keep))
             self._append_routed(txn, info, new_chunk)
             if auto:
-                txn.commit()
+                self._commit_auto(txn)
             self._note_modified(txn, auto, info.id, new_chunk.num_rows)
             return ok(new_chunk.num_rows)
         except TiDBTPUError:

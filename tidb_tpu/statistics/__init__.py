@@ -195,7 +195,14 @@ def build_column_stats(vals: np.ndarray, valid: np.ndarray,
     if d_sample <= 100_000:
         cms = np.zeros((CMS_DEPTH, CMS_WIDTH), dtype=np.int64)
         cnt_scaled = (counts * count_scale).astype(np.int64)
-        for u, c in zip(uniq, cnt_scaled):
+        # heavy hitters are answered EXACTLY by topn_counts — keeping
+        # them out of the sketch removes the entire hot-mass collision
+        # source, so tail estimates really are bounded by
+        # tail_mass / CMS_WIDTH (cmsketch.go separates TopN the same way)
+        in_topn = np.isin(uniq, topn_vals)
+        for u, c, hot in zip(uniq, cnt_scaled, in_topn):
+            if hot:
+                continue
             for d, s in enumerate(_cms_slots(u)):
                 cms[d][s] += int(c)
     else:
