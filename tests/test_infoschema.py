@@ -92,3 +92,23 @@ def test_statements_endpoint():
         assert hit and any(r["count"] >= 3 for r in hit), data
     finally:
         srv.stop()
+
+
+def test_information_schema_partitions_and_views():
+    from tidb_tpu.session import Engine
+    s = Engine().new_session()
+    s.execute("CREATE TABLE pt (id BIGINT, v BIGINT) "
+              "PARTITION BY RANGE (id) ("
+              "PARTITION p0 VALUES LESS THAN (10), "
+              "PARTITION p1 VALUES LESS THAN (MAXVALUE))")
+    s.execute("INSERT INTO pt VALUES (1, 1), (2, 2), (50, 3)")
+    rows = s.query("SELECT PARTITION_NAME, PARTITION_METHOD, "
+                   "PARTITION_DESCRIPTION, TABLE_ROWS FROM "
+                   "information_schema.partitions WHERE TABLE_NAME = 'pt' "
+                   "ORDER BY PARTITION_ORDINAL_POSITION").rows
+    assert rows == [("p0", "RANGE", "10", 2),
+                    ("p1", "RANGE", "MAXVALUE", 1)]
+    s.execute("CREATE VIEW vv AS SELECT id FROM pt WHERE v > 1")
+    rows = s.query("SELECT TABLE_NAME, VIEW_DEFINITION FROM "
+                   "information_schema.views").rows
+    assert rows == [("vv", "SELECT id FROM pt WHERE v > 1")]

@@ -144,3 +144,49 @@ def _engines(session):
     return [("tidb_tpu_cpu", "YES", "vectorized numpy volcano"),
             ("tidb_tpu_device", "DEFAULT" if backend == "tpu" else "YES",
              f"fused XLA fragments ({backend})")]
+
+
+@register("partitions", [("TABLE_NAME", T.varchar()),
+                         ("PARTITION_NAME", T.varchar()),
+                         ("PARTITION_ORDINAL_POSITION", T.bigint()),
+                         ("PARTITION_METHOD", T.varchar()),
+                         ("PARTITION_EXPRESSION", T.varchar()),
+                         ("PARTITION_DESCRIPTION", T.varchar()),
+                         ("TABLE_ROWS", T.bigint())])
+def _partitions(session):
+    """Ref: infoschema/tables.go tablePartitionsCols — one row per
+    partition with live row counts from its region set."""
+    rows = []
+    snap = session.engine.store.snapshot()
+    for t in _user_tables(session):
+        p = getattr(t, "partition", None)
+        if p is None:
+            rows.append((t.name, None, None, None, None, None,
+                         snap.table_data(t.id).live_rows
+                         if snap.has_table(t.id) else 0))
+            continue
+        counts = {k: 0 for k in range(p.n_parts)}
+        if snap.has_table(t.id):
+            for r, alive in snap.scan(t.id):
+                if r.part is not None:
+                    counts[r.part] = counts.get(r.part, 0) + \
+                        int(alive.sum())
+        for i, name in enumerate(p.names):
+            if p.kind == "range":
+                b = p.bounds[i]
+                desc = "MAXVALUE" if b is None else str(b)
+            else:
+                desc = None
+            rows.append((t.name, name, i + 1, p.kind.upper(), p.column,
+                         desc, counts.get(i, 0)))
+    return rows
+
+
+@register("views", [("TABLE_NAME", T.varchar()),
+                    ("VIEW_DEFINITION", T.varchar()),
+                    ("IS_UPDATABLE", T.varchar()),
+                    ("SECURITY_TYPE", T.varchar())])
+def _views(session):
+    """Ref: infoschema/tables.go viewsCols."""
+    return [(v.name, v.sql, "NO", "DEFINER")
+            for v in session.engine.catalog.info_schema.list_views()]
