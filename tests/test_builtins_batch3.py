@@ -193,3 +193,36 @@ def test_review_r5_builtin_findings(s):
     assert q1(s, """JSON_SET('{}', '$.a.b', 1)""") == "{}"
     assert q1(s, """JSON_SET('{"a": null}', '$.a.b', 1)""") == \
         '{"a": null}'
+
+
+def test_json_aggregates(s):
+    s.execute("CREATE TABLE ja (g BIGINT, k VARCHAR(8), v BIGINT)")
+    s.execute("INSERT INTO ja VALUES (1,'a',10),(1,'b',20),(2,'c',NULL),"
+              "(2,'a',40)")
+    rows = s.query("SELECT g, JSON_ARRAYAGG(v) FROM ja GROUP BY g "
+                   "ORDER BY g").rows
+    assert rows[0][1] == "[10, 20]"
+    assert rows[1][1] == "[null, 40]"     # SQL NULL → JSON null
+    rows = s.query("SELECT g, JSON_OBJECTAGG(k, v) FROM ja GROUP BY g "
+                   "ORDER BY g").rows
+    import json
+    assert json.loads(rows[0][1]) == {"a": 10, "b": 20}
+    assert json.loads(rows[1][1]) == {"c": None, "a": 40}
+    # duplicate keys keep the LAST value
+    s.execute("INSERT INTO ja VALUES (1,'a',99)")
+    rows = s.query("SELECT JSON_OBJECTAGG(k, v) FROM ja WHERE g = 1").rows
+    assert json.loads(rows[0][0])["a"] == 99
+
+
+def test_json_aggregates_edge_semantics(s):
+    # empty input → NULL (not "[]"/"{}")
+    s.execute("CREATE TABLE je (g BIGINT, d DATE, v BIGINT)")
+    assert s.query("SELECT JSON_ARRAYAGG(v), JSON_OBJECTAGG(g, v) "
+                   "FROM je").rows == [(None, None)]
+    # non-string keys decode through their type; nested JSON stays JSON
+    s.execute("INSERT INTO je VALUES (1, '2026-07-30', 5)")
+    import json
+    r = s.query("SELECT JSON_OBJECTAGG(d, v), "
+                "JSON_ARRAYAGG(JSON_OBJECT('a', v)) FROM je").rows[0]
+    assert json.loads(r[0]) == {"2026-07-30": 5}
+    assert json.loads(r[1]) == [{"a": 5}]

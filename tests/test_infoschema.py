@@ -112,3 +112,14 @@ def test_information_schema_partitions_and_views():
     rows = s.query("SELECT TABLE_NAME, VIEW_DEFINITION FROM "
                    "information_schema.views").rows
     assert rows == [("vv", "SELECT id FROM pt WHERE v > 1")]
+
+
+def test_show_index():
+    from tidb_tpu.session import Engine
+    s = Engine().new_session()
+    s.execute("CREATE TABLE si (a BIGINT PRIMARY KEY, b BIGINT, "
+              "UNIQUE KEY ub (b))")
+    rows = s.query("SHOW INDEX FROM si").rows
+    assert ("si", 0, "PRIMARY", 1, "a", "BTREE", "public") in rows
+    assert ("si", 0, "ub", 1, "b", "BTREE", "public") in rows
+    assert s.query("SHOW KEYS FROM si").rows == rows

@@ -231,8 +231,8 @@ def test_show_processlist_and_indexes():
     s.execute("CREATE TABLE pi (a BIGINT, PRIMARY KEY (a))")
     s.execute("CREATE INDEX ia ON pi (a)")
     rows = s.query("SHOW INDEXES FROM pi").rows
-    assert ("pi", "PRIMARY", "a", "YES") in rows
-    assert ("pi", "ia", "a", "NO") in rows
+    assert ("pi", 0, "PRIMARY", 1, "a", "BTREE", "public") in rows
+    assert ("pi", 1, "ia", 1, "a", "BTREE", "public") in rows
     assert s.query("SHOW PROCESSLIST").rows is not None
 
 

@@ -27,7 +27,8 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from tidb_tpu import types as T
-from tidb_tpu.errors import TypeError_, UnknownColumnError
+from tidb_tpu.errors import (ExecutionError, TypeError_,
+                             UnknownColumnError)
 from tidb_tpu.types import FieldType, TypeKind
 
 # ---------------------------------------------------------------------------
@@ -2485,6 +2486,8 @@ def infer_type(op: str, args: Sequence[Expression]) -> FieldType:
         return T.varchar(nullable=True)
     if op in _BATCH3_JSON_FNS:
         return T.json_type(True)
+    if op == "json_kv_pair":
+        return T.json_type(True)    # internal pair transport
     if op == "rand":
         return T.double(False)
     if op == "any_value":
@@ -3943,3 +3946,30 @@ def _roles_graphml(func, ctx):
            'xmlns="http://graphml.graphdrawing.org/xmlns"><graph '
            'id="roles" edgedefault="directed"/></graphml>')
     return np.array([xml] * n, dtype=object), np.ones(n, dtype=bool)
+
+
+@kernel("json_kv_pair")
+def _json_kv_pair(func, ctx):
+    """Internal: (key, value) → one object tuple per row, feeding
+    JSON_OBJECTAGG through the single-arg aggregate pipeline. A NULL key
+    is an error (MySQL ER 3158); a NULL value rides as JSON null."""
+    from tidb_tpu.expression.aggfuncs import _json_value
+    kv, km = func.args[0].eval(ctx)
+    vv, vm = func.args[1].eval(ctx)
+    n = ctx.num_rows
+    kv = np.asarray(kv)
+    km = np.asarray(km, dtype=bool)
+    vv = np.asarray(vv)
+    vm = np.asarray(vm, dtype=bool)
+    if not km.all():
+        raise ExecutionError(
+            "JSON documents may not contain NULL member names")
+    out = np.empty(n, dtype=object)
+    kft, vft = func.args[0].ftype, func.args[1].ftype
+    for i in range(n):
+        val = _json_value(vv[i], vft) if vm[i] else None
+        # keys decode through their FieldType (dates/decimals/enums must
+        # not leak their internal encodings), then stringify like MySQL
+        k = _json_value(kv[i], kft)
+        out[i] = (str(k), val)
+    return out, km

@@ -69,6 +69,8 @@ def infer_agg_type(name: str, args: Sequence[Expression],
         return T.double(True)
     if name == "group_concat":
         return T.varchar(nullable=True)
+    if name in ("json_arrayagg", "json_objectagg"):
+        return T.json_type(True)
     if name in ("bit_and", "bit_or", "bit_xor"):
         return T.bigint(False)
     raise PlanError(f"unsupported aggregate function: {name}")
@@ -599,8 +601,8 @@ def _display(raw, ftype: FieldType) -> str:
 def build_agg(desc: AggDesc) -> AggFunc:
     n = desc.name
     if len(desc.args) > 1:
-        # only COUNT(DISTINCT a, b, ...) takes multiple args (MySQL);
-        # the executor dedupes over the arg tuple, NULL in any arg excluded
+        # only COUNT(DISTINCT a, b, ...) takes multiple args (MySQL) —
+        # JSON_OBJECTAGG's pair collapses in the builder
         if not (n == "count" and desc.distinct):
             raise PlanError(
                 f"{n}() with {len(desc.args)} arguments is not supported")
@@ -616,6 +618,10 @@ def build_agg(desc: AggDesc) -> AggFunc:
         return MinMaxAgg(desc, is_min=False)
     if n == "first_row":
         return FirstRowAgg(desc)
+    if n == "json_arrayagg":
+        return JsonArrayAgg(desc)
+    if n == "json_objectagg":
+        return JsonObjectAgg(desc)
     if n in ("var_pop", "variance"):
         return VarianceAgg(desc, sample=False, stddev=False)
     if n == "var_samp":
@@ -633,4 +639,97 @@ def build_agg(desc: AggDesc) -> AggFunc:
 
 AGG_NAMES = {"count", "sum", "avg", "min", "max", "first_row", "var_pop",
              "variance", "var_samp", "std", "stddev", "stddev_pop",
-             "stddev_samp", "group_concat", "bit_and", "bit_or", "bit_xor"}
+             "stddev_samp", "group_concat", "bit_and", "bit_or", "bit_xor",
+             "json_arrayagg", "json_objectagg"}
+
+
+class JsonArrayAgg(AggFunc):
+    """JSON_ARRAYAGG (ref: executor/aggfuncs/func_json_arrayagg.go) —
+    host-only object state; SQL NULL aggregates as JSON null."""
+
+    device_capable = False
+
+    def init(self, xp, n):
+        return ([[] for _ in range(n)],)
+
+    def update(self, xp, state, gid, n, values, validity):
+        (parts,) = state
+        ft = self.desc.args[0].ftype
+        for g, v, ok in zip(np.asarray(gid), values,
+                            np.asarray(validity)):
+            g = int(g)
+            if g >= n:
+                continue          # dead row (out-of-range gid)
+            parts[g].append(_json_value(v, ft) if ok else None)
+        return (parts,)
+
+    def merge(self, xp, state, gid, n, partial):
+        (parts,) = state
+        (pparts,) = partial
+        for g, lst in zip(np.asarray(gid), pparts):
+            if int(g) < n:
+                parts[int(g)].extend(lst)
+        return (parts,)
+
+    def final(self, xp, state):
+        import json
+        (parts,) = state
+        vals = np.array([json.dumps(p, separators=(", ", ": "))
+                         for p in parts], dtype=object)
+        # zero aggregated rows → SQL NULL (MySQL), not "[]"
+        return vals, np.array([bool(p) for p in parts], dtype=bool)
+
+
+class JsonObjectAgg(AggFunc):
+    """JSON_OBJECTAGG over json_kv_pair tuples (func_json_objectagg.go);
+    duplicate keys keep the LAST value (MySQL)."""
+
+    device_capable = False
+
+    def init(self, xp, n):
+        return ([dict() for _ in range(n)],)
+
+    def update(self, xp, state, gid, n, values, validity):
+        (objs,) = state
+        for g, v, ok in zip(np.asarray(gid), values,
+                            np.asarray(validity)):
+            g = int(g)
+            if g >= n or not ok:
+                continue
+            k, val = v
+            objs[g][k] = val
+        return (objs,)
+
+    def merge(self, xp, state, gid, n, partial):
+        (objs,) = state
+        (pobjs,) = partial
+        for g, d in zip(np.asarray(gid), pobjs):
+            if int(g) < n:
+                objs[int(g)].update(d)
+        return (objs,)
+
+    def final(self, xp, state):
+        import json
+        (objs,) = state
+        vals = np.array([json.dumps(o, separators=(", ", ": "))
+                         for o in objs], dtype=object)
+        return vals, np.array([bool(o) for o in objs], dtype=bool)
+
+
+def _json_value(raw, ftype: FieldType):
+    """Decoded SQL value → JSON-serializable value. JSON-typed inputs
+    parse back to structures (nesting must not double-encode)."""
+    from tidb_tpu.types import TypeKind
+    if ftype.kind is TypeKind.JSON:
+        import json
+        try:
+            return json.loads(str(raw))
+        except ValueError:
+            return str(raw)
+    v = ftype.decode_value(raw)
+    if v is None or isinstance(v, (int, float, str, bool)):
+        return v
+    from decimal import Decimal
+    if isinstance(v, Decimal):
+        return float(v)
+    return str(v)

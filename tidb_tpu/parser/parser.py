@@ -981,6 +981,12 @@ class Parser:
         if self.try_kw("columns"):
             self.expect_kw("from")
             return ast.ShowStmt("columns", target=self.ident())
+        if self.at_kw("index", "key") or (
+                self.cur.kind == "ident"
+                and str(self.cur.value).lower() in ("indexes", "keys")):
+            self.advance()
+            self.expect_kw("from")
+            return ast.ShowStmt("index", target=self.ident())
         if self.try_kw("create"):
             if self._word("view"):
                 return ast.ShowStmt("create_view", target=self.ident())
@@ -1008,10 +1014,6 @@ class Parser:
             if word == "charset":
                 self.advance()
                 return ast.ShowStmt("charset")
-            if word == "indexes" or word == "index" or word == "keys":
-                self.advance()
-                self.expect_kw("from")
-                return ast.ShowStmt("indexes", target=self.ident())
         raise ParseError(f"unsupported SHOW near {self._near()}")
 
     # ---- expressions -----------------------------------------------------

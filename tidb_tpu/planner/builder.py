@@ -624,6 +624,13 @@ class AggContext:
             args: List[Expression] = []
         else:
             args = [self.child_rewriter.rewrite(a) for a in node.args]
+        if name == "json_objectagg":
+            # (key, value) collapse into ONE pair-producing expression so
+            # the whole single-arg agg pipeline (partials, spill, merge)
+            # serves the two-arg aggregate unchanged
+            if len(args) != 2:
+                raise PlanError("JSON_OBJECTAGG needs (key, value)")
+            args = [func("json_kv_pair", *args)]
         key = f"{name}|{node.distinct}|{[repr(a) for a in args]}"
         if key in self.agg_keys:
             return self._slot(self.agg_keys[key])

@@ -1605,6 +1605,23 @@ class Session:
                     for c in t.columns]
             return ResultSet(["Field", "Type", "Null", "Key", "Default"],
                              [T.varchar()] * 5, rows)
+        if stmt.kind == "index":
+            t = info_schema.table(stmt.target)
+            rows = []
+            if t.primary_key:
+                for seq, c in enumerate(t.primary_key, 1):
+                    rows.append((t.name, 0, "PRIMARY", seq, c, "BTREE",
+                                 "public"))
+            for ix in t.indexes:
+                for seq, c in enumerate(ix.columns, 1):
+                    rows.append((t.name, 0 if ix.unique else 1, ix.name,
+                                 seq, c, "BTREE",
+                                 getattr(ix, "state", "public")))
+            return ResultSet(
+                ["Table", "Non_unique", "Key_name", "Seq_in_index",
+                 "Column_name", "Index_type", "State"],
+                [T.varchar(), T.bigint(), T.varchar(), T.bigint(),
+                 T.varchar(), T.varchar(), T.varchar()], rows)
         if stmt.kind == "variables":
             rows = sorted((k, str(v)) for k, v in self.vars.items())
             return ResultSet(["Variable_name", "Value"],
@@ -1623,15 +1640,6 @@ class Session:
             return ResultSet(["Table", "Create Table"],
                              [T.varchar(), T.varchar()],
                              [(t.name, create_table_sql(t))])
-        if stmt.kind == "indexes":
-            t = info_schema.table(stmt.target)
-            rows = [(t.name, ix.name, ",".join(ix.columns),
-                     "YES" if ix.unique else "NO") for ix in t.indexes]
-            if t.primary_key:
-                rows.insert(0, (t.name, "PRIMARY",
-                                ",".join(t.primary_key), "YES"))
-            return ResultSet(["Table", "Key_name", "Columns", "Unique"],
-                             [T.varchar()] * 4, rows)
         from tidb_tpu.util.observability import REGISTRY
         if stmt.kind == "metrics":
             return ResultSet(["Metric", "Labels", "Value"],

@@ -104,7 +104,14 @@ def chunk_bytes(chunk: Chunk) -> int:
 def array_bytes(*arrays) -> int:
     total = 0
     for a in arrays:
-        a = np.asarray(a)
+        try:
+            a = np.asarray(a)
+        except ValueError:
+            # ragged python-object states (GROUP_CONCAT / JSON_*AGG
+            # lists): estimate by element count, not a rectangular shape
+            total += sum(8 + 8 * len(x) if hasattr(x, "__len__") else 16
+                         for x in a)
+            continue
         total += a.size * 8 if a.dtype == object else a.nbytes
     return total
 
