@@ -226,3 +226,29 @@ def test_json_aggregates_edge_semantics(s):
                 "JSON_ARRAYAGG(JSON_OBJECT('a', v)) FROM je").rows[0]
     assert json.loads(r[0]) == {"2026-07-30": 5}
     assert json.loads(r[1]) == [{"a": 5}]
+
+
+def test_json_aggregates_spill_and_decimal_exactness(s):
+    from tidb_tpu.errors import PlanError
+    s.execute("CREATE TABLE js (g BIGINT, k VARCHAR(8), "
+              "w DECIMAL(25,2))")
+    s.execute("INSERT INTO js VALUES " + ",".join(
+        f"({i % 50},'k{i}',{10**18 + i}.25)" for i in range(3000)))
+    # quota engages spill: list-state aggregates must survive it
+    s.vars["tidb_mem_quota_query"] = 20000
+    try:
+        rows = s.query("SELECT g, JSON_ARRAYAGG(k) FROM js GROUP BY g "
+                       "ORDER BY g").rows
+    finally:
+        s.vars["tidb_mem_quota_query"] = 0
+    assert len(rows) == 50 and rows[0][1].count("k") == 60
+    # DECIMAL values stay exact in JSON output
+    r = s.query("SELECT JSON_ARRAYAGG(w) FROM js WHERE g = 0 AND "
+                "k = 'k0'").rows[0][0]
+    assert r == "[1000000000000000000.25]", r
+    # DISTINCT rejected like MySQL
+    import pytest as _pt
+    with _pt.raises(PlanError):
+        s.query("SELECT JSON_ARRAYAGG(DISTINCT g) FROM js")
+    with _pt.raises(PlanError):
+        s.query("SELECT JSON_OBJECTAGG(DISTINCT k, g) FROM js")

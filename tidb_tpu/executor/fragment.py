@@ -53,6 +53,17 @@ from tidb_tpu.types import FieldType
 
 DEFAULT_MAX_SLAB_ROWS = 1 << 23   # 8M rows per device slab
 DEFAULT_GROUP_CAP = 1 << 16
+# group caps at or below this ride the flag fetch (padded keys/states are
+# a few MB) — the result then needs NO second ~80ms tunnel round trip
+SMALL_GROUP_CAP = 1 << 14
+
+
+def _piggyback_agg(fetch: dict, out, group_cap: int) -> bool:
+    if group_cap <= SMALL_GROUP_CAP:
+        fetch["keys"] = out["keys"]
+        fetch["states"] = out["states"]
+        return True
+    return False
 
 
 class FragmentFallback(Exception):
@@ -1062,6 +1073,7 @@ class TpuFragmentExec:
             host = None
             if is_agg:
                 fetch["ng"] = out["n_groups"]
+                _piggyback_agg(fetch, out, gcap)
             elif isinstance(root, (PhysTopN, PhysSort)):
                 fetch["no"] = out["n_out"]
             else:
@@ -1116,7 +1128,10 @@ class TpuFragmentExec:
                 return _empty_chunk(self.schema)
             inp_dicts = {i: d for i, d in
                          enumerate(flows.get(id(root), []))}
-            return self._agg_chunk(root, out, inp_dicts, max(n_final, 1))
+            host_tree = (flags["keys"], flags["states"]) \
+                if "keys" in flags else None
+            return self._agg_chunk(root, out, inp_dicts, max(n_final, 1),
+                                   host_tree=host_tree)
         if isinstance(root, (PhysTopN, PhysSort)):
             n_out = int(flags["no"])
             dev_cols = [(v[:n_out], m[:n_out]) for v, m in out["cols"]]
@@ -1561,17 +1576,15 @@ class TpuFragmentExec:
             per_slab = jax.device_get(sliced)
             host_pairs = {ai: [ps[ai] for ps in per_slab]
                           for ai in per_slab[0]} if per_slab else {}
-        # per-slab overflow check, fetched in ONE batched round trip (the
-        # tunnel pays ~100ms latency per device_get, not per array): a slab
-        # whose distinct-group count exceeds group_cap clips gids (factorize
-        # clamps to cap-1), silently conflating groups; the merged n_groups
-        # alone can still be <= cap, so this must be caught per slab.
-        ngs = jax.device_get([p["n_groups"] for p in partials])
-        if any(int(g) > prog.group_cap for g in ngs):
-            raise _GroupCapOverflow()
+        # build the whole device graph FIRST (per-slab partials + merge —
+        # no host sync in between), then fetch every control value in ONE
+        # batched round trip: the tunnel pays ~80ms latency per
+        # device_get, not per array. Per-slab n_groups must still be
+        # checked: a slab whose distinct-group count exceeds group_cap
+        # clips gids (factorize clamps to cap-1), silently conflating
+        # groups, while the merged n_groups alone can look fine.
         if n_slabs == 1:
             out = partials[0]
-            n_final = int(ngs[0])
         else:
             key_cols = []
             for kc in range(len(root.group_exprs)):
@@ -1585,25 +1598,42 @@ class TpuFragmentExec:
                     for f in range(len(partials[0]["states"][ai]))))
             slot_live = jnp.concatenate([p["slot_live"] for p in partials])
             out = prog.merge(key_cols, states, slot_live)
-            n_final = int(out["n_groups"])
-            if n_final > prog.group_cap:
-                raise _GroupCapOverflow()
+        fetch = {"ngs": [p["n_groups"] for p in partials],
+                 "ng": out["n_groups"]}
+        small = _piggyback_agg(fetch, out, prog.group_cap)
+        got = jax.device_get(fetch)
+        if any(int(g) > prog.group_cap for g in got["ngs"]):
+            raise _GroupCapOverflow()
+        n_final = int(got["ng"])
+        if n_final > prog.group_cap:
+            raise _GroupCapOverflow()
         if root.group_exprs and n_final == 0:
             from tidb_tpu.executor import _empty_chunk
             return _empty_chunk(self.schema)
+        host_tree = (got["keys"], got["states"]) if small else None
         return self._agg_chunk(root, out, dicts, max(n_final, 1),
-                               host_pairs)
+                               host_pairs, host_tree=host_tree)
 
     def _agg_chunk(self, root: PhysHashAgg, out, dicts, n_final,
-                   distinct_pairs=None) -> Chunk:
+                   distinct_pairs=None, host_tree=None) -> Chunk:
         from tidb_tpu.ops.jax_env import jax
-        # slice ON DEVICE, fetch EVERYTHING in one device_get: transfers
-        # n_final rows per array in a single tunnel round trip
-        dev_tree = (
-            [(k[:n_final], m[:n_final]) for k, m in out["keys"]],
-            [tuple(a[:n_final] for a in st) for st in out["states"]],
-        )
-        host_keys, host_states = jax.device_get(dev_tree)
+        if host_tree is not None:
+            # keys/states already came back WITH the flag fetch (small
+            # group caps piggyback on round trip #1 — every tunnel round
+            # trip is ~80ms); slice the padding off host-side
+            hk, hs = host_tree
+            host_keys = [(np.asarray(k)[:n_final], np.asarray(m)[:n_final])
+                         for k, m in hk]
+            host_states = [tuple(np.asarray(a)[:n_final] for a in st)
+                           for st in hs]
+        else:
+            # slice ON DEVICE, fetch EVERYTHING in one device_get:
+            # transfers n_final rows per array in one tunnel round trip
+            dev_tree = (
+                [(k[:n_final], m[:n_final]) for k, m in out["keys"]],
+                [tuple(a[:n_final] for a in st) for st in out["states"]],
+            )
+            host_keys, host_states = jax.device_get(dev_tree)
         if distinct_pairs:
             # multi-slab DISTINCT: the device-merged distinct states
             # deduped only within each slab — recompute them from the

@@ -399,7 +399,15 @@ class HashAggExec(Executor):
             keymap = np.full(n_groups, -1, dtype=np.int64)
             keymap[np.nonzero(gsel)[0]] = np.arange(int(gsel.sum()))
             pk_p = [(v[gsel], m[gsel]) for v, m in pk]
-            st_p = [None if st is None else tuple(a[gsel] for a in st)
+
+            def _sel(a):
+                # ragged python-object states (GROUP_CONCAT/JSON_*AGG
+                # lists) partition by comprehension; arrays by mask
+                if isinstance(a, (list, dict)):
+                    return [x for x, keep in zip(a, gsel) if keep]
+                return a[gsel]
+
+            st_p = [None if st is None else tuple(_sel(a) for a in st)
                     for st in states]
             dr_p = []
             for bd in batch_distinct:

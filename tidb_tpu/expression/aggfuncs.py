@@ -619,8 +619,12 @@ def build_agg(desc: AggDesc) -> AggFunc:
     if n == "first_row":
         return FirstRowAgg(desc)
     if n == "json_arrayagg":
+        if desc.distinct:
+            raise PlanError("DISTINCT is not allowed in JSON_ARRAYAGG")
         return JsonArrayAgg(desc)
     if n == "json_objectagg":
+        if desc.distinct:
+            raise PlanError("DISTINCT is not allowed in JSON_OBJECTAGG")
         return JsonObjectAgg(desc)
     if n in ("var_pop", "variance"):
         return VarianceAgg(desc, sample=False, stddev=False)
@@ -672,10 +676,8 @@ class JsonArrayAgg(AggFunc):
         return (parts,)
 
     def final(self, xp, state):
-        import json
         (parts,) = state
-        vals = np.array([json.dumps(p, separators=(", ", ": "))
-                         for p in parts], dtype=object)
+        vals = np.array([_json_dump(p) for p in parts], dtype=object)
         # zero aggregated rows → SQL NULL (MySQL), not "[]"
         return vals, np.array([bool(p) for p in parts], dtype=bool)
 
@@ -709,16 +711,16 @@ class JsonObjectAgg(AggFunc):
         return (objs,)
 
     def final(self, xp, state):
-        import json
         (objs,) = state
-        vals = np.array([json.dumps(o, separators=(", ", ": "))
-                         for o in objs], dtype=object)
+        vals = np.array([_json_dump(o) for o in objs], dtype=object)
         return vals, np.array([bool(o) for o in objs], dtype=bool)
 
 
 def _json_value(raw, ftype: FieldType):
     """Decoded SQL value → JSON-serializable value. JSON-typed inputs
-    parse back to structures (nesting must not double-encode)."""
+    parse back to structures (nesting must not double-encode); DECIMALs
+    stay exact (serialized as number literals by _json_dump)."""
+    from decimal import Decimal
     from tidb_tpu.types import TypeKind
     if ftype.kind is TypeKind.JSON:
         import json
@@ -727,9 +729,23 @@ def _json_value(raw, ftype: FieldType):
         except ValueError:
             return str(raw)
     v = ftype.decode_value(raw)
-    if v is None or isinstance(v, (int, float, str, bool)):
+    if v is None or isinstance(v, (int, float, str, bool, Decimal)):
         return v
+    return str(v)
+
+
+def _json_dump(v) -> str:
+    """Exact JSON serializer: DECIMAL values emit as number literals
+    with full precision (stdlib json would round-trip them through
+    float); everything else matches json.dumps' MySQL-ish spacing."""
+    import json
     from decimal import Decimal
     if isinstance(v, Decimal):
-        return float(v)
-    return str(v)
+        return str(v)
+    if isinstance(v, dict):
+        return "{" + ", ".join(
+            json.dumps(str(k)) + ": " + _json_dump(x)
+            for k, x in v.items()) + "}"
+    if isinstance(v, list):
+        return "[" + ", ".join(_json_dump(x) for x in v) + "]"
+    return json.dumps(v)
