@@ -1076,6 +1076,13 @@ class TpuFragmentExec:
                 _piggyback_agg(fetch, out, gcap)
             elif isinstance(root, (PhysTopN, PhysSort)):
                 fetch["no"] = out["n_out"]
+                if isinstance(root, PhysTopN):
+                    # k is STATIC: slice the padded result to k+offset on
+                    # device and ride the flag fetch — no second trip
+                    k_stat = root.count + root.offset
+                    if k_stat <= SMALL_GROUP_CAP:
+                        fetch["cols"] = [(v[:k_stat], m[:k_stat])
+                                         for v, m in out["cols"]]
             else:
                 # padded cols + live + flags all come in ONE bulk fetch
                 host = jax.device_get(out)
@@ -1134,8 +1141,12 @@ class TpuFragmentExec:
                                    host_tree=host_tree)
         if isinstance(root, (PhysTopN, PhysSort)):
             n_out = int(flags["no"])
-            dev_cols = [(v[:n_out], m[:n_out]) for v, m in out["cols"]]
-            host_cols = jax.device_get(dev_cols)
+            if "cols" in flags:
+                host_cols = [(np.asarray(v)[:n_out], np.asarray(m)[:n_out])
+                             for v, m in flags["cols"]]
+            else:
+                dev_cols = [(v[:n_out], m[:n_out]) for v, m in out["cols"]]
+                host_cols = jax.device_get(dev_cols)
             cols = [_decode_col(ft, np.asarray(v), np.asarray(m),
                                 dicts_root.get(ci))
                     for ci, ((v, m), ft) in

@@ -401,9 +401,10 @@ class HashAggExec(Executor):
             pk_p = [(v[gsel], m[gsel]) for v, m in pk]
 
             def _sel(a):
-                # ragged python-object states (GROUP_CONCAT/JSON_*AGG
-                # lists) partition by comprehension; arrays by mask
-                if isinstance(a, (list, dict)):
+                # ragged python-object states (GROUP_CONCAT/JSON_*AGG:
+                # per-group LISTS) partition by comprehension; arrays by
+                # mask
+                if isinstance(a, list):
                     return [x for x, keep in zip(a, gsel) if keep]
                 return a[gsel]
 
