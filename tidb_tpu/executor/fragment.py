@@ -1076,13 +1076,14 @@ class TpuFragmentExec:
                 _piggyback_agg(fetch, out, gcap)
             elif isinstance(root, (PhysTopN, PhysSort)):
                 fetch["no"] = out["n_out"]
-                if isinstance(root, PhysTopN):
-                    # k is STATIC: slice the padded result to k+offset on
-                    # device and ride the flag fetch — no second trip
-                    k_stat = root.count + root.offset
-                    if k_stat <= SMALL_GROUP_CAP:
-                        fetch["cols"] = [(v[:k_stat], m[:k_stat])
-                                         for v, m in out["cols"]]
+                if isinstance(root, PhysTopN) and out["cols"] and \
+                        out["cols"][0][0].shape[0] <= SMALL_GROUP_CAP:
+                    # the device result is ALREADY truncated to
+                    # min(count+offset, rows) (ops/factorize.topn): when
+                    # that static shape is small it rides the flag fetch
+                    # — no second trip, even for huge LIMITs over small
+                    # inputs
+                    fetch["cols"] = list(out["cols"])
             else:
                 # padded cols + live + flags all come in ONE bulk fetch
                 host = jax.device_get(out)
