@@ -26,6 +26,10 @@ def _needs_reexec() -> bool:
 
 
 def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from tier-1")
+    config.addinivalue_line(
+        "markers", "chaos: failpoint/chaos-sweep tests")
     if not _needs_reexec():
         return
     capman = config.pluginmanager.getplugin("capturemanager")

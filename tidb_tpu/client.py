@@ -4,13 +4,20 @@ The reference ships tools (dumpling, br) that reach the cluster through
 stock MySQL drivers; no driver ships in this image, so this is the
 in-repo equivalent — handshake with mysql_native_password, COM_QUERY,
 text resultset decoding. Used by tidb_tpu.tools (dump/CSV CLIs) and
-available as a programmatic driver for the wire server."""
+available as a programmatic driver for the wire server.
+
+Resilience: with auto_reconnect (default on), a connection the server
+closed (KILL <id>, restart) is re-established with exponential backoff
+and the statement retried — but ONLY for read-only statements, where the
+retry cannot double-apply work (go-sql-driver's ErrBadConn contract:
+never auto-retry a write on an ambiguous connection death)."""
 
 from __future__ import annotations
 
 import hashlib
 import socket
 import struct
+import time
 from typing import List, Optional, Tuple
 
 
@@ -29,20 +36,56 @@ def _scramble(password: str, salt: bytes) -> bytes:
     return bytes(a ^ b for a, b in zip(sha_pw, mix))
 
 
+# statements safe to replay on a fresh connection: no server-side state
+# beyond session vars is at stake and re-running cannot double-apply
+_RETRYABLE_PREFIXES = ("select", "show", "explain", "desc", "use")
+
+
+def _is_retryable_stmt(sql: str) -> bool:
+    return sql.lstrip().lower().startswith(_RETRYABLE_PREFIXES)
+
+
 class Client:
+    RECONNECT_ATTEMPTS = 4
+
     def __init__(self, host: str = "127.0.0.1", port: int = 4000,
                  user: str = "root", password: str = "",
                  timeout: float = 30.0, ssl: bool = False,
-                 ssl_ca: str = None):
-        self.sock = socket.create_connection((host, port), timeout=timeout)
-        self.seq = 0
+                 ssl_ca: str = None, auto_reconnect: bool = True):
+        self._params = (host, port, user, password, timeout)
         self._ssl = ssl
         self._ssl_ca = ssl_ca
+        self.auto_reconnect = auto_reconnect
+        self.seq = 0
+        self.sock = None
+        self._connect()
+
+    def _connect(self) -> None:
+        host, port, user, password, timeout = self._params
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.seq = 0
         try:
             self._handshake(user, password)
         except BaseException:
-            self.sock.close()     # __init__ never returns: don't leak it
+            self.sock.close()     # caller never gets a half-open client
             raise
+
+    def _reconnect_with_backoff(self) -> None:
+        delay = 0.05
+        last = None
+        for _ in range(self.RECONNECT_ATTEMPTS):
+            try:
+                self.sock.close()
+            except Exception:  # noqa: BLE001
+                pass
+            try:
+                self._connect()
+                return
+            except (OSError, ClientError) as e:
+                last = e
+                time.sleep(delay)
+                delay *= 2
+        raise ClientError(2013, f"reconnect failed: {last}")
 
     # -- framing -------------------------------------------------------------
     def _recv(self, n: int) -> bytes:
@@ -124,6 +167,23 @@ class Client:
     def query(self, sql: str) -> Tuple[List[str], List[Tuple]]:
         """→ (column names, rows) for queries; ([], []) for OK packets.
         Every value arrives as str or None (text protocol)."""
+        try:
+            return self._query_once(sql)
+        except (OSError, ClientError) as e:
+            dead = isinstance(e, OSError) or \
+                getattr(e, "code", None) == 2013
+            if not (dead and self.auto_reconnect):
+                raise
+            self._reconnect_with_backoff()
+            if not _is_retryable_stmt(sql):
+                # fresh connection, but the statement's fate on the dead
+                # one is unknowable — surface it instead of re-applying
+                raise ClientError(
+                    2013, "connection lost; statement not retried "
+                          "(not read-only)") from e
+            return self._query_once(sql)
+
+    def _query_once(self, sql: str) -> Tuple[List[str], List[Tuple]]:
         self.seq = 0
         self._write_packet(b"\x03" + sql.encode())
         first = self._read_packet()

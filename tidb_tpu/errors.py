@@ -9,6 +9,9 @@ class TiDBTPUError(Exception):
     """Base error."""
 
     code = 1105  # ER_UNKNOWN_ERROR
+    # transient failures (injected faults, lock contention) may be retried
+    # by backoff-wrapped paths; anything else propagates immediately
+    retryable = False
 
 
 class ParseError(TiDBTPUError):
@@ -49,6 +52,33 @@ class MemoryQuotaExceeded(TiDBTPUError):
 
 class QueryKilledError(TiDBTPUError):
     code = 1317  # ER_QUERY_INTERRUPTED
+
+
+class QueryInterrupted(QueryKilledError):
+    """Cooperative KILL [QUERY] observed at a guard checkpoint (ref:
+    util/sqlkiller — the reference's atomic kill flag, polled by every
+    Next loop)."""
+
+    code = 1317  # ER_QUERY_INTERRUPTED
+
+
+class QueryTimeout(TiDBTPUError):
+    """max_execution_time deadline crossed at a guard checkpoint."""
+
+    code = 3024  # ER_QUERY_TIMEOUT
+
+
+class NoSuchThreadError(TiDBTPUError):
+    """KILL target conn id not found in the process-info registry."""
+
+    code = 1094  # ER_NO_SUCH_THREAD
+
+
+class BackoffExhausted(TiDBTPUError):
+    """Retry budget spent without success (ref: tikv/client-go
+    retry.BackOffer's errors.New("backoffer.maxSleep exceeded"))."""
+
+    code = 1105
 
 
 class DivisionByZero(TiDBTPUError):

@@ -35,26 +35,37 @@ from tidb_tpu.types import FieldType
 class ExecContext:
     """Per-statement execution context (ref: sessionctx.Context subset)."""
 
-    def __init__(self, txn=None, snapshot=None, vars: Optional[Dict] = None):
+    def __init__(self, txn=None, snapshot=None, vars: Optional[Dict] = None,
+                 guard=None):
         from tidb_tpu.util.memory import Tracker
         self.txn = txn              # storage.Transaction (reads merge staged)
         self.snapshot = snapshot    # storage.Snapshot (autocommit reads)
         self.vars = vars or {}
         self.killed = False
+        # per-statement ExecutionGuard (util/guard.py): kill flag +
+        # deadline + root tracker, polled at every checkpoint below
+        self.guard = guard
         self.runtime_stats: Dict[int, "OperatorStats"] = {}
         # per-statement quota root (ref: memory.Tracker attached to the
-        # session; tidb_mem_quota_query, 0 = unlimited)
-        quota = int(self.vars.get("tidb_mem_quota_query", 0) or 0)
-        self.mem_tracker = Tracker("query", quota)
+        # session; tidb_mem_quota_query, 0 = unlimited) — shared with the
+        # guard when one is threaded in, so OOM actions and KILL cancel
+        # through one tracker
+        if guard is not None and guard.mem_tracker is not None:
+            self.mem_tracker = guard.mem_tracker
+        else:
+            quota = int(self.vars.get("tidb_mem_quota_query", 0) or 0)
+            self.mem_tracker = Tracker("query", quota)
         self.tracer = None         # Tracer while TRACE runs (trace.go)
 
     @property
     def chunk_size(self) -> int:
         return int(self.vars.get("max_chunk_size", DEFAULT_CHUNK_SIZE))
 
-    def check_killed(self):
+    def check_killed(self, site: str = "next"):
         if self.killed:
             raise QueryKilledError("Query execution was interrupted")
+        if self.guard is not None:
+            self.guard.check(site)
 
     def scan_table(self, table_id: int, parts=None):
         """Yield (region_or_None, chunk, alive_mask) honoring txn staging.
@@ -194,6 +205,9 @@ def run_to_completion(root: Executor, ctx: ExecContext) -> List[Chunk]:
     try:
         out = []
         while True:
+            # root chunk boundary: the drain loop is itself a guard
+            # checkpoint (leaf executors have no child_next above them)
+            ctx.check_killed("root-next")
             ch = root.next()
             if ch is None:
                 return out

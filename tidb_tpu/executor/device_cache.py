@@ -197,6 +197,8 @@ def wide_decimal_unlimb(limbs: np.ndarray) -> np.ndarray:
 
 def _upload_col(ent: CachedTable, col_idx: int, ftype):
     from tidb_tpu.ops.jax_env import jnp
+    from tidb_tpu.util import failpoint
+    failpoint.inject("device-transfer")
     vals, valid = _materialize_col(ent, col_idx)
     if ftype.is_wide_decimal:
         # wide decimals upload as base-2³⁰ limb planes: (n_limbs, cap)
@@ -307,8 +309,11 @@ def _evict_to_budget(budget: int, keep, keep_aligned=frozenset(),
             break
         total -= _ALIGNED.pop(victim).hbm_bytes()
     while total > budget and len(_CACHE) > 1:
+        # keep_tables holds (store_id, table_id) pairs; cache keys carry a
+        # third partition element — match on the prefix, else partitioned
+        # entries of a protected table get evicted mid-query
         victim = next((k for k in _CACHE
-                       if k != keep and k not in keep_tables), None)
+                       if k != keep and k[:2] not in keep_tables), None)
         if victim is None:
             return
         total -= _CACHE.pop(victim).hbm_bytes()

@@ -41,7 +41,8 @@ import numpy as np
 log = logging.getLogger("tidb_tpu.fragment")
 
 from tidb_tpu.chunk import Chunk, Column
-from tidb_tpu.errors import ExecutionError
+from tidb_tpu.errors import (ExecutionError, MemoryQuotaExceeded,
+                             QueryKilledError, QueryTimeout)
 from tidb_tpu.expression import EvalContext, Expression, ColumnRef
 from tidb_tpu.expression.aggfuncs import AggFunc, build_agg
 from tidb_tpu.planner.physical import (PhysHashAgg, PhysHashJoin,
@@ -871,6 +872,9 @@ class TpuFragmentExec:
             return self._cpu_root.next()
         if self._result is None:
             strict = _var_bool(self.ctx.vars.get("tidb_tpu_strict", False))
+            # checkpoint BEFORE device dispatch: a killed/expired query
+            # must not pay for compile + upload it will never use
+            self.ctx.check_killed("device-dispatch")
             try:
                 import time as _time
 
@@ -891,6 +895,11 @@ class TpuFragmentExec:
                         f"tidb_tpu_strict: device fragment fell back: "
                         f"{self.fallback_reason}") from e
                 return self._fallback_next()
+            except (QueryKilledError, QueryTimeout, MemoryQuotaExceeded):
+                # lifecycle errors unwind past the fallback ladder: a
+                # killed/expired/over-quota query must die, not retry the
+                # same work on CPU
+                raise
             except Exception as e:  # noqa: BLE001
                 # UNEXPECTED device failure: never silent (VERDICT r1 weak #4)
                 self.fallback_reason = f"{type(e).__name__}: {e}"
@@ -899,6 +908,10 @@ class TpuFragmentExec:
                 if strict:
                     raise
                 return self._fallback_next()
+            # checkpoint AFTER host fetch, before results flow upward
+            from tidb_tpu.util import failpoint
+            failpoint.inject("host-fetch")
+            self.ctx.check_killed("host-fetch")
         if self._offset >= self._result.num_rows:
             return None
         size = self.ctx.chunk_size
@@ -979,16 +992,24 @@ class TpuFragmentExec:
 
         want_pairs = ent.n_slabs > 1 and isinstance(root, PhysHashAgg) \
             and any(d.distinct and d.args for d in root.aggs)
+        # recompile retries share the budgeted backoff scope: each overflow
+        # quadruples the cap, and the sleeps double as kill/deadline
+        # checkpoints so a doomed query never queues another compile
+        from tidb_tpu.util.backoff import Backoffer
+        bo = Backoffer("device-recompile", base_ms=1.0, max_ms=50.0,
+                       budget_ms=500.0, guard=getattr(self.ctx, "guard", None))
         while True:
             prog = get_program(chain, used, in_types, slab_cap, group_cap,
                                key_bounds, want_pairs)
             prep_vals = prog.collect_preps(dicts)
             try:
                 result = self._execute(prog, chain, ent, dicts, prep_vals)
-            except _GroupCapOverflow:
+            except _GroupCapOverflow as e:
+                failpoint.inject("device-recompile")
                 if group_cap >= slab_cap * max(n_slabs, 1):
                     raise FragmentFallback("group cap overflow")
                 group_cap = min(group_cap * 4, slab_cap * max(n_slabs, 1))
+                bo.backoff(e)
                 continue
             return result
 
@@ -1461,6 +1482,9 @@ class TpuFragmentExec:
         join_cfgs = [d_replace(c, out_cap=_shard_out_cap(c))
                      if c.mode == "expand" else c for c in join_cfgs]
         while True:
+            # each retrace round is a checkpoint: a killed query must not
+            # queue another multi-shard compile
+            self.ctx.check_killed("device-dispatch")
             prog = _get_dist_program(root, caps, gcap, mesh, bucket_caps,
                                      join_cfgs)
             prep_vals = prog.collect_preps(flow_list)
@@ -1487,6 +1511,8 @@ class TpuFragmentExec:
             needs = np.asarray(out["exchange_need"])
             for need, node in zip(needs, hash_exchanges):
                 if int(need) > bucket_caps[id(node)]:
+                    from tidb_tpu.util import failpoint
+                    failpoint.inject("exchange-overflow")
                     # resize only the overflowed exchange, to its exact
                     # reported need — one recompile, no doubling ladder
                     bucket_caps[id(node)] = _pow2(int(need), lo=64)

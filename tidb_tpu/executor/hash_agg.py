@@ -272,7 +272,9 @@ class HashAggExec(Executor):
             nonlocal distinct_rows
             if self.scalar or spill is not None:
                 return False     # single group: nothing to partition
-            spill = M.PartitionedPickleSpill(self.N_SPILL_PARTITIONS)
+            spill = M.PartitionedPickleSpill(
+                self.N_SPILL_PARTITIONS,
+                guard=getattr(self.ctx, "guard", None))
             for pk, st, dr in zip(partial_keys, partial_states,
                                   _iter_batches(distinct_rows,
                                                 len(partial_keys))):

@@ -231,7 +231,8 @@ class HashJoinExec(Executor):
             if not self.equi or state["spill"] is not None:
                 return False       # cross join cannot partition
             state["spill"] = M.PartitionedChunkSpill(
-                self.N_SPILL_PARTITIONS, build_fts)
+                self.N_SPILL_PARTITIONS, build_fts,
+                guard=getattr(self.ctx, "guard", None))
             for ch in chunks:
                 self._spill_side(state["spill"], ch, build=True)
             chunks.clear()
@@ -259,8 +260,9 @@ class HashJoinExec(Executor):
         if state["spill"] is not None:
             probe_fts = self.children[self._probe_idx].schema
             self._grace = (state["spill"],
-                           M.PartitionedChunkSpill(self.N_SPILL_PARTITIONS,
-                                                   probe_fts))
+                           M.PartitionedChunkSpill(
+                               self.N_SPILL_PARTITIONS, probe_fts,
+                               guard=getattr(self.ctx, "guard", None)))
             return
         self._build_chunk = (Chunk.concat(chunks) if len(chunks) > 1
                              else chunks[0] if chunks

@@ -17,6 +17,7 @@ from tidb_tpu.chunk import Chunk, Column
 from tidb_tpu.executor import Executor
 from tidb_tpu.expression.runner import filter_mask
 from tidb_tpu.planner.physical import PhysTableScan
+from tidb_tpu.util import failpoint
 
 
 class TableScanExec(Executor):
@@ -35,6 +36,7 @@ class TableScanExec(Executor):
     def next(self) -> Optional[Chunk]:
         while True:
             self.ctx.check_killed()
+            failpoint.inject("scan-next")
             item = next(self._iter, None)
             if item is None:
                 return None

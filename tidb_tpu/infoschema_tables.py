@@ -118,9 +118,14 @@ def _session_variables(session):
                           ("TIME", T.double()),
                           ("INFO", T.varchar())])
 def _processlist(session):
-    from tidb_tpu.util.observability import REGISTRY
-    return [(cid, session.user, secs, sql)
-            for cid, secs, sql in REGISTRY.process_rows()]
+    # same source as SHOW PROCESSLIST: every live connection (idle ones
+    # included), each with ITS OWN user — not the querying session's
+    from tidb_tpu.util.guard import PROCESS_REGISTRY
+    return sorted(
+        (cid, user or "",
+         round(guard.elapsed(), 3) if guard is not None else 0.0,
+         guard.sql if guard is not None else None)
+        for cid, user, guard, _killed in PROCESS_REGISTRY.snapshot())
 
 
 @register("table_storage_stats", [("TABLE_NAME", T.varchar()),

@@ -437,6 +437,14 @@ class BeginStmt(StmtNode):
 
 
 @dataclass
+class KillStmt(StmtNode):
+    # KILL [QUERY] <conn_id>: query_only interrupts the running statement
+    # but keeps the connection; bare KILL poisons the connection too
+    conn_id: int = 0
+    query_only: bool = False
+
+
+@dataclass
 class CommitStmt(StmtNode):
     pass
 

@@ -216,6 +216,18 @@ class Parser:
         if self.at_kw("rollback"):
             self.advance()
             return ast.RollbackStmt()
+        if self.at("ident") and str(self.cur.value).lower() == "kill":
+            # KILL [QUERY|CONNECTION] <id> — "kill" stays an ident (like
+            # BEGIN's modes) so it remains usable as a column name
+            self.advance()
+            query_only = False
+            if self.at("ident") and str(self.cur.value).lower() in (
+                    "query", "connection"):
+                query_only = str(self.advance().value).lower() == "query"
+            if not self.at("int"):
+                raise ParseError(
+                    f"expected connection id near {self._near()}")
+            return ast.KillStmt(int(self.advance().value), query_only)
         raise ParseError(f"unsupported statement near {self._near()}")
 
     def load_data(self) -> ast.StmtNode:

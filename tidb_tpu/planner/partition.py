@@ -25,12 +25,14 @@ def row_partitions(pinfo: PartitionInfo, values: np.ndarray,
     """Partition ordinal per row over the ENCODED key column.
 
     RANGE: first partition whose bound exceeds the value; a value beyond
-    the last bound raises ER 1526 (unless MAXVALUE). HASH: MOD(v, n)
-    (floored, always non-negative). NULL routes to partition 0 both ways
-    (MySQL: NULL < any range value; NULL hashes as 0)."""
+    the last bound raises ER 1526 (unless MAXVALUE). HASH: ABS(MOD(v, n))
+    with MySQL's truncated MOD — np.mod is FLOORED, which routes negative
+    keys differently than MySQL (and than prune_partitions would prune).
+    NULL routes to partition 0 both ways (MySQL: NULL < any range value;
+    NULL hashes as 0)."""
     if pinfo.kind == "hash":
         v = np.asarray(values).astype(np.int64, copy=False)
-        ords = np.mod(v, pinfo.num)
+        ords = np.abs(np.fmod(v, pinfo.num))
         return np.where(valid, ords, 0).astype(np.int64)
     # a trailing MAXVALUE partition catches EVERYTHING past the finite
     # bounds (including int64-max itself — no sentinel comparisons)
@@ -106,7 +108,8 @@ def prune_partitions(info: TableInfo, filters) -> Optional[Tuple[int, ...]]:
         for cond in filters or []:
             cc = _const_cmp(cond, p.col_offset)
             if cc and cc[0] == "eq":
-                keep &= {int(np.mod(cc[1], p.num))}
+                # must mirror row_partitions exactly: truncated MOD + abs
+                keep &= {int(np.abs(np.fmod(cc[1], p.num)))}
         return tuple(sorted(keep))
     # RANGE: narrow a [lo_val, hi_val] interval over encoded values, then
     # map to the partition ordinal interval

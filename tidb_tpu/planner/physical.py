@@ -834,6 +834,8 @@ def _try_stream_agg(agg: LogicalAggregation, child: PhysicalPlan,
     if not isinstance(child, PhysTableScan):
         return None
     key = agg.group_exprs[0]
+    if child.table.columns[key.index].ftype.is_ci:
+        return None     # raw-ordered index view ≠ collation order
     ix = _indexed_col(child.table, key.index)
     if ix is None:
         return None
@@ -866,6 +868,8 @@ def _try_index_order(sort: LogicalSort, child: PhysicalPlan,
         node = node.children[0]
     if not isinstance(node, PhysTableScan):
         return None
+    if node.table.columns[idx].ftype.is_ci:
+        return None     # raw-ordered index view ≠ collation order
     ix = _indexed_col(node.table, idx)
     if ix is None:
         return None

@@ -362,7 +362,11 @@ class _Conn:
         self.sock = sock
         self.engine = engine
         self.session = engine.new_session()
-        self.conn_id = conn_id
+        # advertise the SESSION's conn id in the handshake, so the id a
+        # client reads (CONNECTION_ID, or the greeting) is the same id
+        # SHOW PROCESSLIST prints and KILL resolves — `conn_id` from the
+        # listener is just an accept counter
+        self.conn_id = self.session.conn_id
         self.seq = 0
         self.ssl_ctx = ssl_ctx
         self.caps = SERVER_CAPS | (CLIENT_SSL if ssl_ctx else 0)
@@ -529,6 +533,12 @@ class _Conn:
             cmd, data = pkt[0], pkt[1:]
             if cmd == COM_QUIT:
                 return
+            from tidb_tpu.util.guard import PROCESS_REGISTRY
+            if PROCESS_REGISTRY.conn_killed(self.session.conn_id):
+                # killed while idle: drop the socket without answering —
+                # the client observes a dead connection (2013), exactly
+                # what stock drivers expect after killConn
+                return
             try:
                 if cmd == COM_PING:
                     self.write_ok()
@@ -565,6 +575,12 @@ class _Conn:
             except Exception as e:  # noqa: BLE001 — conn must not die
                 traceback.print_exc()
                 self.write_err(1105, f"{type(e).__name__}: {e}")
+            # bare KILL <id> poisons the registry entry; close the socket
+            # after the current command's response is on the wire (the
+            # reference's killConn — clients observe 2013 on next use)
+            from tidb_tpu.util.guard import PROCESS_REGISTRY
+            if PROCESS_REGISTRY.conn_killed(self.session.conn_id):
+                return
 
     # -- prepared statements (ref: server/conn_stmt.go) ----------------------
     def _stmt_prepare(self, sql: str) -> None:

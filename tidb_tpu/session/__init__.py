@@ -44,6 +44,10 @@ DEFAULT_VARS: Dict[str, object] = {
     "tidb_mem_quota_query": 8 << 30,
     "sql_mode": "STRICT_TRANS_TABLES",
     "autocommit": 1,
+    # statement deadline in ms, 0 = none. Deviation from MySQL (which
+    # scopes it to read-only SELECT): applies to EVERY statement — the
+    # never-hang guarantee matters more here than MySQL fidelity
+    "max_execution_time": 0,
 }
 
 
@@ -416,6 +420,13 @@ class Session:
         self._stmt_snapshot = None  # pinned read view (AS OF TIMESTAMP)
         self._for_update_snapshot = None
         self.last_insert_id = 0     # LAST_INSERT_ID() (session.go)
+        # lifecycle guardrails: the per-statement ExecutionGuard (kill
+        # flag + deadline + root tracker) published to PROCESS_REGISTRY
+        # so KILL from any other session can find it
+        self._guard = None
+        self.last_guard = None     # kept after the stmt for introspection
+        from tidb_tpu.util.guard import PROCESS_REGISTRY
+        PROCESS_REGISTRY.register(self)
 
     # ---- public API --------------------------------------------------------
     def execute(self, sql: str) -> List[ResultSet]:
@@ -424,13 +435,28 @@ class Session:
         observability hooks, session/session.go:1614)."""
         import time as _time
 
+        from tidb_tpu.errors import QueryInterrupted
         from tidb_tpu.parser import parse_with_text
+        from tidb_tpu.util.guard import PROCESS_REGISTRY, ExecutionGuard
+        from tidb_tpu.util.memory import Tracker
         from tidb_tpu.util.observability import REGISTRY
         out = []
         for s, one in parse_with_text(sql):
             kind = type(s).__name__
             self._current_sql = one
             self.last_engine = "cpu"
+            if PROCESS_REGISTRY.conn_killed(self.conn_id):
+                raise QueryInterrupted("Connection was killed")
+            # arm this statement's guard: deadline from the sysvar, root
+            # tracker from the quota — PROCESS_REGISTRY makes it killable
+            timeout_ms = int(self.vars.get("max_execution_time", 0) or 0)
+            quota = int(self.vars.get("tidb_mem_quota_query", 0) or 0)
+            guard = ExecutionGuard(self.conn_id, one[:256],
+                                   timeout_ms / 1000.0,
+                                   Tracker("query", quota))
+            self._guard = guard
+            self.last_guard = guard
+            PROCESS_REGISTRY.stmt_begin(self.conn_id, guard)
             REGISTRY.stmt_begin(self.conn_id, one[:256])
             t0 = _time.perf_counter()
             try:
@@ -444,6 +470,8 @@ class Session:
                 # never let this statement's text key a LATER direct
                 # _plan() call (plan-cache poisoning)
                 self._current_sql = None
+                self._guard = None
+                PROCESS_REGISTRY.stmt_end(self.conn_id)
             dt = _time.perf_counter() - t0
             REGISTRY.stmt_end(self.conn_id)
             REGISTRY.inc("tidb_tpu_stmt_total", {"stmt": kind})
@@ -470,11 +498,12 @@ class Session:
     def _exec_ctx(self) -> ExecContext:
         if self._stmt_snapshot is not None:
             return ExecContext(snapshot=self._stmt_snapshot,
-                               vars=self.vars)
+                               vars=self.vars, guard=self._guard)
         if self.txn is not None:
-            return ExecContext(txn=self.txn, vars=self.vars)
+            return ExecContext(txn=self.txn, vars=self.vars,
+                               guard=self._guard)
         return ExecContext(snapshot=self.engine.store.snapshot(),
-                           vars=self.vars)
+                           vars=self.vars, guard=self._guard)
 
     def _write_txn(self) -> Tuple[Transaction, bool]:
         """→ (txn, autocommit): DML inside BEGIN uses the session txn;
@@ -647,21 +676,31 @@ class Session:
                 #    unchecked rows after our snapshot — new data means
                 #    another (checkpoint-incremental) pass
                 from tidb_tpu.ddl import unique_backfill
+                from tidb_tpu.errors import BackoffExhausted
+                from tidb_tpu.util.backoff import Backoffer
                 ckpt_dir = str(self.vars.get(
                     "tidb_ddl_reorg_checkpoint_dir", "") or "") or None
-                for _attempt in range(5):
-                    seen_td = unique_backfill(self, info,
-                                              list(stmt.columns),
-                                              stmt.name, ckpt_dir)
-                    snap_now = self.engine.store.snapshot()
-                    now_td = snap_now.table_data(info.id) \
-                        if snap_now.has_table(info.id) else None
-                    if seen_td is now_td:
-                        break
-                else:
+                # quiescence retries ride the shared budgeted backoff:
+                # each non-quiescent pass waits a beat (stragglers get a
+                # chance to drain) and a hot table exhausts the budget
+                # into the same 8214 cancellation
+                bo = Backoffer("ddl-quiesce", base_ms=5.0, max_ms=100.0,
+                               budget_ms=500.0, guard=self._guard)
+                try:
+                    while True:
+                        seen_td = unique_backfill(self, info,
+                                                  list(stmt.columns),
+                                                  stmt.name, ckpt_dir)
+                        snap_now = self.engine.store.snapshot()
+                        now_td = snap_now.table_data(info.id) \
+                            if snap_now.has_table(info.id) else None
+                        if seen_td is now_td:
+                            break
+                        bo.backoff()
+                except BackoffExhausted as e:
                     raise DDLError(
                         "Cancelled DDL job: table kept changing during "
-                        "unique validation", code=8214)
+                        "unique validation", code=8214) from e
             except BaseException:
                 self.engine.catalog.drop_index(stmt.table, stmt.name)
                 raise
@@ -734,7 +773,27 @@ class Session:
             return ok()
         if isinstance(stmt, ast.AnalyzeTable):
             return self._analyze(stmt)
+        if isinstance(stmt, ast.KillStmt):
+            return self._kill(stmt)
         raise PlanError(f"unsupported statement: {type(stmt).__name__}")
+
+    def _kill(self, stmt: "ast.KillStmt") -> ResultSet:
+        """KILL [QUERY] <id> (ref: server/conn.go handleQuery → KILL,
+        executor/executor.go KillStmt): flips the target statement's
+        guard; bare KILL also poisons the connection. Non-superusers may
+        only kill their own connections (ER 1095 semantics folded into
+        the privilege layer's generic denial)."""
+        from tidb_tpu.errors import NoSuchThreadError
+        from tidb_tpu.util.guard import PROCESS_REGISTRY
+        info = PROCESS_REGISTRY.info(stmt.conn_id)
+        if info is None:
+            raise NoSuchThreadError(f"Unknown thread id: {stmt.conn_id}")
+        if not self.engine.auth.is_superuser(self.user) \
+                and info["user"] not in (None, self.user):
+            raise NoSuchThreadError(
+                f"You are not owner of thread {stmt.conn_id}")
+        PROCESS_REGISTRY.kill(stmt.conn_id, query_only=stmt.query_only)
+        return ok()
 
     # ---- SELECT ------------------------------------------------------------
     def _subquery_evaluator(self) -> SubqueryEvaluator:
@@ -1656,9 +1715,23 @@ class Session:
                 [T.varchar(), T.bigint(), T.double(), T.double(),
                  T.double(), T.bigint()], REGISTRY.summary_rows())
         if stmt.kind == "processlist":
-            return ResultSet(["Id", "Time_s", "Info"],
-                             [T.bigint(), T.double(), T.varchar()],
-                             REGISTRY.process_rows())
+            # every live connection, not only those mid-statement —
+            # otherwise KILL <id> can't target an idle session
+            from tidb_tpu.util.guard import PROCESS_REGISTRY
+            rows = []
+            for cid, user, guard, killed in PROCESS_REGISTRY.snapshot():
+                if guard is not None:
+                    rows.append((cid, user or "", "Query",
+                                 round(guard.elapsed(), 3), guard.sql))
+                else:
+                    rows.append((cid, user or "",
+                                 "Killed" if killed else "Sleep",
+                                 0.0, None))
+            rows.sort()
+            return ResultSet(
+                ["Id", "User", "Command", "Time_s", "Info"],
+                [T.bigint(), T.varchar(), T.varchar(), T.double(),
+                 T.varchar()], rows)
         raise PlanError(f"unsupported SHOW {stmt.kind}")
 
     def _alter_table(self, stmt: ast.AlterTable) -> ResultSet:

@@ -425,23 +425,40 @@ class Store:
 
     def commit(self, txn: "Transaction") -> None:
         from tidb_tpu.util import failpoint
-        failpoint.inject("store-commit")
-        with self._lock:
-            # first-committer-wins: validate EVERYTHING before applying
-            # anything, so a conflict leaves no partial writes behind
-            for tid, masks in txn.staged_deletes.items():
-                self._validate_deletes_locked(tid, masks)
-            for tid in txn.staged_inserts:
-                if tid not in self._tables:
-                    raise TxnError("write conflict: table dropped")
-            for tid, masks in txn.staged_deletes.items():
-                self._delete_locked(tid, masks)
-            for tid, items in txn.staged_inserts.items():
-                for ch, part in items:
-                    self._append_locked(tid, ch, part)
-            for tid in txn.staged_deletes:
-                self._maybe_compact_locked(tid, closing=1)
-            self._bump_locked()
+        bo = None
+        while True:
+            try:
+                failpoint.inject("store-commit")
+                failpoint.inject("commit-conflict")
+                with self._lock:
+                    # first-committer-wins: validate EVERYTHING before
+                    # applying anything, so a conflict leaves no partial
+                    # writes behind
+                    for tid, masks in txn.staged_deletes.items():
+                        self._validate_deletes_locked(tid, masks)
+                    for tid in txn.staged_inserts:
+                        if tid not in self._tables:
+                            raise TxnError("write conflict: table dropped")
+                    for tid, masks in txn.staged_deletes.items():
+                        self._delete_locked(tid, masks)
+                    for tid, items in txn.staged_inserts.items():
+                        for ch, part in items:
+                            self._append_locked(tid, ch, part)
+                    for tid in txn.staged_deletes:
+                        self._maybe_compact_locked(tid, closing=1)
+                    self._bump_locked()
+                return
+            except TxnError as e:
+                # only errors marked retryable (transient region churn,
+                # injected conflicts) re-enter; real first-committer-wins
+                # conflicts propagate immediately
+                if not getattr(e, "retryable", False):
+                    raise
+                if bo is None:
+                    from tidb_tpu.util.backoff import Backoffer
+                    bo = Backoffer("store-commit", base_ms=1.0,
+                                   max_ms=20.0, budget_ms=250.0)
+                bo.backoff(e)
 
     # ---- introspection ---------------------------------------------------
     def stats(self) -> Dict[int, Tuple[int, int]]:

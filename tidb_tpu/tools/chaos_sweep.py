@@ -1,0 +1,301 @@
+"""Chaos / failpoint sweep: fault-inject every registered site under a
+live workload and assert the lifecycle contract — every statement either
+returns the oracle answer or raises a TYPED TiDBTPUError, within a
+deadline; writes are atomic (COUNT advances exactly when the INSERT
+succeeded); the session stays usable afterwards. Never a hang, never
+silent corruption (ref: the reference's failpoint-enabled CI runs,
+pingcap/failpoint + tests/realtikvtest).
+
+Runnable three ways:
+
+    python -m tidb_tpu.tools.chaos_sweep          # CLI, nonzero on fail
+    python tools/chaos_sweep.py                   # repo-root wrapper
+    pytest -m chaos                               # via tests/test_guardrails
+
+The sweep builds its fixture CLEANLY first (faults off), records oracle
+results, then runs one scenario per fault. Each scenario is
+(site, fault, workload): read workloads re-check every query against the
+oracle; write workloads re-count the table. failpoint.counting() meters
+which sites the workload actually reached, so a refactor that silently
+moves a site out of the hot path shows up as lost coverage."""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+from tidb_tpu.errors import (ExecutionError, MemoryQuotaExceeded,
+                             TiDBTPUError, TxnError)
+from tidb_tpu.util import failpoint
+
+# every statement must finish (result or typed error) inside this
+DEADLINE_S = 30.0
+
+QUERIES = [
+    "select count(*), sum(a) from cs_facts",
+    "select b, count(*) from cs_facts group by b order by b",
+    "select d.name, count(*) from cs_facts f join cs_dim d "
+    "on f.b = d.id group by d.name order by d.name",
+    "select a from cs_facts order by a limit 5",
+    # high-cardinality group key: under a squeezed quota this one is what
+    # drives the agg's spill container (thousands of string groups)
+    "select c, count(*) from cs_facts group by c order by c limit 3",
+]
+
+
+def _retryable_txn(msg: str) -> TxnError:
+    e = TxnError(msg)
+    e.retryable = True
+    return e
+
+
+class Scenario:
+    def __init__(self, name: str, site: Optional[str], enable_kw: dict,
+                 run: str = "read", vars: Optional[Dict[str, str]] = None,
+                 extra: Optional[Dict[str, dict]] = None):
+        self.name = name
+        self.site = site
+        self.enable_kw = enable_kw
+        self.run = run               # read | write | ddl | backup
+        self.vars = vars or {}
+        self.extra = extra or {}     # additional site → enable kwargs
+
+
+def _scenarios() -> List[Scenario]:
+    return [
+        # -- CPU pipeline faults ------------------------------------------
+        Scenario("scan transient fault", "scan-next",
+                 dict(raise_=ExecutionError("chaos: scan-next"), times=1)),
+        Scenario("scan fault after warmup", "scan-next",
+                 dict(raise_=ExecutionError("chaos: scan-late"),
+                      after_hits=2, times=1)),
+        Scenario("scan flaky one-in-3", "scan-next",
+                 dict(raise_=ExecutionError("chaos: scan-flaky"),
+                      one_in=3, times=2)),
+        Scenario("tracker quota blown", "tracker-quota",
+                 dict(raise_=MemoryQuotaExceeded("chaos: quota"),
+                      after_hits=5, times=1)),
+        # -- spill path (quota squeezed so the agg engages its spill) -----
+        Scenario("spill write I/O error", "spill-write",
+                 dict(raise_=ExecutionError("chaos: spill-write"), times=1),
+                 vars={"tidb_mem_quota_query": "8000"}),
+        Scenario("spill read-back error", "spill-read",
+                 dict(raise_=ExecutionError("chaos: spill-read"), times=1),
+                 vars={"tidb_mem_quota_query": "8000"}),
+        # -- commit path ---------------------------------------------------
+        Scenario("commit hard conflict", "store-commit",
+                 dict(raise_=TxnError("chaos: conflict"), times=1),
+                 run="write"),
+        Scenario("commit transient conflict (heals)", "commit-conflict",
+                 dict(raise_=_retryable_txn("chaos: transient"), times=2),
+                 run="write"),
+        Scenario("commit retry budget exhausted", "commit-conflict",
+                 dict(raise_=_retryable_txn("chaos: hot key")),
+                 run="write",
+                 extra={"backoff-sleep": dict(value="skip")}),
+        # -- device path (engine forced on; CPU backend still JITs) -------
+        Scenario("device fragment crash → CPU fallback", "device-fragment",
+                 dict(raise_=RuntimeError("chaos: device down"), times=9),
+                 vars={"tidb_tpu_engine": "on",
+                       "tidb_tpu_row_threshold": "0"}),
+        Scenario("HBM upload failure → CPU fallback", "device-transfer",
+                 dict(raise_=RuntimeError("chaos: transfer"), times=9),
+                 vars={"tidb_tpu_engine": "on",
+                       "tidb_tpu_row_threshold": "0"}),
+        Scenario("host fetch interrupted", "host-fetch",
+                 dict(raise_=ExecutionError("chaos: host-fetch"), times=9),
+                 vars={"tidb_tpu_engine": "on",
+                       "tidb_tpu_row_threshold": "0"}),
+        # -- DDL -----------------------------------------------------------
+        Scenario("unique backfill dies mid-reorg", "index-backfill",
+                 dict(raise_=ExecutionError("chaos: backfill"), times=1),
+                 run="ddl"),
+        # -- tools ---------------------------------------------------------
+        Scenario("backup dies between tables", "backup-table",
+                 dict(raise_=TiDBTPUError("chaos: backup"), times=1),
+                 run="backup"),
+        Scenario("restore dies between tables", "restore-table",
+                 dict(raise_=TiDBTPUError("chaos: restore"), times=1),
+                 run="restore"),
+    ]
+
+
+def _run_statement(session, sql: str):
+    """→ (rows|None, error|None, elapsed). Non-TiDBTPUError escapes —
+    that IS a sweep failure."""
+    t0 = time.monotonic()
+    try:
+        rs = session.query(sql)
+        return rs.rows, None, time.monotonic() - t0
+    except TiDBTPUError as e:
+        return None, e, time.monotonic() - t0
+
+
+def run_sweep(verbose: bool = False) -> dict:
+    from tidb_tpu.session import Engine
+    failpoint.disable_all()
+    eng = Engine()
+    s = eng.new_session()
+
+    # fixture FIRST, faults off — the oracle must be trustworthy
+    s.execute("create table cs_dim (id int, name varchar(16))")
+    s.execute("create table cs_facts (a int, b int, c varchar(24))")
+    dim = ", ".join(f"({i}, 'name{i:02d}')" for i in range(8))
+    s.execute(f"insert into cs_dim values {dim}")
+    for base in range(0, 4000, 500):
+        vals = ", ".join(
+            f"({(i * 37) % 997 - 200}, {i % 8}, 'payload-{i:05d}')"
+            for i in range(base, base + 500))
+        s.execute(f"insert into cs_facts values {vals}")
+
+    # coverage meter: which sites does the clean workload even reach?
+    failpoint.reset_counters()
+    with failpoint.counting():
+        for q in QUERIES:
+            s.query(q)
+        s.execute("insert into cs_facts values (1, 1, 'probe')")
+    coverage = failpoint.counters()
+
+    # oracle recorded AFTER the probe write; re-recorded after every
+    # mutating scenario, so "correct result" always means "what a clean
+    # run over the CURRENT data returns"
+    oracle = {q: s.query(q).rows for q in QUERIES}
+    base_count = s.query("select count(*) from cs_facts").scalar()
+
+    failures: List[str] = []
+    results: List[dict] = []
+    reached = {k for k, v in coverage.items() if v > 0}
+    write_seq = 0
+
+    for sc in _scenarios():
+        saved = {k: s.vars.get(k) for k in sc.vars}
+        s.vars.update(sc.vars)
+        if sc.site is not None:
+            failpoint.enable(sc.site, **sc.enable_kw)
+        for site, kw in sc.extra.items():
+            failpoint.enable(site, **kw)
+        errors, wrong, slow = 0, 0, 0
+        try:
+            if sc.run == "read":
+                for q in QUERIES:
+                    rows, err, dt = _run_statement(s, q)
+                    if dt > DEADLINE_S:
+                        slow += 1
+                        failures.append(f"{sc.name}: {q!r} took {dt:.1f}s")
+                    if err is not None:
+                        errors += 1
+                    elif rows != oracle[q]:
+                        wrong += 1
+                        failures.append(
+                            f"{sc.name}: {q!r} SILENT WRONG RESULT")
+            elif sc.run == "write":
+                write_seq += 1
+                ins = (f"insert into cs_facts values "
+                       f"(9000, {write_seq % 8}, 'w{write_seq}')")
+                _, err, dt = _run_statement(s, ins)
+                if dt > DEADLINE_S:
+                    slow += 1
+                    failures.append(f"{sc.name}: insert took {dt:.1f}s")
+                if err is not None:
+                    errors += 1
+                else:
+                    base_count += 1
+                failpoint.disable_all()
+                now = s.query("select count(*) from cs_facts").scalar()
+                if now != base_count:
+                    wrong += 1
+                    failures.append(
+                        f"{sc.name}: NON-ATOMIC WRITE "
+                        f"(count {now} != expected {base_count})")
+            elif sc.run == "ddl":
+                _, err, dt = _run_statement(
+                    s, "create unique index cs_uk on cs_facts (c)")
+                if err is None:
+                    # injected fault didn't stop it — clean up
+                    s.execute("drop index cs_uk on cs_facts")
+                else:
+                    errors += 1
+                if dt > DEADLINE_S:
+                    slow += 1
+                    failures.append(f"{sc.name}: ddl took {dt:.1f}s")
+            elif sc.run in ("backup", "restore"):
+                import tempfile
+                with tempfile.TemporaryDirectory() as d:
+                    if sc.run == "restore":
+                        # backup runs CLEAN (only restore-table is armed):
+                        # the restore then re-applies identical data, so a
+                        # partial restore is detectable as count drift
+                        s.query(f"backup to '{d}/bk'")
+                        stmt = f"restore from '{d}/bk'"
+                    else:
+                        stmt = f"backup to '{d}/bk'"
+                    _, err, dt = _run_statement(s, stmt)
+                    if err is not None:
+                        errors += 1
+                    if dt > DEADLINE_S:
+                        slow += 1
+                        failures.append(
+                            f"{sc.name}: {sc.run} took {dt:.1f}s")
+        except BaseException as e:  # noqa: BLE001 — untyped escape = bug
+            failures.append(
+                f"{sc.name}: UNTYPED ERROR {type(e).__name__}: {e}")
+        finally:
+            # hits() survives disable (counters persist), so meter the
+            # scenario's own coverage before clearing faults
+            for site in ([sc.site] if sc.site else []) + list(sc.extra):
+                if failpoint.hits(site) > 0:
+                    reached.add(site)
+            failpoint.disable_all()
+            for k, v in saved.items():
+                if v is None:
+                    s.vars.pop(k, None)
+                else:
+                    s.vars[k] = v
+
+        # the session must still work after every scenario
+        after = s.query("select count(*) from cs_facts").scalar()
+        if after != base_count:
+            failures.append(f"{sc.name}: count drifted after scenario")
+        if sc.run != "read":
+            # mutating scenarios move the goalposts: refresh the oracle
+            oracle = {q: s.query(q).rows for q in QUERIES}
+            base_count = after
+        results.append({"scenario": sc.name, "site": sc.site,
+                        "errors": errors, "wrong": wrong, "slow": slow})
+        if verbose:
+            print(f"  {sc.name:45s} errors={errors} wrong={wrong}")
+
+    unreached = sorted(set(failpoint.catalog()) - reached)
+    report = {"scenarios": len(results), "results": results,
+              "failures": failures, "coverage": coverage,
+              "unreached": unreached}
+    eng.close()
+    return report
+
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(prog="chaos_sweep")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+    t0 = time.monotonic()
+    report = run_sweep(verbose=args.verbose)
+    dt = time.monotonic() - t0
+    print(f"chaos sweep: {report['scenarios']} scenarios in {dt:.1f}s")
+    print(f"  sites reached by clean workload: "
+          f"{sorted(k for k, v in report['coverage'].items() if v)}")
+    if report["unreached"]:
+        print(f"  unreached sites (need their own scenario/workload): "
+              f"{report['unreached']}")
+    if report["failures"]:
+        print(f"FAILURES ({len(report['failures'])}):")
+        for f in report["failures"]:
+            print(f"  - {f}")
+        return 1
+    print("OK — every fault produced a correct result or a typed error")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -1,0 +1,85 @@
+"""Shared retry backoff: exponential + jitter + budget (ref:
+tikv/client-go retry.BackOffer / util/backoff — commit retries, region
+misses and DDL reorg all share one budgeted sleeper there too).
+
+    bo = Backoffer("store-commit", budget_ms=250)
+    while True:
+        try:
+            return do_commit()
+        except TxnError as e:
+            if not e.retryable:
+                raise
+            bo.backoff(e)        # sleeps, or raises BackoffExhausted
+
+Design points:
+  * budget is CUMULATIVE planned sleep: once the next delay would cross
+    it, backoff() raises BackoffExhausted chained to the last error —
+    callers get a typed error, never an unbounded retry loop;
+  * jitter is deterministic per (name, attempt) so failures reproduce;
+  * failpoint-aware: the "backoff-sleep" site sees every sleep; a test
+    enabling it with value="skip" elides the real sleep while budget
+    accounting still advances (fast deterministic exhaustion tests);
+  * guard-aware: a killed/timed-out query stops sleeping immediately —
+    the sleep happens in short slices with a guard checkpoint between.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from typing import Optional
+
+from tidb_tpu.errors import BackoffExhausted
+from tidb_tpu.util import failpoint
+
+_SLEEP_SLICE_S = 0.025
+
+
+class Backoffer:
+    """One retry scope: exponential delays under a total sleep budget."""
+
+    def __init__(self, name: str, base_ms: float = 2.0,
+                 max_ms: float = 200.0, budget_ms: float = 2000.0,
+                 jitter: float = 0.5, guard=None):
+        self.name = name
+        self.base_ms = float(base_ms)
+        self.max_ms = float(max_ms)
+        self.budget_ms = float(budget_ms)
+        self.jitter = min(max(float(jitter), 0.0), 1.0)
+        self.guard = guard
+        self.attempts = 0
+        self.slept_ms = 0.0
+
+    def _jitter_frac(self) -> float:
+        # deterministic per (name, attempt): reruns reproduce exactly
+        h = hashlib.blake2b(f"{self.name}:{self.attempts}".encode(),
+                            digest_size=4).digest()
+        return int.from_bytes(h, "little") / 0xFFFFFFFF
+
+    def next_delay_ms(self) -> float:
+        d = min(self.base_ms * (2.0 ** self.attempts), self.max_ms)
+        return d * (1.0 - self.jitter * self._jitter_frac())
+
+    def backoff(self, err: Optional[BaseException] = None) -> None:
+        """Sleep one exponential step; raise BackoffExhausted (chained to
+        `err`) once the budget is spent."""
+        delay = self.next_delay_ms()
+        if self.slept_ms + delay > self.budget_ms:
+            raise BackoffExhausted(
+                f"{self.name}: retry budget exhausted after "
+                f"{self.attempts} attempts "
+                f"(~{self.slept_ms:.0f}ms slept)") from err
+        self.attempts += 1
+        self.slept_ms += delay
+        if failpoint.inject("backoff-sleep") == "skip":
+            if self.guard is not None:
+                self.guard.check("backoff")
+            return
+        deadline = time.monotonic() + delay / 1000.0
+        while True:
+            if self.guard is not None:
+                self.guard.check("backoff")   # killed/timed out: stop now
+            rem = deadline - time.monotonic()
+            if rem <= 0:
+                return
+            time.sleep(min(rem, _SLEEP_SLICE_S))

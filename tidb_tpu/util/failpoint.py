@@ -6,7 +6,22 @@ Usage at a site:    failpoint.inject("commit-error")
 In a test:          with failpoint.enabled("commit-error", raise_=TxnError("boom")): ...
 
 Actions: raise an exception, return a value (site decides how to use it),
-or call a hook. Zero overhead when nothing is enabled (one dict probe).
+or call a hook. Triggering modifiers (all composable):
+
+  * after_hits=N — the first N hits pass through untouched, the action
+    fires from hit N+1 on (the reference's `N*return` marker);
+  * one_in=N    — deterministic 1-in-N: fire on every Nth eligible hit
+    (counter-based, not random, so runs reproduce);
+  * times=N     — fire at most N times, then the site passes through
+    (the `N*off` marker — transient faults that heal).
+
+Every inject() call is also counted per site while any failpoint is
+enabled or a `counting()` scope is open — the chaos sweep uses those
+per-site counters to know which faults a workload actually reached.
+Zero overhead when nothing is enabled (one dict probe).
+
+The module-level catalog below names every injection site in the tree so
+tools (chaos_sweep) can enumerate them without importing the world.
 """
 
 from __future__ import annotations
@@ -17,13 +32,66 @@ from typing import Callable, Dict, Optional
 
 _lock = threading.Lock()
 _active: Dict[str, dict] = {}
+_counters: Dict[str, int] = {}       # site → inject() calls observed
+_counting = 0                        # >0: count even with nothing enabled
+
+# ---------------------------------------------------------------------------
+# Site catalog — name → where it trips (keep in sync with inject() sites)
+# ---------------------------------------------------------------------------
+_catalog: Dict[str, str] = {}
+
+
+def register(name: str, desc: str = "") -> None:
+    """Declare an injection site so sweep tools can enumerate it."""
+    _catalog.setdefault(name, desc)
+
+
+def catalog() -> Dict[str, str]:
+    """Registered site name → description (a copy)."""
+    return dict(_catalog)
+
+
+for _site, _desc in (
+    ("device-fragment", "entry of the jitted device-fragment pipeline "
+                        "(executor/fragment.py _run_device)"),
+    ("device-recompile", "group-cap overflow recompile retry "
+                         "(executor/fragment.py)"),
+    ("device-transfer", "HBM column upload (executor/device_cache.py "
+                        "_upload_col)"),
+    ("host-fetch", "device→host result fetch after a fragment runs "
+                   "(executor/fragment.py next)"),
+    ("exchange-overflow", "distributed exchange bucket resize/retrace "
+                          "(executor/fragment.py _run_device_dist)"),
+    ("scan-next", "per-chunk boundary of the CPU table scan "
+                  "(executor/scan.py next)"),
+    ("spill-write", "spill container write (util/memory.py add)"),
+    ("spill-read", "spill container read-back (util/memory.py read)"),
+    ("tracker-quota", "memory tracker consume/quota check "
+                      "(util/memory.py Tracker.consume)"),
+    ("store-commit", "storage commit entry (storage/__init__.py)"),
+    ("commit-conflict", "transient commit conflict before apply "
+                        "(storage/__init__.py — retryable errors hit the "
+                        "backoff loop)"),
+    ("index-backfill", "between DDL unique-backfill batches (ddl.py)"),
+    ("backup-table", "between tables during BACKUP (tools)"),
+    ("restore-table", "between tables during RESTORE (tools)"),
+    ("backoff-sleep", "inside Backoffer.backoff — value 'skip' elides "
+                      "the real sleep (util/backoff.py)"),
+):
+    register(_site, _desc)
 
 
 def enable(name: str, *, raise_: Optional[BaseException] = None,
-           value=None, hook: Optional[Callable] = None) -> None:
+           value=None, hook: Optional[Callable] = None,
+           after_hits: int = 0, one_in: int = 1,
+           times: Optional[int] = None) -> None:
+    register(name)
     with _lock:
+        _counters.pop(name, None)    # fresh scope: stale counts mislead
         _active[name] = {"raise": raise_, "value": value, "hook": hook,
-                         "hits": 0}
+                         "hits": 0, "after_hits": int(after_hits),
+                         "one_in": max(int(one_in), 1), "times": times,
+                         "fired": 0}
 
 
 def disable(name: str) -> None:
@@ -31,22 +99,53 @@ def disable(name: str) -> None:
         _active.pop(name, None)
 
 
+def disable_all() -> None:
+    with _lock:
+        _active.clear()
+
+
 def hits(name: str) -> int:
+    """inject() calls observed at `name` — while the site was enabled, or
+    inside a counting() scope."""
     with _lock:
         ent = _active.get(name)
-        return ent["hits"] if ent else 0
+        if ent is not None:
+            return ent["hits"]
+        return _counters.get(name, 0)
+
+
+def counters() -> Dict[str, int]:
+    """Per-site observed inject() counts (a copy)."""
+    with _lock:
+        return dict(_counters)
+
+
+def reset_counters() -> None:
+    with _lock:
+        _counters.clear()
 
 
 def inject(name: str):
     """Trip the failpoint if enabled: runs the hook, raises, or returns
-    the configured value (None when disabled)."""
-    if not _active:              # fast path: nothing enabled anywhere
+    the configured value (None when disabled or suppressed by a
+    modifier)."""
+    if not _active and not _counting:    # fast path: nothing anywhere
         return None
     with _lock:
+        if _counting or name in _active:
+            _counters[name] = _counters.get(name, 0) + 1
         ent = _active.get(name)
         if ent is None:
             return None
         ent["hits"] += 1
+        h = ent["hits"]
+        if h <= ent["after_hits"]:
+            return None
+        if (h - ent["after_hits"] - 1) % ent["one_in"] != 0:
+            return None
+        if ent["times"] is not None and ent["fired"] >= ent["times"]:
+            return None
+        ent["fired"] += 1
         exc = ent["raise"]
         hook = ent["hook"]
         value = ent["value"]
@@ -64,3 +163,17 @@ def enabled(name: str, **kwargs):
         yield
     finally:
         disable(name)
+
+
+@contextlib.contextmanager
+def counting():
+    """Count inject() calls at EVERY site (not only enabled ones) for the
+    duration — the chaos sweep's coverage meter."""
+    global _counting
+    with _lock:
+        _counting += 1
+    try:
+        yield
+    finally:
+        with _lock:
+            _counting -= 1

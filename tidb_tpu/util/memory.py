@@ -26,6 +26,7 @@ import numpy as np
 from tidb_tpu.chunk import Chunk
 from tidb_tpu.chunk.codec import decode_chunk, encode_chunk
 from tidb_tpu.errors import MemoryQuotaExceeded
+from tidb_tpu.util import failpoint
 
 
 class Tracker:
@@ -42,6 +43,10 @@ class Tracker:
         # operators push a handler; on quota excess handlers run LIFO
         # until one returns True (memory shed/diverted), else fatal
         self.handlers: List[Callable[[], bool]] = []
+        # set on the ROOT by ExecutionGuard: every consume doubles as a
+        # kill/deadline checkpoint (memory-heavy loops stay killable
+        # between chunk boundaries)
+        self.guard = None
 
     def add_handler(self, fn: Callable[[], bool]) -> None:
         self._root().handlers.append(fn)
@@ -58,8 +63,9 @@ class Tracker:
         return t
 
     def consume(self, n: int) -> None:
+        failpoint.inject("tracker-quota")
         t = self
-        while t is not None:
+        while True:
             t.consumed += n
             t.peak = max(t.peak, t.consumed)
             if t.quota and t.consumed > t.quota:
@@ -72,7 +78,11 @@ class Tracker:
                     raise MemoryQuotaExceeded(
                         f"Out Of Memory Quota! quota={t.quota} "
                         f"consumed={t.consumed} tracker={t.label}")
+            if t.parent is None:
+                break
             t = t.parent
+        if t.guard is not None:
+            t.guard.check("mem")
 
     def release(self, n: int) -> None:
         t = self
@@ -146,9 +156,10 @@ class PartitionedChunkSpill:
     """N temp files of length-prefixed wire-codec chunks
     (ListInDisk / RowContainer.SpillToDisk analog)."""
 
-    def __init__(self, n_partitions: int, ftypes):
+    def __init__(self, n_partitions: int, ftypes, guard=None):
         self.n = n_partitions
         self.ftypes = list(ftypes)
+        self.guard = guard
         self._files = [tempfile.TemporaryFile(prefix="tidbtpu-spill-")
                        for _ in range(n_partitions)]
         self.rows = [0] * n_partitions
@@ -157,6 +168,9 @@ class PartitionedChunkSpill:
     def add(self, p: int, chunk: Chunk) -> None:
         if chunk.num_rows == 0:
             return
+        failpoint.inject("spill-write")
+        if self.guard is not None:
+            self.guard.check("spill")
         buf = encode_chunk(chunk)
         f = self._files[p]
         f.write(struct.pack("<Q", len(buf)))
@@ -170,9 +184,12 @@ class PartitionedChunkSpill:
             self.add(int(p), chunk.take(sel))
 
     def read(self, p: int) -> Iterator[Chunk]:
+        failpoint.inject("spill-read")
         f = self._files[p]
         f.seek(0)
         while True:
+            if self.guard is not None:
+                self.guard.check("spill")
             header = f.read(8)
             if len(header) < 8:
                 break
@@ -188,22 +205,29 @@ class PartitionedChunkSpill:
 class PartitionedPickleSpill:
     """N temp files of pickled records (partial agg states)."""
 
-    def __init__(self, n_partitions: int):
+    def __init__(self, n_partitions: int, guard=None):
         self.n = n_partitions
+        self.guard = guard
         self._files = [tempfile.TemporaryFile(prefix="tidbtpu-aggspill-")
                        for _ in range(n_partitions)]
         self.bytes_written = 0
 
     def add(self, p: int, record) -> None:
+        failpoint.inject("spill-write")
+        if self.guard is not None:
+            self.guard.check("spill")
         f = self._files[p]
         before = f.tell()
         pickle.dump(record, f, protocol=pickle.HIGHEST_PROTOCOL)
         self.bytes_written += f.tell() - before
 
     def read(self, p: int) -> Iterator:
+        failpoint.inject("spill-read")
         f = self._files[p]
         f.seek(0)
         while True:
+            if self.guard is not None:
+                self.guard.check("spill")
             try:
                 yield pickle.load(f)
             except EOFError:

@@ -1,0 +1,17 @@
+#!/usr/bin/env python
+"""Repo-root wrapper for the chaos/failpoint sweep.
+
+    python tools/chaos_sweep.py [-v]
+
+See tidb_tpu/tools/chaos_sweep.py for the scenario list and contract."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from tidb_tpu.tools.chaos_sweep import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
