@@ -133,6 +133,49 @@ def test_timeout_zero_means_no_deadline(session):
     assert session.last_guard.deadline is None
 
 
+def test_timeout_scoped_to_read_only_select(session):
+    """MySQL semantics: max_execution_time arms ONLY read-only SELECTs.
+    A write slower than the deadline must run to completion (aborting a
+    half-applied mutation on a timer would corrupt), and SELECT ... FOR
+    UPDATE locks so it is exempt too — only explicit KILL stops those."""
+    s = session
+    s.vars["max_execution_time"] = 40          # ms
+    before = s.query("SELECT COUNT(*) FROM gt").scalar()
+    with failpoint.enabled("store-commit",
+                           hook=lambda: time.sleep(0.08)):
+        s.execute("INSERT INTO gt VALUES (100001, 1, 'slowwrite')")
+    assert s.last_guard.deadline is None       # write ran unarmed
+    assert s.query("SELECT COUNT(*) FROM gt").scalar() == before + 1
+    s.execute("DELETE FROM gt WHERE a = 100001")
+    # FOR UPDATE: exempt even though it reads
+    s.query("SELECT a FROM gt WHERE a < 3 FOR UPDATE")
+    assert s.last_guard.deadline is None
+    # the same sysvar still times out a plain SELECT
+    with failpoint.enabled("scan-next", hook=lambda: time.sleep(0.03)):
+        with pytest.raises(QueryTimeout):
+            s.query("SELECT COUNT(*), SUM(a) FROM gt")
+
+
+def test_processlist_exposes_escalations(session):
+    """information_schema.processlist grows an ESCALATIONS column fed by
+    the running statement's guard (util/escalation.py EscalationStats) —
+    a squeezed group cap makes the device fragment recompile, and the
+    summary shows up on the SAME statement's guard."""
+    s = session
+    s.vars.update(tidb_tpu_engine="on", tidb_tpu_row_threshold=1,
+                  tidb_tpu_group_cap=64)
+    # a + 0 is an expression key: no cached bounds, no NDV pre-sizing —
+    # 6000 distinct values overflow cap 64 → exact-need ladder recompile
+    s.query("SELECT a + 0, COUNT(*) FROM gt GROUP BY a + 0")
+    esc = s.last_guard.escalation
+    assert esc.recompiles >= 1 and esc.exact_resizes >= 1, esc.summary()
+    assert "group:exact" in esc.summary()
+    # the column exists and is well-formed for every live connection
+    rows = s.query("SELECT ID, ESCALATIONS FROM "
+                   "information_schema.processlist").rows
+    assert any(str(r[0]) == str(s.conn_id) for r in rows), rows
+
+
 # ---- lifecycle errors vs the device fallback ladder ------------------------
 
 def test_kill_not_swallowed_by_cpu_fallback(session):
@@ -290,3 +333,18 @@ def test_chaos_sweep_contract():
     # sweep is faulting dead code
     covered = {k for k, v in report["coverage"].items() if v > 0}
     assert {"scan-next", "store-commit", "tracker-quota"} <= covered
+    # the coverage GATE: without a mesh only the mesh-only sites may stay
+    # cold — everything else must have a working scenario
+    assert not report["gated_unreached"], report["gated_unreached"]
+
+
+@pytest.mark.chaos
+def test_chaos_sweep_mesh_contract(eight_devices):
+    # the distributed scenarios only: skewed-exchange escalation and
+    # shard-step fault recovery over a 4-device mesh (the tests already
+    # run under the forced 8-device host platform, so no re-exec needed)
+    from tidb_tpu.tools.chaos_sweep import run_sweep
+    report = run_sweep(mesh=4, mesh_only=True)
+    assert not report["failures"], report["failures"]
+    assert report["scenarios"] >= 3
+    assert not report["gated_unreached"], report["gated_unreached"]

@@ -1,0 +1,198 @@
+"""Mesh-aware chaos: adversarial key distributions through the
+distributed exchange/factorize/agg-join path, the capacity-escalation
+ladder (exact-need resize, typed CapacityError on exhaustion), and
+shard-fault recovery — all on the forced multi-device CPU mesh
+(conftest.py pins XLA_FLAGS=--xla_force_host_platform_device_count=8).
+
+The float payloads are integer-valued on purpose: double-precision sums
+of integers are exact under any reduction order, so the distributed
+result must equal the numpy oracle BYTE-exactly — a dropped row or a
+conflated group cannot hide inside float tolerance."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.errors import CapacityError, ShardFailure
+from tidb_tpu.parallel import make_mesh
+from tidb_tpu.parallel import collective as C
+from tidb_tpu.parallel.dist_query import (reference_agg_join, run_agg_join)
+from tidb_tpu.util import failpoint
+
+
+@pytest.fixture(scope="module")
+def mesh4(eight_devices):
+    return make_mesh(4)
+
+
+def _oracle(pk, px, pq, bk, bg, bw, limit):
+    sums, counts = reference_agg_join(pk, px, pq, bk, bg, bw, limit)
+    return {g: (float(sums[g]), int(counts[g])) for g in sums}
+
+
+def _build(b, n_groups=5):
+    bk = np.arange(b, dtype=np.int64)
+    bg = (bk % n_groups).astype(np.int64)
+    bw = np.ones(b, dtype=np.float64)        # integer-valued: exact sums
+    return bk, bg, bw
+
+
+# ---- adversarial distributions through the escalation ladder ---------------
+
+def test_all_rows_one_shard_exact_need_one_recompile(mesh4):
+    # EVERY probe row carries the same key: the hash exchange funnels the
+    # whole table into one destination bucket. The step reports the exact
+    # need, so recovery is ONE exact-need recompile — and the result is
+    # byte-equal to the oracle (overflow is never silent row loss).
+    N, B = 512, 64
+    rng = np.random.default_rng(7)
+    pk = np.full(N, 13, dtype=np.int64)
+    px = rng.integers(0, 100, N).astype(np.float64)
+    pq = np.zeros(N)                         # filter keeps everything
+    bk, bg, bw = _build(B)
+    out, stats = run_agg_join(mesh4, pk, px, pq, bk, bg, bw,
+                              bucket_cap=16, group_cap=64,
+                              filter_limit=0.5)
+    assert out == _oracle(pk, px, pq, bk, bg, bw, 0.5)
+    assert stats.by_kind.get("exchange:exact") == 1
+    assert stats.recompiles == 1             # exactly one re-execution
+
+
+def test_dense_group_explosion_exact_need(mesh4):
+    # distinct group count blows past group_cap: factorize still reports
+    # the TRUE count, so the ladder resizes the group slots to exact need
+    # in one recompile, not a doubling ladder
+    N, B = 1024, 256
+    rng = np.random.default_rng(11)
+    pk = rng.integers(0, B, N).astype(np.int64)
+    px = rng.integers(0, 50, N).astype(np.float64)
+    pq = rng.uniform(0, 1, N)
+    bk = np.arange(B, dtype=np.int64)
+    bg = bk.copy()                           # every build row its own group
+    bw = np.ones(B, dtype=np.float64)
+    out, stats = run_agg_join(mesh4, pk, px, pq, bk, bg, bw,
+                              bucket_cap=1024, group_cap=16,
+                              filter_limit=0.7)
+    assert out == _oracle(pk, px, pq, bk, bg, bw, 0.7)
+    assert stats.by_kind.get("group:exact", 0) >= 1
+    assert stats.recompiles == 1
+
+
+def test_null_heavy_keys_exact(mesh4):
+    # 70% of probe rows are NULL-keyed (dead in the live mask): they must
+    # neither travel through the exchange nor leak into any group
+    N, B = 1024, 128
+    rng = np.random.default_rng(23)
+    pk = rng.integers(0, B, N).astype(np.int64)
+    px = rng.integers(0, 30, N).astype(np.float64)
+    pq = rng.uniform(0, 1, N)
+    live = rng.random(N) >= 0.7
+    bk, bg, bw = _build(B)
+    out, stats = run_agg_join(mesh4, pk, px, pq, bk, bg, bw,
+                              bucket_cap=512, group_cap=64,
+                              filter_limit=0.6, p_live=live)
+    assert out == _oracle(pk[live], px[live], pq[live], bk, bg, bw, 0.6)
+    assert stats.total == 0                  # capacities held: no retry
+
+
+def test_skew_and_group_explosion_combined(mesh4):
+    # both rungs in one statement: a skewed exchange AND a group blowout —
+    # each overflowed structure costs exactly one exact-need recompile
+    N, B = 768, 192
+    rng = np.random.default_rng(31)
+    pk = np.where(rng.random(N) < 0.9, 5, rng.integers(0, B, N)) \
+        .astype(np.int64)
+    px = rng.integers(0, 20, N).astype(np.float64)
+    pq = np.zeros(N)
+    bk = np.arange(B, dtype=np.int64)
+    bg = bk.copy()
+    bw = np.ones(B, dtype=np.float64)
+    out, stats = run_agg_join(mesh4, pk, px, pq, bk, bg, bw,
+                              bucket_cap=32, group_cap=16,
+                              filter_limit=0.5)
+    assert out == _oracle(pk, px, pq, bk, bg, bw, 0.5)
+    assert stats.by_kind.get("exchange:exact") == 1
+    assert stats.by_kind.get("group:exact") == 1
+    assert stats.recompiles <= 2
+
+
+# ---- typed errors: the ladder never returns truncated rows ----------------
+
+def test_ladder_exhaustion_is_typed_capacity_error(mesh4):
+    # the cap limit is already reached and the skew still overflows: the
+    # driver must raise CapacityError, NOT return a truncated result
+    N, B = 512, 64
+    rng = np.random.default_rng(3)
+    pk = np.full(N, 9, dtype=np.int64)
+    px = rng.integers(0, 10, N).astype(np.float64)
+    pq = np.zeros(N)
+    bk, bg, bw = _build(B)
+    with pytest.raises(CapacityError) as ei:
+        run_agg_join(mesh4, pk, px, pq, bk, bg, bw,
+                     bucket_cap=16, group_cap=64, filter_limit=0.5,
+                     max_bucket_cap=16)
+    assert ei.value.code == 1104
+
+
+def test_require_capacity_guard():
+    # exchange callers without a resize ladder must assert, not drop rows
+    C.require_capacity(64, 64)               # need == cap: fine
+    with pytest.raises(CapacityError):
+        C.require_capacity(65, 64, what="test-exchange")
+
+
+def test_factorize_reports_true_count_past_cap():
+    # the exact-need ladder only works because factorize counts BEFORE
+    # clamping: n_groups is the true distinct count even when cap is tiny
+    from tidb_tpu.ops import factorize as F
+    from tidb_tpu.ops.jax_env import jnp
+    keys = jnp.asarray(np.arange(100, dtype=np.int64))
+    live = jnp.ones(100, dtype=bool)
+    _gids, n_groups, _rep = F.factorize([(keys, None)], live, 16)
+    assert int(n_groups) == 100
+
+
+# ---- shard faults at the SQL level ----------------------------------------
+
+@pytest.fixture(scope="module")
+def dist_session(eight_devices):
+    from tidb_tpu.session import Engine
+    eng = Engine()
+    s = eng.new_session()
+    s.execute("create table mc (k bigint, g bigint, v bigint)")
+    rows = ", ".join(f"({i % 97}, {i % 5}, {i % 101})" for i in range(4000))
+    s.execute(f"insert into mc values {rows}")
+    s.execute("analyze table mc")
+    s.vars.update({"tidb_tpu_engine": "on", "tidb_tpu_row_threshold": 1,
+                   "tidb_tpu_dist_devices": 4})
+    yield s
+    eng.close()
+
+
+DIST_SQL = "select g, count(*), sum(v) from mc group by g order by g"
+
+
+def test_shard_fault_heals_with_one_retry(dist_session):
+    s = dist_session
+    oracle = [(i, 800, sum(j % 101 for j in range(i, 4000, 5)))
+              for i in range(5)]
+    with failpoint.enabled("shard-step",
+                           raise_=ShardFailure("chaos: shard 2 down"),
+                           after_hits=2, times=1):
+        rows = s.query(DIST_SQL).rows
+    assert [tuple(int(x) for x in r) for r in rows] == oracle
+    # the recovery is visible: one whole-step retry, charged to the ladder
+    assert s.last_guard.escalation.shard_retries == 1
+
+
+def test_persistent_shard_fault_is_one_typed_error(dist_session):
+    s = dist_session
+    with failpoint.enabled("shard-step",
+                           raise_=ShardFailure("chaos: shard down")):
+        with pytest.raises(ShardFailure) as ei:
+            s.query(DIST_SQL)
+    assert ei.value.code == 1105
+    assert "twice" in str(ei.value)
+    # the store and the session survived: same statement now answers
+    rows = s.query(DIST_SQL).rows
+    assert [int(r[1]) for r in rows] == [800] * 5
+    assert s.query("select count(*) from mc").scalar() == 4000

@@ -85,8 +85,8 @@ def test_distributed_agg_join_matches_oracle(mesh):
                                filter_limit=0.7)
     args = shard_rows(mesh, [pk, px, pq, np.ones(N, bool),
                              bk, bg, bw, np.ones(B, bool)])
-    kv, km, sums, counts, live, over = step(*args)
-    assert not bool(over)
+    kv, km, sums, counts, live, need, gneed = step(*args)
+    assert int(need) <= N and int(gneed) <= 64  # capacities held
     kv, km, sums, counts, live = map(np.asarray,
                                      (kv, km, sums, counts, live))
     got = {}

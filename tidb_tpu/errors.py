@@ -81,6 +81,24 @@ class BackoffExhausted(TiDBTPUError):
     code = 1105
 
 
+class CapacityError(ExecutionError):
+    """A static-shape capacity (exchange bucket, group cap, join out-cap)
+    overflowed and the escalation ladder is exhausted. Raised instead of
+    returning truncated rows — overflow is NEVER silent row loss."""
+
+    code = 1104  # ER_TOO_BIG_SELECT
+
+
+class ShardFailure(ExecutionError):
+    """One shard's step of a distributed fragment failed (injected fault
+    or a real device/runtime error). The executor retries the whole step
+    once through the escalation ladder; a second failure surfaces as this
+    one typed error."""
+
+    code = 1105
+    retryable = True
+
+
 class DivisionByZero(TiDBTPUError):
     code = 1365  # ER_DIVISION_BY_ZERO
 

@@ -55,6 +55,14 @@ class ExecContext:
         else:
             quota = int(self.vars.get("tidb_mem_quota_query", 0) or 0)
             self.mem_tracker = Tracker("query", quota)
+        # per-statement capacity-escalation counters (util/escalation.py):
+        # shared with the guard so information_schema.processlist can read
+        # them back while the statement runs
+        if guard is not None:
+            self.escalation = guard.escalation
+        else:
+            from tidb_tpu.util.escalation import EscalationStats
+            self.escalation = EscalationStats()
         self.tracer = None         # Tracer while TRACE runs (trace.go)
 
     @property

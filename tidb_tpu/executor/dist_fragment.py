@@ -56,7 +56,7 @@ class DistTreeProgram(TreeProgram):
         P = jax.sharding.PartitionSpec
         root = plan
         flags = {"join_unique": P(), "join_need": P(),
-                 "over_groups": P(), "exchange_need": P()}
+                 "group_need": P(), "exchange_need": P()}
         if isinstance(root, PhysHashAgg):
             out_specs = {"keys": P(AXIS), "states": P(AXIS),
                          "out_live": P(AXIS), **flags}
@@ -74,6 +74,15 @@ class DistTreeProgram(TreeProgram):
                  aligned_inputs=()):
         # the dist path keeps the 3-arg shard_map signature (FK-aligned
         # join structures are a single-chip cache)
+        from tidb_tpu.util import failpoint
+        # host-side per-shard dispatch seam: shard_map traces ONE body
+        # for all shards, so a per-shard fault cannot raise inside the
+        # trace — instead the "shard-step" site fires once per rank here
+        # (after_hits=K selects which shard fails); real device runtime
+        # errors from run() surface through the same retry handler in
+        # the executor (_run_device_dist)
+        for _rank in range(self.n_shards):
+            failpoint.inject("shard-step")
         return self.run(scan_inputs, scan_rows, prep_vals)
 
     # -- traced per-shard body ----------------------------------------------
@@ -97,8 +106,12 @@ class DistTreeProgram(TreeProgram):
         else:
             out["join_unique"] = jnp.zeros(0, dtype=bool)
             out["join_need"] = jnp.zeros(0, dtype=jnp.int64)
-        over_g = out.pop("_over_local", jnp.bool_(False))
-        out["over_groups"] = lax.pmax(over_g.astype(jnp.int32), AXIS) > 0
+        # per-shard TRUE group counts (factorize counts before clamping):
+        # the pmax is the exact global need, so a group-cap overflow is
+        # an exact-need resize — one recompile, not a doubling ladder
+        gneed = out.pop("_gneed_local", jnp.int32(0))
+        out["group_need"] = lax.pmax(
+            jnp.asarray(gneed).astype(jnp.int32), AXIS)
         # per-exchange NEEDED capacities (already pmax'd by exchange()):
         # the executor resizes ONLY the overflowed exchange's buckets to
         # the exact reported need — one skewed exchange costs one
@@ -163,12 +176,12 @@ class DistTreeProgram(TreeProgram):
                 slot_live = jnp.arange(cap, dtype=jnp.int32) < n_groups
                 key_out = [(jnp.asarray(v)[rep], jnp.asarray(m)[rep] &
                             slot_live) for v, m in keys]
-                over = n_groups > cap
+                gneed = jnp.asarray(n_groups, dtype=jnp.int32)
             else:
                 gids = jnp.where(live, jnp.int32(0), jnp.int32(cap))
                 slot_live = jnp.arange(cap, dtype=jnp.int32) < 1
                 key_out = []
-                over = jnp.bool_(False)
+                gneed = jnp.int32(0)
             from tidb_tpu.executor.device_emit import agg_states
             # DISTINCT dedup is exact per shard: the planner re-keyed the
             # exchange on the group keys, so a group's rows never split
@@ -190,7 +203,8 @@ class DistTreeProgram(TreeProgram):
                 f_keys = [(jnp.asarray(v)[frep],
                            jnp.asarray(m)[frep] & out_live)
                           for v, m in gkeys]
-                over = over | (n_own > cap)
+                gneed = jnp.maximum(
+                    gneed, jnp.asarray(n_own, dtype=jnp.int32))
             else:
                 fgids = jnp.where(own, jnp.int32(0), jnp.int32(cap))
                 out_live = (jnp.arange(cap, dtype=jnp.int32) < 1) & \
@@ -203,7 +217,7 @@ class DistTreeProgram(TreeProgram):
                 st = agg.init(jnp, cap)
                 f_states.append(agg.merge(jnp, st, fgids, cap, clean))
             return {"keys": f_keys, "states": f_states,
-                    "out_live": out_live, "_over_local": over}
+                    "out_live": out_live, "_gneed_local": gneed}
         n = live.shape[0]
         cols = [(jnp.zeros(n, dtype=jnp.int64), jnp.zeros(n, dtype=bool))
                 if c is None else c for c in cols]
@@ -222,20 +236,20 @@ class DistTreeProgram(TreeProgram):
                         for v, m in cols[:n_out_cols]]
             return {"cols": gathered,
                     "n_out": jnp.reshape(n_out, (1,)),
-                    "_over_local": jnp.bool_(False)}
+                    "_gneed_local": jnp.int32(0)}
         if isinstance(root, PhysWindow):
             # ---- window root: the exchange co-located every partition on
             # one shard, so per-shard emit_window is globally exact ----
             from tidb_tpu.executor import device_emit
             ctx = self._ctx(cols)
             out = device_emit.emit_window(ctx, live, root)
-            out["_over_local"] = jnp.bool_(False)
+            out["_gneed_local"] = jnp.int32(0)
             return out
         # ---- selection / projection / join row root: per-shard rows,
         # host compacts by live and concatenates ----
         return {"cols": [(jnp.asarray(v), jnp.asarray(m))
                          for v, m in cols[:len(root.schema)]],
-                "live": live, "_over_local": jnp.bool_(False)}
+                "live": live, "_gneed_local": jnp.int32(0)}
 
 
 def unify_string_join_dicts(root: PhysicalPlan, host_cols) -> None:

@@ -41,8 +41,9 @@ import numpy as np
 log = logging.getLogger("tidb_tpu.fragment")
 
 from tidb_tpu.chunk import Chunk, Column
-from tidb_tpu.errors import (ExecutionError, MemoryQuotaExceeded,
-                             QueryKilledError, QueryTimeout)
+from tidb_tpu.errors import (CapacityError, ExecutionError,
+                             MemoryQuotaExceeded, QueryKilledError,
+                             QueryTimeout, ShardFailure)
 from tidb_tpu.expression import EvalContext, Expression, ColumnRef
 from tidb_tpu.expression.aggfuncs import AggFunc, build_agg
 from tidb_tpu.planner.physical import (PhysHashAgg, PhysHashJoin,
@@ -861,10 +862,13 @@ class TpuFragmentExec:
 
     def runtime_info(self) -> str:
         """Surfaced in EXPLAIN ANALYZE (ref: execdetails.go runtime stats)."""
+        esc = getattr(self.ctx, "escalation", None)
+        esc = f", escalation:{esc.summary()}" if esc is not None and \
+            esc.total else ""
         if self.used_device:
-            return "device:yes"
+            return f"device:yes{esc}"
         if self.fallback_reason:
-            return f"device:fallback({self.fallback_reason})"
+            return f"device:fallback({self.fallback_reason}){esc}"
         return ""
 
     def next(self) -> Optional[Chunk]:
@@ -887,6 +891,11 @@ class TpuFragmentExec:
                 global LAST_DEVICE_EXEC_S
                 LAST_DEVICE_EXEC_S = _time.perf_counter() - _t0
                 self.used_device = True
+                _tr = getattr(self.ctx, "tracer", None)
+                _esc = getattr(self.ctx, "escalation", None)
+                if _tr is not None and _esc is not None and _esc.total:
+                    # TRACE shows what the ladder did to this statement
+                    _tr.event("device.escalation", summary=_esc.summary())
             except FragmentFallback as e:
                 # expected ineligibility (shape/feature gate) — quiet path
                 self.fallback_reason = str(e) or "ineligible"
@@ -895,10 +904,14 @@ class TpuFragmentExec:
                         f"tidb_tpu_strict: device fragment fell back: "
                         f"{self.fallback_reason}") from e
                 return self._fallback_next()
-            except (QueryKilledError, QueryTimeout, MemoryQuotaExceeded):
-                # lifecycle errors unwind past the fallback ladder: a
-                # killed/expired/over-quota query must die, not retry the
-                # same work on CPU
+            except (QueryKilledError, QueryTimeout, MemoryQuotaExceeded,
+                    CapacityError, ShardFailure):
+                # lifecycle and typed capacity/shard errors unwind past the
+                # fallback ladder: a killed/expired/over-quota query must
+                # die, not retry the same work on CPU — and a shard fault
+                # that already survived its ladder retry (or an exhausted
+                # capacity ladder) surfaces typed instead of silently
+                # re-running the whole statement on the host
                 raise
             except Exception as e:  # noqa: BLE001
                 # UNEXPECTED device failure: never silent (VERDICT r1 weak #4)
@@ -992,12 +1005,15 @@ class TpuFragmentExec:
 
         want_pairs = ent.n_slabs > 1 and isinstance(root, PhysHashAgg) \
             and any(d.distinct and d.args for d in root.aggs)
-        # recompile retries share the budgeted backoff scope: each overflow
-        # quadruples the cap, and the sleeps double as kill/deadline
-        # checkpoints so a doomed query never queues another compile
-        from tidb_tpu.util.backoff import Backoffer
-        bo = Backoffer("device-recompile", base_ms=1.0, max_ms=50.0,
-                       budget_ms=500.0, guard=getattr(self.ctx, "guard", None))
+        # recompile retries ride the escalation ladder: the observed group
+        # count resizes the cap to exact need (one recompile when the
+        # merged count is the binding one), each attempt charged against
+        # the ladder's backoff budget whose sleeps double as kill/deadline
+        # checkpoints — a doomed query never queues another compile
+        from tidb_tpu.util.escalation import CapacityLadder
+        ladder = CapacityLadder(guard=getattr(self.ctx, "guard", None),
+                                stats=self.ctx.escalation)
+        cap_limit = slab_cap * max(n_slabs, 1)
         while True:
             prog = get_program(chain, used, in_types, slab_cap, group_cap,
                                key_bounds, want_pairs)
@@ -1005,11 +1021,13 @@ class TpuFragmentExec:
             try:
                 result = self._execute(prog, chain, ent, dicts, prep_vals)
             except _GroupCapOverflow as e:
-                failpoint.inject("device-recompile")
-                if group_cap >= slab_cap * max(n_slabs, 1):
+                if group_cap >= cap_limit:
+                    ladder.fallback("group")
                     raise FragmentFallback("group cap overflow")
-                group_cap = min(group_cap * 4, slab_cap * max(n_slabs, 1))
-                bo.backoff(e)
+                group_cap = ladder.resize("group", group_cap,
+                                          need=e.need or None,
+                                          max_cap=cap_limit)
+                ladder.attempt("group", e)
                 continue
             return result
 
@@ -1085,6 +1103,11 @@ class TpuFragmentExec:
             gcap = _initial_group_cap(root, group_cap, max_cap)
         else:
             gcap = 1
+        from tidb_tpu.executor.tree_fragment import JOIN_OUT_CAP
+        from tidb_tpu.util.escalation import CapacityLadder
+        out_cap_max = int(vars_.get("tidb_tpu_join_out_cap", JOIN_OUT_CAP))
+        ladder = CapacityLadder(guard=getattr(self.ctx, "guard", None),
+                                stats=self.ctx.escalation)
         # every device_get is a ~100ms tunnel round trip — batch fetches
         while True:
             prog = get_tree_program(root, caps, gcap, join_cfgs, akb)
@@ -1115,37 +1138,39 @@ class TpuFragmentExec:
             for ji, cfg in enumerate(join_cfgs):
                 uq = bool(np.asarray(flags["ju"])[ji])
                 tot = int(np.asarray(flags["jt"])[ji])
-                if cfg.mode == "unique" and not uq:
-                    # lost PK-FK bet: re-trace this join expanding matches
-                    join_cfgs[ji] = d_replace(
-                        cfg, mode="expand",
-                        out_cap=_pow2(int(cfg.est * 1.3), lo=1024))
-                    retry = True
-                elif cfg.mode == "expand" and tot > cfg.out_cap:
-                    from tidb_tpu.executor.tree_fragment import JOIN_OUT_CAP
-                    out_cap_max = int(vars_.get("tidb_tpu_join_out_cap",
-                                                JOIN_OUT_CAP))
-                    if tot > out_cap_max:
-                        # runaway fan-out (many-to-many on a skewed key):
-                        # too large to materialize in one batch — run the
-                        # tree in K row-range passes over the probe anchor
-                        # and merge root agg states host-side (the grace-
-                        # hash partitioning analog, executor/hash_table.go
-                        # grace partitions / radix-hashjoin design doc)
-                        return self._run_tree_blocked(
-                            root, caps, join_cfgs, ji, walk_joins, akb,
-                            gcap, max_cap, scans, ents, scan_inputs,
-                            scan_rows, flow_list, aligned_inputs, flows,
-                            tot)
-                    # the true total came back: retry exactly once
-                    join_cfgs[ji] = d_replace(cfg, out_cap=_pow2(tot))
+                new_cfg, action = TF.escalate_join(
+                    cfg, uq, tot, out_cap_max,
+                    flip_out_cap=_pow2(int(cfg.est * 1.3), lo=1024),
+                    ladder=ladder)
+                if action == "over-max":
+                    # runaway fan-out (many-to-many on a skewed key):
+                    # too large to materialize in one batch — run the
+                    # tree in K row-range passes over the probe anchor
+                    # and merge root agg states host-side (the grace-
+                    # hash partitioning analog, executor/hash_table.go
+                    # grace partitions / radix-hashjoin design doc)
+                    return self._run_tree_blocked(
+                        root, caps, join_cfgs, ji, walk_joins, akb,
+                        gcap, max_cap, scans, ents, scan_inputs,
+                        scan_rows, flow_list, aligned_inputs, flows,
+                        tot)
+                if new_cfg is not None:
+                    join_cfgs[ji] = new_cfg
                     retry = True
             if is_agg and akb is None and int(flags["ng"]) > gcap:
                 if gcap >= max_cap:
+                    ladder.fallback("group")
                     raise FragmentFallback("group cap overflow")
-                gcap = min(gcap * 4, max_cap)
+                # factorize reported the TRUE distinct count: resize to
+                # exact need in one recompile instead of blind doubling
+                gcap = ladder.resize("group", gcap, need=int(flags["ng"]),
+                                     max_cap=max_cap)
                 retry = True
             if retry:
+                # budget + guard checkpoint between recompiles: a KILL or
+                # deadline lands here, and a recompile-storm exhausts into
+                # a typed error instead of looping
+                ladder.attempt("tree")
                 continue
             break
 
@@ -1481,6 +1506,12 @@ class TpuFragmentExec:
         join_cfgs = TF.plan_join_configs(root, scan_bounds)
         join_cfgs = [d_replace(c, out_cap=_shard_out_cap(c))
                      if c.mode == "expand" else c for c in join_cfgs]
+        from tidb_tpu.errors import ShardFailure
+        from tidb_tpu.util.escalation import CapacityLadder
+        out_cap_max = int(vars_.get("tidb_tpu_join_out_cap", JOIN_OUT_CAP))
+        ladder = CapacityLadder(guard=getattr(self.ctx, "guard", None),
+                                stats=self.ctx.escalation)
+        shard_faults = 0
         while True:
             # each retrace round is a checkpoint: a killed query must not
             # queue another multi-shard compile
@@ -1488,25 +1519,43 @@ class TpuFragmentExec:
             prog = _get_dist_program(root, caps, gcap, mesh, bucket_caps,
                                      join_cfgs)
             prep_vals = prog.collect_preps(flow_list)
-            out = jax.device_get(prog(scan_inputs, scan_rows, prep_vals))
+            try:
+                out = jax.device_get(prog(scan_inputs, scan_rows,
+                                          prep_vals))
+            except Exception as e:
+                # one shard's step failing (the "shard-step" failpoint, or
+                # a real per-device runtime fault) heals by re-dispatching
+                # the WHOLE step — shard_map is deterministic over
+                # host-resident inputs, so a retry recomputes every shard
+                if not (isinstance(e, ShardFailure) or
+                        type(e).__name__ == "XlaRuntimeError"):
+                    raise
+                shard_faults += 1
+                if shard_faults > 1:
+                    # the fault persisted through the retry: surface ONE
+                    # typed error (the store and session stay usable)
+                    raise ShardFailure(
+                        "distributed fragment shard step failed twice: "
+                        f"{e}") from e
+                ladder.shard_retry(e)
+                continue
             retry = False
             ju = np.asarray(out["join_unique"])
             jneed = np.asarray(out["join_need"])
             for ji, cfg in enumerate(join_cfgs):
-                if cfg.mode == "unique" and not bool(ju[ji]):
-                    # lost PK-FK bet on some shard: re-trace that join in
-                    # expand mode (one recompile, never a CPU fallback)
-                    join_cfgs[ji] = d_replace(cfg, mode="expand",
-                                              out_cap=_shard_out_cap(cfg))
-                    retry = True
-                elif cfg.mode == "expand" and int(jneed[ji]) > cfg.out_cap:
-                    if int(jneed[ji]) > JOIN_OUT_CAP:
-                        raise FragmentFallback(
-                            f"join fan-out {int(jneed[ji])} exceeds "
-                            f"device cap")
-                    # the largest shard's true need came back: retry once
-                    join_cfgs[ji] = d_replace(
-                        cfg, out_cap=_pow2(int(jneed[ji])))
+                new_cfg, action = TF.escalate_join(
+                    cfg, bool(ju[ji]), int(jneed[ji]), out_cap_max,
+                    flip_out_cap=_shard_out_cap(cfg), ladder=ladder)
+                if action == "over-max":
+                    ladder.fallback("join")
+                    raise FragmentFallback(
+                        f"join fan-out {int(jneed[ji])} exceeds "
+                        f"device cap")
+                if new_cfg is not None:
+                    # a lost PK-FK bet re-traces in expand mode; an expand
+                    # overflow resizes to the largest shard's true need —
+                    # one recompile either way, never a CPU fallback
+                    join_cfgs[ji] = new_cfg
                     retry = True
             needs = np.asarray(out["exchange_need"])
             for need, node in zip(needs, hash_exchanges):
@@ -1515,15 +1564,23 @@ class TpuFragmentExec:
                     failpoint.inject("exchange-overflow")
                     # resize only the overflowed exchange, to its exact
                     # reported need — one recompile, no doubling ladder
-                    bucket_caps[id(node)] = _pow2(int(need), lo=64)
+                    bucket_caps[id(node)] = ladder.resize(
+                        "exchange", bucket_caps[id(node)],
+                        need=int(need), lo=64)
                     retry = True
-            if bool(out["over_groups"]):
+            gneed = int(out["group_need"])
+            if gneed > gcap:
                 if gcap >= max_cap * nd:
+                    ladder.fallback("group")
                     raise FragmentFallback("group cap overflow")
-                gcap = min(gcap * 4, max_cap * nd)
+                # the pmax'd true per-shard group count came back: exact
+                # need, one recompile
+                gcap = ladder.resize("group", gcap, need=gneed,
+                                     max_cap=max_cap * nd)
                 retry = True
             if not retry:
                 break
+            ladder.attempt("dist")
 
         dicts_root = {i: d for i, d in enumerate(root_dicts)}
         if is_agg:
@@ -1641,10 +1698,14 @@ class TpuFragmentExec:
         small = _piggyback_agg(fetch, out, prog.group_cap)
         got = jax.device_get(fetch)
         if any(int(g) > prog.group_cap for g in got["ngs"]):
-            raise _GroupCapOverflow()
+            # per-slab counts are true (factorize counts before clamping)
+            # but the MERGED count may be understated when slabs clipped,
+            # so the carried need is a valid lower bound — the ladder
+            # resizes to it exactly and re-checks
+            raise _GroupCapOverflow(max(int(g) for g in got["ngs"]))
         n_final = int(got["ng"])
         if n_final > prog.group_cap:
-            raise _GroupCapOverflow()
+            raise _GroupCapOverflow(n_final)
         if root.group_exprs and n_final == 0:
             from tidb_tpu.executor import _empty_chunk
             return _empty_chunk(self.schema)
@@ -1754,7 +1815,13 @@ def _strip_exchanges(plan: PhysicalPlan) -> PhysicalPlan:
 
 
 class _GroupCapOverflow(Exception):
-    pass
+    """Factorize saw more groups than the program's cap. `need` carries
+    the observed true count (0 = unknown) so the escalation ladder can
+    resize to exact need instead of blind doubling."""
+
+    def __init__(self, need: int = 0):
+        super().__init__(f"group cap overflow (need {need})")
+        self.need = int(need)
 
 
 # Device execution time of the most recent fragment run (seconds), set by

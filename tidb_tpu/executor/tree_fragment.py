@@ -366,6 +366,42 @@ class JoinCfg:
     blocked: bool = False
 
 
+def escalate_join(cfg: JoinCfg, unique_ok: bool, total: int,
+                  out_cap_max: int, flip_out_cap: int, ladder=None):
+    """One rung of the join-capacity ladder, shared by the single-chip
+    tree loop and the distributed loop (executor/fragment.py):
+
+      * a lost unique bet flips the join to expand mode at
+        `flip_out_cap` (the caller's estimate policy — global for the
+        tree path, per-shard balanced share for the dist path);
+      * an expand overflow resizes to the EXACT reported total (one
+        recompile covers it) unless the total exceeds `out_cap_max`,
+        where the caller escalates further (blocked multi-pass /
+        fallback).
+
+    → (new_cfg | None, action) with action in
+      {None, "flip", "resize", "over-max"}; new_cfg is None unless the
+    join must re-trace. A util/escalation.CapacityLadder passed as
+    `ladder` gets the rung recorded on its per-query stats."""
+    from dataclasses import replace as d_replace
+
+    from tidb_tpu.executor.device_cache import _pow2
+    if cfg.mode == "unique" and not unique_ok:
+        if ladder is not None:
+            ladder.flip("join")
+        return d_replace(cfg, mode="expand", out_cap=flip_out_cap), "flip"
+    if cfg.mode == "expand" and total > cfg.out_cap:
+        if total > out_cap_max:
+            if ladder is not None:
+                ladder.stats.note("join", "over-max")
+            return None, "over-max"
+        if ladder is not None:
+            ladder.stats.exact_resizes += 1
+            ladder.stats.note("join", "exact")
+        return d_replace(cfg, out_cap=_pow2(total)), "resize"
+    return None, None
+
+
 def _bounds_list(node: PhysicalPlan, scan_bounds
                  ) -> List[Optional[Tuple[int, int]]]:
     """Per output column (lo, hi) value bounds, traced from the device

@@ -116,15 +116,20 @@ def _session_variables(session):
 @register("processlist", [("ID", T.bigint()),
                           ("USER", T.varchar()),
                           ("TIME", T.double()),
-                          ("INFO", T.varchar())])
+                          ("INFO", T.varchar()),
+                          ("ESCALATIONS", T.varchar())])
 def _processlist(session):
     # same source as SHOW PROCESSLIST: every live connection (idle ones
-    # included), each with ITS OWN user — not the querying session's
+    # included), each with ITS OWN user — not the querying session's.
+    # ESCALATIONS is the running statement's capacity-ladder summary
+    # (util/escalation.py): recompiles, exact resizes, shard retries —
+    # live observability for "why is this query recompiling"
     from tidb_tpu.util.guard import PROCESS_REGISTRY
     return sorted(
         (cid, user or "",
          round(guard.elapsed(), 3) if guard is not None else 0.0,
-         guard.sql if guard is not None else None)
+         guard.sql if guard is not None else None,
+         guard.escalation.summary() if guard is not None else "")
         for cid, user, guard, _killed in PROCESS_REGISTRY.snapshot())
 
 

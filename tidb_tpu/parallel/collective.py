@@ -95,6 +95,19 @@ def exchange(arrays: Sequence, dest, live, n_shards: int, bucket_cap: int,
     return recv, recv_live, need
 
 
+def require_capacity(need: int, bucket_cap: int, what: str = "exchange"):
+    """Host-side overflow guard for exchange() callers WITHOUT a resize
+    ladder: rows past bucket_cap were dropped inside the collective, so
+    ignoring the reported need silently loses rows. Call this on the
+    fetched (host) need; it raises a typed CapacityError instead."""
+    from tidb_tpu.errors import CapacityError
+    if int(need) > int(bucket_cap):
+        raise CapacityError(
+            f"{what} needs {int(need)} rows/bucket but capacity is "
+            f"{int(bucket_cap)}; rows would be silently dropped — resize "
+            f"(escalation ladder) or raise the cap")
+
+
 def broadcast_build(arrays: Sequence, live, axis: str = "shard"):
     """Broadcast-join pattern: every shard receives the full build side
     (ExchangeType_Broadcast) — one all_gather along the mesh axis."""

@@ -40,7 +40,9 @@ def _local_grouped_sum(keys, live, values_list, cap: int):
     slot_live = jnp.arange(cap, dtype=jnp.int32) < n_groups
     key_out = [(jnp.asarray(v)[rep], jnp.asarray(m)[rep] & slot_live)
                for v, m in keys]
-    return key_out, sums, counts, slot_live
+    # n_groups is the TRUE distinct count (factorize counts before
+    # clamping) — the caller's ladder resizes to exact need in ONE step
+    return key_out, sums, counts, slot_live, n_groups
 
 
 def _owned_final_merge(gkeys, gsums, gcounts, gslot_live, cap: int,
@@ -61,7 +63,7 @@ def _owned_final_merge(gkeys, gsums, gcounts, gslot_live, cap: int,
     out_live = jnp.arange(cap, dtype=jnp.int32) < n_own
     f_keys = [(jnp.asarray(v)[rep], jnp.asarray(m)[rep] & out_live)
               for v, m in gkeys]
-    return f_keys, f_sums, f_counts, out_live
+    return f_keys, f_sums, f_counts, out_live, n_own
 
 
 def build_agg_join_step(mesh, bucket_cap: int, group_cap: int,
@@ -76,7 +78,13 @@ def build_agg_join_step(mesh, bucket_cap: int, group_cap: int,
       probe:  pk (N,) i64, px pq (N,) float, p_live (N,) bool
       build:  bk (N,) i64, bg (N,) i64, bw (N,) float, b_live (N,) bool
     Output (per shard, concatenated by shard_map): group keys, sums,
-    counts, live slots — each shard owns a disjoint subset of groups.
+    counts, live slots — each shard owns a disjoint subset of groups —
+    plus two replicated overflow flags: `need` (largest per-destination
+    exchange row count; need > bucket_cap means rows were DROPPED and
+    the result is truncated) and `group_need` (largest per-shard true
+    group count; group_need > group_cap means groups were conflated).
+    Callers must check both — run_agg_join below is the ladder driver
+    that re-executes with exact-need capacities instead.
 
     Parallelism content: local filter (region-parallel scan), all_to_all
     hash exchange of BOTH sides (ExchangeType_Hash), per-shard sort-probe
@@ -113,25 +121,98 @@ def build_agg_join_step(mesh, bucket_cap: int, group_cap: int,
         j_live = matched
         # 4. two-phase aggregate: partial by local groups…
         keys = [(jg, jnp.ones(npr, dtype=bool))]
-        pkeys, psums, pcounts, pslot = _local_grouped_sum(
+        pkeys, psums, pcounts, pslot, p_ng = _local_grouped_sum(
             keys, j_live, [rpx * jw], group_cap)
         # …gather partials, merge owned groups
         gkeys, gstates, gslot = C.gather_partials(
             pkeys, [tuple(psums) + (pcounts,)], pslot)
         gsums = [gstates[0][0]]
         gcounts = gstates[0][1]
-        fkeys, fsums, fcounts, fl = _owned_final_merge(
+        fkeys, fsums, fcounts, fl, n_own = _owned_final_merge(
             gkeys, gsums, gcounts, gslot, group_cap, n_shards)
-        overflow = jnp.maximum(p_over, b_over) > bucket_cap
+        need = jnp.maximum(p_over, b_over).astype(jnp.int32)
+        group_need = lax.pmax(
+            jnp.maximum(p_ng, n_own).astype(jnp.int32), AXIS)
         return (fkeys[0][0], fkeys[0][1], fsums[0], fcounts, fl,
-                overflow)
+                need, group_need)
 
     sharded = shard_map(
         step, mesh=mesh,
         in_specs=(P(AXIS),) * 8,
-        out_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P()),
+        out_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(), P()),
         check_rep=False)
     return jax.jit(sharded)
+
+
+def run_agg_join(mesh, pk, px, pq, bk, bg, bw, *, bucket_cap: int,
+                 group_cap: int, filter_limit: float, p_live=None,
+                 b_live=None, guard=None, max_bucket_cap: int = 1 << 22,
+                 max_group_cap: int = 1 << 20):
+    """Host-side escalation-ladder driver for build_agg_join_step.
+
+    Runs the distributed step and consumes its overflow flags: an
+    exchange `need` or `group_need` past the current capacity triggers an
+    exact-need resize + ONE recompile per overflowed structure, each
+    attempt charged against the ladder's backoff budget and
+    guard-checkpointed between recompiles. When a capacity limit is hit
+    the ladder is exhausted and a typed CapacityError raises — truncated
+    rows are never returned.
+
+    → ({group_key: (sum, count)}, EscalationStats)."""
+    from tidb_tpu.errors import BackoffExhausted, CapacityError
+    from tidb_tpu.parallel import shard_rows
+    from tidb_tpu.util import failpoint
+    from tidb_tpu.util.escalation import CapacityLadder
+
+    n, b = len(pk), len(bk)
+    p_live = np.ones(n, dtype=bool) if p_live is None else p_live
+    b_live = np.ones(b, dtype=bool) if b_live is None else b_live
+    ladder = CapacityLadder(guard=guard)
+    while True:
+        if guard is not None:
+            guard.check("device-dispatch")
+        step = build_agg_join_step(mesh, bucket_cap=bucket_cap,
+                                   group_cap=group_cap,
+                                   filter_limit=filter_limit)
+        args = shard_rows(mesh, [pk, px, pq, p_live, bk, bg, bw, b_live])
+        kv, km, sums, counts, live, need, gneed = step(*args)
+        need, gneed = int(need), int(gneed)
+        retry = False
+        if need > bucket_cap:
+            failpoint.inject("exchange-overflow")
+            if bucket_cap >= max_bucket_cap:
+                ladder.fallback("exchange")
+                raise CapacityError(
+                    f"exchange needs {need} rows/bucket but the ladder is "
+                    f"exhausted (cap {bucket_cap}, limit {max_bucket_cap})")
+            bucket_cap = ladder.resize("exchange", bucket_cap, need=need,
+                                       max_cap=max_bucket_cap, lo=8)
+            retry = True
+        if gneed > group_cap:
+            if group_cap >= max_group_cap:
+                ladder.fallback("group")
+                raise CapacityError(
+                    f"aggregate needs {gneed} group slots but the ladder "
+                    f"is exhausted (cap {group_cap}, "
+                    f"limit {max_group_cap})")
+            group_cap = ladder.resize("group", group_cap, need=gneed,
+                                      max_cap=max_group_cap, lo=8)
+            retry = True
+        if not retry:
+            break
+        try:
+            ladder.attempt("agg-join")
+        except BackoffExhausted as e:
+            ladder.fallback("budget")
+            raise CapacityError(
+                "distributed agg-join recompile budget exhausted") from e
+    out = {}
+    kv, km, sums, counts, live = map(np.asarray,
+                                     (kv, km, sums, counts, live))
+    for g, m, sv, c, lv in zip(kv, km, sums, counts, live):
+        if lv and m:
+            out[int(g)] = (float(sv), int(c))
+    return out, ladder.stats
 
 
 def reference_agg_join(pk, px, pq, bk, bg, bw, filter_limit):

@@ -177,6 +177,22 @@ def _stmt_as_of(stmt):
     return found[0] if found else None
 
 
+def _stmt_is_read_only_select(s) -> bool:
+    """MySQL's max_execution_time scope (sql/sql_parse.cc
+    set_statement_timer): only read-only SELECT statements get a timer.
+    SELECT ... FOR UPDATE takes locks, and DML/DDL mutate — aborting those
+    mid-flight on a deadline would leave half-applied work, so they run to
+    completion or an explicit KILL."""
+    if isinstance(s, ast.SelectStmt):
+        return not s.for_update
+    if isinstance(s, ast.SetOpStmt):
+        return _stmt_is_read_only_select(s.left) and \
+            _stmt_is_read_only_select(s.right)
+    if isinstance(s, ast.WithStmt):
+        return _stmt_is_read_only_select(s.stmt)
+    return False
+
+
 def _operator_spans(tr, exec_root) -> None:
     """Per-operator runtime stats rendered as a NESTED span tree (the
     executor Next-wrapper spans of executor.go:278); durations come from
@@ -448,8 +464,13 @@ class Session:
             if PROCESS_REGISTRY.conn_killed(self.conn_id):
                 raise QueryInterrupted("Connection was killed")
             # arm this statement's guard: deadline from the sysvar, root
-            # tracker from the quota — PROCESS_REGISTRY makes it killable
-            timeout_ms = int(self.vars.get("max_execution_time", 0) or 0)
+            # tracker from the quota — PROCESS_REGISTRY makes it killable.
+            # MySQL scopes max_execution_time to read-only SELECT
+            # (sql/sql_parse.cc set_statement_timer): writes and
+            # SELECT ... FOR UPDATE run to completion (or explicit KILL) —
+            # a deadline must never abort a half-applied mutation
+            timeout_ms = int(self.vars.get("max_execution_time", 0) or 0) \
+                if _stmt_is_read_only_select(s) else 0
             quota = int(self.vars.get("tidb_mem_quota_query", 0) or 0)
             guard = ExecutionGuard(self.conn_id, one[:256],
                                    timeout_ms / 1000.0,

@@ -9,7 +9,7 @@ pingcap/failpoint + tests/realtikvtest).
 Runnable three ways:
 
     python -m tidb_tpu.tools.chaos_sweep          # CLI, nonzero on fail
-    python tools/chaos_sweep.py                   # repo-root wrapper
+    python tools/chaos_sweep.py [--mesh N]        # repo-root wrapper
     pytest -m chaos                               # via tests/test_guardrails
 
 The sweep builds its fixture CLEANLY first (faults off), records oracle
@@ -17,7 +17,11 @@ results, then runs one scenario per fault. Each scenario is
 (site, fault, workload): read workloads re-check every query against the
 oracle; write workloads re-count the table. failpoint.counting() meters
 which sites the workload actually reached, so a refactor that silently
-moves a site out of the hot path shows up as lost coverage."""
+moves a site out of the hot path shows up as lost coverage — and the CLI
+exits non-zero when a site the run was supposed to reach stayed cold
+(mesh-only sites are exempt unless --mesh N forces a multi-device CPU
+mesh, which makes the distributed scenarios — skewed exchange overflow,
+shard-step faults — runnable too)."""
 
 from __future__ import annotations
 
@@ -25,7 +29,7 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from tidb_tpu.errors import (ExecutionError, MemoryQuotaExceeded,
-                             TiDBTPUError, TxnError)
+                             ShardFailure, TiDBTPUError, TxnError)
 from tidb_tpu.util import failpoint
 
 # every statement must finish (result or typed error) inside this
@@ -42,6 +46,25 @@ QUERIES = [
     "select c, count(*) from cs_facts group by c order by c limit 3",
 ]
 
+# ~3001 distinct doubles behind an EXPRESSION key: no cached bounds to
+# perfect-hash, no column NDV stats to pre-size the cap — with
+# tidb_tpu_group_cap squeezed the factorize cap overflows and the
+# escalation ladder recompiles exactly once (the only single-process
+# road to the device-recompile site). Compared as sorted row sets:
+# without an ORDER BY the engines may emit groups in any order.
+RECOMPILE_QUERY = "select d + 0.0, count(*) from cs_facts group by d + 0.0"
+
+# distributed shapes — integer results, so dist vs CPU comparison is
+# exact. The DISTINCT agg matters: a plain group-by distributes through
+# gather_partials (no re-key), so only the DISTINCT re-key exchange (and
+# a non-broadcast join) actually traces collective.exchange — the site
+# the mesh coverage gate wants hot
+MESH_QUERIES = [
+    QUERIES[1],
+    "select b, count(distinct a) from cs_facts group by b order by b",
+    QUERIES[2],
+]
+
 
 def _retryable_txn(msg: str) -> TxnError:
     e = TxnError(msg)
@@ -52,17 +75,21 @@ def _retryable_txn(msg: str) -> TxnError:
 class Scenario:
     def __init__(self, name: str, site: Optional[str], enable_kw: dict,
                  run: str = "read", vars: Optional[Dict[str, str]] = None,
-                 extra: Optional[Dict[str, dict]] = None):
+                 extra: Optional[Dict[str, dict]] = None,
+                 mesh: bool = False, require_error: bool = False):
         self.name = name
         self.site = site
         self.enable_kw = enable_kw
-        self.run = run               # read | write | ddl | backup
+        self.run = run               # read | write | ddl | backup | ...
         self.vars = vars or {}
         self.extra = extra or {}     # additional site → enable kwargs
+        self.mesh = mesh             # needs run_sweep(mesh=N)
+        self.require_error = require_error   # fault must SURFACE typed
 
 
-def _scenarios() -> List[Scenario]:
-    return [
+def _scenarios(mesh: Optional[int] = None) -> List[Scenario]:
+    device_on = {"tidb_tpu_engine": "on", "tidb_tpu_row_threshold": "0"}
+    out = [
         # -- CPU pipeline faults ------------------------------------------
         Scenario("scan transient fault", "scan-next",
                  dict(raise_=ExecutionError("chaos: scan-next"), times=1)),
@@ -96,16 +123,20 @@ def _scenarios() -> List[Scenario]:
         # -- device path (engine forced on; CPU backend still JITs) -------
         Scenario("device fragment crash → CPU fallback", "device-fragment",
                  dict(raise_=RuntimeError("chaos: device down"), times=9),
-                 vars={"tidb_tpu_engine": "on",
-                       "tidb_tpu_row_threshold": "0"}),
+                 vars=dict(device_on)),
         Scenario("HBM upload failure → CPU fallback", "device-transfer",
                  dict(raise_=RuntimeError("chaos: transfer"), times=9),
-                 vars={"tidb_tpu_engine": "on",
-                       "tidb_tpu_row_threshold": "0"}),
+                 vars=dict(device_on)),
         Scenario("host fetch interrupted", "host-fetch",
                  dict(raise_=ExecutionError("chaos: host-fetch"), times=9),
-                 vars={"tidb_tpu_engine": "on",
-                       "tidb_tpu_row_threshold": "0"}),
+                 vars=dict(device_on)),
+        # group-cap overflow engages the escalation ladder; the fault
+        # lands on its first recompile attempt → warned CPU fallback,
+        # still the oracle answer (never truncated rows)
+        Scenario("recompile ladder fault → CPU fallback", "device-recompile",
+                 dict(raise_=RuntimeError("chaos: recompile"), times=1),
+                 run="recompile",
+                 vars={**device_on, "tidb_tpu_group_cap": "64"}),
         # -- DDL -----------------------------------------------------------
         Scenario("unique backfill dies mid-reorg", "index-backfill",
                  dict(raise_=ExecutionError("chaos: backfill"), times=1),
@@ -118,6 +149,33 @@ def _scenarios() -> List[Scenario]:
                  dict(raise_=TiDBTPUError("chaos: restore"), times=1),
                  run="restore"),
     ]
+    if mesh:
+        dist_on = {"tidb_tpu_engine": "on", "tidb_tpu_row_threshold": "1",
+                   "tidb_tpu_dist_devices": str(mesh)}
+        out += [
+            # squeezed bucket cap: every hash exchange overflows, reports
+            # its exact need, and the ladder resizes ONCE — the site is
+            # armed with no action, purely metering that the resize path
+            # ran while results stay byte-equal to the CPU oracle
+            Scenario("mesh exchange overflow → exact-need resize",
+                     "exchange-overflow", dict(), run="mesh-read",
+                     vars={**dist_on, "tidb_tpu_exchange_bucket_cap": "8"},
+                     mesh=True),
+            # one shard's step raises once: the executor re-dispatches the
+            # whole step through the ladder and the query still answers
+            Scenario("mesh shard fault heals after retry", "shard-step",
+                     dict(raise_=ShardFailure("chaos: shard down"),
+                          times=1),
+                     run="mesh-read", vars=dict(dist_on), mesh=True),
+            # the fault persists through the retry: ONE typed ShardFailure
+            # must surface (a silent CPU re-run would hide a dead shard)
+            Scenario("mesh shard fault persists → typed error",
+                     "shard-step",
+                     dict(raise_=ShardFailure("chaos: shard down")),
+                     run="mesh-read", vars=dict(dist_on), mesh=True,
+                     require_error=True),
+        ]
+    return out
 
 
 def _run_statement(session, sql: str):
@@ -131,35 +189,55 @@ def _run_statement(session, sql: str):
         return None, e, time.monotonic() - t0
 
 
-def run_sweep(verbose: bool = False) -> dict:
+def run_sweep(verbose: bool = False, mesh: Optional[int] = None,
+              mesh_only: bool = False) -> dict:
+    """mesh=N runs the distributed scenarios over an N-device mesh (the
+    process must already see ≥N devices — the CLI's --mesh forces a host
+    CPU mesh via XLA_FLAGS before jax loads). mesh_only skips the
+    single-process scenarios: the cheap pytest `-m chaos` mesh variant."""
     from tidb_tpu.session import Engine
+    if mesh:
+        import jax
+        if len(jax.devices()) < mesh:
+            raise RuntimeError(
+                f"--mesh {mesh} needs {mesh} devices, jax sees "
+                f"{len(jax.devices())}; set XLA_FLAGS="
+                f"--xla_force_host_platform_device_count={mesh} before "
+                f"jax loads (tools/chaos_sweep.py --mesh does this)")
     failpoint.disable_all()
     eng = Engine()
     s = eng.new_session()
 
     # fixture FIRST, faults off — the oracle must be trustworthy
     s.execute("create table cs_dim (id int, name varchar(16))")
-    s.execute("create table cs_facts (a int, b int, c varchar(24))")
+    s.execute("create table cs_facts (a int, b int, c varchar(24), "
+              "d double)")
     dim = ", ".join(f"({i}, 'name{i:02d}')" for i in range(8))
     s.execute(f"insert into cs_dim values {dim}")
     for base in range(0, 4000, 500):
         vals = ", ".join(
-            f"({(i * 37) % 997 - 200}, {i % 8}, 'payload-{i:05d}')"
+            f"({(i * 37) % 997 - 200}, {i % 8}, 'payload-{i:05d}', "
+            f"{((i * 53) % 3001) / 8.0})"
             for i in range(base, base + 500))
         s.execute(f"insert into cs_facts values {vals}")
+    # NDV stats so the distributed planner trusts its row estimates
+    s.execute("analyze table cs_dim")
+    s.execute("analyze table cs_facts")
 
     # coverage meter: which sites does the clean workload even reach?
     failpoint.reset_counters()
     with failpoint.counting():
         for q in QUERIES:
             s.query(q)
-        s.execute("insert into cs_facts values (1, 1, 'probe')")
+        s.execute("insert into cs_facts values (1, 1, 'probe', 0.0)")
     coverage = failpoint.counters()
 
     # oracle recorded AFTER the probe write; re-recorded after every
     # mutating scenario, so "correct result" always means "what a clean
     # run over the CURRENT data returns"
-    oracle = {q: s.query(q).rows for q in QUERIES}
+    oracle_qs = QUERIES + [RECOMPILE_QUERY] + \
+        [q for q in MESH_QUERIES if q not in QUERIES]
+    oracle = {q: s.query(q).rows for q in oracle_qs}
     base_count = s.query("select count(*) from cs_facts").scalar()
 
     failures: List[str] = []
@@ -167,7 +245,9 @@ def run_sweep(verbose: bool = False) -> dict:
     reached = {k for k, v in coverage.items() if v > 0}
     write_seq = 0
 
-    for sc in _scenarios():
+    for sc in _scenarios(mesh):
+        if mesh_only and not sc.mesh:
+            continue
         saved = {k: s.vars.get(k) for k in sc.vars}
         s.vars.update(sc.vars)
         if sc.site is not None:
@@ -188,10 +268,41 @@ def run_sweep(verbose: bool = False) -> dict:
                         wrong += 1
                         failures.append(
                             f"{sc.name}: {q!r} SILENT WRONG RESULT")
+            elif sc.run == "recompile":
+                q = RECOMPILE_QUERY
+                rows, err, dt = _run_statement(s, q)
+                if dt > DEADLINE_S:
+                    slow += 1
+                    failures.append(f"{sc.name}: {q!r} took {dt:.1f}s")
+                if err is not None:
+                    errors += 1
+                elif sorted(rows) != sorted(oracle[q]):
+                    wrong += 1
+                    failures.append(f"{sc.name}: {q!r} SILENT WRONG RESULT")
+            elif sc.run == "mesh-read":
+                for q in MESH_QUERIES:
+                    rows, err, dt = _run_statement(s, q)
+                    if dt > DEADLINE_S:
+                        slow += 1
+                        failures.append(f"{sc.name}: {q!r} took {dt:.1f}s")
+                    if err is not None:
+                        errors += 1
+                        if not sc.require_error:
+                            failures.append(
+                                f"{sc.name}: {q!r} unexpected typed error "
+                                f"{type(err).__name__}: {err}")
+                    elif sc.require_error:
+                        failures.append(
+                            f"{sc.name}: {q!r} expected a typed error, "
+                            f"got a silent result")
+                    elif rows != oracle[q]:
+                        wrong += 1
+                        failures.append(
+                            f"{sc.name}: {q!r} SILENT WRONG RESULT")
             elif sc.run == "write":
                 write_seq += 1
                 ins = (f"insert into cs_facts values "
-                       f"(9000, {write_seq % 8}, 'w{write_seq}')")
+                       f"(9000, {write_seq % 8}, 'w{write_seq}', 0.0)")
                 _, err, dt = _run_statement(s, ins)
                 if dt > DEADLINE_S:
                     slow += 1
@@ -256,9 +367,9 @@ def run_sweep(verbose: bool = False) -> dict:
         after = s.query("select count(*) from cs_facts").scalar()
         if after != base_count:
             failures.append(f"{sc.name}: count drifted after scenario")
-        if sc.run != "read":
+        if sc.run not in ("read", "recompile", "mesh-read"):
             # mutating scenarios move the goalposts: refresh the oracle
-            oracle = {q: s.query(q).rows for q in QUERIES}
+            oracle = {q: s.query(q).rows for q in oracle_qs}
             base_count = after
         results.append({"scenario": sc.name, "site": sc.site,
                         "errors": errors, "wrong": wrong, "slow": slow})
@@ -266,9 +377,20 @@ def run_sweep(verbose: bool = False) -> dict:
             print(f"  {sc.name:45s} errors={errors} wrong={wrong}")
 
     unreached = sorted(set(failpoint.catalog()) - reached)
+    # the coverage GATE: a cold site the run was supposed to exercise.
+    # Without a mesh, mesh-only sites are exempt (a single-process
+    # workload cannot trace an exchange); mesh_only conversely gates only
+    # the distributed sites (the CPU scenarios were skipped on purpose).
+    exempt = set()
+    if not mesh:
+        exempt = failpoint.mesh_only_sites()
+    elif mesh_only:
+        exempt = set(failpoint.catalog()) - failpoint.mesh_only_sites()
+    gated_unreached = sorted(set(unreached) - exempt)
     report = {"scenarios": len(results), "results": results,
               "failures": failures, "coverage": coverage,
-              "unreached": unreached}
+              "unreached": unreached,
+              "gated_unreached": gated_unreached}
     eng.close()
     return report
 
@@ -277,20 +399,29 @@ def main(argv=None) -> int:
     import argparse
     ap = argparse.ArgumentParser(prog="chaos_sweep")
     ap.add_argument("-v", "--verbose", action="store_true")
+    ap.add_argument("--mesh", type=int, default=0, metavar="N",
+                    help="also run the distributed scenarios over an "
+                         "N-device forced host CPU mesh")
+    ap.add_argument("--mesh-only", action="store_true",
+                    help="with --mesh: run ONLY the distributed scenarios")
     args = ap.parse_args(argv)
     t0 = time.monotonic()
-    report = run_sweep(verbose=args.verbose)
+    report = run_sweep(verbose=args.verbose, mesh=args.mesh or None,
+                       mesh_only=args.mesh_only)
     dt = time.monotonic() - t0
     print(f"chaos sweep: {report['scenarios']} scenarios in {dt:.1f}s")
     print(f"  sites reached by clean workload: "
           f"{sorted(k for k, v in report['coverage'].items() if v)}")
     if report["unreached"]:
-        print(f"  unreached sites (need their own scenario/workload): "
-              f"{report['unreached']}")
+        print(f"  unreached sites: {report['unreached']}")
     if report["failures"]:
         print(f"FAILURES ({len(report['failures'])}):")
         for f in report["failures"]:
             print(f"  - {f}")
+        return 1
+    if report["gated_unreached"]:
+        print(f"COVERAGE GATE: sites this run should have reached stayed "
+              f"cold: {report['gated_unreached']}")
         return 1
     print("OK — every fault produced a correct result or a typed error")
     return 0

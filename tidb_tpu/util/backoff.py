@@ -50,6 +50,11 @@ class Backoffer:
         self.attempts = 0
         self.slept_ms = 0.0
 
+    def remaining_ms(self) -> float:
+        """Budget not yet spent — lets ladder callers decide whether a
+        further escalation rung is even affordable."""
+        return max(self.budget_ms - self.slept_ms, 0.0)
+
     def _jitter_frac(self) -> float:
         # deterministic per (name, attempt): reruns reproduce exactly
         h = hashlib.blake2b(f"{self.name}:{self.attempts}".encode(),

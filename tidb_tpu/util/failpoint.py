@@ -39,16 +39,27 @@ _counting = 0                        # >0: count even with nothing enabled
 # Site catalog — name → where it trips (keep in sync with inject() sites)
 # ---------------------------------------------------------------------------
 _catalog: Dict[str, str] = {}
+_mesh_only: set = set()     # sites only reachable on a multi-device mesh
 
 
-def register(name: str, desc: str = "") -> None:
-    """Declare an injection site so sweep tools can enumerate it."""
+def register(name: str, desc: str = "", mesh_only: bool = False) -> None:
+    """Declare an injection site so sweep tools can enumerate it.
+    mesh_only marks sites that only a distributed (multi-device) workload
+    can reach — the chaos sweep's coverage gate exempts them when it runs
+    without a mesh."""
     _catalog.setdefault(name, desc)
+    if mesh_only:
+        _mesh_only.add(name)
 
 
 def catalog() -> Dict[str, str]:
     """Registered site name → description (a copy)."""
     return dict(_catalog)
+
+
+def mesh_only_sites() -> set:
+    """Sites a single-device workload cannot reach (a copy)."""
+    return set(_mesh_only)
 
 
 for _site, _desc in (
@@ -60,8 +71,6 @@ for _site, _desc in (
                         "_upload_col)"),
     ("host-fetch", "device→host result fetch after a fragment runs "
                    "(executor/fragment.py next)"),
-    ("exchange-overflow", "distributed exchange bucket resize/retrace "
-                          "(executor/fragment.py _run_device_dist)"),
     ("scan-next", "per-chunk boundary of the CPU table scan "
                   "(executor/scan.py next)"),
     ("spill-write", "spill container write (util/memory.py add)"),
@@ -79,6 +88,17 @@ for _site, _desc in (
                       "the real sleep (util/backoff.py)"),
 ):
     register(_site, _desc)
+
+# distributed-only sites: a single-device workload never traces an
+# exchange or dispatches per-shard steps, so the sweep's coverage gate
+# only demands them when it runs with a mesh (--mesh N)
+register("exchange-overflow", "distributed exchange bucket resize/retrace "
+         "(executor/fragment.py _run_device_dist)", mesh_only=True)
+register("shard-step", "host-side per-shard dispatch of a distributed "
+         "fragment step (executor/dist_fragment.py __call__) — a raise "
+         "here models ONE shard failing; the executor retries the step "
+         "once through the ladder, then surfaces a typed ShardFailure",
+         mesh_only=True)
 
 
 def enable(name: str, *, raise_: Optional[BaseException] = None,

@@ -42,12 +42,16 @@ class ExecutionGuard:
     """Kill flag + deadline + root memory tracker for ONE statement."""
 
     __slots__ = ("conn_id", "sql", "started", "deadline", "mem_tracker",
-                 "checkpoints", "_killed")
+                 "checkpoints", "_killed", "escalation")
 
     def __init__(self, conn_id: int = 0, sql: str = "",
                  timeout_s: float = 0.0, mem_tracker=None):
+        from tidb_tpu.util.escalation import EscalationStats
         self.conn_id = conn_id
         self.sql = sql
+        # per-statement capacity-escalation counters (util/escalation.py),
+        # read back by information_schema.processlist
+        self.escalation = EscalationStats()
         self.started = time.monotonic()
         self.deadline = (self.started + timeout_s
                          if timeout_s and timeout_s > 0 else None)

@@ -1,7 +1,12 @@
 #!/usr/bin/env python
 """Repo-root wrapper for the chaos/failpoint sweep.
 
-    python tools/chaos_sweep.py [-v]
+    python tools/chaos_sweep.py [-v] [--mesh N [--mesh-only]]
+
+--mesh N forces an N-device host CPU mesh (XLA_FLAGS must be set BEFORE
+jax first loads, which is why this wrapper — not the sweep module —
+owns it) so the distributed scenarios run: skewed-exchange overflow
+through the escalation ladder, and shard-step fault recovery.
 
 See tidb_tpu/tools/chaos_sweep.py for the scenario list and contract."""
 
@@ -10,6 +15,19 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+if "--mesh" in sys.argv:
+    try:
+        _n = int(sys.argv[sys.argv.index("--mesh") + 1])
+    except (IndexError, ValueError):
+        _n = 0
+    if _n > 1 and "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") +
+            f" --xla_force_host_platform_device_count={_n}").strip()
+        # multi-device needs deterministic 64-bit keys shard-side too
+        os.environ.setdefault("JAX_ENABLE_X64", "1")
 
 from tidb_tpu.tools.chaos_sweep import main  # noqa: E402
 
