@@ -30,6 +30,9 @@ def pytest_configure(config):
         "markers", "slow: long-running tests excluded from tier-1")
     config.addinivalue_line(
         "markers", "chaos: failpoint/chaos-sweep tests")
+    config.addinivalue_line(
+        "markers", "perf_smoke: tier-1 perf guardrails (tiny scale, "
+        "asserts zero retraces and streamed-overlap phase accounting)")
     if not _needs_reexec():
         return
     capman = config.pluginmanager.getplugin("capturemanager")

@@ -226,6 +226,26 @@ def test_breadth_string_builtins():
                  "HXXo", 2, "b", "Hi")
 
 
+def test_round_scale_exact_half_away_from_zero():
+    """ROUND with a scale argument is EXACT decimal half-away-from-zero
+    (the reference's types.Round): float arithmetic would turn 1.005
+    into 1.00499…  and round it DOWN."""
+    from decimal import Decimal
+    from tidb_tpu.session import Engine
+    s = Engine().new_session()
+    q = lambda sql: s.query(sql).rows[0][0]    # noqa: E731
+    assert q("SELECT ROUND(1.005, 2)") == Decimal("1.01")
+    assert q("SELECT ROUND(1.25, 1)") == Decimal("1.3")
+    assert q("SELECT ROUND(-1.25, 1)") == Decimal("-1.3")
+    assert q("SELECT ROUND(2.567, 10)") == Decimal("2.567")
+    # half-away-from-zero at scale 0 (Python's round() would give 2/-2)
+    assert q("SELECT ROUND(2.5)") == 3
+    assert q("SELECT ROUND(-2.5)") == -3
+    # negative scale zeroes digits LEFT of the point, on ints too
+    assert q("SELECT ROUND(123.456, -2)") == 100
+    assert q("SELECT ROUND(12345, -2)") == 12300
+
+
 def test_breadth_math_misc_builtins():
     from tidb_tpu.session import Engine
     s = Engine().new_session()

@@ -1,0 +1,88 @@
+"""Tier-1 perf guardrails (tiny scale, CPU backend, fast).
+
+Not a benchmark — these pin the two properties the overlap runtime's
+speed rests on, which a correctness suite would never notice breaking:
+
+* warm-path stability: repeating an identical query must trace ZERO new
+  programs (PROGRAM_TRACES frozen) and re-upload NOTHING (the cache
+  entry's device arrays keep their identities);
+* phase accounting: a cold multi-slab first touch must attribute time
+  to every pipeline phase (encode/upload/compute/fetch/decode) with a
+  sane overlap-efficiency ratio, because bench.py and EXPLAIN ANALYZE
+  report those numbers as the optimization's evidence.
+"""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.executor import device_cache as dc
+from tidb_tpu.executor import fragment
+from tidb_tpu.session import Engine
+
+pytestmark = pytest.mark.perf_smoke
+
+SQL = "SELECT c, COUNT(*), SUM(a), AVG(b) FROM p GROUP BY c"
+
+
+@pytest.fixture()
+def session():
+    eng = Engine()
+    eng.global_vars["tidb_enable_auto_analyze"] = False
+    s = eng.new_session()
+    s.execute("CREATE TABLE p (a BIGINT, b DOUBLE, c VARCHAR(8))")
+    rng = np.random.default_rng(3)
+    words = ["ant", "bee", "cow", "dog"]
+    rows = [f"({int(rng.integers(0, 100))},{float(rng.normal()):.4f},"
+            f"'{words[int(rng.integers(0, 4))]}')" for _ in range(3000)]
+    s.execute("INSERT INTO p VALUES " + ",".join(rows))
+    s.vars["tidb_tpu_engine"] = "on"
+    s.vars["tidb_tpu_row_threshold"] = 1
+    s.vars["tidb_tpu_max_slab_rows"] = 1024   # 3 slabs → real streaming
+    return eng, s
+
+
+def _entry(eng):
+    tid = eng.catalog.info_schema.table("p").id
+    for (sid, t, _parts), ent in dc._CACHE.items():
+        if sid == id(eng.store) and t == tid:
+            return ent
+    raise AssertionError("table p not cached")
+
+
+def test_cold_first_touch_reports_all_phases(session):
+    eng, s = session
+    rows_cold = s.query(SQL).rows
+    assert rows_cold
+    ph = fragment.LAST_PHASES
+    assert ph is not None
+    d = ph.as_dict()
+    # the cold run really encoded and uploaded (first touch) and computed
+    assert d["encode_s"] > 0.0
+    assert d["upload_s"] > 0.0
+    assert d["compute_s"] > 0.0
+    assert d["decode_s"] >= 0.0
+    assert 0.0 <= d["overlap_efficiency"] <= 1.0
+    assert ph.total > 0.0
+
+
+def test_repeat_query_zero_retraces_and_no_reupload(session):
+    eng, s = session
+    rows_cold = s.query(SQL).rows          # cold: trace + first touch
+    ent = _entry(eng)
+    dev_ids = {i: [id(v) for v, _m in slabs]
+               for i, slabs in ent.dev.items()}
+    assert dev_ids, "cold run left no device arrays cached"
+    traces = fragment.PROGRAM_TRACES
+
+    rows_warm = s.query(SQL).rows          # warm: must reuse everything
+    assert fragment.PROGRAM_TRACES == traces, \
+        "repeated identical query re-traced a program"
+    ent2 = _entry(eng)
+    assert ent2 is ent, "repeated query rebuilt the cache entry"
+    for i, ids in dev_ids.items():
+        assert [id(v) for v, _m in ent.dev[i]] == ids, \
+            f"column {i} re-uploaded on a warm repeat"
+    assert sorted(map(str, rows_warm)) == sorted(map(str, rows_cold))
+    # warm run uploads nothing: its phase record shows no upload seconds
+    ph = fragment.LAST_PHASES
+    assert ph is not None and ph.as_dict()["upload_s"] == 0.0

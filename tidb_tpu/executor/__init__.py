@@ -63,6 +63,11 @@ class ExecContext:
         else:
             from tidb_tpu.util.escalation import EscalationStats
             self.escalation = EscalationStats()
+        # per-statement device phase timings (util/phases.py): encode/
+        # upload/compute/fetch/decode seconds + overlap efficiency,
+        # surfaced in EXPLAIN ANALYZE runtime info and the trace
+        from tidb_tpu.util.phases import PhaseTimer
+        self.phases = PhaseTimer()
         self.tracer = None         # Tracer while TRACE runs (trace.go)
 
     @property

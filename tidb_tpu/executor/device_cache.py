@@ -66,6 +66,32 @@ class CachedTable:
                 total += v.nbytes + m.nbytes
         return total
 
+    def delete(self) -> None:
+        """Free the device buffers NOW (donation discipline): an evicted
+        entry must not keep HBM resident until the GC happens to run —
+        a recompile right after eviction would otherwise double the
+        high-water mark."""
+        for slabs in self.dev.values():
+            for v, m in slabs:
+                _delete_array(v)
+                _delete_array(m)
+        self.dev.clear()
+
+
+def _delete_array(a) -> None:
+    try:
+        a.delete()
+    except Exception:  # noqa: BLE001 — already deleted / committed text
+        pass
+
+
+def _entry_delete(ent) -> None:
+    """Free an evicted entry's device buffers (tolerates test doubles
+    that stub hbm_bytes() without delete())."""
+    delete = getattr(ent, "delete", None)
+    if delete is not None:
+        delete()
+
 
 _CACHE: "OrderedDict[int, CachedTable]" = OrderedDict()
 # FK-aligned join structures (see AlignedJoin below); keyed by join path
@@ -73,16 +99,24 @@ _ALIGNED: "OrderedDict[tuple, AlignedJoin]" = OrderedDict()
 
 
 def clear():
+    for e in _CACHE.values():
+        _entry_delete(e)
+    for e in _ALIGNED.values():
+        _entry_delete(e)
     _CACHE.clear()
     _ALIGNED.clear()
 
 
 def invalidate(table_id: int):
     for key in [k for k in _CACHE if k[1] == table_id]:
-        _CACHE.pop(key, None)
+        ent = _CACHE.pop(key, None)
+        if ent is not None:
+            _entry_delete(ent)
     for key in [k for k, e in _ALIGNED.items()
                 if table_id in e.tds]:
-        _ALIGNED.pop(key, None)
+        ent = _ALIGNED.pop(key, None)
+        if ent is not None:
+            _entry_delete(ent)
 
 
 _STORE_FINALIZERS: Dict[int, object] = {}
@@ -90,9 +124,9 @@ _STORE_FINALIZERS: Dict[int, object] = {}
 
 def _evict_store(store_id: int):
     for key in [k for k in _CACHE if k[0] == store_id]:
-        _CACHE.pop(key, None)
+        _entry_delete(_CACHE.pop(key, None))
     for key in [k for k in _ALIGNED if k[0] == store_id]:
-        _ALIGNED.pop(key, None)
+        _entry_delete(_ALIGNED.pop(key, None))
     _STORE_FINALIZERS.pop(store_id, None)
 
 
@@ -195,60 +229,132 @@ def wide_decimal_unlimb(limbs: np.ndarray) -> np.ndarray:
     return out
 
 
-def _upload_col(ent: CachedTable, col_idx: int, ftype):
-    from tidb_tpu.ops.jax_env import jnp
-    from tidb_tpu.util import failpoint
-    failpoint.inject("device-transfer")
+def _col_prep(ent: CachedTable, col_idx: int, ftype) -> dict:
+    """Once-per-column host prep for the streamed first-touch: materialize
+    the column and build the GLOBAL dictionary/bounds. Per-slab encoding
+    then reduces to a searchsorted against the sorted keys (strings), an
+    astype (DOUBLE) or a limb split (wide decimals) of the slab's slice —
+    byte-identical to encoding the whole column at once, because the
+    dictionary is global and searchsorted on the sorted unique keys IS
+    np.unique's return_inverse."""
     vals, valid = _materialize_col(ent, col_idx)
     if ftype.is_wide_decimal:
-        # wide decimals upload as base-2³⁰ limb planes: (n_limbs, cap)
-        limbs = wide_decimal_limbs(vals, ftype.wide_limb_count)
-        ent.dicts[col_idx] = None
-        ent.bounds[col_idx] = None
-        slabs = []
-        for s in range(ent.n_slabs):
-            start = s * ent.slab_cap
-            stop = min(start + ent.slab_cap, ent.total)
-            n = stop - start
-            v = limbs[:, start:stop]
-            m = valid[start:stop]
-            if n < ent.slab_cap:
-                pv = np.zeros((limbs.shape[0], ent.slab_cap),
-                              dtype=np.int64)
-                pv[:, :n] = v
-                pm = np.zeros(ent.slab_cap, dtype=bool)
-                pm[:n] = m
-                v, m = pv, pm
-            slabs.append((jnp.asarray(v), jnp.asarray(m)))
-        ent.dev[col_idx] = slabs
-        return
-    vals, dictionary = _encode_col(ftype, vals, valid)
-    ent.dicts[col_idx] = dictionary
-    ent.bounds[col_idx] = _col_bounds(vals, valid, dictionary)
-    slabs = []
+        return {"kind": "wide", "vals": vals, "valid": valid,
+                "n_limbs": ftype.wide_limb_count,
+                "dict": None, "bounds": None}
+    if ftype.is_varlen:
+        str_vals = np.array([str(v) for v in vals], dtype=object)
+        if ftype.is_ci:
+            from tidb_tpu.types import fold_ci_array
+            folded = fold_ci_array(str_vals)
+            keys, first = np.unique(folded, return_index=True)
+            dictionary = str_vals[first]    # representative per fold class
+            prep = {"kind": "str", "vals": folded, "valid": valid,
+                    "keys": keys}
+        else:
+            dictionary = np.unique(str_vals)
+            prep = {"kind": "str", "vals": str_vals, "valid": valid,
+                    "keys": dictionary}
+        prep["dict"] = dictionary
+        prep["bounds"] = (0, len(dictionary) - 1) if len(dictionary) else None
+        return prep
+    if vals.dtype == np.dtype(np.float64):
+        from tidb_tpu.ops.jax_env import device_float_dtype
+        return {"kind": "float", "vals": vals, "valid": valid,
+                "dtype": np.dtype(device_float_dtype()),
+                "dict": None, "bounds": None}
+    return {"kind": "num", "vals": vals, "valid": valid,
+            "dict": None, "bounds": _col_bounds(vals, valid, None)}
+
+
+def _slab_host(prep: dict, start: int, stop: int, slab_cap: int):
+    """Encode + pad ONE slab of a prepped column → (host vals, host mask)."""
+    n = stop - start
+    valid = prep["valid"][start:stop]
+    kind = prep["kind"]
+    if kind == "wide":
+        v = wide_decimal_limbs(prep["vals"][start:stop], prep["n_limbs"])
+        if n < slab_cap:
+            pv = np.zeros((v.shape[0], slab_cap), dtype=np.int64)
+            pv[:, :n] = v
+            v = pv
+    else:
+        if kind == "str":
+            v = np.searchsorted(prep["keys"],
+                                prep["vals"][start:stop]).astype(np.int32)
+        elif kind == "float":
+            v = prep["vals"][start:stop].astype(prep["dtype"])
+        else:
+            v = prep["vals"][start:stop]
+        if n < slab_cap:
+            pv = np.zeros(slab_cap, dtype=v.dtype)
+            pv[:n] = v
+            v = pv
+    m = valid
+    if n < slab_cap:
+        pm = np.zeros(slab_cap, dtype=bool)
+        pm[:n] = m
+        m = pm
+    return v, m
+
+
+def _stream_slabs(ctx, ent: CachedTable, key, used_cols, preps, phases):
+    """Generator behind open_table: per slab, encode the missing columns
+    (host), issue their uploads (async device_put), and yield
+    (slab_idx, {col: (vals, valid)}) covering EVERY used column so the
+    caller can dispatch that slab's compute before the next encode —
+    encode(k+1) ∥ upload(k) ∥ compute(k-1). Completed columns commit to
+    the cache entry only after the LAST slab: a stream abandoned by an
+    error or a CPU fallback never leaves a half-uploaded column behind."""
+    from tidb_tpu.ops.jax_env import jnp
+    new_slabs = {i: [] for i in preps}
     for s in range(ent.n_slabs):
         start = s * ent.slab_cap
         stop = min(start + ent.slab_cap, ent.total)
-        n = stop - start
-        v = vals[start:stop]
-        m = valid[start:stop]
-        if n < ent.slab_cap:
-            pv = np.zeros(ent.slab_cap, dtype=v.dtype)
-            pv[:n] = v
-            pm = np.zeros(ent.slab_cap, dtype=bool)
-            pm[:n] = m
-            v, m = pv, pm
-        slabs.append((jnp.asarray(v), jnp.asarray(m)))
-    ent.dev[col_idx] = slabs
+        host = {}
+        with phases.phase("encode"):
+            for i, prep in preps.items():
+                host[i] = _slab_host(prep, start, stop, ent.slab_cap)
+        with phases.phase("upload"):
+            for i, (hv, hm) in host.items():
+                new_slabs[i].append((jnp.asarray(hv), jnp.asarray(hm)))
+        phases.mark_in_flight()
+        cols = {i: (new_slabs[i][s] if i in new_slabs else ent.dev[i][s])
+                for i in used_cols}
+        yield s, cols
+    for i, slabs in new_slabs.items():
+        ent.dev[i] = slabs
+    phases.clear_in_flight()
+    if key is not None:
+        budget = int(ctx.vars.get("tidb_tpu_hbm_budget",
+                                  DEFAULT_HBM_BUDGET_BYTES))
+        _evict_to_budget(budget, keep=key, keep_tables=_protected(ctx))
 
 
-def get_table(ctx, scan, used_cols, max_slab: int) -> CachedTable:
-    """→ CachedTable with every column in `used_cols` uploaded.
+def _protected(ctx) -> frozenset:
+    """(store_id, table_id) pairs the in-flight statement still needs —
+    set by multi-scan executors so a mid-query budget eviction (which now
+    DELETES buffers) can't free a sibling scan's arrays."""
+    return getattr(ctx, "_device_cache_protect", frozenset())
+
+
+def open_table(ctx, scan, used_cols, max_slab: int, phases=None):
+    """→ (CachedTable, slab stream or None) — the streamed first-touch.
+
+    Warm path (every used column already resident) returns stream=None.
+    Cold/partial first touch returns a generator yielding
+    (slab_idx, {col: (vals, valid)}) per slab; driving per-slab compute
+    between yields pipelines host encode behind device transfers. The
+    column dictionaries and bounds ARE committed eagerly (program
+    construction needs key bounds before the first slab runs); the device
+    arrays commit only when the stream completes.
 
     Cacheable only for snapshot reads (ctx.txn is None); transaction reads
     build a transient entry so staged rows are visible without poisoning
     the shared cache.
     """
+    from tidb_tpu.util import failpoint
+    from tidb_tpu.util.phases import PhaseTimer
     table_id = scan.table.id
     cacheable = getattr(ctx, "txn", None) is None
     td = ctx.snapshot.table_data(table_id) if cacheable else None
@@ -268,6 +374,7 @@ def get_table(ctx, scan, used_cols, max_slab: int) -> CachedTable:
                             or ent.n_cols != len(scan.schema)):
         # td identity = data freshness; n_cols = DDL (ADD/DROP COLUMN) guard
         _CACHE.pop(key, None)
+        ent.delete()
         ent = None
     if ent is None:
         parts, total = _collect_parts(ctx, scan)
@@ -278,21 +385,36 @@ def get_table(ctx, scan, used_cols, max_slab: int) -> CachedTable:
         if cacheable:
             _CACHE[key] = ent
             while len(_CACHE) > MAX_CACHED_TABLES:
-                _CACHE.popitem(last=False)
+                _CACHE.popitem(last=False)[1].delete()
     elif cacheable:
         _CACHE.move_to_end(key)
 
-    if ent.total:
-        ftypes = scan.schema.field_types
-        uploaded = False
-        for i in used_cols:
-            if i not in ent.dev:
-                _upload_col(ent, i, ftypes[i])
-                uploaded = True
-        if uploaded and cacheable:
-            budget = int(ctx.vars.get("tidb_tpu_hbm_budget",
-                                      DEFAULT_HBM_BUDGET_BYTES))
-            _evict_to_budget(budget, keep=key)
+    if not ent.total:
+        return ent, None
+    missing = [i for i in used_cols if i not in ent.dev]
+    if not missing:
+        return ent, None
+    failpoint.inject("device-transfer")
+    ph = phases if phases is not None else PhaseTimer()
+    ftypes = scan.schema.field_types
+    preps = {}
+    with ph.phase("encode"):
+        for i in missing:
+            preps[i] = _col_prep(ent, i, ftypes[i])
+            ent.dicts[i] = preps[i]["dict"]
+            ent.bounds[i] = preps[i]["bounds"]
+    return ent, _stream_slabs(ctx, ent, key, list(used_cols), preps, ph)
+
+
+def get_table(ctx, scan, used_cols, max_slab: int,
+              phases=None) -> CachedTable:
+    """→ CachedTable with every column in `used_cols` uploaded (open_table
+    drained — callers that can't interleave compute, e.g. the join-tree
+    path, still get the per-slab encode∥upload pipelining)."""
+    ent, stream = open_table(ctx, scan, used_cols, max_slab, phases=phases)
+    if stream is not None:
+        for _ in stream:
+            pass
     return ent
 
 
@@ -307,7 +429,9 @@ def _evict_to_budget(budget: int, keep, keep_aligned=frozenset(),
         victim = next((k for k in _ALIGNED if k not in keep_aligned), None)
         if victim is None:
             break
-        total -= _ALIGNED.pop(victim).hbm_bytes()
+        ent = _ALIGNED.pop(victim)
+        total -= ent.hbm_bytes()
+        _entry_delete(ent)
     while total > budget and len(_CACHE) > 1:
         # keep_tables holds (store_id, table_id) pairs; cache keys carry a
         # third partition element — match on the prefix, else partitioned
@@ -316,7 +440,9 @@ def _evict_to_budget(budget: int, keep, keep_aligned=frozenset(),
                        if k != keep and k[:2] not in keep_tables), None)
         if victim is None:
             return
-        total -= _CACHE.pop(victim).hbm_bytes()
+        ent = _CACHE.pop(victim)
+        total -= ent.hbm_bytes()
+        _entry_delete(ent)
 
 
 def aligned_budget_check(ctx, keep_keys=frozenset(),
@@ -388,6 +514,19 @@ class AlignedJoin:
                 total += v.nbytes + m.nbytes
         return total
 
+    def delete(self) -> None:
+        """Free device buffers on eviction (see CachedTable.delete)."""
+        for arrs in (self.matched, self.midx):
+            for a in arrs:
+                _delete_array(a)
+        for slabs in self.cols.values():
+            for v, m in slabs:
+                _delete_array(v)
+                _delete_array(m)
+        self.matched = []
+        self.midx = []
+        self.cols.clear()
+
 
 def _fresh(ctx, tds) -> bool:
     return all(ctx.snapshot.table_data(tid) is td for tid, td in tds.items())
@@ -429,6 +568,7 @@ def get_aligned(ctx, key, tds: Dict[int, object],
             _ALIGNED.move_to_end(key)
             return ent if ent.unique else None
         _ALIGNED.pop(key, None)
+        ent.delete()
 
     lo, hi = bounds
     domain = hi - lo + 1

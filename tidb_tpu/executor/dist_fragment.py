@@ -18,6 +18,13 @@ jitted shard_map program over a 1-D device mesh:
 
 XLA schedules the collectives and overlaps them with per-shard compute —
 the compiler replaces the reference's goroutine/gRPC exchange plumbing.
+
+Fault retries here stay FULL-STEP: the whole fragment is one shard_map
+program, so a shard fault (or capacity overflow) has no per-slab partial
+checkpoints to resume from — unlike the single-device agg path
+(fragment._execute_agg), which re-executes only the overflowed slabs.
+Per-shard re-dispatch would need device-to-host checkpointing of the
+healthy shards' partial states between steps (see ROADMAP).
 """
 
 from __future__ import annotations

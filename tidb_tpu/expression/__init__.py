@@ -648,19 +648,84 @@ def _floor_div_neg(xp, a, b):
     return a // b  # python/numpy floor-div already floors toward -inf
 
 
+def _half_away_div(xp, v, mul):
+    """Exact half-away-from-zero division of scaled ints by `mul` (the
+    MySQL decimal rounding rule, types/mydecimal.go Round)."""
+    half = mul // 2
+    return xp.where(v >= 0, (v + half) // mul, -((-v + half) // mul))
+
+
 @kernel("round")
 def _round(func, ctx):
     xp = ctx.xp
     v, m = func.args[0].eval(ctx)
     ft = func.args[0].ftype
+    d = _const_int(func.args[1]) if len(func.args) == 2 else None
     if ft.kind is TypeKind.DECIMAL:
-        mul = 10 ** ft.scale
-        half = mul // 2
-        q = xp.where(v >= 0, (v + half) // mul, -((-v + half) // mul))
-        return q, m
+        if len(func.args) == 1:
+            return _half_away_div(xp, v, 10 ** ft.scale), m
+        if d is not None:
+            # ROUND(dec, const d): exact scaled-int arithmetic. t is the
+            # kept digit position (may be negative); the result scale is
+            # max(t, 0) — infer_type computed the same, so func.ftype
+            # agrees with the value by construction.
+            t = min(int(d), ft.scale)
+            if t >= ft.scale:
+                return v, m
+            q = _half_away_div(xp, v, 10 ** (ft.scale - t))
+            if t < 0:
+                q = q * (10 ** (-t))
+            return q, m
+        # non-constant d: per-row, same clamp discipline as TRUNCATE
+        dv, dm = func.args[1].eval(ctx)
+        m = m & dm
+        s = ft.scale
+        dcl = xp.clip(dv.astype(xp.int64), -18, s)
+        e = xp.clip(s - dcl, 0, 18)
+        p = (10 ** e) if ctx.on_device else \
+            xp.asarray(10 ** e).astype(xp.int64)
+        # result keeps the input scale (infer_type): round at d digits,
+        # then scale back up
+        return _half_away_div(xp, v, p) * p, m
     if ft.kind.is_integer:
-        return v, m
+        if len(func.args) == 1:
+            return v, m
+        if d is not None:
+            if int(d) >= 0:
+                return v, m
+            mul = 10 ** min(-int(d), 18)
+            return _half_away_div(xp, v, mul) * mul, m
+        dv, dm = func.args[1].eval(ctx)
+        m = m & dm
+        e = xp.clip(-dv.astype(xp.int64), 0, 18)
+        p = (10 ** e) if ctx.on_device else \
+            xp.asarray(10 ** e).astype(xp.int64)
+        return _half_away_div(xp, v, p) * p, m
+    if len(func.args) == 2:
+        # ROUND(double, d) stays double (MySQL): half-away at d decimals
+        fdt = _xp_dtype(xp, T.double(), ctx.on_device) or np.float64
+        x = _to_float(xp, v, ft, fdt)
+        if d is not None:
+            p = float(10.0 ** int(d))
+            dm = None
+        else:
+            dv, dm = func.args[1].eval(ctx)
+            p = xp.power(xp.asarray(10.0, dtype=fdt), dv.astype(fdt))
+        q = xp.where(x >= 0, xp.floor(x * p + 0.5),
+                     xp.ceil(x * p - 0.5)) / p
+        return q, (m if dm is None else m & dm)
     return _round_half_away(xp, v), m
+
+
+def _const_int(e) -> "Optional[int]":
+    """Constant integer-ish expression value, else None."""
+    if isinstance(e, Constant) and e.value is not None \
+            and not isinstance(e.value, str):
+        try:
+            return int(e.value)
+        except (TypeError, ValueError):
+            return None
+    return None
 
 
 @kernel("sqrt")
@@ -2413,8 +2478,22 @@ def infer_type(op: str, args: Sequence[Expression]) -> FieldType:
     if op in ("abs",):
         return args[0].ftype
     if op in ("ceil", "floor", "round"):
-        if args[0].ftype.kind is TypeKind.DECIMAL:
-            return T.decimal(args[0].ftype.precision, 0, nullable)
+        ft0 = args[0].ftype
+        if op == "round" and len(args) == 2:
+            # ROUND(x, d) preserves decimal scale (ROADMAP: ROUND(1.005, 2)
+            # must be 1.01, exact half-away-from-zero — not integer 1)
+            if ft0.kind is TypeKind.DECIMAL:
+                d = _const_int(args[1])
+                if d is None:
+                    return ft0.with_nullable(nullable)
+                scale = max(0, min(int(d), ft0.scale))
+                return T.decimal(max(ft0.precision, scale + 1), scale,
+                                 nullable)
+            if ft0.kind.is_integer:
+                return T.bigint(nullable)
+            return T.double(nullable)
+        if ft0.kind is TypeKind.DECIMAL:
+            return T.decimal(ft0.precision, 0, nullable)
         return T.bigint(nullable)
     if op in ("sqrt", "pow", "exp", "ln", "log", "log2", "log10", "sin",
               "cos", "tan", "cot", "asin", "acos", "atan", "degrees",

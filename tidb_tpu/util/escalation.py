@@ -42,7 +42,8 @@ class EscalationStats:
     """Per-query escalation counters (the ladder's observability half)."""
 
     __slots__ = ("recompiles", "exact_resizes", "doublings", "mode_flips",
-                 "shard_retries", "fallbacks", "by_kind")
+                 "shard_retries", "fallbacks", "slabs_rerun", "slabs_reused",
+                 "by_kind")
 
     def __init__(self):
         self.recompiles = 0      # re-executions the ladder charged
@@ -51,6 +52,10 @@ class EscalationStats:
         self.mode_flips = 0      # join unique→expand re-traces
         self.shard_retries = 0   # whole-step retries after a shard fault
         self.fallbacks = 0       # rung 3: cap limit hit, CPU/host fallback
+        # resumable-escalation reuse counters: on a retry, how many slab
+        # partials were re-executed vs merged back in from the checkpoint
+        self.slabs_rerun = 0
+        self.slabs_reused = 0
         self.by_kind: Dict[str, int] = {}   # "exchange:exact" → count
 
     def note(self, kind: str, rung: str) -> None:
@@ -69,7 +74,8 @@ class EscalationStats:
             return ""
         parts = []
         for name in ("recompiles", "exact_resizes", "doublings",
-                     "mode_flips", "shard_retries", "fallbacks"):
+                     "mode_flips", "shard_retries", "fallbacks",
+                     "slabs_rerun", "slabs_reused"):
             v = getattr(self, name)
             if v:
                 parts.append(f"{name}={v}")
@@ -130,6 +136,17 @@ class CapacityLadder:
         if max_cap is not None:
             new = min(new, int(max_cap))
         return new
+
+    def partial_resume(self, kind: str, rerun: int, reused: int) -> None:
+        """Record a resumable retry's reuse split: `rerun` slab partials
+        re-executed after the recompile, `reused` checkpointed partials
+        merged back in untouched. Only the re-run slabs cost device time,
+        so the retry's backoff charge already reflects one recompile —
+        these counters make the saved work observable."""
+        self.stats.slabs_rerun += int(rerun)
+        self.stats.slabs_reused += int(reused)
+        if reused:
+            self.stats.note(kind, "partial-reuse")
 
     def flip(self, kind: str = "join") -> None:
         """A mode flip re-trace (join unique→expand bet lost)."""

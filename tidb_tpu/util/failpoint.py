@@ -68,7 +68,7 @@ for _site, _desc in (
     ("device-recompile", "group-cap overflow recompile retry "
                          "(executor/fragment.py)"),
     ("device-transfer", "HBM column upload (executor/device_cache.py "
-                        "_upload_col)"),
+                        "open_table streamed first-touch)"),
     ("host-fetch", "device→host result fetch after a fragment runs "
                    "(executor/fragment.py next)"),
     ("scan-next", "per-chunk boundary of the CPU table scan "
