@@ -403,6 +403,14 @@ def main():
                   "cpu_rows_per_sec": round(n_rows / cpu_t, 1),
                   "q1_device_exec_s": round(dev_exec, 3),
                   "q1_vs_roofline": round(roofline_s / dev_t, 3)})
+    # shard-recovery accounting (util/escalation.py): on a healthy run
+    # all three stay 0 — nonzero values flag that the timing above
+    # includes rank re-execution or a degraded mesh
+    esc = s.last_guard.escalation if s.last_guard is not None else None
+    if esc is not None:
+        extra.update({"q1_shards_rerun": esc.shards_rerun,
+                      "q1_shards_reused": esc.shards_reused,
+                      "q1_degraded_mesh": esc.degraded_mesh})
     HEADLINE["value"] = n_rows / dev_t
     HEADLINE["vs"] = cpu_t / dev_t
 

@@ -331,16 +331,17 @@ def test_set_global_persists_via_backup(tmp_path):
 
 def test_show_grants_requires_privilege():
     import pytest
+    from tidb_tpu.errors import SpecificAccessDeniedError
     from tidb_tpu.session import Engine
-    from tidb_tpu.session.auth import PrivilegeError
     eng = Engine()
     s = eng.new_session()
     s.execute("CREATE USER bob IDENTIFIED BY 'x'")
     s2 = eng.new_session()
     s2.user = "bob"
     s2.query("SHOW GRANTS")                 # own grants: fine
-    with pytest.raises(PrivilegeError):
+    with pytest.raises(SpecificAccessDeniedError) as ei:
         s2.query("SHOW GRANTS FOR root")    # other users: SUPER only
+    assert ei.value.code == 1227
 
 
 def test_regexp_rlike():

@@ -111,6 +111,66 @@ def test_show_processlist_lists_this_connection(session):
     assert any(str(session.conn_id) == str(r[0]) for r in rows), rows
 
 
+# ---- PROCESS / SUPER privileges --------------------------------------------
+
+def test_kill_other_user_without_super_is_1095(eng):
+    """MySQL's error split: unknown thread → 1094; thread exists but is
+    someone else's and the killer lacks SUPER → 1095; with a global
+    SUPER grant the kill goes through."""
+    from tidb_tpu.errors import KillDeniedError
+    root_s = eng.new_session()
+    root_s.execute("CREATE USER IF NOT EXISTS killer IDENTIFIED BY 'x'")
+    s_eve = eng.new_session()
+    s_eve.user = "killer"
+    # unknown id stays strictly 1094 — even for an unprivileged user
+    with pytest.raises(NoSuchThreadError) as ei:
+        s_eve.execute("KILL QUERY 99999999")
+    assert ei.value.code == 1094
+    # root's live thread: exists, not yours, no SUPER → 1095
+    with pytest.raises(KillDeniedError) as ei:
+        s_eve.execute(f"KILL QUERY {root_s.conn_id}")
+    assert ei.value.code == 1095
+    assert str(root_s.conn_id) in str(ei.value)
+    # ...and the target was NOT killed
+    assert root_s.query("SELECT 1 + 1").scalar() == 2
+    # SUPER must be a *.* grant; a db-scoped one must not escalate
+    root_s.execute("GRANT SUPER ON test.* TO killer")
+    with pytest.raises(KillDeniedError):
+        s_eve.execute(f"KILL QUERY {root_s.conn_id}")
+    root_s.execute("GRANT SUPER ON *.* TO killer")
+    s_eve.execute(f"KILL QUERY {root_s.conn_id}")   # idle target: no-op
+    assert root_s.query("SELECT 1 + 1").scalar() == 2
+    root_s.execute("DROP USER killer")
+
+
+def test_processlist_requires_process_priv_to_see_others(eng):
+    """Without the global PROCESS privilege SHOW PROCESSLIST (and
+    information_schema.processlist) lists only the caller's own
+    threads (sql/sql_show.cc mysqld_list_processes)."""
+    root_s = eng.new_session()
+    root_s.execute("CREATE USER IF NOT EXISTS watcher IDENTIFIED BY 'x'")
+    root_s.execute("GRANT SELECT ON *.* TO watcher")
+    s_w = eng.new_session()
+    s_w.user = "watcher"
+
+    def visible(sess):
+        return {int(r[0]) for r in sess.query("SHOW PROCESSLIST").rows}
+
+    assert root_s.conn_id not in visible(s_w)
+    assert s_w.conn_id in visible(s_w)
+    ids = {int(r[0]) for r in s_w.query(
+        "SELECT ID FROM information_schema.processlist").rows}
+    assert root_s.conn_id not in ids and s_w.conn_id in ids
+    # root (ALL on *.*) sees everyone
+    assert {root_s.conn_id, s_w.conn_id} <= visible(root_s)
+    # a db-scoped PROCESS grant must not unlock the global view
+    root_s.execute("GRANT PROCESS ON test.* TO watcher")
+    assert root_s.conn_id not in visible(s_w)
+    root_s.execute("GRANT PROCESS ON *.* TO watcher")
+    assert {root_s.conn_id, s_w.conn_id} <= visible(s_w)
+    root_s.execute("DROP USER watcher")
+
+
 # ---- statement timeout -----------------------------------------------------
 
 def test_max_execution_time_interrupts_multichunk_scan(session):

@@ -171,28 +171,122 @@ def dist_session(eight_devices):
 DIST_SQL = "select g, count(*), sum(v) from mc group by g order by g"
 
 
+ORACLE = [(i, 800, sum(j % 101 for j in range(i, 4000, 5)))
+          for i in range(5)]
+
+
+def _rows(rs):
+    return [tuple(int(x) for x in r) for r in rs.rows]
+
+
 def test_shard_fault_heals_with_one_retry(dist_session):
+    # transient fault on ONE rank's dispatch: the staged path re-executes
+    # only that rank (same device), reusing the other ranks' checkpoints
     s = dist_session
-    oracle = [(i, 800, sum(j % 101 for j in range(i, 4000, 5)))
-              for i in range(5)]
     with failpoint.enabled("shard-step",
                            raise_=ShardFailure("chaos: shard 2 down"),
                            after_hits=2, times=1):
-        rows = s.query(DIST_SQL).rows
-    assert [tuple(int(x) for x in r) for r in rows] == oracle
-    # the recovery is visible: one whole-step retry, charged to the ladder
-    assert s.last_guard.escalation.shard_retries == 1
+        rows = _rows(s.query(DIST_SQL))
+    assert rows == ORACLE                     # byte-exact, not approximate
+    esc = s.last_guard.escalation
+    assert esc.shard_retries == 1             # one same-device retry
+    assert esc.shards_rerun == 1              # exactly the failed rank
+    assert esc.shards_reused == 3             # N-1 checkpoints reused
+    assert esc.degraded_mesh == 0             # never left the full mesh
+    assert "shard:partial-reuse" in esc.summary()
 
 
-def test_persistent_shard_fault_is_one_typed_error(dist_session):
+def test_checkpoint_write_fault_heals(dist_session):
+    # the device→host checkpoint itself is a fault domain: losing one
+    # rank's checkpoint re-runs only that rank
+    s = dist_session
+    with failpoint.enabled("shard-checkpoint-write",
+                           raise_=ShardFailure("chaos: checkpoint lost"),
+                           times=1):
+        rows = _rows(s.query(DIST_SQL))
+    assert rows == ORACLE
+    esc = s.last_guard.escalation
+    assert esc.shards_rerun == 1 and esc.shards_reused == 3
+
+
+def test_persistent_device_fault_degrades_mesh(dist_session):
+    # one rank's device fails dispatch AND the same-device retry: the
+    # rank's work re-dispatches onto a surviving device (degraded mesh),
+    # the query completes byte-exactly, and a retryable warning is left
+    # for SHOW WARNINGS
     s = dist_session
     with failpoint.enabled("shard-step",
-                           raise_=ShardFailure("chaos: shard down")):
-        with pytest.raises(ShardFailure) as ei:
-            s.query(DIST_SQL)
+                           raise_=ShardFailure("chaos: device 2 bad"),
+                           after_hits=2, times=2):
+        rows = _rows(s.query(DIST_SQL))
+    assert rows == ORACLE
+    esc = s.last_guard.escalation
+    assert esc.degraded_mesh == 1
+    assert esc.shards_rerun == 1 and esc.shards_reused == 3
+    assert "shard:redispatch" in esc.summary()
+    warns = s.query("SHOW WARNINGS").rows
+    assert len(warns) == 1, warns
+    level, code, msg = warns[0]
+    assert level == "Warning" and int(code) == ShardFailure.code
+    assert "degraded mesh" in msg and "re-dispatched" in msg
+    # the diagnostics area resets on the next ordinary statement
+    assert s.query("select 1 + 1").scalar() == 2
+    assert s.query("SHOW WARNINGS").rows == []
+
+
+def test_fully_dead_shard_is_one_typed_error(dist_session):
+    # the rank fails on its own device AND on re-dispatch to a surviving
+    # device: the ladder is exhausted — ONE typed retryable ShardFailure,
+    # never a truncated result — and the session/store stay usable
+    s = dist_session
+    with failpoint.enabled("shard-step",
+                           raise_=ShardFailure("chaos: device down"),
+                           after_hits=2):
+        with failpoint.enabled("shard-redispatch",
+                               raise_=ShardFailure("chaos: spare down")):
+            with pytest.raises(ShardFailure) as ei:
+                s.query(DIST_SQL)
     assert ei.value.code == 1105
-    assert "twice" in str(ei.value)
+    assert ei.value.retryable
+    assert "re-dispatch" in str(ei.value)
     # the store and the session survived: same statement now answers
-    rows = s.query(DIST_SQL).rows
-    assert [int(r[1]) for r in rows] == [800] * 5
+    assert _rows(s.query(DIST_SQL)) == ORACLE
     assert s.query("select count(*) from mc").scalar() == 4000
+
+
+def test_staged_matches_monolithic_bytes(dist_session):
+    # same SQL through both distributed paths: the staged (checkpointed)
+    # aggregation must be byte-identical to the monolithic shard_map run
+    s = dist_session
+    staged = _rows(s.query(DIST_SQL))
+    s.vars["tidb_tpu_dist_staged"] = "off"
+    try:
+        mono = _rows(s.query(DIST_SQL))
+    finally:
+        s.vars["tidb_tpu_dist_staged"] = "on"
+    assert staged == mono == ORACLE
+
+
+def test_skewed_keys_survive_shard_fault_byte_exact(dist_session):
+    # adversarial skew: ~90% of rows share one key, so one rank owns a
+    # giant group while others are sparse — a mid-mesh fault must still
+    # reproduce the oracle byte-exactly
+    s = dist_session
+    s.execute("create table ms (k bigint, v bigint)")
+    vals = [(7 if i % 10 else 700 + i, i % 13) for i in range(2000)]
+    s.execute("insert into ms values " +
+              ", ".join(f"({k}, {v})" for k, v in vals))
+    s.execute("analyze table ms")
+    oracle = {}
+    for k, v in vals:
+        c, t = oracle.get(k, (0, 0))
+        oracle[k] = (c + 1, t + v)
+    expect = [(k, c, t) for k, (c, t) in sorted(oracle.items())]
+    sql = "select k, count(*), sum(v) from ms group by k order by k"
+    with failpoint.enabled("shard-step",
+                           raise_=ShardFailure("chaos: shard down"),
+                           after_hits=1, times=2):
+        rows = _rows(s.query(sql))
+    assert rows == expect
+    esc = s.last_guard.escalation
+    assert esc.shards_rerun >= 1

@@ -74,6 +74,20 @@ class NoSuchThreadError(TiDBTPUError):
     code = 1094  # ER_NO_SUCH_THREAD
 
 
+class KillDeniedError(TiDBTPUError):
+    """KILL target exists but belongs to another user and the killer
+    lacks SUPER (MySQL: you need SUPER to kill other users' threads)."""
+
+    code = 1095  # ER_KILL_DENIED_ERROR
+
+
+class SpecificAccessDeniedError(TiDBTPUError):
+    """A statement needs a specific global privilege (PROCESS, SUPER)
+    the current user was not granted."""
+
+    code = 1227  # ER_SPECIFIC_ACCESS_DENIED_ERROR
+
+
 class BackoffExhausted(TiDBTPUError):
     """Retry budget spent without success (ref: tikv/client-go
     retry.BackOffer's errors.New("backoffer.maxSleep exceeded"))."""
@@ -91,9 +105,10 @@ class CapacityError(ExecutionError):
 
 class ShardFailure(ExecutionError):
     """One shard's step of a distributed fragment failed (injected fault
-    or a real device/runtime error). The executor retries the whole step
-    once through the escalation ladder; a second failure surfaces as this
-    one typed error."""
+    or a real device/runtime error) and the per-shard recovery ladder —
+    retry on the same device, then re-dispatch onto a surviving device
+    (degraded mesh) — is exhausted. Surfaces as this one typed retryable
+    error; the session and store stay fully usable."""
 
     code = 1105
     retryable = True

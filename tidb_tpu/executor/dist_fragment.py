@@ -19,12 +19,22 @@ jitted shard_map program over a 1-D device mesh:
 XLA schedules the collectives and overlaps them with per-shard compute —
 the compiler replaces the reference's goroutine/gRPC exchange plumbing.
 
-Fault retries here stay FULL-STEP: the whole fragment is one shard_map
-program, so a shard fault (or capacity overflow) has no per-slab partial
-checkpoints to resume from — unlike the single-device agg path
-(fragment._execute_agg), which re-executes only the overflowed slabs.
-Per-shard re-dispatch would need device-to-host checkpointing of the
-healthy shards' partial states between steps (see ROADMAP).
+Fault recovery comes in two grades:
+
+  * Exchange-free agg fragments (a plain group-by — the only collective
+    is the final gather_partials) run STAGED via StagedDistAgg below:
+    each rank's local partial aggregation is dispatched as its own
+    single-device program, its result checkpointed device→host, and the
+    final merge happens host-side over the checkpoints. A shard fault
+    re-executes ONLY the failed rank — once on its own device, then
+    re-dispatched onto a surviving device (degraded-mesh mode, recorded
+    as a retryable session warning) before one typed ShardFailure ends
+    the ladder. Healthy ranks' checkpoints are never recomputed
+    (EscalationStats shards_rerun/shards_reused).
+  * Exchange-carrying fragments (joins, DISTINCT re-keys, windows) stay
+    one monolithic shard_map program, so their fault retry remains
+    full-step: collectives entangle every rank's state, and there is no
+    per-rank cut at which a host checkpoint is consistent.
 """
 
 from __future__ import annotations
@@ -257,6 +267,191 @@ class DistTreeProgram(TreeProgram):
         return {"cols": [(jnp.asarray(v), jnp.asarray(m))
                          for v, m in cols[:len(root.schema)]],
                 "live": live, "_gneed_local": jnp.int32(0)}
+
+
+class StagedDistAgg:
+    """Checkpointable staged execution of an exchange-free distributed
+    agg fragment (the distributed half of fragment._execute_agg's
+    resumable-escalation story).
+
+    Stages: per-rank local partial aggregation (one single-device
+    program per rank, pinned by committed `jax.device_put` transfers) →
+    device-to-host checkpoint of each rank's packed (keys, states)
+    partials → host-side final merge (fragment._merge_tree_agg_passes).
+    The host slices in `rank_cols` are the recovery source of truth: on
+    a shard fault only the failed rank's slice is re-uploaded and re-run
+
+      1. once more on its own device          (ladder.shard_retry), then
+      2. onto a surviving device — degraded-mesh mode
+         (ladder.redispatch, a retryable session warning), then
+      3. one typed retryable ShardFailure; the session stays usable.
+
+    Healthy ranks' checkpoints are reused untouched (shards_reused); a
+    per-rank group-cap overflow re-runs only the overflowed ranks at the
+    exact-need cap, like the single-device slab ladder. Every re-run is
+    charged to the shared backoff budget, and every abandoned device
+    buffer of a failed attempt is `jax.Array.delete()`d before the next
+    dispatch so recovery never doubles HBM residency."""
+
+    def __init__(self, root, chain, mesh, rank_cols, rank_rows, dicts,
+                 used_cols, in_types, slab_cap: int, group_cap: int,
+                 cap_limit: int, ctx, ladder):
+        self.root = root
+        self.chain = chain
+        self.devices = list(mesh.devices.flat)
+        self.nd = len(self.devices)
+        self.rank_cols = rank_cols    # rank → {col: (np vals, np valid)}
+        self.rank_rows = rank_rows    # (nd,) int32 true per-rank rows
+        self.dicts = dicts            # col → dictionary (collect_preps)
+        self.used_cols = used_cols
+        self.in_types = in_types
+        self.slab_cap = slab_cap
+        self.group_cap = group_cap
+        self.cap_limit = cap_limit
+        self.ctx = ctx
+        self.ladder = ladder
+
+    def execute(self) -> List[dict]:
+        """→ per-rank host checkpoints in rank order, each a pass_out
+        {"ng", "keys", "states"} ready for _merge_tree_agg_passes."""
+        from tidb_tpu.executor.fragment import (FragmentFallback,
+                                                _GroupCapOverflow,
+                                                get_program)
+        ckpts: List[Optional[dict]] = [None] * self.nd
+        ng_true = [0] * self.nd
+        caps_ran = [0] * self.nd
+        to_run = list(range(self.nd))
+        while True:
+            # between dispatch rounds is a guard checkpoint: a killed
+            # query must not queue another per-rank compile
+            self.ctx.check_killed("device-dispatch")
+            prog = get_program(self.chain, self.used_cols, self.in_types,
+                               self.slab_cap, self.group_cap)
+            prep_vals = prog.collect_preps(self.dicts)
+            for r in to_run:
+                ckpts[r], ng_true[r] = self._run_rank(r, prog, prep_vals)
+                caps_ran[r] = self.group_cap
+            # overflow iff a rank's TRUE group count exceeded the cap IT
+            # ran at (factorize counts before clamping); there is no
+            # merged-count rung — the final merge is host-side, uncapped
+            over = [r for r in range(self.nd) if ng_true[r] > caps_ran[r]]
+            if not over:
+                return ckpts
+            if self.group_cap >= self.cap_limit:
+                self.ladder.fallback("group")
+                raise FragmentFallback("group cap overflow")
+            need = max(ng_true[r] for r in over)
+            self.group_cap = self.ladder.resize(
+                "group", self.group_cap, need=need, max_cap=self.cap_limit)
+            self.ladder.attempt("group", _GroupCapOverflow(need))
+            self.ladder.partial_resume("group", rerun=len(over),
+                                       reused=self.nd - len(over))
+            to_run = over
+
+    @staticmethod
+    def _is_shard_fault(e: BaseException) -> bool:
+        from tidb_tpu.errors import ShardFailure
+        return isinstance(e, ShardFailure) or \
+            type(e).__name__ == "XlaRuntimeError"
+
+    def _run_rank(self, r: int, prog, prep_vals):
+        """One rank's local work through the per-shard recovery ladder."""
+        from tidb_tpu.errors import ShardFailure
+        from tidb_tpu.util import failpoint
+        try:
+            return self._attempt(r, self.devices[r], prog, prep_vals,
+                                 site="shard-step")
+        except Exception as e1:
+            if not self._is_shard_fault(e1):
+                raise
+            # rung 1: retry on the rank's own device. Healthy ranks'
+            # checkpoints are untouched — only this rank re-runs.
+            self.ctx.check_killed("shard-retry")
+            self.ladder.shard_retry(e1)
+            try:
+                out = self._attempt(r, self.devices[r], prog, prep_vals,
+                                    site="shard-step")
+            except Exception as e2:
+                if not self._is_shard_fault(e2):
+                    raise
+                # rung 2: the device is persistently bad — degraded-mesh
+                # mode: re-plan this rank's slice onto a surviving device
+                # (the re-dispatch recompile is charged to the budget)
+                failpoint.inject("degraded-mesh-replan")
+                self.ctx.check_killed("shard-redispatch")
+                self.ladder.redispatch(e2)
+                spare = self.devices[(r + 1) % self.nd]
+                try:
+                    out = self._attempt(r, spare, prog, prep_vals,
+                                        site="shard-redispatch")
+                except Exception as e3:
+                    if not self._is_shard_fault(e3):
+                        raise
+                    # ladder exhausted: ONE typed retryable error — the
+                    # store and session stay fully usable
+                    raise ShardFailure(
+                        f"shard {r} failed on its device and on "
+                        f"re-dispatch to a surviving device: {e3}") from e3
+                self._warn_degraded(r, e2)
+            self.ladder.shard_resume(rerun=1, reused=self.nd - 1)
+            return out
+
+    def _attempt(self, r: int, dev, prog, prep_vals, site: str):
+        """Upload rank r's host slice onto `dev`, run the partial there,
+        fetch its checkpoint → ({"ng", "keys", "states"}, true_count)."""
+        from tidb_tpu.executor.fragment import _tree_delete
+        from tidb_tpu.ops.jax_env import jax, jnp
+        from tidb_tpu.util import failpoint
+        ph = self.ctx.phases
+        dcols = None
+        out = None
+        try:
+            failpoint.inject(site)
+            with ph.phase("upload"):
+                # committed transfers pin the jitted partial to `dev` —
+                # this is how one rank's program lands on one device (and
+                # how a re-dispatch lands on a DIFFERENT one)
+                dcols = {i: (jax.device_put(self.rank_cols[r][i][0], dev),
+                             jax.device_put(self.rank_cols[r][i][1], dev))
+                         for i in prog.used_cols}
+            with ph.phase("compute"):
+                out = prog.partial(dcols,
+                                   jnp.int32(int(self.rank_rows[r])),
+                                   prep_vals)
+                jax.block_until_ready(out)
+            failpoint.inject("shard-checkpoint-write")
+            with ph.phase("fetch"):
+                ngt = int(np.asarray(jax.device_get(out["n_groups"])))
+                live_n = ngt if self.root.group_exprs else 1
+                # factorize packs live groups into slots 0..ng-1, so the
+                # checkpoint is the sliced prefix — exactly a pass_out
+                k = min(live_n, prog.group_cap)
+                got = jax.device_get(
+                    {"keys": [(v[:k], m[:k]) for v, m in out["keys"]],
+                     "states": [tuple(a[:k] for a in st)
+                                for st in out["states"]]})
+            return ({"ng": k, "keys": got["keys"],
+                     "states": got["states"]}, ngt)
+        finally:
+            # eager-delete discipline: free the rank's device buffers —
+            # on success the host checkpoint is now authoritative, on a
+            # fault the abandoned buffers must be gone BEFORE the retry /
+            # re-dispatch uploads its generation (never 2× HBM residency)
+            _tree_delete(dcols)
+            _tree_delete(out)
+
+    def _warn_degraded(self, r: int, err: BaseException) -> None:
+        """Degraded-mesh completion is a typed, retryable warning on the
+        statement guard (surfaced by SHOW WARNINGS), NOT an error — the
+        result is complete and exact; only the mesh shrank."""
+        from tidb_tpu.errors import ShardFailure
+        guard = getattr(self.ctx, "guard", None)
+        if guard is not None and hasattr(guard, "warnings"):
+            guard.warnings.append(
+                ("Warning", ShardFailure.code,
+                 f"shard {r} persistently failed and was re-dispatched "
+                 f"onto a surviving device (degraded mesh, retryable): "
+                 f"{err}"))
 
 
 def unify_string_join_dicts(root: PhysicalPlan, host_cols) -> None:

@@ -1458,6 +1458,79 @@ class TpuFragmentExec:
         return self._agg_chunk(root, out, inp_dicts, max(n_final, 1))
 
     # ---- distributed (multi-shard) pipeline --------------------------------
+    @staticmethod
+    def _staged_dist_chain(root) -> Optional[List[PhysicalPlan]]:
+        """Root→scan chain when this dist fragment is eligible for the
+        staged checkpointable path: an agg root over an exchange-free
+        Scan/Selection/Projection chain (a PhysExchange anywhere breaks
+        _linearize), no DISTINCT aggs (per-rank dedup cannot merge
+        without key co-location), and every stage device-capable for the
+        single-device chain program."""
+        if not isinstance(root, PhysHashAgg):
+            return None
+        if any(d.distinct and d.args for d in root.aggs):
+            return None
+        chain = _linearize(root)
+        if chain is None or not _fragment_ok(root, 0):
+            return None
+        return chain
+
+    def _run_dist_agg_staged(self, root, mesh, host_cols,
+                             scan_meta) -> Optional[Chunk]:
+        """Staged checkpointable dist agg (dist_fragment.StagedDistAgg):
+        per-rank partials → host checkpoints → host merge. Returns None
+        when the fragment is not eligible — the caller falls through to
+        the monolithic shard_map program."""
+        chain = self._staged_dist_chain(root)
+        if chain is None or len(scan_meta) != 1:
+            return None
+        from tidb_tpu.executor import tree_fragment as TF
+        from tidb_tpu.executor.device_cache import _pow2
+        from tidb_tpu.executor.dist_fragment import StagedDistAgg
+        from tidb_tpu.util.escalation import CapacityLadder
+        scan, used_enc, total = scan_meta[0]
+        used_cols = _used_column_indices(chain)
+        if not set(used_cols) <= set(used_enc):
+            return None
+        nd = mesh.devices.size
+        cap = _pow2((total + nd - 1) // nd, lo=8)
+        # per-rank host slices — the checkpoint story's source of truth:
+        # a retry or re-dispatch re-uploads ONLY its rank's slice
+        rank_cols = []
+        for r in range(nd):
+            lo = r * cap
+            cols = {}
+            for i in used_cols:
+                vals, valid, _d = host_cols[(id(scan), i)]
+                pv = np.zeros(cap, dtype=vals.dtype)
+                pm = np.zeros(cap, dtype=bool)
+                seg = vals[lo:lo + cap]
+                pv[:seg.shape[0]] = seg
+                segm = valid[lo:lo + cap]
+                pm[:segm.shape[0]] = segm
+                cols[i] = (pv, pm)
+            rank_cols.append(cols)
+        rank_rows = np.clip(total - np.arange(nd) * cap, 0,
+                            cap).astype(np.int32)
+        dicts = {i: host_cols[(id(scan), i)][2] for i in used_cols}
+        in_types = [scan.schema.field_types[i] for i in used_cols]
+        vars_ = self.ctx.vars
+        group_cap = int(vars_.get("tidb_tpu_group_cap",
+                                  DEFAULT_GROUP_CAP))
+        cap_limit = cap * nd
+        gcap = _initial_group_cap(root, group_cap, cap_limit)
+        ladder = CapacityLadder(guard=getattr(self.ctx, "guard", None),
+                                stats=self.ctx.escalation)
+        runner = StagedDistAgg(root, chain, mesh, rank_cols, rank_rows,
+                               dicts, used_cols, in_types, cap, gcap,
+                               cap_limit, self.ctx, ladder)
+        pass_outs = runner.execute()
+        flows, _root_dicts = TF.dictionary_flows(root, {id(scan): dicts})
+        inp_dicts = {i: d for i, d in
+                     enumerate(flows.get(id(root), []))}
+        with self.ctx.phases.phase("decode"):
+            return self._merge_tree_agg_passes(root, pass_outs, inp_dicts)
+
     def _run_device_dist(self) -> Chunk:
         """Planner-fragmented tree as one shard_map program over the mesh
         (executor/dist_fragment.py; the MPPGather role of
@@ -1510,6 +1583,17 @@ class TpuFragmentExec:
         # equal strings hash equal on every shard (dist_fragment doc)
         from tidb_tpu.executor.dist_fragment import unify_string_join_dicts
         unify_string_join_dicts(root, host_cols)
+        # staged checkpointable path: an exchange-free agg chain runs as
+        # per-rank single-device partials with device→host checkpoints —
+        # a shard fault re-executes ONLY the failed rank (StagedDistAgg's
+        # retry → re-dispatch → degraded-mesh ladder). Exchange-carrying
+        # plans (joins, DISTINCT re-keys, windows) keep the monolithic
+        # shard_map program below, where fault retry stays full-step.
+        if _var_bool(self.ctx.vars.get("tidb_tpu_dist_staged", "on")):
+            staged = self._run_dist_agg_staged(root, mesh, host_cols,
+                                               scan_meta)
+            if staged is not None:
+                return staged
         from tidb_tpu.executor.device_cache import _col_bounds
         for scan, used, total in scan_meta:
             cap = _pow2((total + nd - 1) // nd, lo=8)

@@ -120,17 +120,22 @@ def _session_variables(session):
                           ("ESCALATIONS", T.varchar())])
 def _processlist(session):
     # same source as SHOW PROCESSLIST: every live connection (idle ones
-    # included), each with ITS OWN user — not the querying session's.
+    # included), each with ITS OWN user — not the querying session's —
+    # and, like SHOW PROCESSLIST, only the caller's own threads unless
+    # they hold the global PROCESS privilege.
     # ESCALATIONS is the running statement's capacity-ladder summary
-    # (util/escalation.py): recompiles, exact resizes, shard retries —
-    # live observability for "why is this query recompiling"
+    # (util/escalation.py): recompiles, exact resizes, shard retries,
+    # degraded-mesh re-dispatches — live observability for "why is this
+    # query recompiling"
     from tidb_tpu.util.guard import PROCESS_REGISTRY
+    see_all = session.engine.auth.has_global(session.user, "PROCESS")
     return sorted(
         (cid, user or "",
          round(guard.elapsed(), 3) if guard is not None else 0.0,
          guard.sql if guard is not None else None,
          guard.escalation.summary() if guard is not None else "")
-        for cid, user, guard, _killed in PROCESS_REGISTRY.snapshot())
+        for cid, user, guard, _killed in PROCESS_REGISTRY.snapshot()
+        if see_all or user in (None, session.user))
 
 
 @register("table_storage_stats", [("TABLE_NAME", T.varchar()),

@@ -315,6 +315,10 @@ class Parser:
             elif self.at_kw("select", "insert", "update", "delete",
                             "create", "drop", "alter", "index"):
                 privs.append(self.advance().value.upper())
+            elif self.at("ident") and \
+                    str(self.cur.value).lower() in ("process", "super"):
+                # global admin privileges (not reserved words in MySQL)
+                privs.append(str(self.advance().value).upper())
             else:
                 raise ParseError(f"expected privilege near {self._near()}")
             if not self.try_op(","):
@@ -1020,6 +1024,9 @@ class Parser:
             if word == "processlist":
                 self.advance()
                 return ast.ShowStmt("processlist")
+            if word == "warnings":
+                self.advance()
+                return ast.ShowStmt("warnings")
             if word == "collation":
                 self.advance()
                 return ast.ShowStmt("collation")

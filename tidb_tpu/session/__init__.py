@@ -41,6 +41,9 @@ DEFAULT_VARS: Dict[str, object] = {
     "max_chunk_size": 65536,
     "tidb_tpu_engine": "auto",        # on | off | auto (auto: on when TPU)
     "tidb_tpu_row_threshold": 32768,  # min est. rows to route to device
+    # staged (checkpointable, per-shard recoverable) distributed agg;
+    # off = always the monolithic shard_map program
+    "tidb_tpu_dist_staged": "on",
     "tidb_mem_quota_query": 8 << 30,
     "sql_mode": "STRICT_TRANS_TABLES",
     "autocommit": 1,
@@ -441,6 +444,9 @@ class Session:
         # so KILL from any other session can find it
         self._guard = None
         self.last_guard = None     # kept after the stmt for introspection
+        # (Level, Code, Message) rows of the last completed statement —
+        # SHOW WARNINGS reads these; e.g. a degraded-mesh completion
+        self.warnings: List[tuple] = []
         from tidb_tpu.util.guard import PROCESS_REGISTRY
         PROCESS_REGISTRY.register(self)
 
@@ -494,6 +500,8 @@ class Session:
                 self._guard = None
                 PROCESS_REGISTRY.stmt_end(self.conn_id)
             dt = _time.perf_counter() - t0
+            if not (isinstance(s, ast.ShowStmt) and s.kind == "warnings"):
+                self.warnings = list(guard.warnings)
             REGISTRY.stmt_end(self.conn_id)
             REGISTRY.inc("tidb_tpu_stmt_total", {"stmt": kind})
             REGISTRY.observe("tidb_tpu_stmt_seconds", dt, {"stmt": kind})
@@ -801,17 +809,19 @@ class Session:
     def _kill(self, stmt: "ast.KillStmt") -> ResultSet:
         """KILL [QUERY] <id> (ref: server/conn.go handleQuery → KILL,
         executor/executor.go KillStmt): flips the target statement's
-        guard; bare KILL also poisons the connection. Non-superusers may
-        only kill their own connections (ER 1095 semantics folded into
-        the privilege layer's generic denial)."""
-        from tidb_tpu.errors import NoSuchThreadError
+        guard; bare KILL also poisons the connection. MySQL's error split
+        (sql/sql_class.cc kill_one_thread): unknown id → ER 1094; id
+        exists but belongs to someone else and the killer lacks the
+        global SUPER privilege → ER 1095 — NOT 1094, so an unprivileged
+        user can still tell 'no such thread' from 'not yours'."""
+        from tidb_tpu.errors import KillDeniedError, NoSuchThreadError
         from tidb_tpu.util.guard import PROCESS_REGISTRY
         info = PROCESS_REGISTRY.info(stmt.conn_id)
         if info is None:
             raise NoSuchThreadError(f"Unknown thread id: {stmt.conn_id}")
-        if not self.engine.auth.is_superuser(self.user) \
-                and info["user"] not in (None, self.user):
-            raise NoSuchThreadError(
+        if info["user"] not in (None, self.user) \
+                and not self.engine.auth.has_global(self.user, "SUPER"):
+            raise KillDeniedError(
                 f"You are not owner of thread {stmt.conn_id}")
         PROCESS_REGISTRY.kill(stmt.conn_id, query_only=stmt.query_only)
         return ok()
@@ -1641,10 +1651,11 @@ class Session:
         if stmt.kind == "grants":
             target = stmt.target or self.user
             if target.lower() != self.user.lower() and \
-                    not self.engine.auth.is_superuser(self.user):
-                from tidb_tpu.session.auth import PrivilegeError
-                raise PrivilegeError(
-                    "SHOW GRANTS for other users requires SUPER")
+                    not self.engine.auth.has_global(self.user, "SUPER"):
+                from tidb_tpu.errors import SpecificAccessDeniedError
+                raise SpecificAccessDeniedError(
+                    "Access denied; you need (at least one of) the "
+                    "SUPER privilege(s) for this operation")
             rows = self.engine.auth.show_grants(target)
             return ResultSet([f"Grants for {target}@%"], [T.varchar()],
                              rows)
@@ -1735,12 +1746,24 @@ class Session:
                 ["Digest", "Count", "Sum_s", "Avg_s", "Max_s", "Rows"],
                 [T.varchar(), T.bigint(), T.double(), T.double(),
                  T.double(), T.bigint()], REGISTRY.summary_rows())
+        if stmt.kind == "warnings":
+            # diagnostics of the LAST non-diagnostic statement — SHOW
+            # WARNINGS itself must not clear what it reports (MySQL's
+            # diagnostics-area statement classes)
+            return ResultSet(["Level", "Code", "Message"],
+                             [T.varchar(), T.bigint(), T.varchar()],
+                             list(self.warnings))
         if stmt.kind == "processlist":
             # every live connection, not only those mid-statement —
-            # otherwise KILL <id> can't target an idle session
+            # otherwise KILL <id> can't target an idle session. Without
+            # the global PROCESS privilege a user sees only their own
+            # threads (sql/sql_show.cc mysqld_list_processes)
             from tidb_tpu.util.guard import PROCESS_REGISTRY
+            see_all = self.engine.auth.has_global(self.user, "PROCESS")
             rows = []
             for cid, user, guard, killed in PROCESS_REGISTRY.snapshot():
+                if not see_all and user not in (None, self.user):
+                    continue
                 if guard is not None:
                     rows.append((cid, user or "", "Query",
                                  round(guard.elapsed(), 3), guard.sql))

@@ -31,7 +31,7 @@ class AccessDeniedError(TiDBTPUError):
 
 
 PRIVS = {"SELECT", "INSERT", "UPDATE", "DELETE", "CREATE", "DROP",
-         "ALTER", "INDEX", "ALL"}
+         "ALTER", "INDEX", "PROCESS", "SUPER", "ALL"}
 
 
 def stage2_of(password: str) -> bytes:
@@ -167,6 +167,16 @@ class AuthManager:
         with self._lock:
             privs = self.grants.get(user.lower(), {}).get(("*", "*"))
         return bool(privs) and "ALL" in privs
+
+    def has_global(self, user: str, priv: str) -> bool:
+        """A global admin privilege (PROCESS, SUPER): satisfied ONLY by a
+        *.* grant — MySQL refuses these at db/table scope, and a scoped
+        grant must never escalate to seeing/killing other users' threads
+        (mysql_acl's global_priv check)."""
+        with self._lock:
+            privs = self.grants.get(user.lower(), {}).get(("*", "*"),
+                                                          set())
+        return "ALL" in privs or priv.upper() in privs
 
     def require(self, user: str, priv: str, table: Optional[str],
                 db: str = DEFAULT_DB) -> None:

@@ -161,19 +161,45 @@ def _scenarios(mesh: Optional[int] = None) -> List[Scenario]:
                      "exchange-overflow", dict(), run="mesh-read",
                      vars={**dist_on, "tidb_tpu_exchange_bucket_cap": "8"},
                      mesh=True),
-            # one shard's step raises once: the executor re-dispatches the
-            # whole step through the ladder and the query still answers
+            # one shard's step raises once: the staged agg re-runs only
+            # that rank against its checkpoint; the monolithic shapes
+            # (DISTINCT re-key, join) retry the whole step — either way
+            # the query still answers the oracle
             Scenario("mesh shard fault heals after retry", "shard-step",
                      dict(raise_=ShardFailure("chaos: shard down"),
                           times=1),
                      run="mesh-read", vars=dict(dist_on), mesh=True),
-            # the fault persists through the retry: ONE typed ShardFailure
-            # must surface (a silent CPU re-run would hide a dead shard)
+            # losing one rank's device→host checkpoint re-runs only that
+            # rank (staged path only — hence the mesh-agg workload)
+            Scenario("mesh checkpoint write fails once → heals",
+                     "shard-checkpoint-write",
+                     dict(raise_=ShardFailure("chaos: checkpoint lost"),
+                          times=1),
+                     run="mesh-agg", vars=dict(dist_on), mesh=True),
+            # a persistently bad device: dispatch AND same-device retry
+            # fail, so the rank's work re-dispatches onto a surviving
+            # device (degraded mesh) and the result still matches the
+            # oracle; the extras are armed with no action purely to meter
+            # that the recovery sites actually fired
+            Scenario("mesh device persistently bad → degraded-mesh heal",
+                     "shard-step",
+                     dict(raise_=ShardFailure("chaos: device bad"),
+                          times=2),
+                     run="mesh-agg", vars=dict(dist_on), mesh=True,
+                     extra={"degraded-mesh-replan": dict(),
+                            "shard-redispatch": dict()}),
+            # the fault persists through every recovery rung — the
+            # same-device retry AND the re-dispatch onto a spare: ONE
+            # typed ShardFailure must surface (a silent CPU re-run would
+            # hide a dead shard)
             Scenario("mesh shard fault persists → typed error",
                      "shard-step",
                      dict(raise_=ShardFailure("chaos: shard down")),
                      run="mesh-read", vars=dict(dist_on), mesh=True,
-                     require_error=True),
+                     require_error=True,
+                     extra={"shard-redispatch":
+                            dict(raise_=ShardFailure("chaos: spare down"))
+                            }),
         ]
     return out
 
@@ -279,8 +305,14 @@ def run_sweep(verbose: bool = False, mesh: Optional[int] = None,
                 elif sorted(rows) != sorted(oracle[q]):
                     wrong += 1
                     failures.append(f"{sc.name}: {q!r} SILENT WRONG RESULT")
-            elif sc.run == "mesh-read":
-                for q in MESH_QUERIES:
+            elif sc.run in ("mesh-read", "mesh-agg"):
+                # mesh-agg: only the staged-eligible plain group-by —
+                # the DISTINCT/join shapes run monolithic, where a
+                # persistent shard-step fault means a typed error, not a
+                # degraded-mesh heal
+                qs = MESH_QUERIES[:1] if sc.run == "mesh-agg" \
+                    else MESH_QUERIES
+                for q in qs:
                     rows, err, dt = _run_statement(s, q)
                     if dt > DEADLINE_S:
                         slow += 1
@@ -367,7 +399,7 @@ def run_sweep(verbose: bool = False, mesh: Optional[int] = None,
         after = s.query("select count(*) from cs_facts").scalar()
         if after != base_count:
             failures.append(f"{sc.name}: count drifted after scenario")
-        if sc.run not in ("read", "recompile", "mesh-read"):
+        if sc.run not in ("read", "recompile", "mesh-read", "mesh-agg"):
             # mutating scenarios move the goalposts: refresh the oracle
             oracle = {q: s.query(q).rows for q in oracle_qs}
             base_count = after

@@ -177,6 +177,12 @@ class FieldType:
             return int(v)
         if k in (TypeKind.DATETIME, TypeKind.TIMESTAMP):
             if isinstance(v, str):
+                # Python < 3.11 fromisoformat demands exactly 3 or 6
+                # fractional-second digits; MySQL accepts any 1..6
+                # ('00:00:00.5') — pad the fraction to 6
+                head, dot, frac = v.partition(".")
+                if dot and frac.isdigit() and len(frac) < 6:
+                    v = f"{head}.{frac:<06s}"
                 v = _dt.datetime.fromisoformat(v)
             if isinstance(v, _dt.date) and not isinstance(v, _dt.datetime):
                 v = _dt.datetime(v.year, v.month, v.day)

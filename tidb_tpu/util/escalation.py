@@ -43,7 +43,7 @@ class EscalationStats:
 
     __slots__ = ("recompiles", "exact_resizes", "doublings", "mode_flips",
                  "shard_retries", "fallbacks", "slabs_rerun", "slabs_reused",
-                 "by_kind")
+                 "shards_rerun", "shards_reused", "degraded_mesh", "by_kind")
 
     def __init__(self):
         self.recompiles = 0      # re-executions the ladder charged
@@ -56,6 +56,12 @@ class EscalationStats:
         # partials were re-executed vs merged back in from the checkpoint
         self.slabs_rerun = 0
         self.slabs_reused = 0
+        # per-shard fault recovery: after a shard fault, how many ranks'
+        # local work re-executed vs restored from host checkpoints, and
+        # whether the fragment completed on a degraded (N-1) mesh
+        self.shards_rerun = 0
+        self.shards_reused = 0
+        self.degraded_mesh = 0
         self.by_kind: Dict[str, int] = {}   # "exchange:exact" → count
 
     def note(self, kind: str, rung: str) -> None:
@@ -75,7 +81,8 @@ class EscalationStats:
         parts = []
         for name in ("recompiles", "exact_resizes", "doublings",
                      "mode_flips", "shard_retries", "fallbacks",
-                     "slabs_rerun", "slabs_reused"):
+                     "slabs_rerun", "slabs_reused",
+                     "shards_rerun", "shards_reused", "degraded_mesh"):
             v = getattr(self, name)
             if v:
                 parts.append(f"{name}={v}")
@@ -158,6 +165,25 @@ class CapacityLadder:
         budget/checkpoint path as a capacity recompile."""
         self.stats.shard_retries += 1
         self.stats.note("shard", "retry")
+        failpoint.inject("device-recompile")
+        self.bo.backoff(err)
+
+    def shard_resume(self, rerun: int, reused: int) -> None:
+        """Record a per-shard recovery's reuse split: `rerun` ranks'
+        local work re-executed, `reused` ranks' partials restored from
+        their host checkpoints untouched."""
+        self.stats.shards_rerun += int(rerun)
+        self.stats.shards_reused += int(reused)
+        if reused:
+            self.stats.note("shard", "partial-reuse")
+
+    def redispatch(self, err: Optional[BaseException] = None) -> None:
+        """One degraded-mesh re-dispatch: a persistently failing rank's
+        work moves onto a surviving device. The recompile (the program
+        is re-pinned to a different device) is charged to the shared
+        backoff budget exactly like a capacity recompile."""
+        self.stats.degraded_mesh += 1
+        self.stats.note("shard", "redispatch")
         failpoint.inject("device-recompile")
         self.bo.backoff(err)
 

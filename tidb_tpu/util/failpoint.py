@@ -95,10 +95,21 @@ for _site, _desc in (
 register("exchange-overflow", "distributed exchange bucket resize/retrace "
          "(executor/fragment.py _run_device_dist)", mesh_only=True)
 register("shard-step", "host-side per-shard dispatch of a distributed "
-         "fragment step (executor/dist_fragment.py __call__) — a raise "
-         "here models ONE shard failing; the executor retries the step "
-         "once through the ladder, then surfaces a typed ShardFailure",
+         "fragment step (executor/dist_fragment.py) — a raise here models "
+         "ONE shard failing; the staged agg path retries only that rank, "
+         "then re-dispatches it onto a surviving device (degraded mesh); "
+         "the monolithic path retries the whole step once",
          mesh_only=True)
+register("shard-checkpoint-write", "device→host checkpoint of one rank's "
+         "partial-agg results in the staged distributed path "
+         "(executor/dist_fragment.py StagedDistAgg)", mesh_only=True)
+register("shard-redispatch", "re-dispatch of a persistently failing "
+         "rank's local work onto a surviving device — a raise here models "
+         "the recovery path ALSO failing, exhausting the ladder into a "
+         "typed ShardFailure (executor/dist_fragment.py)", mesh_only=True)
+register("degraded-mesh-replan", "entry of degraded-mesh mode: the "
+         "fragment re-plans the failed rank's work on the N-1 surviving "
+         "ranks (executor/dist_fragment.py)", mesh_only=True)
 
 
 def enable(name: str, *, raise_: Optional[BaseException] = None,

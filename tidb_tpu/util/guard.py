@@ -42,7 +42,7 @@ class ExecutionGuard:
     """Kill flag + deadline + root memory tracker for ONE statement."""
 
     __slots__ = ("conn_id", "sql", "started", "deadline", "mem_tracker",
-                 "checkpoints", "_killed", "escalation")
+                 "checkpoints", "_killed", "escalation", "warnings")
 
     def __init__(self, conn_id: int = 0, sql: str = "",
                  timeout_s: float = 0.0, mem_tracker=None):
@@ -62,6 +62,9 @@ class ExecutionGuard:
             mem_tracker.guard = self
         self.checkpoints: Dict[str, int] = {}
         self._killed = False
+        # (level, code, message) rows the statement accumulated — e.g.
+        # a degraded-mesh completion — read back by SHOW WARNINGS
+        self.warnings: list = []
 
     @property
     def killed(self) -> bool:
