@@ -29,6 +29,14 @@ ANY host CPU engine could answer, making every multiplier
 non-self-referential rather than a ratio against this repo's own
 single-threaded volcano.
 
+Throughput: a "Concurrent serving" section runs a mixed repeated-Q1/Q3
+warm workload at concurrency 1 and 8 through the device scheduler
+(executor/scheduler.py) and reports qps_c1 / qps_c8 / qps_scaling plus
+the scheduler's admission counters. On a TPU tunnel the device round
+trip is latency-bound, so 8 threads overlapping host encode/decode with
+each other's device waits should scale ≥2x; on a single-core CPU host
+the numbers land but the scaling is compute-bound.
+
 Env: BENCH_SF (default 10) scales row count (SF=1 → 6,001,215 lineitem
 rows); BENCH_REPS / BENCH_CPU_REPS as above; BENCH_TIME_BUDGET_S
 (default 840) is the wall-clock budget for the WHOLE run — when it runs
@@ -36,15 +44,24 @@ short the bench degrades (fewer CPU reps, then skipped secondary
 queries, each flagged in the JSON) and a SIGALRM backstop emits the
 partial JSON rather than dying silently inside a rep. The deadline is
 an absolute epoch pinned in the environment so a CPU re-exec inherits
-the original clock instead of restarting it.
+the original clock instead of restarting it. The CPU baseline is
+additionally memoized in a sidecar JSON keyed (SF, host) — the CPU
+volcano's time for a fixed dataset does not drift run-over-run, so a
+re-run (or a rerun after an outer-timeout kill) spends its budget on
+the device sections instead of re-measuring the same baseline; delete
+the sidecar (path in the JSON) or set BENCH_CPU_CACHE=off to re-measure.
 """
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import signal
+import socket
 import sys
+import tempfile
+import threading
 import time
 
 import numpy as np
@@ -117,6 +134,56 @@ def bench_deadline() -> float:
 
 def remaining_s() -> float:
     return bench_deadline() - time.time()
+
+
+# ---- CPU-baseline sidecar cache -------------------------------------------
+# The CPU volcano's best-of-N seconds for a fixed (SF, host) dataset are
+# deterministic to noise; re-measuring them every invocation is what blew
+# past the outer timeout historically (rc:124 with no JSON). First run
+# measures and writes; later runs (including a retry after a kill — the
+# sidecar survives the process) reuse and spend the budget on device work.
+
+def cpu_cache_path() -> str:
+    return os.environ.get("BENCH_CPU_CACHE_PATH") or os.path.join(
+        tempfile.gettempdir(),
+        f"tidb_tpu_bench_cpu_{socket.gethostname()}.json")
+
+
+def cpu_cache_load(sf: float) -> dict:
+    if os.environ.get("BENCH_CPU_CACHE", "on").lower() in ("off", "0"):
+        return {}
+    try:
+        with open(cpu_cache_path()) as f:
+            data = json.load(f)
+        if data.get("sf") == sf and data.get("host") == \
+                socket.gethostname():
+            return data.get("queries", {})
+    except (OSError, ValueError):
+        pass
+    return {}
+
+
+def cpu_cache_store(sf: float, name: str, best: float, walls: list):
+    if os.environ.get("BENCH_CPU_CACHE", "on").lower() in ("off", "0"):
+        return
+    path = cpu_cache_path()
+    try:
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            data = {}
+        if data.get("sf") != sf or data.get("host") != \
+                socket.gethostname():
+            data = {"sf": sf, "host": socket.gethostname(), "queries": {}}
+        data.setdefault("queries", {})[name] = {
+            "best": best, "walls": walls, "ts": time.time()}
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f)
+        os.replace(tmp, path)
+    except OSError as e:
+        log(f"cpu sidecar cache write failed (non-fatal): {e}")
 
 
 def backend_error(e: BaseException) -> bool:
@@ -272,14 +339,18 @@ def build_engine(n_rows: int):
     return eng, s
 
 
-def time_query(s, reps: int, sql: str = Q1):
+def time_query(s, reps: int, sql: str = Q1, reserve_s: float = 90.0):
     """→ (best wall seconds, device-exec seconds of the best run,
-    [every rep's wall seconds])."""
+    [every rep's wall seconds]). Budget-aware: after each rep, if
+    another rep of the same duration would eat into `reserve_s` of
+    wall budget kept for the rest of the run, stop early — a truncated
+    best-of-N (visible as len(walls) < reps in the artifact) beats an
+    rc:124 with no JSON at all."""
     from tidb_tpu.executor import fragment as frag_mod
     best = float("inf")
     exec_s = 0.0
     walls = []
-    for _ in range(max(reps, 1)):
+    for i in range(max(reps, 1)):
         frag_mod.LAST_DEVICE_EXEC_S = 0.0
         t0 = time.perf_counter()
         rs = s.query(sql)
@@ -289,6 +360,11 @@ def time_query(s, reps: int, sql: str = Q1):
             best = dt
             exec_s = frag_mod.LAST_DEVICE_EXEC_S
         assert rs.rows, "query returned no rows"
+        if i + 1 < max(reps, 1) and \
+                remaining_s() - reserve_s < dt * 1.5:
+            log(f"  rep budget: stopping after {i + 1}/{reps} reps "
+                f"({remaining_s():.0f}s left)")
+            break
     return best, exec_s, walls
 
 
@@ -315,6 +391,48 @@ def check_device_used(s, sql: str) -> bool:
     return bool(frags) and all(f.used_device for f in frags)
 
 
+def run_mix(eng, conc: int, total: int, section_budget_s: float):
+    """Mixed warm Q1/Q3 workload on `conc` sessions (one thread each,
+    the wire server's threading model) pulling query indices from one
+    shared counter — even index Q1, odd Q3. → (completed, wall seconds,
+    scheduler stats over the window, [errors])."""
+    from tidb_tpu.executor.scheduler import SCHEDULER
+    sessions = []
+    for _ in range(conc):
+        ss = eng.new_session()
+        ss.vars["tidb_tpu_engine"] = "on"
+        ss.vars["tidb_tpu_row_threshold"] = 32768
+        sessions.append(ss)
+    counter = itertools.count()
+    done = [0] * conc
+    errors: list = []
+    stop_at = time.monotonic() + section_budget_s
+
+    def worker(k: int):
+        ss = sessions[k]
+        try:
+            while True:
+                i = next(counter)
+                if i >= total or time.monotonic() > stop_at:
+                    break
+                rs = ss.query(Q1 if i % 2 == 0 else Q3)
+                assert rs.rows, "mix query returned no rows"
+                done[k] += 1
+        except Exception as e:  # noqa: BLE001 — reported in the JSON
+            errors.append(f"{type(e).__name__}: {e}"[:200])
+
+    SCHEDULER.reset_stats()
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=worker, args=(k,), daemon=True)
+               for k in range(conc)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    return sum(done), wall, SCHEDULER.stats(), errors
+
+
 def main():
     sf = float(os.environ.get("BENCH_SF", "10"))
     reps = int(os.environ.get("BENCH_REPS", "2"))
@@ -326,7 +444,9 @@ def main():
     deadline = bench_deadline()
     if hasattr(signal, "SIGALRM"):
         signal.signal(signal.SIGALRM, _on_alarm)
-        signal.alarm(max(int(deadline - time.time()), 1))
+        # fire 15s BEFORE the budget line: the partial-JSON emit and
+        # interpreter teardown must finish inside the driver's window
+        signal.alarm(max(int(deadline - time.time()) - 15, 1))
 
     # probe/initialize the backend FIRST — datagen takes a while and a dead
     # backend must be discovered (and retried/re-execed) before spending it
@@ -366,19 +486,31 @@ def main():
                   "q1_cpu_roofline_s": round(roofline_s, 3)})
 
     # CPU baseline (the reference-equivalent vectorized volcano engine).
-    # The headline ratio needs at least ONE CPU rep; degrade rather than
+    # Sidecar-cached per (SF, host): a warm re-run reuses the measured
+    # baseline and spends its wall budget on the device sections. The
+    # headline ratio needs at least ONE CPU rep; degrade rather than
     # skip when the budget is already short after datagen.
+    cpu_cached = cpu_cache_load(sf)
+    extra["cpu_cache_path"] = cpu_cache_path()
     q1_cpu_reps = cpu_reps
     if remaining_s() < 300.0 and cpu_reps > 1:
         q1_cpu_reps = 1
         extra["q1_cpu_reps_degraded"] = True
         log(f"budget short ({remaining_s():.0f}s left): Q1 CPU reps → 1")
-    s.vars["tidb_tpu_engine"] = "off"
-    log("timing CPU Q1…")
-    cpu_t, _, cpu_walls = time_query(s, q1_cpu_reps)
+    hit = cpu_cached.get("q1")
+    if hit:
+        cpu_t, cpu_walls = float(hit["best"]), list(hit["walls"])
+        extra["q1_cpu_cached"] = True
+        log(f"CPU Q1 baseline from sidecar cache: best {cpu_t:.3f}s "
+            f"of {cpu_walls}")
+    else:
+        s.vars["tidb_tpu_engine"] = "off"
+        log("timing CPU Q1…")
+        cpu_t, _, cpu_walls = time_query(s, q1_cpu_reps)
+        cpu_cache_store(sf, "q1", cpu_t, cpu_walls)
+        log(f"CPU engine Q1: best {cpu_t:.3f}s of {cpu_walls} "
+            f"({n_rows / cpu_t / 1e6:.1f}M rows/s)")
     extra["q1_cpu_reps_s"] = cpu_walls
-    log(f"CPU engine Q1: best {cpu_t:.3f}s of {cpu_walls} "
-        f"({n_rows / cpu_t / 1e6:.1f}M rows/s)")
 
     # Device path (fused fragment)
     from tidb_tpu.executor import fragment as frag_mod
@@ -414,6 +546,46 @@ def main():
     HEADLINE["value"] = n_rows / dev_t
     HEADLINE["vs"] = cpu_t / dev_t
 
+    # ---- concurrent serving: warm mixed Q1/Q3 throughput ------------------
+    # concurrency 1 vs 8 through the device scheduler. Runs right after
+    # the Q1 device section so qps_c1/qps_c8 land even if a later join
+    # section dies; budget-degraded totals shrink rather than skip — the
+    # fields must always be in the artifact. Q3 is compile-warmed first
+    # so the mix measures serving, not tracing.
+    try:
+        log("concurrent serving: warming Q3 device path…")
+        time_query(s, 1, Q3, reserve_s=60.0)
+        q3_warm, _, _ = time_query(s, 1, Q3, reserve_s=60.0)
+        per_pair = max(dev_t + q3_warm, 1e-3)
+        section_s = max(10.0, min(90.0, remaining_s() * 0.2))
+        total = int(max(16, min(96, 2 * section_s / per_pair)))
+        log(f"concurrent serving: {total} queries per level, "
+            f"~{section_s:.0f}s budget per level")
+        n1, w1, _, err1 = run_mix(eng, 1, total, section_s)
+        n8, w8, sched, err8 = run_mix(eng, 8, total, section_s)
+        qps_c1 = n1 / w1 if w1 > 0 and n1 else 0.0
+        qps_c8 = n8 / w8 if w8 > 0 and n8 else 0.0
+        scaling = qps_c8 / qps_c1 if qps_c1 else 0.0
+        extra.update({
+            "qps_c1": round(qps_c1, 2), "qps_c8": round(qps_c8, 2),
+            "qps_scaling": round(scaling, 3),
+            # fraction of perfect linear scaling achieved at c8: how
+            # much of the 8 threads' host work overlapped device time
+            "qps_overlap_efficiency": round(scaling / 8.0, 3),
+            "qps_queries": {"c1": n1, "c8": n8, "target": total},
+            "qps_scheduler": sched})
+        if err1 or err8:
+            extra["qps_errors"] = (err1 + err8)[:4]
+        log(f"concurrent serving: c1 {qps_c1:.2f} qps ({n1} in "
+            f"{w1:.1f}s), c8 {qps_c8:.2f} qps ({n8} in {w8:.1f}s), "
+            f"scaling {scaling:.2f}x, scheduler {sched}")
+    except Exception as e:  # noqa: BLE001 — fields must still land
+        if backend_error(e):
+            raise
+        log(f"concurrent serving section failed: {e}")
+        extra.update({"qps_c1": 0.0, "qps_c8": 0.0,
+                      "qps_error": f"{type(e).__name__}: {e}"[:200]})
+
     # secondary metrics: Q3 join and Q5 3-table join (configs #3/#5) —
     # each checks the wall budget first: skip entirely under ~90s left,
     # degrade to 1 CPU rep under ~240s, flagging either in the JSON so
@@ -430,8 +602,16 @@ def main():
             extra[f"{name}_cpu_reps_degraded"] = True
             log(f"budget short ({left:.0f}s left): {name} CPU reps → 1")
         try:
-            s.vars["tidb_tpu_engine"] = "off"
-            c_t, _, c_walls = time_query(s, q_cpu_reps, sql)
+            hit = cpu_cached.get(name)
+            if hit:
+                c_t, c_walls = float(hit["best"]), list(hit["walls"])
+                extra[f"{name}_cpu_cached"] = True
+                log(f"CPU {name} baseline from sidecar cache: "
+                    f"best {c_t:.3f}s")
+            else:
+                s.vars["tidb_tpu_engine"] = "off"
+                c_t, _, c_walls = time_query(s, q_cpu_reps, sql)
+                cpu_cache_store(sf, name, c_t, c_walls)
             s.vars["tidb_tpu_engine"] = "on"
             time_query(s, 1, sql)          # compile warmup
             used = check_device_used(s, sql)

@@ -65,6 +65,52 @@ def test_cold_first_touch_reports_all_phases(session):
     assert ph.total > 0.0
 
 
+def test_warm_concurrency_zero_retraces_zero_reuploads(session):
+    """8 threads re-running the warm query concurrently: ZERO new traces
+    (per-signature build locks make the compile cache single-flight) and
+    ZERO re-uploads (every thread reuses the same device arrays) — the
+    serving-throughput claim rests on the warm path staying warm under
+    concurrency, not just in a single-threaded loop."""
+    import threading
+    eng, s = session
+    rows_cold = s.query(SQL).rows          # cold: trace + first touch
+    ent = _entry(eng)
+    dev_ids = {i: [id(v) for v, _m in slabs]
+               for i, slabs in ent.dev.items()}
+    traces = fragment.PROGRAM_TRACES
+
+    sessions = []
+    for _ in range(8):
+        ss = eng.new_session()
+        ss.vars["tidb_tpu_engine"] = "on"
+        ss.vars["tidb_tpu_row_threshold"] = 1
+        ss.vars["tidb_tpu_max_slab_rows"] = 1024
+        sessions.append(ss)
+    failures = []
+    barrier = threading.Barrier(8)
+
+    def worker(k):
+        barrier.wait()
+        for _ in range(3):
+            if sessions[k].query(SQL).rows != rows_cold:
+                failures.append(f"thread {k} diverged")
+
+    threads = [threading.Thread(target=worker, args=(k,)) for k in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not any(t.is_alive() for t in threads), "warm replay hung"
+    assert not failures, failures
+    assert fragment.PROGRAM_TRACES == traces, \
+        "concurrent warm replays re-traced a program"
+    ent2 = _entry(eng)
+    assert ent2 is ent, "concurrent warm replays rebuilt the cache entry"
+    for i, ids in dev_ids.items():
+        assert [id(v) for v, _m in ent.dev[i]] == ids, \
+            f"column {i} re-uploaded under warm concurrency"
+
+
 def test_repeat_query_zero_retraces_and_no_reupload(session):
     eng, s = session
     rows_cold = s.query(SQL).rows          # cold: trace + first touch

@@ -129,6 +129,16 @@ class DeadlockError(TxnError):
     code = 1213  # ER_LOCK_DEADLOCK
 
 
+class SchemaChangedError(TxnError):
+    """Schema-lease violation at commit: a table this transaction wrote
+    changed shape (columns / indexes / primary key) between the
+    statement's plan snapshot and its commit (ref:
+    domain/schema_validator.go — ErrInfoSchemaChanged). DDL on tables
+    the transaction never touched does NOT raise this."""
+
+    code = 1105  # ER_UNKNOWN_ERROR (TiDB reports 8028 via 1105 envelope)
+
+
 class DuplicateKeyError(TiDBTPUError):
     code = 1062  # ER_DUP_ENTRY
 

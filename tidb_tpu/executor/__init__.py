@@ -80,6 +80,15 @@ class ExecContext:
         if self.guard is not None:
             self.guard.check(site)
 
+    def device_slot(self):
+        """Admission slot for device dispatch (executor/scheduler.py):
+        one statement enqueues XLA work at a time; host phases and the
+        blocking fetches stay outside so sessions overlap. Queue waits
+        are charged to this statement's guard; KILL/deadline are honored
+        while queued."""
+        from tidb_tpu.executor.scheduler import device_slot
+        return device_slot(self)
+
     def scan_table(self, table_id: int, parts=None):
         """Yield (region_or_None, chunk, alive_mask) honoring txn staging.
         `parts` = pruned partition ordinals (None = all)."""

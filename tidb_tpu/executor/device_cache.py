@@ -23,7 +23,9 @@ precedent.
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
+from contextlib import contextmanager
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -97,37 +99,112 @@ _CACHE: "OrderedDict[int, CachedTable]" = OrderedDict()
 # FK-aligned join structures (see AlignedJoin below); keyed by join path
 _ALIGNED: "OrderedDict[tuple, AlignedJoin]" = OrderedDict()
 
+# ONE lock for all shared device-cache state (_CACHE, _ALIGNED, the
+# protection registry, eviction). RLock because eviction helpers are
+# reachable from paths that already hold it. Expensive work — host scans,
+# encoding, uploads, LUT builds — happens OUTSIDE the lock; only dict
+# lookups/insertions/evictions are serialized, so concurrent first
+# touches of DIFFERENT tables still overlap.
+_LOCK = threading.RLock()
+
+# thread ident → frozenset of (store_id, table_id) pairs that thread's
+# in-flight statement is actively computing on. The per-THREAD successor
+# to the old per-ExecContext `_device_cache_protect` attribute: sibling
+# sessions consult the union, so their evictions can never free device
+# buffers another statement is mid-compute on.
+_PROTECT: Dict[int, frozenset] = {}
+
+
+def _all_protected() -> frozenset:
+    with _LOCK:
+        if not _PROTECT:
+            return frozenset()
+        out = set()
+        for pairs in _PROTECT.values():
+            out |= pairs
+        return frozenset(out)
+
+
+@contextmanager
+def protect_tables(pairs):
+    """Mark (store_id, table_id) pairs in active use by THIS thread for
+    the duration — every device executor wraps its compute in this, so a
+    sibling thread's budget/LRU eviction skips the entries and a stale-
+    entry pop defers the buffer free to refcounting (below)."""
+    tid = threading.get_ident()
+    pairs = frozenset(pairs)
+    with _LOCK:
+        prev = _PROTECT.get(tid)
+        _PROTECT[tid] = pairs if prev is None else (prev | pairs)
+    try:
+        yield
+    finally:
+        with _LOCK:
+            if prev is None:
+                _PROTECT.pop(tid, None)
+            else:
+                _PROTECT[tid] = prev
+
+
+def _safe_delete(ent, pair=None) -> None:
+    """Free an evicted entry's device buffers — unless a concurrent
+    statement may still be computing on them, in which case the explicit
+    free is skipped and refcounting reclaims the arrays the moment the
+    last in-flight reference drops (correctness over HBM promptness)."""
+    if pair is not None:
+        if pair in _all_protected():
+            return
+    elif _PROTECT:
+        # derived entries (aligned joins) aren't tracked pair-wise: with
+        # ANY statement in flight, defer to refcount reclamation
+        return
+    _entry_delete(ent)
+
 
 def clear():
-    for e in _CACHE.values():
-        _entry_delete(e)
-    for e in _ALIGNED.values():
-        _entry_delete(e)
-    _CACHE.clear()
-    _ALIGNED.clear()
+    with _LOCK:
+        cache = list(_CACHE.items())
+        aligned = list(_ALIGNED.values())
+        _CACHE.clear()
+        _ALIGNED.clear()
+    for k, e in cache:
+        _safe_delete(e, k[:2])
+    for e in aligned:
+        _safe_delete(e)
 
 
 def invalidate(table_id: int):
-    for key in [k for k in _CACHE if k[1] == table_id]:
-        ent = _CACHE.pop(key, None)
-        if ent is not None:
-            _entry_delete(ent)
-    for key in [k for k, e in _ALIGNED.items()
-                if table_id in e.tds]:
-        ent = _ALIGNED.pop(key, None)
-        if ent is not None:
-            _entry_delete(ent)
+    dead_c, dead_a = [], []
+    with _LOCK:
+        for key in [k for k in _CACHE if k[1] == table_id]:
+            ent = _CACHE.pop(key, None)
+            if ent is not None:
+                dead_c.append((key, ent))
+        for key in [k for k, e in _ALIGNED.items()
+                    if table_id in e.tds]:
+            ent = _ALIGNED.pop(key, None)
+            if ent is not None:
+                dead_a.append(ent)
+    for key, ent in dead_c:
+        _safe_delete(ent, key[:2])
+    for ent in dead_a:
+        _safe_delete(ent)
 
 
 _STORE_FINALIZERS: Dict[int, object] = {}
 
 
 def _evict_store(store_id: int):
-    for key in [k for k in _CACHE if k[0] == store_id]:
-        _entry_delete(_CACHE.pop(key, None))
-    for key in [k for k in _ALIGNED if k[0] == store_id]:
-        _entry_delete(_ALIGNED.pop(key, None))
-    _STORE_FINALIZERS.pop(store_id, None)
+    with _LOCK:
+        dead_c = [(k, _CACHE.pop(k)) for k in list(_CACHE)
+                  if k[0] == store_id]
+        dead_a = [_ALIGNED.pop(k) for k in list(_ALIGNED)
+                  if k[0] == store_id]
+        _STORE_FINALIZERS.pop(store_id, None)
+    for key, ent in dead_c:
+        _safe_delete(ent, key[:2])
+    for ent in dead_a:
+        _safe_delete(ent)
 
 
 def _pow2(n: int, lo: int = 1024) -> int:
@@ -322,8 +399,14 @@ def _stream_slabs(ctx, ent: CachedTable, key, used_cols, preps, phases):
         cols = {i: (new_slabs[i][s] if i in new_slabs else ent.dev[i][s])
                 for i in used_cols}
         yield s, cols
-    for i, slabs in new_slabs.items():
-        ent.dev[i] = slabs
+    with _LOCK:
+        for i, slabs in new_slabs.items():
+            # first-commit-wins: two threads cold-loading the same column
+            # concurrently both stream byte-identical slabs (the encode is
+            # deterministic); the loser's arrays drop on the floor and
+            # refcounting frees them — never a half-overwritten column
+            if i not in ent.dev:
+                ent.dev[i] = slabs
     phases.clear_in_flight()
     if key is not None:
         budget = int(ctx.vars.get("tidb_tpu_hbm_budget",
@@ -332,10 +415,13 @@ def _stream_slabs(ctx, ent: CachedTable, key, used_cols, preps, phases):
 
 
 def _protected(ctx) -> frozenset:
-    """(store_id, table_id) pairs the in-flight statement still needs —
-    set by multi-scan executors so a mid-query budget eviction (which now
-    DELETES buffers) can't free a sibling scan's arrays."""
-    return getattr(ctx, "_device_cache_protect", frozenset())
+    """(store_id, table_id) pairs ANY in-flight statement still needs:
+    the per-thread protect_tables registrations of every live thread,
+    plus the legacy per-ExecContext attribute (kept for callers that set
+    it directly) — so a mid-query budget eviction (which DELETES buffers)
+    can't free a sibling statement's arrays."""
+    own = getattr(ctx, "_device_cache_protect", frozenset())
+    return frozenset(own) | _all_protected()
 
 
 def open_table(ctx, scan, used_cols, max_slab: int, phases=None):
@@ -364,30 +450,61 @@ def open_table(ctx, scan, used_cols, max_slab: int, phases=None):
     parts = getattr(scan, "partitions", None)
     key = (id(store), table_id,
            None if parts is None else tuple(parts)) if cacheable else None
-    if store is not None and id(store) not in _STORE_FINALIZERS:
-        import weakref
-        _STORE_FINALIZERS[id(store)] = weakref.finalize(
-            store, _evict_store, id(store))
+    with _LOCK:
+        if store is not None and id(store) not in _STORE_FINALIZERS:
+            import weakref
+            _STORE_FINALIZERS[id(store)] = weakref.finalize(
+                store, _evict_store, id(store))
 
-    ent = _CACHE.get(key) if cacheable else None
-    if ent is not None and (ent.td is not td or ent.max_slab != max_slab
-                            or ent.n_cols != len(scan.schema)):
+    def _usable(e):
         # td identity = data freshness; n_cols = DDL (ADD/DROP COLUMN) guard
-        _CACHE.pop(key, None)
-        ent.delete()
-        ent = None
+        return (e.td is td and e.max_slab == max_slab
+                and e.n_cols == len(scan.schema))
+
+    stale = None
+    with _LOCK:
+        ent = _CACHE.get(key) if cacheable else None
+        if ent is not None and not _usable(ent):
+            _CACHE.pop(key, None)
+            stale = ent
+            ent = None
+        elif ent is not None:
+            _CACHE.move_to_end(key)
+    if stale is not None:
+        _safe_delete(stale, key[:2])
     if ent is None:
         parts, total = _collect_parts(ctx, scan)
         slab_cap = _pow2(min(total, max_slab)) if total else 1024
         n_slabs = (total + slab_cap - 1) // slab_cap
-        ent = CachedTable(td, max_slab, total, slab_cap, n_slabs, parts,
-                          len(scan.schema))
+        built = CachedTable(td, max_slab, total, slab_cap, n_slabs, parts,
+                            len(scan.schema))
         if cacheable:
-            _CACHE[key] = ent
-            while len(_CACHE) > MAX_CACHED_TABLES:
-                _CACHE.popitem(last=False)[1].delete()
-    elif cacheable:
-        _CACHE.move_to_end(key)
+            victims = []
+            with _LOCK:
+                cur = _CACHE.get(key)
+                if cur is not None and _usable(cur):
+                    # lost a cold-build race: adopt the winner, drop ours
+                    ent = cur
+                    _CACHE.move_to_end(key)
+                else:
+                    if cur is not None:
+                        victims.append(_CACHE.pop(key))
+                    ent = _CACHE[key] = built
+                    prot = _all_protected()
+                    over = len(_CACHE) - MAX_CACHED_TABLES
+                    for k in list(_CACHE):
+                        if over <= 0:
+                            break
+                        # LRU trim skips the new entry and any table a
+                        # live statement protects (cache may transiently
+                        # exceed the cap under heavy concurrency)
+                        if k != key and k[:2] not in prot:
+                            victims.append(_CACHE.pop(k))
+                            over -= 1
+            for v in victims:
+                _entry_delete(v)
+        else:
+            ent = built
 
     if not ent.total:
         return ent, None
@@ -421,28 +538,38 @@ def get_table(ctx, scan, used_cols, max_slab: int,
 def _evict_to_budget(budget: int, keep, keep_aligned=frozenset(),
                      keep_tables=frozenset()) -> None:
     """Drop LRU cached entries until resident bytes fit the HBM budget
-    (never the entries in active use). Aligned join structures evict
+    (never the entries in active use — the caller's keeps PLUS every live
+    thread's protect_tables registration). Aligned join structures evict
     first — they are derived data, rebuildable from the tables."""
-    total = sum(e.hbm_bytes() for e in _CACHE.values()) + \
-        sum(e.hbm_bytes() for e in _ALIGNED.values())
-    while total > budget:
-        victim = next((k for k in _ALIGNED if k not in keep_aligned), None)
-        if victim is None:
-            break
-        ent = _ALIGNED.pop(victim)
-        total -= ent.hbm_bytes()
+    dead_c, dead_a = [], []
+    with _LOCK:
+        keep_tables = frozenset(keep_tables) | _all_protected()
+        total = sum(e.hbm_bytes() for e in _CACHE.values()) + \
+            sum(e.hbm_bytes() for e in _ALIGNED.values())
+        while total > budget:
+            victim = next((k for k in _ALIGNED if k not in keep_aligned),
+                          None)
+            if victim is None:
+                break
+            ent = _ALIGNED.pop(victim)
+            total -= ent.hbm_bytes()
+            dead_a.append(ent)
+        while total > budget and len(_CACHE) > 1:
+            # keep_tables holds (store_id, table_id) pairs; cache keys
+            # carry a third partition element — match on the prefix, else
+            # partitioned entries of a protected table get evicted
+            # mid-query
+            victim = next((k for k in _CACHE
+                           if k != keep and k[:2] not in keep_tables), None)
+            if victim is None:
+                break
+            ent = _CACHE.pop(victim)
+            total -= ent.hbm_bytes()
+            dead_c.append(ent)
+    for ent in dead_c:
         _entry_delete(ent)
-    while total > budget and len(_CACHE) > 1:
-        # keep_tables holds (store_id, table_id) pairs; cache keys carry a
-        # third partition element — match on the prefix, else partitioned
-        # entries of a protected table get evicted mid-query
-        victim = next((k for k in _CACHE
-                       if k != keep and k[:2] not in keep_tables), None)
-        if victim is None:
-            return
-        ent = _CACHE.pop(victim)
-        total -= ent.hbm_bytes()
-        _entry_delete(ent)
+    for ent in dead_a:
+        _safe_delete(ent)
 
 
 def aligned_budget_check(ctx, keep_keys=frozenset(),
@@ -561,14 +688,18 @@ def get_aligned(ctx, key, tds: Dict[int, object],
     probe key (raw ints or dictionary codes already in the build's code
     space). bounds: the build key column's (lo, hi) value domain."""
     from tidb_tpu.ops.jax_env import jax, jnp
-    ent = _ALIGNED.get(key)
-    if ent is not None:
-        if _fresh(ctx, ent.tds) and ent.slab_cap == slab_cap \
-                and ent.n_slabs == n_slabs:
-            _ALIGNED.move_to_end(key)
-            return ent if ent.unique else None
-        _ALIGNED.pop(key, None)
-        ent.delete()
+    stale = None
+    with _LOCK:
+        ent = _ALIGNED.get(key)
+        if ent is not None:
+            if _fresh(ctx, ent.tds) and ent.slab_cap == slab_cap \
+                    and ent.n_slabs == n_slabs:
+                _ALIGNED.move_to_end(key)
+                return ent if ent.unique else None
+            _ALIGNED.pop(key, None)
+            stale = ent
+    if stale is not None:
+        _safe_delete(stale)
 
     lo, hi = bounds
     domain = hi - lo + 1
@@ -594,7 +725,9 @@ def get_aligned(ctx, key, tds: Dict[int, object],
     maxcnt, lut = _lut(bk_v, bk_m)
     if int(jax.device_get(maxcnt)) > 1:
         ent.unique = False          # negative result cached
-        _ALIGNED[key] = ent
+        with _LOCK:
+            if key not in _ALIGNED:
+                _ALIGNED[key] = ent
         return None
 
     @jax.jit
@@ -610,7 +743,14 @@ def get_aligned(ctx, key, tds: Dict[int, object],
         midx, matched = _probe(lut, pv, pm)
         ent.midx.append(midx)
         ent.matched.append(matched)
-    _ALIGNED[key] = ent
+    with _LOCK:
+        cur = _ALIGNED.get(key)
+        if cur is not None and _fresh(ctx, cur.tds) \
+                and cur.slab_cap == slab_cap and cur.n_slabs == n_slabs:
+            # lost a concurrent build race: adopt the installed entry
+            # (byte-identical build), ours frees via refcount
+            return cur if cur.unique else None
+        _ALIGNED[key] = ent
     return ent
 
 
@@ -631,7 +771,8 @@ def aligned_col(ent: AlignedJoin, build_ent: CachedTable, col: int):
 
     slabs = [_gather(midx, matched)
              for midx, matched in zip(ent.midx, ent.matched)]
-    ent.cols[col] = slabs
-    return slabs
+    with _LOCK:
+        # first-commit-wins against a concurrent identical gather
+        return ent.cols.setdefault(col, slabs)
 
 

@@ -414,10 +414,14 @@ class StagedDistAgg:
                 dcols = {i: (jax.device_put(self.rank_cols[r][i][0], dev),
                              jax.device_put(self.rank_cols[r][i][1], dev))
                          for i in prog.used_cols}
+            with self.ctx.device_slot():
+                with ph.phase("compute"):
+                    out = prog.partial(dcols,
+                                       jnp.int32(int(self.rank_rows[r])),
+                                       prep_vals)
             with ph.phase("compute"):
-                out = prog.partial(dcols,
-                                   jnp.int32(int(self.rank_rows[r])),
-                                   prep_vals)
+                # drain outside the scheduler slot (GIL-released wait):
+                # sibling statements dispatch while this rank executes
                 jax.block_until_ready(out)
             failpoint.inject("shard-checkpoint-write")
             with ph.phase("fetch"):

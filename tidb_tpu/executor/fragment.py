@@ -34,6 +34,7 @@ analog for the device engine).
 from __future__ import annotations
 
 import logging
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -248,6 +249,14 @@ from collections import OrderedDict
 _COMPILE_CACHE: "OrderedDict[str, object]" = OrderedDict()
 MAX_COMPILED_PROGRAMS = 64
 
+# guards _COMPILE_CACHE / PROGRAM_TRACES / _BUILD_LOCKS — connection
+# threads share one program cache
+_CC_LOCK = threading.RLock()
+# per-signature build locks: two threads cold-compiling the SAME
+# signature serialize (one trace, the loser adopts it); different
+# signatures still compile concurrently
+_BUILD_LOCKS: Dict[str, threading.Lock] = {}
+
 # Incremented inside the traced _partial/_merge bodies, so it moves once
 # per TRACE, not once per call — the zero-retrace assertion the perf_smoke
 # tier watches (a repeated identical query must leave it unchanged).
@@ -256,7 +265,18 @@ PROGRAM_TRACES = 0
 
 def _count_trace() -> None:
     global PROGRAM_TRACES
-    PROGRAM_TRACES += 1
+    with _CC_LOCK:
+        PROGRAM_TRACES += 1
+
+
+def _build_lock(sig: str) -> threading.Lock:
+    with _CC_LOCK:
+        lk = _BUILD_LOCKS.get(sig)
+        if lk is None:
+            lk = _BUILD_LOCKS[sig] = threading.Lock()
+            while len(_BUILD_LOCKS) > 4 * MAX_COMPILED_PROGRAMS:
+                _BUILD_LOCKS.pop(next(iter(_BUILD_LOCKS)))
+        return lk
 
 
 def _tree_delete(tree) -> None:
@@ -276,16 +296,18 @@ def _tree_delete(tree) -> None:
 
 
 def _cache_get(sig: str):
-    prog = _COMPILE_CACHE.get(sig)
-    if prog is not None:
-        _COMPILE_CACHE.move_to_end(sig)
-    return prog
+    with _CC_LOCK:
+        prog = _COMPILE_CACHE.get(sig)
+        if prog is not None:
+            _COMPILE_CACHE.move_to_end(sig)
+        return prog
 
 
 def _cache_put(sig: str, prog) -> None:
-    _COMPILE_CACHE[sig] = prog
-    while len(_COMPILE_CACHE) > MAX_COMPILED_PROGRAMS:
-        _COMPILE_CACHE.popitem(last=False)
+    with _CC_LOCK:
+        _COMPILE_CACHE[sig] = prog
+        while len(_COMPILE_CACHE) > MAX_COMPILED_PROGRAMS:
+            _COMPILE_CACHE.popitem(last=False)
 
 
 def _chain_signature(chain: List[PhysicalPlan], used_cols: Sequence[int],
@@ -558,9 +580,13 @@ def get_program(chain, used_cols, in_types, slab_cap, group_cap,
                            key_bounds) + f"|pairs={want_pairs}"
     prog = _cache_get(sig)
     if prog is None:
-        prog = _FragmentProgram(chain, used_cols, in_types, slab_cap,
-                                group_cap, key_bounds, want_pairs)
-        _cache_put(sig, prog)
+        with _build_lock(sig):
+            prog = _cache_get(sig)      # double-checked: one trace per sig
+            if prog is None:
+                prog = _FragmentProgram(chain, used_cols, in_types,
+                                        slab_cap, group_cap, key_bounds,
+                                        want_pairs)
+                _cache_put(sig, prog)
     return prog
 
 
@@ -576,9 +602,12 @@ def _get_dist_program(root, caps, group_cap, mesh, bucket_caps,
            tree_signature(root, caps, group_cap, join_cfgs))
     prog = _cache_get(sig)
     if prog is None:
-        prog = DistTreeProgram(root, caps, group_cap, mesh,
-                               dict(bucket_caps), join_cfgs)
-        _cache_put(sig, prog)
+        with _build_lock(sig):
+            prog = _cache_get(sig)      # double-checked: one trace per sig
+            if prog is None:
+                prog = DistTreeProgram(root, caps, group_cap, mesh,
+                                       dict(bucket_caps), join_cfgs)
+                _cache_put(sig, prog)
     return prog
 
 
@@ -588,9 +617,12 @@ def get_tree_program(root, caps, group_cap, join_cfgs=None,
     sig = tree_signature(root, caps, group_cap, join_cfgs, agg_key_bounds)
     prog = _cache_get(sig)
     if prog is None:
-        prog = TreeProgram(root, caps, group_cap, join_cfgs,
-                           agg_key_bounds)
-        _cache_put(sig, prog)
+        with _build_lock(sig):
+            prog = _cache_get(sig)      # double-checked: one trace per sig
+            if prog is None:
+                prog = TreeProgram(root, caps, group_cap, join_cfgs,
+                                   agg_key_bounds)
+                _cache_put(sig, prog)
     return prog
 
 
@@ -905,8 +937,12 @@ class TpuFragmentExec:
         ph = getattr(self.ctx, "phases", None)
         phs = f", phases:{{{ph.summary()}}}" if ph is not None and \
             ph.summary() else ""
+        g = getattr(self.ctx, "guard", None)
+        qw = (f", queue_wait:{g.queue_wait_s * 1000.0:.1f}ms"
+              f"({g.queue_waits})"
+              if g is not None and getattr(g, "queue_waits", 0) else "")
         if self.used_device:
-            return f"device:yes{esc}{phs}"
+            return f"device:yes{esc}{phs}{qw}"
         if self.fallback_reason:
             return f"device:fallback({self.fallback_reason}){esc}"
         return ""
@@ -927,7 +963,12 @@ class TpuFragmentExec:
                 with maybe_span(getattr(self.ctx, "tracer", None),
                                 "device.fragment",
                                 root=self.plan.root.name):
-                    self._result = self._run_device()
+                    # mark every table this fragment reads as in active
+                    # use for the statement's WHOLE device run: sibling
+                    # sessions' evictions (budget, LRU, invalidation)
+                    # must never free buffers mid-compute
+                    with self._protect_tables():
+                        self._result = self._run_device()
                 global LAST_DEVICE_EXEC_S, LAST_PHASES
                 LAST_DEVICE_EXEC_S = _time.perf_counter() - _t0
                 self.used_device = True
@@ -999,6 +1040,16 @@ class TpuFragmentExec:
             self._cpu_root.close()
             self._cpu_root = None
         self._result = None
+
+    def _protect_tables(self):
+        """protect_tables() context over every scan in this fragment —
+        per-THREAD registration (device_cache._PROTECT), so concurrent
+        statements see each other's in-flight tables as unevictable."""
+        from tidb_tpu.executor import device_cache
+        from tidb_tpu.executor.tree_fragment import _scans
+        store = getattr(self.ctx.snapshot, "store", None)
+        return device_cache.protect_tables(
+            (id(store), s.table.id) for s in _scans(self.plan.root))
 
     # ---- device pipeline ---------------------------------------------------
     def _run_device(self) -> Chunk:
@@ -1100,25 +1151,20 @@ class TpuFragmentExec:
 
         scans = TF._scans(root)
         ents = []
-        # protect every scan of THIS statement from the budget eviction a
-        # sibling scan's streamed upload may trigger (eviction DELETES
-        # device buffers now — freeing an in-flight table would poison
-        # the query)
-        store = getattr(self.ctx.snapshot, "store", None)
-        self.ctx._device_cache_protect = frozenset(
-            (id(store), s.table.id) for s in scans)
-        try:
-            for scan in scans:
-                used = scan.used_columns if scan.used_columns else \
-                    list(range(len(scan.schema)))
-                ent = device_cache.get_table(self.ctx, scan, used,
-                                             max_slab,
-                                             phases=self.ctx.phases)
-                if ent.total == 0:
-                    raise FragmentFallback("empty input")
-                ents.append((ent, used))
-        finally:
-            self.ctx._device_cache_protect = frozenset()
+        # every scan of THIS statement is already protected from sibling
+        # evictions for the whole device run: next() wrapped _run_device
+        # in _protect_tables(), which registers the (store, table) pairs
+        # per-THREAD in device_cache — the budget eviction a sibling
+        # scan's streamed upload triggers skips them
+        for scan in scans:
+            used = scan.used_columns if scan.used_columns else \
+                list(range(len(scan.schema)))
+            ent = device_cache.get_table(self.ctx, scan, used,
+                                         max_slab,
+                                         phases=self.ctx.phases)
+            if ent.total == 0:
+                raise FragmentFallback("empty input")
+            ents.append((ent, used))
         caps = {id(s): (e.slab_cap, e.n_slabs)
                 for s, (e, _) in zip(scans, ents)}
         scan_dicts = {id(s): {i: e.dicts.get(i) for i in u}
@@ -1171,9 +1217,14 @@ class TpuFragmentExec:
         while True:
             prog = get_tree_program(root, caps, gcap, join_cfgs, akb)
             prep_vals = prog.collect_preps(flow_list)
-            with ph.phase("compute"):
-                out = prog(scan_inputs, scan_rows, prep_vals,
-                           aligned_inputs)
+            # scheduler slot spans DISPATCH only (jax queues the program
+            # asynchronously); the blocking fetches below run outside it,
+            # so a sibling statement's encode/dispatch overlaps this
+            # one's device execution
+            with self.ctx.device_slot():
+                with ph.phase("compute"):
+                    out = prog(scan_inputs, scan_rows, prep_vals,
+                               aligned_inputs)
             fetch = {"ju": out["join_unique"], "jt": out["join_totals"]}
             host = None
             if is_agg:
@@ -1352,8 +1403,9 @@ class TpuFragmentExec:
             for k in range(K):
                 rng = (np.int32(k * step),
                        np.int32(min((k + 1) * step, total_cap)))
-                out = prog(scan_inputs, scan_rows, prep_vals,
-                           aligned_inputs, rng)
+                with self.ctx.device_slot():
+                    out = prog(scan_inputs, scan_rows, prep_vals,
+                               aligned_inputs, rng)
                 # flags first: a restart/overflow pass never transfers its
                 # (discarded) group arrays, and good passes transfer only
                 # ng live slots instead of the full gcap padding
@@ -1676,9 +1728,14 @@ class TpuFragmentExec:
             prep_vals = prog.collect_preps(flow_list)
             try:
                 # a shard fault (failpoint or real device error) can
-                # surface at the drain OR the fetch — both stay in the try
+                # surface at the drain OR the fetch — both stay in the
+                # try. The scheduler slot covers only the async dispatch;
+                # the GIL-releasing drain runs outside it so sibling
+                # statements' host phases overlap the mesh execution.
+                with self.ctx.device_slot():
+                    with ph.phase("compute"):
+                        raw = prog(scan_inputs, scan_rows, prep_vals)
                 with ph.phase("compute"):
-                    raw = prog(scan_inputs, scan_rows, prep_vals)
                     jax.block_until_ready(raw)
                 with ph.phase("fetch"):
                     out = jax.device_get(raw)
@@ -1845,17 +1902,22 @@ class TpuFragmentExec:
             if to_run is None:
                 for s, (cols, n) in enumerate(
                         self._slab_iter(ent, stream, prog.used_cols)):
-                    with ph.phase("compute"):
-                        partials[s] = prog.partial(cols, jnp.int32(n),
-                                                   prep_vals)
+                    # slot per slab DISPATCH: the streamed encode of the
+                    # next slab (inside _slab_iter) runs slot-free, so a
+                    # sibling's dispatch interleaves with our host work
+                    with self.ctx.device_slot():
+                        with ph.phase("compute"):
+                            partials[s] = prog.partial(cols, jnp.int32(n),
+                                                       prep_vals)
                     caps[s] = group_cap
             else:
                 for s in to_run:
                     stale = partials[s]
                     cols, n = self._slab(ent, s, prog.used_cols)
-                    with ph.phase("compute"):
-                        partials[s] = prog.partial(cols, jnp.int32(n),
-                                                   prep_vals)
+                    with self.ctx.device_slot():
+                        with ph.phase("compute"):
+                            partials[s] = prog.partial(cols, jnp.int32(n),
+                                                       prep_vals)
                     caps[s] = group_cap
                     pairs_cache[s] = None
                     _tree_delete(stale)
@@ -1889,32 +1951,36 @@ class TpuFragmentExec:
             # exceeds the cap it ran at clips gids (factorize clamps to
             # cap-1), silently conflating groups, while the merged
             # n_groups alone can look fine.
-            with ph.phase("compute"):
-                if n_slabs == 1:
-                    out = partials[0]
-                else:
-                    key_cols = []
-                    for kc in range(len(root.group_exprs)):
-                        v = jnp.concatenate([p["keys"][kc][0]
-                                             for p in partials])
-                        m = jnp.concatenate([p["keys"][kc][1]
-                                             for p in partials])
-                        key_cols.append((v, m))
-                    states = []
-                    for ai in range(len(root.aggs)):
-                        states.append(tuple(
-                            jnp.concatenate([p["states"][ai][f]
-                                             for p in partials])
-                            for f in range(
-                                len(partials[0]["states"][ai]))))
-                    slot_live = jnp.concatenate([p["slot_live"]
+            with self.ctx.device_slot():
+                with ph.phase("compute"):
+                    if n_slabs == 1:
+                        out = partials[0]
+                    else:
+                        key_cols = []
+                        for kc in range(len(root.group_exprs)):
+                            v = jnp.concatenate([p["keys"][kc][0]
                                                  for p in partials])
-                    out = prog.merge(key_cols, states, slot_live)
-                fetch = {"ngs": [p["n_groups"] for p in partials],
-                         "ng": out["n_groups"]}
-                small = _piggyback_agg(fetch, out, prog.group_cap)
+                            m = jnp.concatenate([p["keys"][kc][1]
+                                                 for p in partials])
+                            key_cols.append((v, m))
+                        states = []
+                        for ai in range(len(root.aggs)):
+                            states.append(tuple(
+                                jnp.concatenate([p["states"][ai][f]
+                                                 for p in partials])
+                                for f in range(
+                                    len(partials[0]["states"][ai]))))
+                        slot_live = jnp.concatenate([p["slot_live"]
+                                                     for p in partials])
+                        out = prog.merge(key_cols, states, slot_live)
+                    fetch = {"ngs": [p["n_groups"] for p in partials],
+                             "ng": out["n_groups"]}
+                    small = _piggyback_agg(fetch, out, prog.group_cap)
+            with ph.phase("compute"):
                 # drain inside "compute" so the flag fetch below measures
-                # pure transfer, not the device finishing its work
+                # pure transfer, not the device finishing its work — but
+                # OUTSIDE the scheduler slot: the wait releases the GIL,
+                # siblings dispatch meanwhile
                 jax.block_until_ready(fetch)
             with ph.phase("fetch"):
                 got = jax.device_get(fetch)
@@ -2019,8 +2085,10 @@ class TpuFragmentExec:
         ph = self.ctx.phases
         outs = []
         for cols, n in self._slab_iter(ent, stream, prog.used_cols):
-            with ph.phase("compute"):
-                outs.append(prog.partial(cols, jnp.int32(n), prep_vals))
+            with self.ctx.device_slot():
+                with ph.phase("compute"):
+                    outs.append(prog.partial(cols, jnp.int32(n),
+                                             prep_vals))
         with ph.phase("compute"):
             jax.block_until_ready([o["n_out"] for o in outs])
         with ph.phase("fetch"):
@@ -2056,8 +2124,10 @@ class TpuFragmentExec:
         ph = self.ctx.phases
         outs = []
         for cols, n in self._slab_iter(ent, stream, prog.used_cols):
-            with ph.phase("compute"):
-                outs.append(prog.partial(cols, jnp.int32(n), prep_vals))
+            with self.ctx.device_slot():
+                with ph.phase("compute"):
+                    outs.append(prog.partial(cols, jnp.int32(n),
+                                             prep_vals))
         with ph.phase("compute"):
             jax.block_until_ready(outs)
         with ph.phase("fetch"):

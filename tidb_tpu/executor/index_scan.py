@@ -12,6 +12,7 @@ write-path maintenance (append-only storage rebuilds lazily).
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 from typing import List, Optional, Tuple
 
@@ -121,11 +122,17 @@ _PREFIX_CACHE: "OrderedDict[Tuple, PrefixSortedIndex]" = OrderedDict()
 # with 3 indexes must not hold 3 copies of its rows)
 _VIEW_CACHE: "OrderedDict[Tuple, Tuple]" = OrderedDict()
 
+# host-side caches shared across connection threads: the lock covers the
+# dict operations only (index builds run outside it and commit
+# last-writer-wins — builds are deterministic over the same snapshot)
+_LOCK = threading.Lock()
+
 
 def clear():
-    _CACHE.clear()
-    _PREFIX_CACHE.clear()
-    _VIEW_CACHE.clear()
+    with _LOCK:
+        _CACHE.clear()
+        _PREFIX_CACHE.clear()
+        _VIEW_CACHE.clear()
 
 
 def _fill_nulls(vals: np.ndarray, valid: np.ndarray):
@@ -148,11 +155,12 @@ def get_prefix_index(ctx, table_id: int, col_idxs, table_info
     td = ctx.snapshot.table_data(table_id) if cacheable else None
     store = getattr(ctx.snapshot, "store", None) if cacheable else None
     key = (id(store), table_id, tuple(col_idxs)) if cacheable else None
-    ent = _PREFIX_CACHE.get(key) if cacheable else None
-    if ent is not None and ent.td is td and \
-            len(ent.view.columns) == len(table_info.columns):
-        _PREFIX_CACHE.move_to_end(key)
-        return ent
+    with _LOCK:
+        ent = _PREFIX_CACHE.get(key) if cacheable else None
+        if ent is not None and ent.td is td and \
+                len(ent.view.columns) == len(table_info.columns):
+            _PREFIX_CACHE.move_to_end(key)
+            return ent
     view = _live_view(ctx, table_id, table_info, cacheable, td, store)
     ctx.check_killed()
     keys = []
@@ -165,9 +173,10 @@ def get_prefix_index(ctx, table_id: int, col_idxs, table_info
     ent = PrefixSortedIndex(td, arrs, order.astype(np.int64), view,
                             tuple(col_idxs))
     if cacheable:
-        _PREFIX_CACHE[key] = ent
-        while len(_PREFIX_CACHE) > MAX_CACHED_INDEXES:
-            _PREFIX_CACHE.popitem(last=False)
+        with _LOCK:
+            _PREFIX_CACHE[key] = ent
+            while len(_PREFIX_CACHE) > MAX_CACHED_INDEXES:
+                _PREFIX_CACHE.popitem(last=False)
     return ent
 
 
@@ -175,11 +184,12 @@ def _live_view(ctx, table_id: int, table_info, cacheable, td,
                store) -> Chunk:
     vkey = (id(store), table_id) if cacheable else None
     if cacheable:
-        hit = _VIEW_CACHE.get(vkey)
-        if hit is not None and hit[0] is td and \
-                len(hit[1].columns) == len(table_info.columns):
-            _VIEW_CACHE.move_to_end(vkey)
-            return hit[1]
+        with _LOCK:
+            hit = _VIEW_CACHE.get(vkey)
+            if hit is not None and hit[0] is td and \
+                    len(hit[1].columns) == len(table_info.columns):
+                _VIEW_CACHE.move_to_end(vkey)
+                return hit[1]
     from tidb_tpu.executor.scan import align_chunk_to_schema
     live_chunks: List[Chunk] = []
     for _region, chunk, alive in ctx.scan_table(table_id):
@@ -195,9 +205,10 @@ def _live_view(ctx, table_id: int, table_info, cacheable, td,
     else:
         view = _empty_chunk([c.ftype for c in table_info.columns])
     if cacheable:
-        _VIEW_CACHE[vkey] = (td, view)
-        while len(_VIEW_CACHE) > MAX_CACHED_INDEXES:
-            _VIEW_CACHE.popitem(last=False)
+        with _LOCK:
+            _VIEW_CACHE[vkey] = (td, view)
+            while len(_VIEW_CACHE) > MAX_CACHED_INDEXES:
+                _VIEW_CACHE.popitem(last=False)
     return view
 
 
@@ -209,11 +220,12 @@ def get_index(ctx, table_id: int, col_idx: int, table_info) -> SortedIndex:
     store = getattr(ctx.snapshot, "store", None) if cacheable else None
     key = (id(store), table_id, col_idx) if cacheable else None
 
-    ent = _CACHE.get(key) if cacheable else None
-    if ent is not None and ent.td is td and \
-            len(ent.view.columns) == len(table_info.columns):
-        _CACHE.move_to_end(key)
-        return ent
+    with _LOCK:
+        ent = _CACHE.get(key) if cacheable else None
+        if ent is not None and ent.td is td and \
+                len(ent.view.columns) == len(table_info.columns):
+            _CACHE.move_to_end(key)
+            return ent
 
     view = _live_view(ctx, table_id, table_info, cacheable, td, store)
     ctx.check_killed()
@@ -226,9 +238,10 @@ def get_index(ctx, table_id: int, col_idx: int, table_info) -> SortedIndex:
     ent = SortedIndex(td, vals[valid][order], nn_pos[order], pos[~valid],
                       n, view)
     if cacheable:
-        _CACHE[key] = ent
-        while len(_CACHE) > MAX_CACHED_INDEXES:
-            _CACHE.popitem(last=False)
+        with _LOCK:
+            _CACHE[key] = ent
+            while len(_CACHE) > MAX_CACHED_INDEXES:
+                _CACHE.popitem(last=False)
     return ent
 
 

@@ -1,0 +1,194 @@
+"""Admission-controlled device scheduler: one dispatch slot per process.
+
+The wire server runs one OS thread per connection (server/__init__.py),
+but the engine owns ONE accelerator. Left alone, concurrent statements
+would interleave their XLA dispatches arbitrarily: no fairness, no
+queue-time observability, and a KILL aimed at a statement stuck behind a
+long device program would only land after the device freed up.
+
+This module is the TiDB-side analog of a coprocessor request scheduler
+(the reference bounds in-flight cop tasks per store; accelerator SQL
+engines like the Presto-on-GPU work batch many small queries onto one
+device the same way): a FIFO ticket queue in front of *device dispatch*.
+
+Scope of the slot — dispatch, not residency:
+
+  * A statement holds the slot while it ENQUEUES device work (the jitted
+    program call and, on a cold path, its compile). JAX dispatch is
+    asynchronous, so the slot is held for the host-side cost of queueing
+    the program, not for the device execution itself — the accelerator's
+    own in-order execution stream serializes the actual compute.
+  * Host-side phases — parse/plan, slab encode, result decode, and the
+    GIL-released blocking waits (block_until_ready / device_get) — run
+    OUTSIDE the slot. Query B's encode therefore overlaps query A's XLA
+    execution exactly as the phase machinery (util/phases.py) names it.
+
+Fairness: tickets grant FIFO, except that a connection which has taken
+FAIRNESS_CAP consecutive grants while another connection waits yields to
+the oldest waiter from a different connection — a tight repeated-query
+loop cannot starve a sibling session.
+
+Lifecycle: a queued waiter polls its ExecutionGuard every POLL_S, so
+KILL / deadline / OOM land as typed errors (1317 et al.) WHILE QUEUED,
+before the statement ever reaches the device. Queue-wait seconds are
+charged to the guard (queue_wait_s / queue_waits) and surfaced through
+information_schema.processlist and EXPLAIN ANALYZE runtime info.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+# consecutive grants one connection may take while another conn waits
+DEFAULT_FAIRNESS_CAP = 4
+# guard-poll cadence while queued (KILL latency bound when the holder
+# does not release for a long time; release itself wakes waiters)
+POLL_S = 0.02
+
+
+class DeviceScheduler:
+    """FIFO + fairness-capped admission queue for device dispatch."""
+
+    def __init__(self, fairness_cap: int = DEFAULT_FAIRNESS_CAP):
+        self._cv = threading.Condition()
+        self._holder: Optional[int] = None     # thread ident
+        self._depth = 0                        # reentrant holds
+        self._next_ticket = 0
+        self._queue: list = []                 # [ticket, conn_id, tid]
+        self._last_conn: Optional[int] = None
+        self._consecutive = 0
+        self.fairness_cap = fairness_cap
+        # cumulative counters (read by bench.py and tests; reset via
+        # reset_stats — monotonic within a process otherwise)
+        self.admissions = 0
+        self.waits = 0               # admissions that actually queued
+        self.wait_s_total = 0.0
+        self.yields = 0              # fairness-cap rotations
+
+    # -- grant policy --------------------------------------------------------
+    def _grantee(self):
+        """Entry to admit next: FIFO head, unless the head's connection
+        just exhausted its consecutive-grant cap while a different
+        connection waits behind it."""
+        if not self._queue:
+            return None
+        head = min(self._queue, key=lambda e: e[0])
+        if self._consecutive >= self.fairness_cap \
+                and head[1] == self._last_conn:
+            other = [e for e in self._queue if e[1] != self._last_conn]
+            if other:
+                return min(other, key=lambda e: e[0])
+        return head
+
+    # -- acquire / release ---------------------------------------------------
+    def acquire(self, guard=None, conn_id: int = 0) -> float:
+        """Block until admitted; → seconds spent queued. Reentrant per
+        thread. Raises the guard's typed error (QueryInterrupted /
+        QueryTimeout / OOM action) if the statement is killed or expires
+        while queued."""
+        tid = threading.get_ident()
+        with self._cv:
+            if self._holder == tid:
+                self._depth += 1
+                return 0.0
+            ent = [self._next_ticket, conn_id, tid]
+            self._next_ticket += 1
+            self._queue.append(ent)
+            t0 = time.monotonic()
+            queued = False
+            try:
+                while self._holder is not None or self._grantee() is not ent:
+                    queued = True
+                    self._cv.wait(POLL_S)
+                    if guard is not None:
+                        guard.check("device-queue")
+            except BaseException:
+                self._queue.remove(ent)
+                self._cv.notify_all()
+                raise
+            self._queue.remove(ent)
+            self._holder = tid
+            self._depth = 1
+            waited = time.monotonic() - t0
+            if conn_id == self._last_conn:
+                self._consecutive += 1
+            else:
+                if self._consecutive >= self.fairness_cap \
+                        and self._queue:
+                    self.yields += 1
+                self._last_conn = conn_id
+                self._consecutive = 1
+            self.admissions += 1
+            if queued:
+                self.waits += 1
+                self.wait_s_total += waited
+            # uncontended admissions report zero wait: the few-µs lock
+            # acquisition is not queue time and must not show up in
+            # processlist / EXPLAIN ANALYZE as one
+            return waited if queued else 0.0
+
+    def release(self) -> None:
+        with self._cv:
+            if self._holder != threading.get_ident():
+                return                      # defensive: never held
+            if self._depth > 1:
+                self._depth -= 1
+                return
+            self._depth = 0
+            self._holder = None
+            self._cv.notify_all()
+
+    @contextmanager
+    def slot(self, guard=None, conn_id: int = 0):
+        """Admission-scoped context. Charges queue wait to the guard."""
+        waited = self.acquire(guard=guard, conn_id=conn_id)
+        try:
+            if waited and guard is not None:
+                guard.queue_wait_s += waited
+                guard.queue_waits += 1
+            yield waited
+        finally:
+            self.release()
+
+    def queue_depth(self) -> int:
+        with self._cv:
+            return len(self._queue) + (1 if self._holder is not None else 0)
+
+    def stats(self) -> dict:
+        with self._cv:
+            return {"admissions": self.admissions, "waits": self.waits,
+                    "wait_s_total": round(self.wait_s_total, 6),
+                    "yields": self.yields}
+
+    def reset_stats(self) -> None:
+        with self._cv:
+            self.admissions = 0
+            self.waits = 0
+            self.wait_s_total = 0.0
+            self.yields = 0
+
+
+SCHEDULER = DeviceScheduler()
+
+
+@contextmanager
+def _null_slot():
+    yield 0.0
+
+
+def device_slot(ctx):
+    """The executor-facing entry: SCHEDULER.slot bound to the statement's
+    guard/conn, or a no-op when `tidb_tpu_scheduler=off`."""
+    mode = str(ctx.vars.get("tidb_tpu_scheduler", "on")).lower()
+    if mode in ("off", "0", "false"):
+        return _null_slot()
+    guard = getattr(ctx, "guard", None)
+    conn_id = getattr(guard, "conn_id", 0) if guard is not None else 0
+    return SCHEDULER.slot(guard=guard, conn_id=conn_id)
+
+
+__all__ = ["DeviceScheduler", "SCHEDULER", "device_slot",
+           "DEFAULT_FAIRNESS_CAP", "POLL_S"]

@@ -117,7 +117,8 @@ def _session_variables(session):
                           ("USER", T.varchar()),
                           ("TIME", T.double()),
                           ("INFO", T.varchar()),
-                          ("ESCALATIONS", T.varchar())])
+                          ("ESCALATIONS", T.varchar()),
+                          ("QUEUE_WAIT_MS", T.double())])
 def _processlist(session):
     # same source as SHOW PROCESSLIST: every live connection (idle ones
     # included), each with ITS OWN user — not the querying session's —
@@ -126,14 +127,18 @@ def _processlist(session):
     # ESCALATIONS is the running statement's capacity-ladder summary
     # (util/escalation.py): recompiles, exact resizes, shard retries,
     # degraded-mesh re-dispatches — live observability for "why is this
-    # query recompiling"
+    # query recompiling". QUEUE_WAIT_MS is the statement's cumulative
+    # device-scheduler admission wait (executor/scheduler.py) — live
+    # observability for "is this query running or queued".
     from tidb_tpu.util.guard import PROCESS_REGISTRY
     see_all = session.engine.auth.has_global(session.user, "PROCESS")
     return sorted(
         (cid, user or "",
          round(guard.elapsed(), 3) if guard is not None else 0.0,
          guard.sql if guard is not None else None,
-         guard.escalation.summary() if guard is not None else "")
+         guard.escalation.summary() if guard is not None else "",
+         round(getattr(guard, "queue_wait_s", 0.0) * 1000.0, 3)
+         if guard is not None else 0.0)
         for cid, user, guard, _killed in PROCESS_REGISTRY.snapshot()
         if see_all or user in (None, session.user))
 

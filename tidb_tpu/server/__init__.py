@@ -589,8 +589,13 @@ class _Conn:
         at prepare time with parameters bound to NULL, so strict binary-
         protocol clients get true column count and definitions up front.
         Parameters still type as VARCHAR (the reference also defers
-        param inference to EXECUTE for most types). Statements that only
-        plan with concrete values fall back to 0 columns."""
+        param inference to EXECUTE for most types). The probe is CHEAP
+        by construction (session.plan_for_prepare): subquery evaluation
+        and plan-cache insertion are disabled, so preparing a statement
+        never executes user reads and never pollutes the plan cache
+        with NULL-substituted parameter text. Statements whose metadata
+        would require running subqueries, or that only plan with
+        concrete values, fall back to 0 columns."""
         self._next_stmt_id += 1
         st = PreparedStmt(self._next_stmt_id, sql)
         self.stmts[st.stmt_id] = st
@@ -601,9 +606,10 @@ class _Conn:
             probe = substitute_placeholders(sql, [None] * st.n_params)
             stmt = _parse(probe)[0]
             if isinstance(stmt, (_ast.SelectStmt, _ast.SetOpStmt)):
-                plan = self.session._plan(stmt)
-                names = [c.name for c in plan.schema.columns]
-                ftypes = list(plan.schema.field_types)
+                plan = self.session.plan_for_prepare(stmt)
+                if plan is not None:
+                    names = [c.name for c in plan.schema.columns]
+                    ftypes = list(plan.schema.field_types)
         except Exception:  # noqa: BLE001 — metadata is best-effort
             names, ftypes = [], []
         # response: [OK, stmt_id, n_cols, n_params, 0, warnings]

@@ -24,8 +24,8 @@ from tidb_tpu import types as T
 from tidb_tpu.catalog import Catalog, ColumnInfo, IndexInfo, TableInfo
 from tidb_tpu.chunk import Chunk, Column
 from tidb_tpu.errors import (DDLError, ExecutionError, PlanError,
-                             TiDBTPUError, TxnError, UnknownColumnError,
-                             UnknownTableError)
+                             SchemaChangedError, TiDBTPUError, TxnError,
+                             UnknownColumnError, UnknownTableError)
 from tidb_tpu.executor import ExecContext, build, run_to_completion
 from tidb_tpu.expression import Expression
 from tidb_tpu.expression.runner import eval_on_chunk, filter_mask
@@ -89,6 +89,23 @@ class ResultSet:
 
 def ok(affected: int = 0) -> ResultSet:
     return ResultSet([], [], [], affected_rows=affected, is_query=False)
+
+
+class _PrepareProbeSkip(Exception):
+    """Internal: planning under plan_for_prepare reached a point that
+    would EXECUTE a subquery — prepare-time metadata is not worth
+    running user reads, so the probe bails out instead."""
+
+
+def _table_schema_sig(info) -> tuple:
+    """Shape signature of a table for the commit-time schema-lease check:
+    column layout, index set (incl. uniqueness and DDL state — an index
+    going write_only→public mid-transaction IS a relevant change) and
+    primary key. Row counts / statistics deliberately excluded."""
+    return (tuple((c.name.lower(), str(c.ftype)) for c in info.columns),
+            tuple(sorted((ix.name.lower(), tuple(ix.columns), ix.unique,
+                          ix.state) for ix in info.indexes)),
+            tuple(info.primary_key))
 
 
 def _plan_tables(plan) -> List[str]:
@@ -435,6 +452,7 @@ class Session:
         self._plan_cache: "OrderedDict[tuple, object]" = OrderedDict()
         self._subq_execs = 0
         self._current_sql: Optional[str] = None
+        self._prepare_probe = False  # COM_STMT_PREPARE metadata planning
         self._tracer = None        # set while a TRACE statement runs
         self._stmt_snapshot = None  # pinned read view (AS OF TIMESTAMP)
         self._for_update_snapshot = None
@@ -545,17 +563,50 @@ class Session:
         txn.schema_version0 = self.engine.catalog.user_version
         return txn, True
 
+    def _note_touched(self, txn: Transaction, info: TableInfo) -> None:
+        """Record the schema signature the statement planned against for
+        a table it is about to write. The lease check at commit compares
+        only THESE tables — an unrelated concurrent DDL (new table,
+        index on a table this txn never wrote) must not abort the
+        commit (domain/schema_validator.go relatedChanges)."""
+        touched = getattr(txn, "touched_schema", None)
+        if touched is None:
+            touched = txn.touched_schema = {}
+        touched.setdefault(info.id, _table_schema_sig(info))
+
+    def _touched_schema_changed(self, txn: Transaction) -> bool:
+        """True when a table this txn wrote changed shape since the
+        writing statement captured its TableInfo. Conservative on two
+        edges: a write path that never called _note_touched, or staged
+        writes against table ids with no recorded signature, fall back
+        to 'changed' (abort) — correctness over availability."""
+        touched = getattr(txn, "touched_schema", None)
+        if not touched:
+            return True
+        staged = set(txn.staged_inserts) | set(txn.staged_deletes)
+        if staged - set(touched):
+            return True
+        info_schema = self.engine.catalog.info_schema
+        for tid, sig in touched.items():
+            info = info_schema.table_by_id(tid)
+            if info is None or _table_schema_sig(info) != sig:
+                return True
+        return False
+
     def _commit_auto(self, txn: Transaction) -> None:
         """Autocommit with the SAME schema-lease check explicit txns get
         at COMMIT: a statement that captured its TableInfo before a
         concurrent DDL (e.g. a unique index going write-only) must abort
         rather than commit rows that skipped the new constraint
-        (domain/schema_validator.go — the lease covers autocommit too)."""
+        (domain/schema_validator.go — the lease covers autocommit too).
+        The check is TABLE-SCOPED: user_version bumps from DDL on tables
+        this statement never wrote do not abort it."""
         if getattr(txn, "schema_version0", None) is not None and \
                 self.engine.catalog.user_version != txn.schema_version0 \
-                and txn.has_staged_writes():
+                and txn.has_staged_writes() \
+                and self._touched_schema_changed(txn):
             txn.rollback()
-            raise TxnError(
+            raise SchemaChangedError(
                 "Information schema is changed during the execution of "
                 "the statement; please retry")
         txn.commit()
@@ -781,12 +832,15 @@ class Session:
                 try:
                     # schema lease check (domain/schema_validator.go): a
                     # concurrent DDL may have changed layouts the staged
-                    # chunks were built against — abort, don't corrupt
+                    # chunks were built against — abort, don't corrupt.
+                    # Table-scoped: only DDL that reshaped a table this
+                    # txn actually wrote aborts the commit.
                     if self.engine.catalog.user_version != \
                             getattr(self, "_txn_schema_version", None) \
-                            and self.txn.has_staged_writes():
+                            and self.txn.has_staged_writes() \
+                            and self._touched_schema_changed(self.txn):
                         self.txn.rollback()
-                        raise TxnError(
+                        raise SchemaChangedError(
                             "Information schema is changed during the "
                             "execution of the statement; please retry")
                     self.txn.commit()
@@ -827,8 +881,27 @@ class Session:
         return ok()
 
     # ---- SELECT ------------------------------------------------------------
+    def plan_for_prepare(self, stmt):
+        """Plan for COM_STMT_PREPARE column metadata ONLY (ref:
+        server/driver_tidb.go Prepare). Prepare must never execute user
+        data reads, so subquery evaluation is disabled for the duration:
+        a statement whose plan needs a subquery result (scalar subquery
+        folding, apply probe) raises _PrepareProbeSkip and the caller
+        falls back to deferred metadata (0 columns). Plans built under
+        the probe are also kept out of the plan cache — NULL-substituted
+        parameter text must not shadow real executions."""
+        self._prepare_probe = True
+        try:
+            return self._plan(stmt)
+        except _PrepareProbeSkip:
+            return None
+        finally:
+            self._prepare_probe = False
+
     def _subquery_evaluator(self) -> SubqueryEvaluator:
         def run(sel: ast.SelectStmt):
+            if self._prepare_probe:
+                raise _PrepareProbeSkip()
             # expression subqueries read tables too — same privilege gate
             # as a top-level SELECT (privileges.go checks every access)
             self._check_privileges(sel)
@@ -837,6 +910,8 @@ class Session:
             return rs.rows, rs.ftypes
 
         def run_plan(logical):
+            if self._prepare_probe:
+                raise _PrepareProbeSkip()
             # execute an already-built logical subquery plan (the
             # decorrelator's probe build) without re-planning the AST
             from tidb_tpu.planner import optimize_logical
@@ -895,7 +970,8 @@ class Session:
                 return hit
         before = self._subq_execs
         plan = optimize(stmt, self.engine.catalog.info_schema, ctx)
-        if key is not None and self._subq_execs == before:
+        if key is not None and self._subq_execs == before \
+                and not self._prepare_probe:
             self._plan_cache[key] = plan
             while len(self._plan_cache) > self.PLAN_CACHE_SIZE:
                 self._plan_cache.popitem(last=False)
@@ -1253,6 +1329,7 @@ class Session:
             chunk = self._rows_chunk(stmt, info, names)
         chunk = self._fill_auto_increment(info, chunk)
         txn, auto = self._write_txn()
+        self._note_touched(txn, info)
         try:
             # route-validate BEFORE REPLACE stages conflicting-row deletes
             # (a superset of the post-enforce rows, so validity carries)
@@ -1527,6 +1604,7 @@ class Session:
     def _delete(self, stmt: ast.Delete) -> ResultSet:
         info = self.engine.catalog.info_schema.table(stmt.table.name)
         txn, auto = self._write_txn()
+        self._note_touched(txn, info)
         try:
             if txn.pessimistic:
                 region_masks, staged_keep, _ = self._pessimistic_match(
@@ -1560,6 +1638,7 @@ class Session:
             info.column(name)  # validates the column exists
             assigns[name.lower()] = rw.rewrite(expr)
         txn, auto = self._write_txn()
+        self._note_touched(txn, info)
         try:
             if txn.pessimistic:
                 region_masks, staged_keep, matched = \

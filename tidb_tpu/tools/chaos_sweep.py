@@ -25,6 +25,7 @@ shard-step faults — runnable too)."""
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Callable, Dict, List, Optional
 
@@ -200,6 +201,17 @@ def _scenarios(mesh: Optional[int] = None) -> List[Scenario]:
                      extra={"shard-redispatch":
                             dict(raise_=ShardFailure("chaos: spare down"))
                             }),
+            # two-session isolation: session A takes a shard fault on
+            # the mesh path while session B serves the single-process
+            # device path CONCURRENTLY — B must stay byte-exact and
+            # error-free throughout (the fault, the retry, the shared
+            # HBM/compile caches and scheduler never leak across
+            # sessions), and A still heals to the oracle answer
+            Scenario("shard fault isolated from concurrent session",
+                     "shard-step",
+                     dict(raise_=ShardFailure("chaos: shard down"),
+                          times=1),
+                     run="mesh-isolation", vars=dict(dist_on), mesh=True),
         ]
     return out
 
@@ -331,6 +343,60 @@ def run_sweep(verbose: bool = False, mesh: Optional[int] = None,
                         wrong += 1
                         failures.append(
                             f"{sc.name}: {q!r} SILENT WRONG RESULT")
+            elif sc.run == "mesh-isolation":
+                # session B: single-process device path (no mesh vars →
+                # it never traces shard-step), looping a read the whole
+                # time session A's mesh query faults and heals
+                s2 = eng.new_session()
+                s2.vars["tidb_tpu_engine"] = "on"
+                s2.vars["tidb_tpu_row_threshold"] = "1"
+                b_query = QUERIES[1]
+                b_fail: List[str] = []
+                b_done = [0]
+                stop = threading.Event()
+
+                def sibling():
+                    try:
+                        while not stop.is_set() and b_done[0] < 24:
+                            rows = s2.query(b_query).rows
+                            if rows != oracle[b_query]:
+                                b_fail.append(
+                                    "sibling session WRONG RESULT while "
+                                    "peer shard faulted")
+                                return
+                            b_done[0] += 1
+                    except BaseException as e:  # noqa: BLE001
+                        b_fail.append(
+                            f"sibling session error during peer fault: "
+                            f"{type(e).__name__}: {e}")
+
+                th = threading.Thread(target=sibling, daemon=True)
+                th.start()
+                try:
+                    for q in MESH_QUERIES:
+                        rows, err, dt = _run_statement(s, q)
+                        if dt > DEADLINE_S:
+                            slow += 1
+                            failures.append(
+                                f"{sc.name}: {q!r} took {dt:.1f}s")
+                        if err is not None:
+                            errors += 1
+                            failures.append(
+                                f"{sc.name}: {q!r} did not heal: "
+                                f"{type(err).__name__}: {err}")
+                        elif rows != oracle[q]:
+                            wrong += 1
+                            failures.append(
+                                f"{sc.name}: {q!r} SILENT WRONG RESULT")
+                finally:
+                    stop.set()
+                    th.join(DEADLINE_S)
+                if th.is_alive():
+                    failures.append(f"{sc.name}: sibling session HUNG")
+                failures.extend(f"{sc.name}: {m}" for m in b_fail)
+                if b_done[0] == 0 and not b_fail:
+                    failures.append(
+                        f"{sc.name}: sibling session made no progress")
             elif sc.run == "write":
                 write_seq += 1
                 ins = (f"insert into cs_facts values "

@@ -26,6 +26,7 @@ EXPLAIN ANALYZE runtime info.
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, Optional
 
 from tidb_tpu.errors import BackoffExhausted
@@ -43,9 +44,14 @@ class EscalationStats:
 
     __slots__ = ("recompiles", "exact_resizes", "doublings", "mode_flips",
                  "shard_retries", "fallbacks", "slabs_rerun", "slabs_reused",
-                 "shards_rerun", "shards_reused", "degraded_mesh", "by_kind")
+                 "shards_rerun", "shards_reused", "degraded_mesh", "by_kind",
+                 "_lk")
 
     def __init__(self):
+        # counters are written by the statement's own thread only, but
+        # processlist / EXPLAIN ANALYZE read them from OTHER connections'
+        # threads mid-flight — the lock keeps by_kind iteration safe
+        self._lk = threading.Lock()
         self.recompiles = 0      # re-executions the ladder charged
         self.exact_resizes = 0   # rung 1: resize to a reported exact need
         self.doublings = 0       # rung 2: bounded geometric growth
@@ -66,7 +72,8 @@ class EscalationStats:
 
     def note(self, kind: str, rung: str) -> None:
         k = f"{kind}:{rung}"
-        self.by_kind[k] = self.by_kind.get(k, 0) + 1
+        with self._lk:
+            self.by_kind[k] = self.by_kind.get(k, 0) + 1
 
     @property
     def total(self) -> int:
@@ -86,7 +93,9 @@ class EscalationStats:
             v = getattr(self, name)
             if v:
                 parts.append(f"{name}={v}")
-        parts.extend(f"{k}={v}" for k, v in sorted(self.by_kind.items()))
+        with self._lk:
+            by_kind = sorted(self.by_kind.items())
+        parts.extend(f"{k}={v}" for k, v in by_kind)
         return " ".join(parts)
 
 

@@ -42,7 +42,8 @@ class ExecutionGuard:
     """Kill flag + deadline + root memory tracker for ONE statement."""
 
     __slots__ = ("conn_id", "sql", "started", "deadline", "mem_tracker",
-                 "checkpoints", "_killed", "escalation", "warnings")
+                 "checkpoints", "_killed", "escalation", "warnings",
+                 "queue_wait_s", "queue_waits")
 
     def __init__(self, conn_id: int = 0, sql: str = "",
                  timeout_s: float = 0.0, mem_tracker=None):
@@ -62,6 +63,12 @@ class ExecutionGuard:
             mem_tracker.guard = self
         self.checkpoints: Dict[str, int] = {}
         self._killed = False
+        # device-scheduler admission accounting (executor/scheduler.py):
+        # total seconds this statement spent queued for the device slot
+        # and how many admissions actually waited — surfaced through
+        # information_schema.processlist and EXPLAIN ANALYZE
+        self.queue_wait_s = 0.0
+        self.queue_waits = 0
         # (level, code, message) rows the statement accumulated — e.g.
         # a degraded-mesh completion — read back by SHOW WARNINGS
         self.warnings: list = []
