@@ -405,6 +405,7 @@ def run_mix(eng, conc: int, total: int, section_budget_s: float):
         sessions.append(ss)
     counter = itertools.count()
     done = [0] * conc
+    lat_s: list = [[] for _ in range(conc)]   # per-query wall seconds
     errors: list = []
     stop_at = time.monotonic() + section_budget_s
 
@@ -415,7 +416,9 @@ def run_mix(eng, conc: int, total: int, section_budget_s: float):
                 i = next(counter)
                 if i >= total or time.monotonic() > stop_at:
                     break
+                q0 = time.perf_counter()
                 rs = ss.query(Q1 if i % 2 == 0 else Q3)
+                lat_s[k].append(time.perf_counter() - q0)
                 assert rs.rows, "mix query returned no rows"
                 done[k] += 1
         except Exception as e:  # noqa: BLE001 — reported in the JSON
@@ -430,7 +433,37 @@ def run_mix(eng, conc: int, total: int, section_budget_s: float):
     for t in threads:
         t.join()
     wall = time.perf_counter() - t0
-    return sum(done), wall, SCHEDULER.stats(), errors
+    all_lat = sorted(x for per in lat_s for x in per)
+    return sum(done), wall, SCHEDULER.stats(), errors, all_lat
+
+
+def query_roofline_fraction(s, gbs: float) -> float:
+    """Roofline fraction of the session's LAST statement: the HBM bytes
+    its device program streamed (PhaseTimer scan_bytes) at the measured
+    stream bandwidth, over the measured device wall — the fraction of
+    the wall the pure memory floor explains (1.0 = bandwidth-bound)."""
+    from tidb_tpu.util import roofline
+    g = s.last_guard
+    if g is None:
+        return 0.0
+    ph = g.phases
+    return round(roofline.fraction(ph.scan_bytes, ph.wall_s, gbs=gbs), 4)
+
+
+def latency_percentiles_ms(lat_s) -> dict:
+    """Tail-latency summary of a sorted per-query wall list — p99 is the
+    first-class serving metric (interactive/batch separation needs it),
+    not derivable from throughput alone."""
+    if not lat_s:
+        return {"latency_p50_ms": 0.0, "latency_p95_ms": 0.0,
+                "latency_p99_ms": 0.0}
+
+    def pct(q):
+        i = min(len(lat_s) - 1, int(q * (len(lat_s) - 1) + 0.5))
+        return round(lat_s[i] * 1000.0, 2)
+
+    return {"latency_p50_ms": pct(0.50), "latency_p95_ms": pct(0.95),
+            "latency_p99_ms": pct(0.99)}
 
 
 def main():
@@ -451,6 +484,13 @@ def main():
     # probe/initialize the backend FIRST — datagen takes a while and a dead
     # backend must be discovered (and retried/re-execed) before spending it
     backend_name = probe_backend()
+    # opt-in cross-session Chrome trace for the whole bench run (QPS
+    # storm included): start BEFORE warmup so cold compiles land in it
+    trace_dir = os.environ.get("BENCH_TRACE_DIR")
+    if trace_dir:
+        from tidb_tpu.util import timeline
+        extra_trace_path = timeline.start_global(trace_dir)
+        log(f"chrome trace → {extra_trace_path}")
     try:
         # BEFORE datagen: the bench's own burn would dominate load1 and
         # hide a genuinely busy host
@@ -458,6 +498,10 @@ def main():
     except OSError:
         load1 = None
     gbs = host_stream_gbs()
+    # the engine's per-query roofline fractions (EXPLAIN ANALYZE, bench
+    # JSON) divide by the SAME measured bandwidth as the bench rooflines
+    from tidb_tpu.util import roofline as roofline_mod
+    roofline_mod.set_measured_gbs(gbs)
     # bytes-touched rooflines: the minimum column bytes any columnar CPU
     # engine must stream per query (host-width: 8B decimals/keys/codes,
     # 4B dates), over the measured bandwidth
@@ -534,7 +578,8 @@ def main():
     extra.update({"device_fragment": used_device,
                   "cpu_rows_per_sec": round(n_rows / cpu_t, 1),
                   "q1_device_exec_s": round(dev_exec, 3),
-                  "q1_vs_roofline": round(roofline_s / dev_t, 3)})
+                  "q1_vs_roofline": round(roofline_s / dev_t, 3),
+                  "q1_roofline_fraction": query_roofline_fraction(s, gbs)})
     # shard-recovery accounting (util/escalation.py): on a healthy run
     # all three stay 0 — nonzero values flag that the timing above
     # includes rank re-execution or a degraded mesh
@@ -561,13 +606,19 @@ def main():
         total = int(max(16, min(96, 2 * section_s / per_pair)))
         log(f"concurrent serving: {total} queries per level, "
             f"~{section_s:.0f}s budget per level")
-        n1, w1, _, err1 = run_mix(eng, 1, total, section_s)
-        n8, w8, sched, err8 = run_mix(eng, 8, total, section_s)
+        n1, w1, _, err1, lat1 = run_mix(eng, 1, total, section_s)
+        n8, w8, sched, err8, lat8 = run_mix(eng, 8, total, section_s)
         qps_c1 = n1 / w1 if w1 > 0 and n1 else 0.0
         qps_c8 = n8 / w8 if w8 > 0 and n8 else 0.0
         scaling = qps_c8 / qps_c1 if qps_c1 else 0.0
+        p1, p8 = latency_percentiles_ms(lat1), latency_percentiles_ms(lat8)
+        log(f"latency c1 p50/p95/p99 {p1['latency_p50_ms']}/"
+            f"{p1['latency_p95_ms']}/{p1['latency_p99_ms']}ms, c8 "
+            f"{p8['latency_p50_ms']}/{p8['latency_p95_ms']}/"
+            f"{p8['latency_p99_ms']}ms")
         extra.update({
             "qps_c1": round(qps_c1, 2), "qps_c8": round(qps_c8, 2),
+            "qps_latency_c1": p1, "qps_latency_c8": p8,
             "qps_scaling": round(scaling, 3),
             # fraction of perfect linear scaling achieved at c8: how
             # much of the 8 threads' host work overlapped device time
@@ -629,7 +680,9 @@ def main():
                 f"{name}_cpu_s": round(c_t, 3),
                 f"{name}_cpu_reps_s": c_walls,
                 f"{name}_cpu_roofline_s": round(rl, 3),
-                f"{name}_vs_roofline": round(rl / d_t, 3)})
+                f"{name}_vs_roofline": round(rl / d_t, 3),
+                f"{name}_roofline_fraction":
+                    query_roofline_fraction(s, gbs)})
         except Exception as e:  # noqa: BLE001 — must not sink the headline
             if backend_error(e):
                 raise                      # __main__ routes to cpu_reexec
@@ -638,6 +691,10 @@ def main():
 
     if hasattr(signal, "SIGALRM"):
         signal.alarm(0)
+    if trace_dir:
+        from tidb_tpu.util import timeline
+        path = timeline.flush()
+        extra["chrome_trace_path"] = path
     emit(HEADLINE["value"], HEADLINE["vs"], extra)
 
 

@@ -63,11 +63,16 @@ class ExecContext:
         else:
             from tidb_tpu.util.escalation import EscalationStats
             self.escalation = EscalationStats()
-        # per-statement device phase timings (util/phases.py): encode/
-        # upload/compute/fetch/decode seconds + overlap efficiency,
-        # surfaced in EXPLAIN ANALYZE runtime info and the trace
-        from tidb_tpu.util.phases import PhaseTimer
-        self.phases = PhaseTimer()
+        # per-statement device phase timings + byte/compile ledger
+        # (util/phases.py), surfaced in EXPLAIN ANALYZE runtime info,
+        # the statements_summary digest profile and the trace — shared
+        # with the guard so every ExecContext of one statement writes
+        # into the same ledger
+        if guard is not None and getattr(guard, "phases", None) is not None:
+            self.phases = guard.phases
+        else:
+            from tidb_tpu.util.phases import PhaseTimer
+            self.phases = PhaseTimer()
         self.tracer = None         # Tracer while TRACE runs (trace.go)
 
     @property

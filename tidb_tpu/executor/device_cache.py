@@ -30,6 +30,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from tidb_tpu.util import timeline
+
 MAX_CACHED_TABLES = 4
 # HBM budget for the table cache (v5e has 16 GiB; leave headroom for the
 # programs' working set). Exceeding it evicts LRU tables — the memory
@@ -90,6 +92,16 @@ def _delete_array(a) -> None:
 def _entry_delete(ent) -> None:
     """Free an evicted entry's device buffers (tolerates test doubles
     that stub hbm_bytes() without delete())."""
+    if timeline.ENABLED:
+        from tidb_tpu.util import phases as _ph
+        cur = _ph.current()
+        try:
+            freed = int(ent.hbm_bytes())
+        except Exception:  # noqa: BLE001 — test doubles may stub this out
+            freed = 0
+        timeline.instant("evict", "cache",
+                         pid=cur.conn_id if cur is not None else 0,
+                         args={"bytes": freed})
     delete = getattr(ent, "delete", None)
     if delete is not None:
         delete()
@@ -395,9 +407,15 @@ def _stream_slabs(ctx, ent: CachedTable, key, used_cols, preps, phases):
         with phases.phase("upload"):
             for i, (hv, hm) in host.items():
                 new_slabs[i].append((jnp.asarray(hv), jnp.asarray(hm)))
+        phases.add_h2d(sum(hv.nbytes + hm.nbytes
+                           for hv, hm in host.values()))
         phases.mark_in_flight()
         cols = {i: (new_slabs[i][s] if i in new_slabs else ent.dev[i][s])
                 for i in used_cols}
+        # HBM bytes this slab's compute will read — warm columns included,
+        # so roofline scan_bytes covers the whole program, not just the
+        # cold uploads
+        phases.add_scan(sum(v.nbytes + m.nbytes for v, m in cols.values()))
         yield s, cols
     with _LOCK:
         for i, slabs in new_slabs.items():
@@ -508,11 +526,17 @@ def open_table(ctx, scan, used_cols, max_slab: int, phases=None):
 
     if not ent.total:
         return ent, None
+    ph = phases if phases is not None else PhaseTimer()
     missing = [i for i in used_cols if i not in ent.dev]
     if not missing:
+        # fully warm: the program still READS every resident slab — charge
+        # those HBM bytes to the statement so roofline accounting holds on
+        # hot re-runs, not just cold first touches
+        ph.add_scan(sum(v.nbytes + m.nbytes
+                        for i in used_cols if i in ent.dev
+                        for v, m in ent.dev[i]))
         return ent, None
     failpoint.inject("device-transfer")
-    ph = phases if phases is not None else PhaseTimer()
     ftypes = scan.schema.field_types
     preps = {}
     with ph.phase("encode"):

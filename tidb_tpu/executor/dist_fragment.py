@@ -414,6 +414,11 @@ class StagedDistAgg:
                 dcols = {i: (jax.device_put(self.rank_cols[r][i][0], dev),
                              jax.device_put(self.rank_cols[r][i][1], dev))
                          for i in prog.used_cols}
+            _rank_b = sum(self.rank_cols[r][i][0].nbytes +
+                          self.rank_cols[r][i][1].nbytes
+                          for i in prog.used_cols)
+            ph.add_h2d(_rank_b)
+            ph.add_scan(_rank_b)    # the rank's partial streams these slabs
             with self.ctx.device_slot():
                 with ph.phase("compute"):
                     out = prog.partial(dcols,
@@ -434,6 +439,8 @@ class StagedDistAgg:
                     {"keys": [(v[:k], m[:k]) for v, m in out["keys"]],
                      "states": [tuple(a[:k] for a in st)
                                 for st in out["states"]]})
+            from tidb_tpu.util.phases import tree_nbytes
+            ph.add_d2h(tree_nbytes(got) + 4)
             return ({"ng": k, "keys": got["keys"],
                      "states": got["states"]}, ngt)
         finally:

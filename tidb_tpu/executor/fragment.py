@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -53,6 +54,7 @@ from tidb_tpu.planner.physical import (PhysHashAgg, PhysHashJoin,
                                        PhysTpuFragment, PhysWindow,
                                        PhysicalPlan)
 from tidb_tpu.types import FieldType
+from tidb_tpu.util.phases import tree_nbytes
 
 DEFAULT_MAX_SLAB_ROWS = 1 << 23   # 8M rows per device slab
 DEFAULT_GROUP_CAP = 1 << 16
@@ -574,6 +576,21 @@ def _dict_list(dicts_by_index: Dict[int, Optional[np.ndarray]]) -> List:
     return [dicts_by_index.get(i) for i in range(n)]
 
 
+def _charge_compile(kind: str, t0: float) -> None:
+    """Attribute one cold program build to the running statement: bump its
+    PhaseTimer compile counter (thread-local — the single-flight builders
+    have no ExecContext in reach) and emit a timeline compile event."""
+    from tidb_tpu.util import phases as _phases
+    from tidb_tpu.util import timeline
+    cur = _phases.current()
+    if cur is not None:
+        cur.note_compile()
+    if timeline.ENABLED:
+        timeline.record(f"compile:{kind}", "compile",
+                        dur_us=(time.perf_counter() - t0) * 1e6,
+                        pid=cur.conn_id if cur is not None else 0)
+
+
 def get_program(chain, used_cols, in_types, slab_cap, group_cap,
                 key_bounds=None, want_pairs=False) -> _FragmentProgram:
     sig = _chain_signature(chain, used_cols, in_types, slab_cap, group_cap,
@@ -583,10 +600,12 @@ def get_program(chain, used_cols, in_types, slab_cap, group_cap,
         with _build_lock(sig):
             prog = _cache_get(sig)      # double-checked: one trace per sig
             if prog is None:
+                t0 = time.perf_counter()
                 prog = _FragmentProgram(chain, used_cols, in_types,
                                         slab_cap, group_cap, key_bounds,
                                         want_pairs)
                 _cache_put(sig, prog)
+                _charge_compile("chain", t0)
     return prog
 
 
@@ -605,9 +624,11 @@ def _get_dist_program(root, caps, group_cap, mesh, bucket_caps,
         with _build_lock(sig):
             prog = _cache_get(sig)      # double-checked: one trace per sig
             if prog is None:
+                t0 = time.perf_counter()
                 prog = DistTreeProgram(root, caps, group_cap, mesh,
                                        dict(bucket_caps), join_cfgs)
                 _cache_put(sig, prog)
+                _charge_compile("dist", t0)
     return prog
 
 
@@ -620,9 +641,11 @@ def get_tree_program(root, caps, group_cap, join_cfgs=None,
         with _build_lock(sig):
             prog = _cache_get(sig)      # double-checked: one trace per sig
             if prog is None:
+                t0 = time.perf_counter()
                 prog = TreeProgram(root, caps, group_cap, join_cfgs,
                                    agg_key_bounds)
                 _cache_put(sig, prog)
+                _charge_compile("tree", t0)
     return prog
 
 
@@ -941,8 +964,14 @@ class TpuFragmentExec:
         qw = (f", queue_wait:{g.queue_wait_s * 1000.0:.1f}ms"
               f"({g.queue_waits})"
               if g is not None and getattr(g, "queue_waits", 0) else "")
+        rf = ""
+        if ph is not None and ph.scan_bytes and ph.wall_s > 0.0:
+            from tidb_tpu.util import roofline
+            frac = roofline.fraction(ph.scan_bytes, ph.wall_s)
+            if frac > 0.0:
+                rf = f", roofline_fraction:{frac:.3f}"
         if self.used_device:
-            return f"device:yes{esc}{phs}{qw}"
+            return f"device:yes{esc}{phs}{qw}{rf}"
         if self.fallback_reason:
             return f"device:fallback({self.fallback_reason}){esc}"
         return ""
@@ -1244,11 +1273,13 @@ class TpuFragmentExec:
                 # padded cols + live + flags all come in ONE bulk fetch
                 with ph.phase("fetch"):
                     host = jax.device_get(out)
+                ph.add_d2h(tree_nbytes(host))
                 fetch = {"ju": host["join_unique"],
                          "jt": host["join_totals"]}
             if host is None:
                 with ph.phase("fetch"):
                     flags = jax.device_get(fetch)
+                ph.add_d2h(tree_nbytes(flags))
             else:
                 flags = fetch
             retry = False
@@ -1310,7 +1341,9 @@ class TpuFragmentExec:
                              for v, m in flags["cols"]]
             else:
                 dev_cols = [(v[:n_out], m[:n_out]) for v, m in out["cols"]]
-                host_cols = jax.device_get(dev_cols)
+                with ph.phase("fetch"):
+                    host_cols = jax.device_get(dev_cols)
+                ph.add_d2h(tree_nbytes(host_cols))
             cols = [_decode_col(ft, np.asarray(v), np.asarray(m),
                                 dicts_root.get(ci))
                     for ci, ((v, m), ft) in
@@ -1409,7 +1442,7 @@ class TpuFragmentExec:
                 # flags first: a restart/overflow pass never transfers its
                 # (discarded) group arrays, and good passes transfer only
                 # ng live slots instead of the full gcap padding
-                got = jax.device_get({
+                got = self.ctx.phases.fetch({
                     "ju": out["join_unique"], "jt": out["join_totals"],
                     "ng": out["n_groups"]})
                 for ji, cfg in enumerate(join_cfgs):
@@ -1435,7 +1468,7 @@ class TpuFragmentExec:
                 if overflow or restart:
                     break
                 ng = int(np.asarray(got["ng"]))
-                got.update(jax.device_get({
+                got.update(self.ctx.phases.fetch({
                     "keys": [(v[:ng], m[:ng]) for v, m in out["keys"]],
                     "states": [tuple(a[:ng] for a in st)
                                for st in out["states"]]}))
@@ -1667,6 +1700,9 @@ class TpuFragmentExec:
                 with ph.phase("upload"):
                     cols[i] = (jax.device_put(pv, sharding),
                                jax.device_put(pm, sharding))
+                ph.add_h2d(pv.nbytes + pm.nbytes)
+                # the dist program streams these shards from HBM too
+                ph.add_scan(pv.nbytes + pm.nbytes)
                 ph.mark_in_flight()
             rows = np.clip(total - np.arange(nd) * cap, 0,
                            cap).astype(np.int32)
@@ -1739,6 +1775,7 @@ class TpuFragmentExec:
                     jax.block_until_ready(raw)
                 with ph.phase("fetch"):
                     out = jax.device_get(raw)
+                ph.add_d2h(tree_nbytes(out))
             except Exception as e:
                 # one shard's step failing (the "shard-step" failpoint, or
                 # a real per-device runtime fault) heals by re-dispatching
@@ -1941,6 +1978,7 @@ class TpuFragmentExec:
                              for ai in partials[s]["pairs"]}
                             for si, s in enumerate(need)]
                         per_slab = jax.device_get(sliced)
+                    ph.add_d2h(tree_nbytes(counts) + tree_nbytes(per_slab))
                     for s, ps in zip(need, per_slab):
                         pairs_cache[s] = ps
             # build the whole device graph FIRST (per-slab partials +
@@ -1984,6 +2022,7 @@ class TpuFragmentExec:
                 jax.block_until_ready(fetch)
             with ph.phase("fetch"):
                 got = jax.device_get(fetch)
+            ph.add_d2h(tree_nbytes(got))
             # overflow iff a slab's TRUE count exceeded the cap IT ran at
             # (factorize counts before clamping, so per-slab ngs are true;
             # reused partials ran at an older, smaller cap and stay valid)
@@ -2058,7 +2097,7 @@ class TpuFragmentExec:
                 [(k[:n_final], m[:n_final]) for k, m in out["keys"]],
                 [tuple(a[:n_final] for a in st) for st in out["states"]],
             )
-            host_keys, host_states = jax.device_get(dev_tree)
+            host_keys, host_states = self.ctx.phases.fetch(dev_tree)
         if distinct_pairs:
             # multi-slab DISTINCT: the device-merged distinct states
             # deduped only within each slab — recompute them from the
@@ -2098,6 +2137,7 @@ class TpuFragmentExec:
             dev_tree = [[(v[:n], m[:n]) for v, m in o["cols"]]
                         for o, n in zip(outs, n_outs)]
             host_tree = jax.device_get(dev_tree)
+        ph.add_d2h(tree_nbytes(host_tree) + 4 * len(n_outs))
         with ph.phase("decode"):
             pieces = [self._cols_chunk(root, cols_host, dicts)
                       for cols_host in host_tree]
@@ -2132,6 +2172,7 @@ class TpuFragmentExec:
             jax.block_until_ready(outs)
         with ph.phase("fetch"):
             host_outs = jax.device_get(outs)   # one batched round trip
+        ph.add_d2h(tree_nbytes(host_outs))
         with ph.phase("decode"):
             pieces: List[Chunk] = []
             for out in host_outs:

@@ -42,6 +42,8 @@ import time
 from contextlib import contextmanager
 from typing import Optional
 
+from tidb_tpu.util import timeline
+
 # consecutive grants one connection may take while another conn waits
 DEFAULT_FAIRNESS_CAP = 4
 # guard-poll cadence while queued (KILL latency bound when the holder
@@ -145,6 +147,10 @@ class DeviceScheduler:
     def slot(self, guard=None, conn_id: int = 0):
         """Admission-scoped context. Charges queue wait to the guard."""
         waited = self.acquire(guard=guard, conn_id=conn_id)
+        if timeline.ENABLED and waited > 0.0:
+            timeline.record("sched-queue", "sched", dur_us=waited * 1e6,
+                            pid=conn_id)
+        hold_t0 = timeline.now_us() if timeline.ENABLED else 0.0
         try:
             if waited and guard is not None:
                 guard.queue_wait_s += waited
@@ -152,6 +158,10 @@ class DeviceScheduler:
             yield waited
         finally:
             self.release()
+            if timeline.ENABLED:
+                timeline.record("sched-slot", "sched",
+                                dur_us=timeline.now_us() - hold_t0,
+                                pid=conn_id, ts_us=hold_t0)
 
     def queue_depth(self) -> int:
         with self._cv:

@@ -202,6 +202,64 @@ def _partitions(session):
     return rows
 
 
+@register("statements_summary",
+          [("DIGEST_TEXT", T.varchar()),
+           ("EXEC_COUNT", T.bigint()),
+           ("SUM_LATENCY_S", T.double()),
+           ("AVG_LATENCY_S", T.double()),
+           ("MAX_LATENCY_S", T.double()),
+           ("ROWS_SENT", T.bigint()),
+           ("ENGINE", T.varchar()),
+           ("DEVICE_SECONDS", T.double()),
+           ("H2D_BYTES", T.bigint()),
+           ("D2H_BYTES", T.bigint()),
+           ("SCAN_BYTES", T.bigint()),
+           ("COMPILES", T.bigint()),
+           ("QUEUE_WAIT_S", T.double()),
+           ("QUEUE_WAITS", T.bigint()),
+           ("QUEUE_P50_MS", T.double()),
+           ("QUEUE_P99_MS", T.double())])
+def _statements_summary(session):
+    """TopSQL-style per-digest device-time attribution (ref:
+    util/stmtsummary — here extended with the PhaseTimer ledger): every
+    counter is the exact sum over that digest's statements, so a row's
+    byte/compile columns equal the sum of its EXPLAIN ANALYZE totals."""
+    from tidb_tpu.util.observability import REGISTRY
+    return [(p["digest"], p["count"], p["sum_s"], p["avg_s"], p["max_s"],
+             p["rows"], p["engine"], p["device_s"], p["h2d_bytes"],
+             p["d2h_bytes"], p["scan_bytes"], p["compiles"],
+             p["queue_wait_s"], p["queue_waits"], p["queue_p50_ms"],
+             p["queue_p99_ms"])
+            for p in REGISTRY.summary_profiles()]
+
+
+@register("slow_query", [("TIME", T.varchar()),
+                         ("QUERY_TIME_S", T.double()),
+                         ("DEVICE_SECONDS", T.double()),
+                         ("QUEUE_WAIT_MS", T.double()),
+                         ("H2D_BYTES", T.bigint()),
+                         ("COMPILES", T.bigint()),
+                         ("ROWS_SENT", T.bigint()),
+                         ("ENGINE", T.varchar()),
+                         ("QUERY", T.varchar())])
+def _slow_query(session):
+    """The slow-log ring (ref: infoschema slow_query memtable over the
+    slow log file) with per-entry device attribution."""
+    from tidb_tpu.util.observability import REGISTRY
+    return REGISTRY.slow_rows_full()
+
+
+@register("engine_metrics", [("METRIC", T.varchar()),
+                             ("LABELS", T.varchar()),
+                             ("VALUE", T.double())])
+def _engine_metrics(session):
+    """Every registry counter and histogram (bucket/count/sum rows
+    included) as SQL — the metrics_schema analog, so percentiles can be
+    derived without scraping /metrics."""
+    from tidb_tpu.util.observability import REGISTRY
+    return REGISTRY.metric_rows()
+
+
 @register("views", [("TABLE_NAME", T.varchar()),
                     ("VIEW_DEFINITION", T.varchar()),
                     ("IS_UPDATABLE", T.varchar()),

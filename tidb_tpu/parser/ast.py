@@ -393,6 +393,9 @@ class LoadData(StmtNode):
 @dataclass
 class TraceStmt(StmtNode):
     stmt: StmtNode
+    # 'row' (default span-tree result set) or 'chrome' (one-row Chrome
+    # trace JSON — executor/trace.go's TRACE FORMAT='json' analog)
+    format: str = "row"
 
 
 @dataclass

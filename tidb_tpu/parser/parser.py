@@ -184,7 +184,16 @@ class Parser:
             return ast.Explain(self.statement(), analyze)
         if self.at_kw("trace"):
             self.advance()
-            return ast.TraceStmt(self.statement())
+            fmt = "row"
+            if self._word("format"):
+                self.try_op("=")
+                if not self.at("str"):
+                    raise ParseError(
+                        f"expected format string near {self._near()}")
+                fmt = str(self.advance().value).lower()
+                if fmt not in ("row", "chrome"):
+                    raise ParseError(f"unknown TRACE format {fmt!r}")
+            return ast.TraceStmt(self.statement(), fmt)
         if self.at_kw("set"):
             return self.set_stmt()
         if self.at_kw("show"):

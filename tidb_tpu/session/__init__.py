@@ -51,6 +51,10 @@ DEFAULT_VARS: Dict[str, object] = {
     # scopes it to read-only SELECT): applies to EVERY statement — the
     # never-hang guarantee matters more here than MySQL fidelity
     "max_execution_time": 0,
+    # when non-empty, every session appends scheduler/compile/stream/
+    # eviction events into ONE Chrome-trace JSON under this directory
+    # (util/timeline.py) — load it in chrome://tracing or Perfetto
+    "tidb_tpu_trace_dir": "",
 }
 
 
@@ -477,6 +481,8 @@ class Session:
 
         from tidb_tpu.errors import QueryInterrupted
         from tidb_tpu.parser import parse_with_text
+        from tidb_tpu.util import phases as phases_mod
+        from tidb_tpu.util import timeline
         from tidb_tpu.util.guard import PROCESS_REGISTRY, ExecutionGuard
         from tidb_tpu.util.memory import Tracker
         from tidb_tpu.util.observability import REGISTRY
@@ -502,31 +508,39 @@ class Session:
             self._guard = guard
             self.last_guard = guard
             PROCESS_REGISTRY.stmt_begin(self.conn_id, guard)
-            REGISTRY.stmt_begin(self.conn_id, one[:256])
+            # opt-in cross-session Chrome trace: the sysvar names the
+            # directory; start is idempotent, clearing the var stops it
+            trace_dir = str(self.vars.get("tidb_tpu_trace_dir", "") or "")
+            if trace_dir:
+                timeline.start_global(trace_dir)
+            # bind the statement's attribution ledger to this thread so
+            # compile builders / evictions without a ctx can charge it
+            phases_mod.set_current(guard.phases)
             t0 = _time.perf_counter()
             try:
                 rs = self._execute_stmt(s)
             except Exception:
                 REGISTRY.inc("tidb_tpu_stmt_errors_total",
                              {"stmt": kind})
-                REGISTRY.stmt_end(self.conn_id)
                 raise
             finally:
                 # never let this statement's text key a LATER direct
                 # _plan() call (plan-cache poisoning)
                 self._current_sql = None
                 self._guard = None
+                phases_mod.set_current(None)
                 PROCESS_REGISTRY.stmt_end(self.conn_id)
+                if timeline.ENABLED:
+                    timeline.flush(force=False)
             dt = _time.perf_counter() - t0
             if not (isinstance(s, ast.ShowStmt) and s.kind == "warnings"):
                 self.warnings = list(guard.warnings)
-            REGISTRY.stmt_end(self.conn_id)
             REGISTRY.inc("tidb_tpu_stmt_total", {"stmt": kind})
             REGISTRY.observe("tidb_tpu_stmt_seconds", dt, {"stmt": kind})
             n_rows = rs.row_count if rs.is_query else rs.affected_rows
             threshold = float(self.vars.get("long_query_time", 0.3))
             REGISTRY.record_stmt(one, dt, n_rows, self.last_engine,
-                                 threshold)
+                                 threshold, guard=guard)
             out.append(rs)
         return out
 
@@ -1114,8 +1128,16 @@ class Session:
 
     def _trace(self, stmt) -> ResultSet:
         """TRACE <stmt>: run it with a span recorder attached and return
-        the span tree (ref: executor/trace.go)."""
+        the span tree (ref: executor/trace.go) — or, with
+        FORMAT='chrome', capture the cross-thread timeline events of just
+        this statement and return the Chrome-trace JSON as one row."""
         from tidb_tpu.util.tracing import Tracer
+        if getattr(stmt, "format", "row") == "chrome":
+            from tidb_tpu.util import timeline
+            with timeline.capture() as cap:
+                self._execute_stmt(stmt.stmt)
+            return ResultSet(["trace"], [T.varchar()],
+                             [(timeline.render(cap.events),)])
         prev = self._tracer
         tr = Tracer()
         self._tracer = tr

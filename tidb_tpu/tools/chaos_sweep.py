@@ -25,6 +25,7 @@ shard-step faults — runnable too)."""
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Callable, Dict, List, Optional
@@ -503,6 +504,24 @@ def main(argv=None) -> int:
     ap.add_argument("--mesh-only", action="store_true",
                     help="with --mesh: run ONLY the distributed scenarios")
     args = ap.parse_args(argv)
+    # metric-naming lint FIRST: a drifting metric name/label fails the
+    # sweep before any scenario spends wall time (tools/check_metrics.py)
+    import importlib.util as _ilu
+    _repo = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "..", "..")
+    _cm_path = os.path.join(_repo, "tools", "check_metrics.py")
+    if os.path.exists(_cm_path):
+        _spec = _ilu.spec_from_file_location("check_metrics", _cm_path)
+        _cm = _ilu.module_from_spec(_spec)
+        _spec.loader.exec_module(_cm)
+        _problems = _cm.run(_repo)
+        if _problems:
+            for p in _problems:
+                print(p)
+            print(f"chaos sweep: metric lint failed "
+                  f"({len(_problems)} violation(s))")
+            return 1
+        print("chaos sweep: metric lint ok")
     t0 = time.monotonic()
     report = run_sweep(verbose=args.verbose, mesh=args.mesh or None,
                        mesh_only=args.mesh_only)

@@ -43,16 +43,22 @@ class ExecutionGuard:
 
     __slots__ = ("conn_id", "sql", "started", "deadline", "mem_tracker",
                  "checkpoints", "_killed", "escalation", "warnings",
-                 "queue_wait_s", "queue_waits")
+                 "queue_wait_s", "queue_waits", "phases")
 
     def __init__(self, conn_id: int = 0, sql: str = "",
                  timeout_s: float = 0.0, mem_tracker=None):
         from tidb_tpu.util.escalation import EscalationStats
+        from tidb_tpu.util.phases import PhaseTimer
         self.conn_id = conn_id
         self.sql = sql
         # per-statement capacity-escalation counters (util/escalation.py),
         # read back by information_schema.processlist
         self.escalation = EscalationStats()
+        # the statement's attribution ledger (util/phases.py): phase
+        # seconds, h2d/d2h/scan bytes, compile count — every ExecContext
+        # of this statement shares it, and record_stmt folds it into the
+        # digest profile at statement end
+        self.phases = PhaseTimer(conn_id)
         self.started = time.monotonic()
         self.deadline = (self.started + timeout_s
                          if timeout_s and timeout_s > 0 else None)

@@ -20,27 +20,64 @@ already in flight (at least one slab uploaded/dispatched). A cold
 single-slab table can overlap nothing (0.0); an n-slab streamed cold
 start approaches (n-1)/n; the serial encode-all/upload-all/run shape
 scores 0.0 by construction.
+
+Beyond seconds, the PhaseTimer is the statement's attribution ledger
+(the stmtsummary/execdetails analog): host→device bytes uploaded
+(h2d_bytes), device→host bytes fetched (d2h_bytes), HBM bytes the
+device program read (scan_bytes — resident column slabs touched, warm
+or cold), and XLA trace/compile count (compiles). ExecutionGuard owns
+one per statement; every ExecContext of that statement shares it, so
+EXPLAIN ANALYZE, the statements_summary digest profile, the slow log
+and the Chrome timeline all read the SAME counters.
+
+A thread-local `current()` pointer (set by Session.execute around each
+statement) lets sites with no ExecContext in reach — the single-flight
+program builders, cache evictions — attribute to the running statement.
 """
 
 from __future__ import annotations
 
+import threading
 import time
 from contextlib import contextmanager
-from typing import Dict
+from typing import Dict, Optional
+
+from tidb_tpu.util import timeline
 
 PHASES = ("encode", "upload", "compute", "fetch", "decode")
+
+_tls = threading.local()
+
+
+def set_current(pt: Optional["PhaseTimer"]) -> None:
+    """Bind `pt` as this thread's running statement's PhaseTimer (None to
+    clear).  Statement execution is single-threaded per connection, so
+    compile/eviction sites reached from the statement's call stack can
+    attribute to it without threading a context through every layer."""
+    _tls.pt = pt
+
+
+def current() -> Optional["PhaseTimer"]:
+    return getattr(_tls, "pt", None)
 
 
 class PhaseTimer:
     """Per-statement phase accumulator (ExecContext.phases)."""
 
-    __slots__ = ("seconds", "overlapped_s", "wall_s", "_in_flight")
+    __slots__ = ("seconds", "overlapped_s", "wall_s", "_in_flight",
+                 "h2d_bytes", "d2h_bytes", "scan_bytes", "compiles",
+                 "conn_id")
 
-    def __init__(self):
+    def __init__(self, conn_id: int = 0):
         self.seconds: Dict[str, float] = {p: 0.0 for p in PHASES}
         self.overlapped_s = 0.0   # encode seconds with device work in flight
         self.wall_s = 0.0         # device-path wall (set by the executor)
         self._in_flight = False
+        self.h2d_bytes = 0        # host→device upload bytes
+        self.d2h_bytes = 0        # device→host fetch bytes
+        self.scan_bytes = 0       # HBM column bytes the program read
+        self.compiles = 0         # XLA program traces charged to this stmt
+        self.conn_id = conn_id    # timeline pid (0 = unattributed)
 
     @contextmanager
     def phase(self, name: str):
@@ -52,6 +89,9 @@ class PhaseTimer:
             self.seconds[name] = self.seconds.get(name, 0.0) + dt
             if name == "encode" and self._in_flight:
                 self.overlapped_s += dt
+            if timeline.ENABLED:
+                timeline.record(name, name, dur_us=dt * 1e6,
+                                pid=self.conn_id)
 
     def mark_in_flight(self) -> None:
         """First slab's device work has been issued: later encode time is
@@ -63,6 +103,29 @@ class PhaseTimer:
 
     def add_wall(self, dt: float) -> None:
         self.wall_s += dt
+
+    # -- byte / compile attribution -----------------------------------------
+    def add_h2d(self, n: int) -> None:
+        self.h2d_bytes += int(n)
+
+    def add_d2h(self, n: int) -> None:
+        self.d2h_bytes += int(n)
+
+    def add_scan(self, n: int) -> None:
+        self.scan_bytes += int(n)
+
+    def note_compile(self) -> None:
+        self.compiles += 1
+
+    def fetch(self, tree):
+        """jax.device_get under the fetch phase, with the transferred
+        bytes charged to d2h_bytes — the one chokepoint every result
+        round trip should go through."""
+        from tidb_tpu.ops.jax_env import jax
+        with self.phase("fetch"):
+            host = jax.device_get(tree)
+        self.add_d2h(tree_nbytes(host))
+        return host
 
     @property
     def total(self) -> float:
@@ -78,6 +141,10 @@ class PhaseTimer:
         out = {f"{p}_s": round(self.seconds.get(p, 0.0), 4) for p in PHASES}
         out["overlap_efficiency"] = round(self.overlap_efficiency(), 3)
         out["wall_s"] = round(self.wall_s, 4)
+        out["h2d_bytes"] = self.h2d_bytes
+        out["d2h_bytes"] = self.d2h_bytes
+        out["scan_bytes"] = self.scan_bytes
+        out["compiles"] = self.compiles
         return out
 
     def summary(self) -> str:
@@ -90,7 +157,31 @@ class PhaseTimer:
         parts = [f"{short[p]}={self.seconds[p]:.3f}s" for p in PHASES
                  if self.seconds.get(p, 0.0) > 0.0005]
         parts.append(f"ov={self.overlap_efficiency():.2f}")
+        if self.h2d_bytes or self.d2h_bytes:
+            parts.append(f"h2d={self.h2d_bytes}B d2h={self.d2h_bytes}B")
+        if self.compiles:
+            parts.append(f"compiles={self.compiles}")
         return " ".join(parts)
 
 
-__all__ = ["PhaseTimer", "PHASES"]
+def tree_nbytes(tree) -> int:
+    """Total nbytes of every array leaf in a (nested) container of host
+    arrays — the byte meter behind PhaseTimer.fetch / upload sites."""
+    total = 0
+    stack = [tree]
+    while stack:
+        x = stack.pop()
+        if x is None:
+            continue
+        nb = getattr(x, "nbytes", None)
+        if nb is not None:
+            total += int(nb)
+        elif isinstance(x, dict):
+            stack.extend(x.values())
+        elif isinstance(x, (list, tuple)):
+            stack.extend(x)
+    return total
+
+
+__all__ = ["PhaseTimer", "PHASES", "set_current", "current",
+           "tree_nbytes"]

@@ -31,15 +31,15 @@ class StatusServer:
                     self.send_header("Content-Type",
                                      "text/plain; version=0.0.4")
                 elif self.path == "/statements":
-                    # TopSQL-lite: per-digest cumulative wall time,
-                    # heaviest first (summary_rows already orders by
-                    # -sum_s; util/topsql + statements_summary analog
-                    # over HTTP, server/http_status.go:279)
-                    rows = REGISTRY.summary_rows()
-                    body = json.dumps([
-                        {"digest": d, "count": c, "sum_s": ss,
-                         "avg_s": a, "max_s": mx, "rows": rw}
-                        for d, c, ss, a, mx, rw in rows]).encode()
+                    # TopSQL: full per-digest device-time attribution
+                    # profiles, heaviest cumulative wall first
+                    # (util/topsql + statements_summary analog over
+                    # HTTP, server/http_status.go:279) — includes
+                    # device_s / h2d_bytes / d2h_bytes / scan_bytes /
+                    # compiles / queue p50+p99 alongside the original
+                    # digest/count/sum_s keys
+                    body = json.dumps(
+                        REGISTRY.summary_profiles()).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
                 elif self.path == "/status":

@@ -1,0 +1,208 @@
+"""Cross-session Chrome-trace timeline (ref: util/tracecpu + the
+TopSQL collector; rendering targets chrome://tracing / Perfetto).
+
+The TRACE statement's span tree (util/tracing.py) sees ONE statement on
+ONE thread.  What it cannot show is the interaction BETWEEN sessions —
+a statement queued behind a sibling's device dispatch, a single-flight
+compile another connection is waiting on, an eviction triggered by a
+different statement's budget check.  This module is the process-wide
+recorder for exactly those events: every thread appends into one shared
+buffer, and the flush writes ONE Chrome-trace JSON
+(`{"traceEvents": [...]}`) where
+
+  * pid  = connection id (one process lane per session),
+  * tid  = device stream (sched / compile / encode / upload / compute /
+           fetch / decode / cache), named via thread_name metadata,
+  * ts   = microseconds on one shared monotonic epoch, so cross-thread
+           ordering in the viewer is real ordering.
+
+Opt-in and zero-cost when off: recording sites check the module-level
+`ENABLED` bool (flipped only by `start_global` / `capture`), so the off
+path is one attribute load — the perf_smoke tier pins that no events
+accumulate when tracing is off.  Two activation paths share the buffer
+machinery:
+
+  * `SET tidb_tpu_trace_dir = '/path'` starts the process-global
+    collector; the session flushes it after every statement (throttled)
+    into  <dir>/tidb_tpu_trace_<os-pid>.json  — the cross-session file.
+  * `TRACE FORMAT='chrome' <stmt>` attaches a scoped collector for one
+    statement and returns the JSON as a result row (executor/trace.go's
+    chrome format analog).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+# Fast-path flag: True iff at least one collector is attached.  Recording
+# sites read this before building any event dict, so tracing-off overhead
+# is a single module-attribute load.
+ENABLED = False
+
+_LOCK = threading.Lock()
+_T0 = time.perf_counter()          # shared epoch for every thread's ts
+
+# device-stream lanes: stable small tids so the viewer groups events the
+# same way run over run; thread_name metadata labels them at flush
+STREAMS = {"sched": 1, "compile": 2, "encode": 3, "upload": 4,
+           "compute": 5, "fetch": 6, "decode": 7, "cache": 8}
+
+_GLOBAL: Optional["_Collector"] = None     # tidb_tpu_trace_dir sink
+_GLOBAL_PATH: Optional[str] = None
+_SCOPED: List["_Collector"] = []           # TRACE FORMAT='chrome' sinks
+_LAST_FLUSH = 0.0
+_FLUSH_MIN_INTERVAL_S = 0.25
+
+
+class _Collector:
+    __slots__ = ("events", "dirty")
+
+    def __init__(self):
+        self.events: List[dict] = []
+        self.dirty = False
+
+
+def _refresh_enabled() -> None:
+    global ENABLED
+    ENABLED = _GLOBAL is not None or bool(_SCOPED)
+
+
+def now_us() -> float:
+    return (time.perf_counter() - _T0) * 1e6
+
+
+def record(name: str, stream: str, dur_us: float = 0.0, pid: int = 0,
+           ts_us: Optional[float] = None, args: Optional[dict] = None,
+           ph: str = "X") -> None:
+    """Append one complete ("X") or instant ("i") event to every attached
+    collector.  `ts_us` is the START timestamp; when omitted the event is
+    assumed to END now (ts = now - dur)."""
+    if not ENABLED:
+        return
+    end = now_us()
+    ts = ts_us if ts_us is not None else max(end - dur_us, 0.0)
+    ev = {"name": name, "cat": stream, "ph": ph,
+          "ts": round(ts, 1), "pid": int(pid),
+          "tid": STREAMS.get(stream, 15)}
+    if ph == "X":
+        ev["dur"] = round(max(dur_us, 0.0), 1)
+    else:
+        ev["s"] = "g"
+    if args:
+        ev["args"] = args
+    with _LOCK:
+        if _GLOBAL is not None:
+            _GLOBAL.events.append(ev)
+            _GLOBAL.dirty = True
+        for c in _SCOPED:
+            c.events.append(ev)
+
+
+def instant(name: str, stream: str, pid: int = 0,
+            args: Optional[dict] = None) -> None:
+    record(name, stream, pid=pid, ts_us=now_us(), args=args, ph="i")
+
+
+# ---- global (tidb_tpu_trace_dir) collector --------------------------------
+
+def start_global(trace_dir: str) -> str:
+    """Idempotently attach the process-global collector writing to
+    <trace_dir>/tidb_tpu_trace_<pid>.json.  → the file path."""
+    global _GLOBAL, _GLOBAL_PATH
+    with _LOCK:
+        if _GLOBAL is None:
+            _GLOBAL = _Collector()
+        _GLOBAL_PATH = os.path.join(
+            str(trace_dir), f"tidb_tpu_trace_{os.getpid()}.json")
+    _refresh_enabled()
+    return _GLOBAL_PATH
+
+
+def stop_global() -> None:
+    global _GLOBAL, _GLOBAL_PATH
+    flush()
+    with _LOCK:
+        _GLOBAL = None
+        _GLOBAL_PATH = None
+    _refresh_enabled()
+
+
+def global_path() -> Optional[str]:
+    return _GLOBAL_PATH
+
+
+def flush(force: bool = True) -> Optional[str]:
+    """Write the global collector's events to its JSON file (atomic
+    tmp+rename).  force=False throttles to one write per
+    _FLUSH_MIN_INTERVAL_S — the per-statement flush path."""
+    global _LAST_FLUSH
+    with _LOCK:
+        if _GLOBAL is None or _GLOBAL_PATH is None or not _GLOBAL.dirty:
+            return _GLOBAL_PATH
+        now = time.monotonic()
+        if not force and now - _LAST_FLUSH < _FLUSH_MIN_INTERVAL_S:
+            return _GLOBAL_PATH
+        _LAST_FLUSH = now
+        events = list(_GLOBAL.events)
+        _GLOBAL.dirty = False
+        path = _GLOBAL_PATH
+    body = render(events)
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(body)
+        os.replace(tmp, path)
+    except OSError:
+        # tracing must never sink the statement that triggered the flush
+        return None
+    return path
+
+
+# ---- scoped capture (TRACE FORMAT='chrome') -------------------------------
+
+@contextmanager
+def capture():
+    """Collect every event recorded while the context is active —
+    the statement-scoped sink behind TRACE FORMAT='chrome'."""
+    c = _Collector()
+    with _LOCK:
+        _SCOPED.append(c)
+    _refresh_enabled()
+    try:
+        yield c
+    finally:
+        with _LOCK:
+            try:
+                _SCOPED.remove(c)
+            except ValueError:
+                pass
+        _refresh_enabled()
+
+
+def render(events: List[dict]) -> str:
+    """Chrome-trace JSON: events sorted by ts (so every tid's sequence is
+    monotonically non-decreasing) plus process/thread_name metadata."""
+    ordered = sorted(events, key=lambda e: e["ts"])
+    seen: Dict[tuple, str] = {}
+    for e in ordered:
+        seen.setdefault((e["pid"], e["tid"]), e["cat"])
+    meta: List[dict] = []
+    for pid in sorted({p for p, _ in seen}):
+        meta.append({"name": "process_name", "ph": "M", "pid": pid,
+                     "tid": 0, "args": {"name": f"conn {pid}"}})
+    for (pid, tid), cat in sorted(seen.items()):
+        meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                     "tid": tid, "args": {"name": cat}})
+    return json.dumps({"traceEvents": meta + ordered,
+                       "displayTimeUnit": "ms"})
+
+
+__all__ = ["ENABLED", "STREAMS", "record", "instant", "start_global",
+           "stop_global", "global_path", "flush", "capture", "render",
+           "now_us"]
