@@ -664,9 +664,16 @@ def main():
                 c_t, _, c_walls = time_query(s, q_cpu_reps, sql)
                 cpu_cache_store(sf, name, c_t, c_walls)
             s.vars["tidb_tpu_engine"] = "on"
+            cc0 = dict(frag_mod.COMPILE_COUNTS)
             time_query(s, 1, sql)          # compile warmup
             used = check_device_used(s, sql)
             d_t, d_exec, _ = time_query(s, reps, sql)
+            # per-kind compile split for this query's cold trace: a fused
+            # pipeline shows {"fused": …} here, a mega-slab fallback
+            # shows {"tree": …} — the warm reps above must add ZERO
+            cc_delta = {k: v - cc0.get(k, 0)
+                        for k, v in frag_mod.COMPILE_COUNTS.items()
+                        if v > cc0.get(k, 0)}
             rl = join_roofline[name]
             log(f"{name.upper()} join: CPU best {c_t:.3f}s of {c_walls}, "
                 f"TPU {d_t:.3f}s wall / {d_exec:.3f}s exec "
@@ -682,7 +689,21 @@ def main():
                 f"{name}_cpu_roofline_s": round(rl, 3),
                 f"{name}_vs_roofline": round(rl / d_t, 3),
                 f"{name}_roofline_fraction":
-                    query_roofline_fraction(s, gbs)})
+                    query_roofline_fraction(s, gbs),
+                f"{name}_compiles": cc_delta})
+            # fused-pipeline launch accounting from the LAST warm rep:
+            # programs_per_slab = (slab partials + root merge) / slabs —
+            # the issue's warm target is ≤2 launches per slab
+            qph = frag_mod.LAST_PHASES
+            if qph is not None and qph.fused_pipelines:
+                extra.update({
+                    f"{name}_fused_pipelines": qph.fused_pipelines,
+                    f"{name}_programs_launched": qph.programs_launched,
+                    f"{name}_programs_per_slab": round(
+                        qph.programs_launched / qph.fused_pipelines, 2)})
+                log(f"{name} fused: {qph.fused_pipelines} slab programs, "
+                    f"{qph.programs_launched} launches warm "
+                    f"({extra[f'{name}_programs_per_slab']}/slab)")
         except Exception as e:  # noqa: BLE001 — must not sink the headline
             if backend_error(e):
                 raise                      # __main__ routes to cpu_reexec

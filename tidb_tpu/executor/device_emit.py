@@ -21,6 +21,73 @@ from tidb_tpu.expression import EvalContext
 from tidb_tpu.expression.aggfuncs import AggFunc
 
 
+def emit_root(ctx: EvalContext, live, root, aggs=None, group_cap: int = 0,
+              key_bounds=None, pairs_out: bool = False, slab_cap: int = 0):
+    """Root reduction dispatch for a fused pipeline: the single emit
+    point every device program (linear chain, join tree, fused per-slab
+    pipeline, distributed shard) routes its root operator through.
+
+    → HashAgg: emit_agg's {keys, states, n_groups, slot_live[, pairs]};
+      TopN/Sort: {cols, n_out} (gathered in sorted order, truncated to
+      k for TopN); Window: emit_window's {cols, live}; any row root
+      (Selection/Projection/Join): padded {cols, live}."""
+    from tidb_tpu.ops.jax_env import jnp
+    from tidb_tpu.ops import factorize as F
+    from tidb_tpu.planner.physical import (PhysHashAgg, PhysSort,
+                                           PhysTopN, PhysWindow)
+    if isinstance(root, PhysHashAgg):
+        return emit_agg(ctx, live, root, aggs, group_cap, key_bounds,
+                        pairs_out=pairs_out)
+    if isinstance(root, (PhysTopN, PhysSort)):
+        keys = [e.eval(ctx) for e in root.by]
+        out_cols = [ctx.column(i) for i in range(len(root.schema))]
+        if isinstance(root, PhysTopN):
+            k = min(root.count + root.offset, slab_cap or live.shape[0])
+            idx, n_out = F.topn(keys, root.descs, live, k)
+        else:
+            idx, n_out = F.sort_perm(keys, root.descs, live)
+        gathered = [(jnp.asarray(v)[idx], jnp.asarray(m)[idx])
+                    for v, m in out_cols]
+        return {"cols": gathered, "n_out": n_out}
+    if isinstance(root, PhysWindow):
+        return emit_window(ctx, live, root)
+    out_cols = [ctx.column(i) for i in range(len(root.schema))]
+    return {"cols": [(jnp.asarray(v), jnp.asarray(m))
+                     for v, m in out_cols], "live": live}
+
+
+def emit_merge(root, aggs: List[AggFunc], group_cap: int, key_cols,
+               states, slot_live):
+    """Root merge of stacked per-slab agg partials: re-factorize the
+    concatenated partial keys under their slot_live masks (ragged caps
+    are fine — dead slots map past the cap), sanitize dead slots to
+    identities, scatter-merge states (AggFunc.merge is the same segment
+    op as update — SURVEY A.4). One implementation shared by the chain
+    program's merge and the fused pipeline's root-merge program."""
+    from tidb_tpu.ops.jax_env import jnp
+    from tidb_tpu.ops import factorize as F
+    cap = group_cap
+    if root.group_exprs:
+        gids, n_final, rep = F.factorize(key_cols, slot_live, cap)
+        gids = jnp.where(slot_live, gids, jnp.int32(cap))
+        key_out = [(jnp.asarray(v)[rep], jnp.asarray(m)[rep] &
+                    (jnp.arange(cap) < n_final)) for v, m in key_cols]
+    else:
+        gids = jnp.where(slot_live, jnp.int32(0), jnp.int32(cap))
+        n_final = jnp.int32(1)
+        key_out = []
+    out_states = []
+    for agg, partial in zip(aggs, states):
+        clean = tuple(
+            jnp.where(slot_live, arr,
+                      jnp.zeros_like(arr) if arr.dtype != jnp.bool_
+                      else jnp.zeros_like(arr))
+            for arr in partial)
+        st = agg.init(jnp, cap)
+        out_states.append(agg.merge(jnp, st, gids, cap, clean))
+    return {"keys": key_out, "states": out_states, "n_groups": n_final}
+
+
 def emit_agg(ctx: EvalContext, live, root, aggs: List[AggFunc],
              group_cap: int, key_bounds=None, pairs_out: bool = False):
     """Grouped-aggregation partial over one batch → {keys, states,

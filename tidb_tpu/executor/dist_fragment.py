@@ -424,6 +424,8 @@ class StagedDistAgg:
                     out = prog.partial(dcols,
                                        jnp.int32(int(self.rank_rows[r])),
                                        prep_vals)
+            ph.note_launch()
+            ph.note_fused()   # per-rank chain partial = fused local stage
             with ph.phase("compute"):
                 # drain outside the scheduler slot (GIL-released wait):
                 # sibling statements dispatch while this rank executes

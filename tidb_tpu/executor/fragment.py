@@ -264,6 +264,11 @@ _BUILD_LOCKS: Dict[str, threading.Lock] = {}
 # tier watches (a repeated identical query must leave it unchanged).
 PROGRAM_TRACES = 0
 
+# Cumulative cold-build counts per program kind ("chain" | "dist" |
+# "tree" | "fused") — bench.py snapshots deltas around each query to
+# report fused-vs-unfused compile counts in its JSON extras.
+COMPILE_COUNTS: Dict[str, int] = {}
+
 
 def _count_trace() -> None:
     global PROGRAM_TRACES
@@ -506,67 +511,26 @@ class _FragmentProgram:
         return ctx, live
 
     def _partial(self, cols, n_rows, prep_vals):
-        from tidb_tpu.ops.jax_env import jnp
-        from tidb_tpu.ops import factorize as F
+        # A chain partial IS a fused pipeline: scan → filter/project →
+        # root reduction in one trace.  The root dispatch lives in
+        # device_emit.emit_root so the linear-chain, join-tree and fused
+        # per-slab programs share one emit layer.
         from tidb_tpu.executor import device_emit
         _count_trace()
         ctx, live = self._eval_chain(cols, n_rows, prep_vals)
-        root = self.root
-        if isinstance(root, PhysHashAgg):
-            # pairs_out: DISTINCT aggs additionally emit their deduped
-            # (group, value) pair sets so multi-slab executions can merge
-            # them across slabs (the distinct-partials split of
-            # aggfuncs/func_sum.go:49-59) — no separate program, the pair
-            # factorize is shared with the state mask
-            return device_emit.emit_agg(ctx, live, root, self.aggs,
-                                        self.group_cap, self.key_bounds,
-                                        pairs_out=self.has_distinct)
-        if isinstance(root, (PhysTopN, PhysSort)):
-            keys = [e.eval(ctx) for e in root.by]
-            out_cols = [ctx.column(i) for i in range(len(root.schema))]
-            if isinstance(root, PhysTopN):
-                k = min(root.count + root.offset, self.slab_cap)
-                idx, n_out = F.topn(keys, root.descs, live, k)
-            else:
-                idx, n_out = F.sort_perm(keys, root.descs, live)
-            gathered = [(jnp.asarray(v)[idx], jnp.asarray(m)[idx])
-                        for v, m in out_cols]
-            return {"cols": gathered, "n_out": n_out}
-        if isinstance(root, PhysWindow):
-            return device_emit.emit_window(ctx, live, root)
-        # Selection/Projection root: columns + live mask, host compacts
-        out_cols = [ctx.column(i) for i in range(len(root.schema))]
-        return {"cols": [(jnp.asarray(v), jnp.asarray(m))
-                         for v, m in out_cols], "live": live}
+        return device_emit.emit_root(
+            ctx, live, self.root, aggs=getattr(self, "aggs", None),
+            group_cap=self.group_cap, key_bounds=self.key_bounds,
+            pairs_out=self.has_distinct, slab_cap=self.slab_cap)
 
     def _merge(self, key_cols, states, slot_live):
         """Merge stacked slab partials: re-factorize partial keys, sanitize
         dead slots to identities, scatter-merge states (AggFunc.merge is the
         same segment op as update — SURVEY A.4)."""
-        from tidb_tpu.ops.jax_env import jnp
-        from tidb_tpu.ops import factorize as F
+        from tidb_tpu.executor import device_emit
         _count_trace()
-        cap = self.group_cap
-        root = self.root
-        if root.group_exprs:
-            gids, n_final, rep = F.factorize(key_cols, slot_live, cap)
-            gids = jnp.where(slot_live, gids, jnp.int32(cap))
-            key_out = [(jnp.asarray(v)[rep], jnp.asarray(m)[rep] &
-                        (jnp.arange(cap) < n_final)) for v, m in key_cols]
-        else:
-            gids = jnp.where(slot_live, jnp.int32(0), jnp.int32(cap))
-            n_final = jnp.int32(1)
-            key_out = []
-        out_states = []
-        for agg, partial in zip(self.aggs, states):
-            clean = tuple(
-                jnp.where(slot_live, arr,
-                          jnp.zeros_like(arr) if arr.dtype != jnp.bool_
-                          else jnp.zeros_like(arr))
-                for arr in partial)
-            st = agg.init(jnp, cap)
-            out_states.append(agg.merge(jnp, st, gids, cap, clean))
-        return {"keys": key_out, "states": out_states, "n_groups": n_final}
+        return device_emit.emit_merge(self.root, self.aggs, self.group_cap,
+                                      key_cols, states, slot_live)
 
 
 def _dict_list(dicts_by_index: Dict[int, Optional[np.ndarray]]) -> List:
@@ -582,6 +546,8 @@ def _charge_compile(kind: str, t0: float) -> None:
     have no ExecContext in reach) and emit a timeline compile event."""
     from tidb_tpu.util import phases as _phases
     from tidb_tpu.util import timeline
+    with _CC_LOCK:
+        COMPILE_COUNTS[kind] = COMPILE_COUNTS.get(kind, 0) + 1
     cur = _phases.current()
     if cur is not None:
         cur.note_compile()
@@ -646,6 +612,70 @@ def get_tree_program(root, caps, group_cap, join_cfgs=None,
                                    agg_key_bounds)
                 _cache_put(sig, prog)
                 _charge_compile("tree", t0)
+    return prog
+
+
+def get_pipeline_program(root, caps, group_cap, join_cfgs=None,
+                         agg_key_bounds=None):
+    """Fused per-slab pipeline program: a TreeProgram whose probe-anchor
+    scan capacity is ONE slab, so scan → filter → project → join-probe →
+    partial-agg over that slab trace as a single jitted XLA program whose
+    intermediates never leave registers/HBM.  The signature extends
+    tree_signature — the per-scan `cap=CxN` term already distinguishes the
+    per-slab anchor shape from the mega-slab tree program — and cold
+    builds charge the `compile:fused` timeline lane."""
+    from tidb_tpu.executor.tree_fragment import TreeProgram, tree_signature
+    sig = "fused|" + tree_signature(root, caps, group_cap, join_cfgs,
+                                    agg_key_bounds)
+    prog = _cache_get(sig)
+    if prog is None:
+        with _build_lock(sig):
+            prog = _cache_get(sig)      # double-checked: one trace per sig
+            if prog is None:
+                t0 = time.perf_counter()
+                prog = TreeProgram(root, caps, group_cap, join_cfgs,
+                                   agg_key_bounds)
+                _cache_put(sig, prog)
+                _charge_compile("fused", t0)
+    return prog, sig
+
+
+class _AggMergeProgram:
+    """Root merge for fused-pipeline agg partials: the per-slab pipeline
+    programs each emit a group_cap-slot partial, and this (single, cached)
+    program re-factorizes the stacked keys and scatter-merges the states —
+    the second and last device launch of a warm fused execution."""
+
+    def __init__(self, root, group_cap: int):
+        from tidb_tpu.ops.jax_env import jax, on_tpu
+        self.root = root
+        self.group_cap = group_cap
+        self.aggs = [build_agg(d) for d in root.aggs]
+        if on_tpu():
+            # stacked partials are dead after the merge — donate them
+            self.merge = jax.jit(self._merge, donate_argnums=(0, 1, 2))
+        else:
+            self.merge = jax.jit(self._merge)
+
+    def _merge(self, key_cols, states, slot_live):
+        from tidb_tpu.executor import device_emit
+        _count_trace()
+        return device_emit.emit_merge(self.root, self.aggs, self.group_cap,
+                                      key_cols, states, slot_live)
+
+
+def get_merge_program(root, group_cap: int,
+                      pipeline_sig: str) -> _AggMergeProgram:
+    sig = "fusedmerge|" + pipeline_sig
+    prog = _cache_get(sig)
+    if prog is None:
+        with _build_lock(sig):
+            prog = _cache_get(sig)      # double-checked: one trace per sig
+            if prog is None:
+                t0 = time.perf_counter()
+                prog = _AggMergeProgram(root, group_cap)
+                _cache_put(sig, prog)
+                _charge_compile("fused", t0)
     return prog
 
 
@@ -1243,6 +1273,29 @@ class TpuFragmentExec:
                                 stats=self.ctx.escalation)
         # every device_get is a ~100ms tunnel round trip — batch fetches
         ph = self.ctx.phases
+        # ---- fused per-slab pipeline -----------------------------------
+        # Agg-rooted trees (the Q3/Q5 shape) run scan → filter → project →
+        # join-probe → partial-agg as ONE program PER PROBE SLAB plus one
+        # root merge, instead of one mega-slab program: intermediates stay
+        # in registers/HBM and warm launches drop to ≤2 per slab. DISTINCT
+        # aggs keep the mega-slab path (their pair sets dedupe globally).
+        if is_agg and _var_bool(vars_.get("tidb_tpu_fused_pipeline", "on")) \
+                and not any(d.distinct and d.args for d in root.aggs):
+            anchor = TF.aligned_chain(root.children[0])[0]
+            anchor_i = next((i for i, s in enumerate(scans)
+                             if s is anchor), None)
+            if anchor_i is not None:
+                res = self._run_fused_pipeline(
+                    root, caps, scans, ents, scan_inputs, scan_rows,
+                    flow_list, flows, aligned_inputs, join_cfgs,
+                    walk_joins, akb, gcap, max_cap, out_cap_max, ladder,
+                    anchor_i)
+                if res is not None:
+                    return res
+                # a join's fan-out exceeded out_cap_max inside the fused
+                # driver: fall through to the mega-slab loop, whose own
+                # over-max rung escalates to blocked multi-pass execution
+                # (learned flips/resizes persist in join_cfgs)
         while True:
             prog = get_tree_program(root, caps, gcap, join_cfgs, akb)
             prep_vals = prog.collect_preps(flow_list)
@@ -1254,6 +1307,7 @@ class TpuFragmentExec:
                 with ph.phase("compute"):
                     out = prog(scan_inputs, scan_rows, prep_vals,
                                aligned_inputs)
+            ph.note_launch()
             fetch = {"ju": out["join_unique"], "jt": out["join_totals"]}
             host = None
             if is_agg:
@@ -1353,6 +1407,211 @@ class TpuFragmentExec:
         return _compact_decode(host["cols"], host["live"],
                                root.schema.field_types, dicts_root)
 
+    def _run_fused_pipeline(self, root, caps, scans, ents, scan_inputs,
+                            scan_rows, flow_list, flows, aligned_inputs,
+                            join_cfgs, walk_joins, akb, gcap, max_cap,
+                            out_cap_max, ladder, anchor_i
+                            ) -> Optional[Chunk]:
+        """Whole-pipeline fusion: ONE traced XLA program per probe-anchor
+        slab covering scan → filter → project → join-probe → partial-agg,
+        plus one shared root-merge program — intermediates never leave
+        registers/HBM and the warm path launches ≤2 programs per slab.
+
+        Join build sides ride inside each per-slab program at their FULL
+        (mega-slab) capacities — dimension tables, or FK-aligned columns
+        already in the anchor's row space — so every launch joins a
+        partition of the probe rows against complete build sides and the
+        slab union of agg partials is exact for every join kind (tree_ok
+        pins outer joins to preserve the probe side, the same argument
+        that makes _run_tree_blocked's row-range passes exact).
+
+        RESUMABLE: per-slab partials are checkpoints. A lost unique bet
+        re-traces and re-runs every slab (the join's trace changed); an
+        expand-capacity resize or a group-cap overflow re-runs ONLY the
+        slabs that overflowed; a merged-count-only overflow re-runs zero
+        slabs (bigger-cap re-merge of the checkpoints). Returns None when
+        a join's fan-out exceeds out_cap_max — the caller's mega-slab
+        loop owns the blocked multi-pass escalation."""
+        import hashlib
+
+        from tidb_tpu.executor import tree_fragment as TF
+        from tidb_tpu.executor.device_cache import _pow2
+        from tidb_tpu.ops.jax_env import jax, jnp
+
+        ph = self.ctx.phases
+        anchor = scans[anchor_i]
+        a_ent = ents[anchor_i][0]
+        n_slabs, slab_cap = a_ent.n_slabs, a_ent.slab_cap
+        pipe_caps = dict(caps)
+        pipe_caps[id(anchor)] = (slab_cap, 1)
+        anchor_rows = scan_rows[anchor_i]
+
+        # Joins whose aligned inputs live in the ANCHOR's row space — the
+        # only ones whose matched/column slabs may be sliced per anchor
+        # slab: the root's probe chain, plus recursively the build chains
+        # of its ALIGNED joins (_plan_aligned_joins re-anchored those to
+        # the fact row space via anchor_subs). An aligned join hanging
+        # off a non-aligned build subtree keeps its own fact scan's row
+        # space and passes its inputs through whole.
+        anchor_spaced: set = set()
+        stack = list(TF.aligned_chain(root.children[0])[1])
+        while stack:
+            j = stack.pop()
+            anchor_spaced.add(id(j))
+            ji = walk_joins.index(j)
+            if join_cfgs[ji].mode == "aligned":
+                bi = 1 if j.build_right else 0
+                stack.extend(TF.aligned_chain(j.children[bi])[1])
+
+        def slab_args(s):
+            si = list(scan_inputs)
+            si[anchor_i] = {i: [scan_inputs[anchor_i][i][s]]
+                            for i in scan_inputs[anchor_i]}
+            sr = list(scan_rows)
+            sr[anchor_i] = np.array([anchor_rows[s]], dtype=np.int32)
+            ai = []
+            for ji, jn in enumerate(walk_joins):
+                matched, jcols = aligned_inputs[ji]
+                if matched and id(jn) in anchor_spaced:
+                    ai.append(((matched[s],),
+                               {c: (sl[s],) for c, sl in jcols.items()}))
+                else:
+                    ai.append((matched, jcols))
+            return tuple(si), tuple(sr), tuple(ai)
+
+        from tidb_tpu.util import failpoint
+        partials: List = [None] * n_slabs
+        caps_ran = [0] * n_slabs       # group cap each partial ran at
+        to_run: Optional[List[int]] = None     # None = cold first pass
+        n_joins = len(walk_joins)
+        while True:
+            prog, pipe_sig = get_pipeline_program(root, pipe_caps, gcap,
+                                                  join_cfgs, akb)
+            prep_vals = prog.collect_preps(flow_list)
+            sig12 = hashlib.sha1(pipe_sig.encode()).hexdigest()[:12]
+            for s in (range(n_slabs) if to_run is None else to_run):
+                stale = partials[s]
+                si, sr, ai = slab_args(s)
+                # slot per slab DISPATCH (async queue) — one labeled
+                # compute span per fused slab program in the trace
+                with self.ctx.device_slot():
+                    with ph.phase("compute", sig=f"fused:{sig12}"):
+                        partials[s] = prog(si, sr, prep_vals, ai)
+                ph.note_launch()
+                ph.note_fused()
+                caps_ran[s] = gcap
+                if stale is not None:
+                    _tree_delete(stale)
+            # per-slab partials + root merge build the whole device graph
+            # first; every control value returns in ONE batched fetch
+            with self.ctx.device_slot():
+                with ph.phase("compute"):
+                    if n_slabs == 1:
+                        out = partials[0]
+                    else:
+                        mp = get_merge_program(root, gcap, pipe_sig)
+                        key_cols = []
+                        for kc in range(len(root.group_exprs)):
+                            key_cols.append(tuple(
+                                jnp.concatenate([p["keys"][kc][f]
+                                                 for p in partials])
+                                for f in range(2)))
+                        states = []
+                        for ai_ in range(len(root.aggs)):
+                            states.append(tuple(
+                                jnp.concatenate([p["states"][ai_][f]
+                                                 for p in partials])
+                                for f in range(
+                                    len(partials[0]["states"][ai_]))))
+                        slot_live = jnp.concatenate([p["slot_live"]
+                                                     for p in partials])
+                        out = mp.merge(key_cols, states, slot_live)
+                        ph.note_launch()
+                    fetch = {"ngs": [p["n_groups"] for p in partials],
+                             "ng": out["n_groups"],
+                             "jus": [p["join_unique"] for p in partials],
+                             "jts": [p["join_totals"] for p in partials]}
+                    small = _piggyback_agg(fetch, out, gcap)
+            with ph.phase("compute"):
+                jax.block_until_ready(fetch)
+            with ph.phase("fetch"):
+                got = jax.device_get(fetch)
+            ph.add_d2h(tree_nbytes(got))
+            # the fused-program capacity boundary: everything below
+            # classifies this round's overflows into rerun sets
+            failpoint.inject("fused-pipeline-overflow")
+            jts = np.asarray(got["jts"]).reshape(n_slabs, n_joins) \
+                if n_joins else np.zeros((n_slabs, 0), dtype=np.int64)
+            jus = np.asarray(got["jus"]).reshape(n_slabs, n_joins) \
+                if n_joins else np.zeros((n_slabs, 0), dtype=bool)
+            retry = False
+            charged = False
+            rerun: set = set()
+            for ji, cfg in enumerate(join_cfgs):
+                uq = bool(jus[:, ji].all())
+                tot = int(jts[:, ji].max()) if n_slabs else 0
+                new_cfg, action = TF.escalate_join(
+                    cfg, uq, tot, out_cap_max,
+                    flip_out_cap=_pow2(int(cfg.est * 1.3), lo=1024),
+                    ladder=ladder)
+                if action == "over-max":
+                    for p in partials:
+                        _tree_delete(p)
+                    if n_slabs > 1:
+                        _tree_delete(out)
+                    return None
+                if new_cfg is not None:
+                    join_cfgs[ji] = new_cfg
+                    retry = True
+                    if action == "flip":
+                        # the join's trace changed: every checkpoint is
+                        # from the wrong program — full re-run
+                        rerun.update(range(n_slabs))
+                    else:
+                        # exact resize: only slabs whose OWN fan-out
+                        # overflowed the old cap re-run
+                        rerun.update(s for s in range(n_slabs)
+                                     if int(jts[s, ji]) > cfg.out_cap)
+            n_final = int(got["ng"])
+            if akb is None:
+                over = [s for s in range(n_slabs)
+                        if int(got["ngs"][s]) > caps_ran[s]]
+                if over or n_final > gcap:
+                    if gcap >= max_cap:
+                        ladder.fallback("group")
+                        raise FragmentFallback("group cap overflow")
+                    # clipped slabs understate the merged count, so the
+                    # max overflowed per-slab count is the valid lower
+                    # bound; merged-only overflow is exact (rerun=0)
+                    need_cap = max([int(got["ngs"][s]) for s in over]
+                                   + [n_final])
+                    gcap = ladder.resize("group", gcap, need=need_cap,
+                                         max_cap=max_cap)
+                    ladder.attempt("group", _GroupCapOverflow(need_cap))
+                    ladder.partial_resume("group", rerun=len(over),
+                                          reused=n_slabs - len(over))
+                    charged = True
+                    rerun.update(over)
+                    retry = True
+            if retry:
+                if not charged:
+                    # budget + guard checkpoint between recompiles (the
+                    # join rungs above already recorded their own stats)
+                    ladder.attempt("fused")
+                if n_slabs > 1:
+                    _tree_delete(out)     # stale merge generation
+                to_run = sorted(rerun)
+                continue
+            break
+        if root.group_exprs and n_final == 0:
+            from tidb_tpu.executor import _empty_chunk
+            return _empty_chunk(self.schema)
+        inp_dicts = {i: d for i, d in enumerate(flows.get(id(root), []))}
+        host_tree = (got["keys"], got["states"]) if small else None
+        with ph.phase("decode"):
+            return self._agg_chunk(root, out, inp_dicts, max(n_final, 1),
+                                   host_tree=host_tree)
+
     def _run_tree_blocked(self, root, caps, join_cfgs, bji, walk_joins,
                           akb, gcap, max_cap, scans, ents, scan_inputs,
                           scan_rows, flow_list, aligned_inputs, flows,
@@ -1439,6 +1698,7 @@ class TpuFragmentExec:
                 with self.ctx.device_slot():
                     out = prog(scan_inputs, scan_rows, prep_vals,
                                aligned_inputs, rng)
+                self.ctx.phases.note_launch()
                 # flags first: a restart/overflow pass never transfers its
                 # (discarded) group arrays, and good passes transfer only
                 # ng live slots instead of the full gcap padding
@@ -1771,6 +2031,7 @@ class TpuFragmentExec:
                 with self.ctx.device_slot():
                     with ph.phase("compute"):
                         raw = prog(scan_inputs, scan_rows, prep_vals)
+                ph.note_launch()
                 with ph.phase("compute"):
                     jax.block_until_ready(raw)
                 with ph.phase("fetch"):
@@ -1946,6 +2207,8 @@ class TpuFragmentExec:
                         with ph.phase("compute"):
                             partials[s] = prog.partial(cols, jnp.int32(n),
                                                        prep_vals)
+                    ph.note_launch()
+                    ph.note_fused()   # a chain partial IS a fused pipeline
                     caps[s] = group_cap
             else:
                 for s in to_run:
@@ -1955,6 +2218,8 @@ class TpuFragmentExec:
                         with ph.phase("compute"):
                             partials[s] = prog.partial(cols, jnp.int32(n),
                                                        prep_vals)
+                    ph.note_launch()
+                    ph.note_fused()
                     caps[s] = group_cap
                     pairs_cache[s] = None
                     _tree_delete(stale)
@@ -2011,6 +2276,7 @@ class TpuFragmentExec:
                         slot_live = jnp.concatenate([p["slot_live"]
                                                      for p in partials])
                         out = prog.merge(key_cols, states, slot_live)
+                        ph.note_launch()
                     fetch = {"ngs": [p["n_groups"] for p in partials],
                              "ng": out["n_groups"]}
                     small = _piggyback_agg(fetch, out, prog.group_cap)
@@ -2128,6 +2394,8 @@ class TpuFragmentExec:
                 with ph.phase("compute"):
                     outs.append(prog.partial(cols, jnp.int32(n),
                                              prep_vals))
+            ph.note_launch()
+            ph.note_fused()
         with ph.phase("compute"):
             jax.block_until_ready([o["n_out"] for o in outs])
         with ph.phase("fetch"):
@@ -2168,6 +2436,8 @@ class TpuFragmentExec:
                 with ph.phase("compute"):
                     outs.append(prog.partial(cols, jnp.int32(n),
                                              prep_vals))
+            ph.note_launch()
+            ph.note_fused()
         with ph.phase("compute"):
             jax.block_until_ready(outs)
         with ph.phase("fetch"):

@@ -653,6 +653,8 @@ class TreeProgram:
     # -- trace ---------------------------------------------------------------
     def _run(self, scan_inputs, scan_rows, prep_vals, aligned_inputs=(),
              ranges=None):
+        from tidb_tpu.executor.fragment import _count_trace
+        _count_trace()        # once per TRACE — perf_smoke retrace meter
         self._prepared = {id(n): v
                           for n, v in zip(self.prep_nodes, prep_vals)
                           if v is not None}
@@ -958,7 +960,6 @@ class TreeProgram:
     # -- root reductions ------------------------------------------------------
     def _finish(self, cols, live):
         from tidb_tpu.ops.jax_env import jnp
-        from tidb_tpu.ops import factorize as F
         from tidb_tpu.executor import device_emit
         root = self.plan
         flags = self._join_unique_flags
@@ -971,8 +972,9 @@ class TreeProgram:
         }
         if isinstance(root, PhysHashAgg):
             ctx = self._ctx(cols)
-            out = device_emit.emit_agg(ctx, live, root, self.aggs,
-                                       self.group_cap, self.agg_key_bounds)
+            out = device_emit.emit_root(ctx, live, root, aggs=self.aggs,
+                                        group_cap=self.group_cap,
+                                        key_bounds=self.agg_key_bounds)
             out.update(out_flags)
             return out
         # non-agg roots emit every schema column; unused (None) positions
@@ -980,26 +982,10 @@ class TreeProgram:
         n = live.shape[0]
         cols = [(jnp.zeros(n, dtype=jnp.int64), jnp.zeros(n, dtype=bool))
                 if c is None else c for c in cols]
-        if isinstance(root, (PhysTopN, PhysSort)):
-            ctx = self._ctx(cols)
-            keys = [e.eval(ctx) for e in root.by]
-            n_out_cols = len(root.schema)
-            if isinstance(root, PhysTopN):
-                k = min(root.count + root.offset, live.shape[0])
-                idx, n_out = F.topn(keys, root.descs, live, k)
-            else:
-                idx, n_out = F.sort_perm(keys, root.descs, live)
-            gathered = [(jnp.take(jnp.asarray(v), idx),
-                         jnp.take(jnp.asarray(m), idx))
-                        for v, m in cols[:n_out_cols]]
-            return {"cols": gathered, "n_out": n_out, **out_flags}
-        if isinstance(root, PhysWindow):
-            ctx = self._ctx(cols)
-            out = device_emit.emit_window(ctx, live, root)
-            out.update(out_flags)
-            return out
-        return {"cols": [(jnp.asarray(v), jnp.asarray(m))
-                         for v, m in cols], "live": live, **out_flags}
+        ctx = self._ctx(cols)
+        out = device_emit.emit_root(ctx, live, root)
+        out.update(out_flags)
+        return out
 
     def __call__(self, scan_inputs, scan_rows, prep_vals,
                  aligned_inputs=(), ranges=None):

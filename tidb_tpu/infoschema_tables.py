@@ -215,6 +215,8 @@ def _partitions(session):
            ("D2H_BYTES", T.bigint()),
            ("SCAN_BYTES", T.bigint()),
            ("COMPILES", T.bigint()),
+           ("PROGRAMS_LAUNCHED", T.bigint()),
+           ("FUSED_PIPELINES", T.bigint()),
            ("QUEUE_WAIT_S", T.double()),
            ("QUEUE_WAITS", T.bigint()),
            ("QUEUE_P50_MS", T.double()),
@@ -228,6 +230,7 @@ def _statements_summary(session):
     return [(p["digest"], p["count"], p["sum_s"], p["avg_s"], p["max_s"],
              p["rows"], p["engine"], p["device_s"], p["h2d_bytes"],
              p["d2h_bytes"], p["scan_bytes"], p["compiles"],
+             p["programs_launched"], p["fused_pipelines"],
              p["queue_wait_s"], p["queue_waits"], p["queue_p50_ms"],
              p["queue_p99_ms"])
             for p in REGISTRY.summary_profiles()]

@@ -56,6 +56,15 @@ QUERIES = [
 # without an ORDER BY the engines may emit groups in any order.
 RECOMPILE_QUERY = "select d + 0.0, count(*) from cs_facts group by d + 0.0"
 
+# join + EXPRESSION group key: the agg-over-join shape rides the fused
+# per-slab pipeline, and the expression key (no cached bounds, no NDV
+# stats) keeps the factorize cap at the session var — squeezing
+# tidb_tpu_group_cap makes the overflow land INSIDE the fused driver's
+# batched flag round, where the resumable retry re-runs only the
+# overflowed slabs. ~997 distinct keys; compared as sorted row sets.
+FUSED_QUERY = ("select f.a + 0, count(*) from cs_facts f "
+               "join cs_dim d on f.b = d.id group by f.a + 0")
+
 # distributed shapes — integer results, so dist vs CPU comparison is
 # exact. The DISTINCT agg matters: a plain group-by distributes through
 # gather_partials (no re-key), so only the DISTINCT re-key exchange (and
@@ -139,6 +148,24 @@ def _scenarios(mesh: Optional[int] = None) -> List[Scenario]:
                  dict(raise_=RuntimeError("chaos: recompile"), times=1),
                  run="recompile",
                  vars={**device_on, "tidb_tpu_group_cap": "64"}),
+        # the fused per-slab pipeline's capacity boundary: the site is
+        # armed with NO action — it purely meters that the fused driver's
+        # overflow-classification round ran — while the squeezed group
+        # cap forces an in-pipeline escalation whose resumable retry is
+        # asserted through the capacity ladder (slabs_rerun, exact
+        # resize), results staying byte-equal to the oracle
+        Scenario("fused pipeline overflow → resumable in-pipeline retry",
+                 "fused-pipeline-overflow", dict(), run="fused",
+                 vars={**device_on, "tidb_tpu_group_cap": "64",
+                       "tidb_tpu_max_slab_rows": "1024"}),
+        # a fault AT the fused capacity boundary: the per-statement guard
+        # converts it to a warned CPU fallback — oracle rows, never a
+        # truncated fused result
+        Scenario("fused boundary fault → CPU fallback",
+                 "fused-pipeline-overflow",
+                 dict(raise_=RuntimeError("chaos: fused boundary"),
+                      times=9),
+                 run="fused", vars=dict(device_on)),
         # -- DDL -----------------------------------------------------------
         Scenario("unique backfill dies mid-reorg", "index-backfill",
                  dict(raise_=ExecutionError("chaos: backfill"), times=1),
@@ -274,7 +301,7 @@ def run_sweep(verbose: bool = False, mesh: Optional[int] = None,
     # oracle recorded AFTER the probe write; re-recorded after every
     # mutating scenario, so "correct result" always means "what a clean
     # run over the CURRENT data returns"
-    oracle_qs = QUERIES + [RECOMPILE_QUERY] + \
+    oracle_qs = QUERIES + [RECOMPILE_QUERY, FUSED_QUERY] + \
         [q for q in MESH_QUERIES if q not in QUERIES]
     oracle = {q: s.query(q).rows for q in oracle_qs}
     base_count = s.query("select count(*) from cs_facts").scalar()
@@ -318,6 +345,32 @@ def run_sweep(verbose: bool = False, mesh: Optional[int] = None,
                 elif sorted(rows) != sorted(oracle[q]):
                     wrong += 1
                     failures.append(f"{sc.name}: {q!r} SILENT WRONG RESULT")
+            elif sc.run == "fused":
+                q = FUSED_QUERY
+                rows, err, dt = _run_statement(s, q)
+                if dt > DEADLINE_S:
+                    slow += 1
+                    failures.append(f"{sc.name}: {q!r} took {dt:.1f}s")
+                if err is not None:
+                    errors += 1
+                elif sorted(rows) != sorted(oracle[q]):
+                    wrong += 1
+                    failures.append(f"{sc.name}: {q!r} SILENT WRONG RESULT")
+                elif sc.enable_kw.get("raise_") is None:
+                    # site armed with no action → the fused driver must
+                    # have taken its RESUMABLE escalation: the squeezed
+                    # group cap overflows inside the pipeline, the ladder
+                    # records one exact resize, and only overflowed slab
+                    # partials re-run (uniform key spread here → all of
+                    # them overflow; reuse-split skew is pinned down in
+                    # tests/test_fused_pipeline.py)
+                    esc = s.last_guard.escalation
+                    if esc.slabs_rerun == 0 or esc.exact_resizes == 0:
+                        failures.append(
+                            f"{sc.name}: fused driver skipped the "
+                            f"resumable retry (slabs_rerun="
+                            f"{esc.slabs_rerun} exact_resizes="
+                            f"{esc.exact_resizes})")
             elif sc.run in ("mesh-read", "mesh-agg"):
                 # mesh-agg: only the staged-eligible plain group-by —
                 # the DISTINCT/join shapes run monolithic, where a
@@ -466,7 +519,8 @@ def run_sweep(verbose: bool = False, mesh: Optional[int] = None,
         after = s.query("select count(*) from cs_facts").scalar()
         if after != base_count:
             failures.append(f"{sc.name}: count drifted after scenario")
-        if sc.run not in ("read", "recompile", "mesh-read", "mesh-agg"):
+        if sc.run not in ("read", "recompile", "fused",
+                          "mesh-read", "mesh-agg"):
             # mutating scenarios move the goalposts: refresh the oracle
             oracle = {q: s.query(q).rows for q in oracle_qs}
             base_count = after

@@ -110,6 +110,10 @@ register("shard-redispatch", "re-dispatch of a persistently failing "
 register("degraded-mesh-replan", "entry of degraded-mesh mode: the "
          "fragment re-plans the failed rank's work on the N-1 surviving "
          "ranks (executor/dist_fragment.py)", mesh_only=True)
+register("fused-pipeline-overflow", "capacity boundary of the fused "
+         "per-slab pipeline driver — hit after every round's batched flag "
+         "fetch, right before join/group overflows are classified into "
+         "rerun sets (executor/fragment.py _run_fused_pipeline)")
 
 
 def enable(name: str, *, raise_: Optional[BaseException] = None,

@@ -155,6 +155,7 @@ class Registry:
                      "max_s": 0.0, "rows": 0, "last_seen": 0.0,
                      "device_s": 0.0, "h2d_bytes": 0, "d2h_bytes": 0,
                      "scan_bytes": 0, "compiles": 0,
+                     "programs_launched": 0, "fused_pipelines": 0,
                      "queue_wait_s": 0.0, "queue_waits": 0,
                      "queue_hist": _hist_new(),
                      "phase_s": {}, "engine": engine}
@@ -177,6 +178,8 @@ class Registry:
                 s["d2h_bytes"] += ph.d2h_bytes
                 s["scan_bytes"] += ph.scan_bytes
                 s["compiles"] += ph.compiles
+                s["programs_launched"] += ph.programs_launched
+                s["fused_pipelines"] += ph.fused_pipelines
                 for p, v in ph.seconds.items():
                     s["phase_s"][p] = s["phase_s"].get(p, 0.0) + v
             if seconds >= threshold:
@@ -241,6 +244,8 @@ class Registry:
                     "d2h_bytes": s["d2h_bytes"],
                     "scan_bytes": s["scan_bytes"],
                     "compiles": s["compiles"],
+                    "programs_launched": s.get("programs_launched", 0),
+                    "fused_pipelines": s.get("fused_pipelines", 0),
                     "queue_wait_s": round(s["queue_wait_s"], 6),
                     "queue_waits": s["queue_waits"],
                     "queue_p50_ms": round(

@@ -66,7 +66,7 @@ class PhaseTimer:
 
     __slots__ = ("seconds", "overlapped_s", "wall_s", "_in_flight",
                  "h2d_bytes", "d2h_bytes", "scan_bytes", "compiles",
-                 "conn_id")
+                 "programs_launched", "fused_pipelines", "conn_id")
 
     def __init__(self, conn_id: int = 0):
         self.seconds: Dict[str, float] = {p: 0.0 for p in PHASES}
@@ -77,10 +77,15 @@ class PhaseTimer:
         self.d2h_bytes = 0        # device→host fetch bytes
         self.scan_bytes = 0       # HBM column bytes the program read
         self.compiles = 0         # XLA program traces charged to this stmt
+        self.programs_launched = 0  # jitted device program dispatches
+        self.fused_pipelines = 0    # of those, whole-pipeline slab launches
         self.conn_id = conn_id    # timeline pid (0 = unattributed)
 
     @contextmanager
-    def phase(self, name: str):
+    def phase(self, name: str, sig: Optional[str] = None):
+        """`sig` labels the timeline span (the fused pipeline's signature
+        digest on per-slab compute spans); the seconds ledger is keyed by
+        `name` alone."""
         t0 = time.perf_counter()
         try:
             yield
@@ -91,7 +96,8 @@ class PhaseTimer:
                 self.overlapped_s += dt
             if timeline.ENABLED:
                 timeline.record(name, name, dur_us=dt * 1e6,
-                                pid=self.conn_id)
+                                pid=self.conn_id,
+                                args={"sig": sig} if sig else None)
 
     def mark_in_flight(self) -> None:
         """First slab's device work has been issued: later encode time is
@@ -116,6 +122,15 @@ class PhaseTimer:
 
     def note_compile(self) -> None:
         self.compiles += 1
+
+    def note_launch(self, n: int = 1) -> None:
+        """A jitted device program was dispatched (warm or cold)."""
+        self.programs_launched += int(n)
+
+    def note_fused(self, n: int = 1) -> None:
+        """A dispatched program was a whole-pipeline fused slab launch
+        (scan→filter→join-probe→partial-agg in one traced XLA program)."""
+        self.fused_pipelines += int(n)
 
     def fetch(self, tree):
         """jax.device_get under the fetch phase, with the transferred
@@ -145,6 +160,8 @@ class PhaseTimer:
         out["d2h_bytes"] = self.d2h_bytes
         out["scan_bytes"] = self.scan_bytes
         out["compiles"] = self.compiles
+        out["programs_launched"] = self.programs_launched
+        out["fused_pipelines"] = self.fused_pipelines
         return out
 
     def summary(self) -> str:
@@ -161,6 +178,9 @@ class PhaseTimer:
             parts.append(f"h2d={self.h2d_bytes}B d2h={self.d2h_bytes}B")
         if self.compiles:
             parts.append(f"compiles={self.compiles}")
+        if self.programs_launched:
+            parts.append(f"launches={self.programs_launched} "
+                         f"fused={self.fused_pipelines}")
         return " ".join(parts)
 
 
