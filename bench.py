@@ -591,6 +591,43 @@ def main():
     HEADLINE["value"] = n_rows / dev_t
     HEADLINE["vs"] = cpu_t / dev_t
 
+    # ---- compressed layouts: bytes saved + first-touch A/B ----------------
+    # The cold Q1 ledger above ran with compressed layouts (the default):
+    # its logical/physical byte pair IS the bytes-saved figure. The A/B
+    # re-touches the table raw (compression off invalidates the cache
+    # entry) and then compressed again, so both first-touch walls and
+    # both PCIe byte totals come from the same warm process.
+    try:
+        if ph is not None and ph.h2d_logical_bytes > ph.h2d_bytes:
+            extra["q1_bytes_saved"] = ph.h2d_logical_bytes - ph.h2d_bytes
+        log("compression A/B: raw first touch…")
+        s.vars["tidb_tpu_compression"] = "off"
+        raw_touch_t, _, _ = time_query(s, 1, reserve_s=60.0)
+        ph_raw = frag_mod.LAST_PHASES
+        log("compression A/B: compressed first touch…")
+        s.vars["tidb_tpu_compression"] = "on"
+        comp_touch_t, _, _ = time_query(s, 1, reserve_s=60.0)
+        ph_comp = frag_mod.LAST_PHASES
+        if ph_raw is not None and ph_comp is not None and \
+                ph_raw.h2d_bytes and ph_comp.h2d_bytes:
+            red = ph_raw.h2d_bytes / ph_comp.h2d_bytes
+            extra.update({
+                "q1_first_touch_raw_s": round(raw_touch_t, 3),
+                "q1_first_touch_compressed_s": round(comp_touch_t, 3),
+                "q1_h2d_bytes_raw": ph_raw.h2d_bytes,
+                "q1_h2d_bytes_compressed": ph_comp.h2d_bytes,
+                "q1_h2d_reduction_x": round(red, 2),
+                "q1_bytes_saved": ph_raw.h2d_bytes - ph_comp.h2d_bytes,
+            })
+            log(f"compression: h2d {ph_raw.h2d_bytes}B raw → "
+                f"{ph_comp.h2d_bytes}B compressed ({red:.1f}x less PCIe), "
+                f"first touch {raw_touch_t:.3f}s → {comp_touch_t:.3f}s")
+    except BenchBudgetExceeded:
+        raise
+    except Exception as e:
+        log(f"compression A/B skipped: {e}")
+        extra["compression_ab_error"] = str(e)[:200]
+
     # ---- concurrent serving: warm mixed Q1/Q3 throughput ------------------
     # concurrency 1 vs 8 through the device scheduler. Runs right after
     # the Q1 device section so qps_c1/qps_c8 land even if a later join

@@ -1,0 +1,200 @@
+"""Compressed physical column layouts for the device cache.
+
+Per-column layout chosen once at encode time ("Fine-Tuning Data
+Structures for Analytical Query Processing" — the load-time layout
+decision is the highest-leverage lever for scan-bound analytics):
+
+  * pack — frame-of-reference bit-packing: codes are `value - ref`
+    (ref = min over valid values, so negative ints need no zig-zag)
+    packed at the observed bit width into uint32 words;
+  * dict — low-cardinality int columns store sorted-dictionary rank
+    codes (the string-dictionary idea extended to ints), packed at the
+    code width, with ONE shared dictionary values array per column.
+
+Width is rounded up to {0, 1, 2, 4, 8, 16, 32} so codes never straddle
+a word boundary and the device decode is a gather-free broadcast
+shift/mask. Width 0 means every valid value equals `ref` (single
+distinct value, or an all-NULL column) — no words are stored at all.
+Validity masks are themselves bit-packed at width 1 over the padded
+slab, so a compressed slab is (words, mask_words[, dictvals]) and the
+raw representation never crosses PCIe.
+
+Decode is xp-generic (numpy for the CPU oracle in tests, jnp inside
+traced fragments via device_emit.emit_decode) and byte-exact: packing
+the PADDED slab preserves the False padding of the mask, and invalid
+slots pack as code 0 — their decoded values are don't-care because
+every consumer masks by validity.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import numpy as np
+
+from tidb_tpu.errors import LayoutError
+
+#: legal packed widths — each divides the 32-bit word exactly
+WIDTHS = (0, 1, 2, 4, 8, 16, 32)
+WORD_BITS = 32
+#: dictionary layout only below this cardinality (TiFlash's low-card
+#: dictionary threshold is the same order of magnitude)
+DICT_CARD_CAP = 4096
+
+
+@dataclass(frozen=True)
+class ColLayout:
+    """Static per-column layout descriptor — hashable and data-light so
+    it keys program signatures (escalation recompiles stay exact-need)."""
+
+    kind: str      # "pack" (FoR bit-pack) | "dict" (dictionary codes)
+    width: int     # bits per packed code — one of WIDTHS
+    ref: int       # frame-of-reference base (pack); 0 for dict
+    dtype: str     # logical numpy dtype name the decode restores
+    card: int = 0  # dictionary cardinality (dict kind only)
+
+    def sig(self) -> str:
+        return (f"{self.kind}:w{self.width}:r{self.ref}:"
+                f"c{self.card}:{self.dtype}")
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return np.dtype(self.dtype)
+
+
+def validate(layout) -> None:
+    """Reject a corrupted/inconsistent descriptor with a typed error —
+    consumers call this BEFORE decoding, so a bad descriptor can never
+    reach the traced decode and produce silently wrong rows."""
+    if not isinstance(layout, ColLayout):
+        raise LayoutError(
+            f"column layout descriptor is not a ColLayout: {layout!r}")
+    if layout.kind not in ("pack", "dict"):
+        raise LayoutError(f"unknown layout kind {layout.kind!r}")
+    if layout.width not in WIDTHS:
+        raise LayoutError(
+            f"illegal packed width {layout.width} (legal: {WIDTHS})")
+    try:
+        dt = np.dtype(layout.dtype)
+    except TypeError as e:
+        raise LayoutError(
+            f"layout dtype {layout.dtype!r} is not a dtype") from e
+    if dt.kind not in "iu":
+        raise LayoutError(
+            f"layout dtype {layout.dtype!r} is not an integer type")
+    if layout.kind == "dict" and layout.card <= 0:
+        raise LayoutError(
+            f"dict layout with non-positive cardinality {layout.card}")
+
+
+def _round_width(bits: int) -> Optional[int]:
+    for w in WIDTHS:
+        if bits <= w:
+            return w
+    return None
+
+
+def choose_layout(vals: np.ndarray, valid: np.ndarray,
+                  allow_dict: bool = True
+                  ) -> Tuple[Optional[ColLayout], Optional[np.ndarray]]:
+    """GLOBAL per-column layout decision → (layout or None, dictvals).
+
+    Over the FULL column so every slab shares one layout (and one
+    program signature). Floats, wide decimals (never integer dtype
+    here) and columns whose observed range needs more than half the
+    logical width stay raw — compression must at least halve the value
+    bytes to be worth a layout."""
+    dt = vals.dtype
+    if dt.kind not in "iu" or dt.itemsize > 8:
+        return None, None
+    max_width = dt.itemsize * 8 // 2
+    name = dt.name
+    vv = vals if valid.all() else vals[valid]
+    if vv.size == 0:
+        # all-NULL column: width 0, nothing stored but the packed mask
+        return ColLayout("pack", 0, 0, name), None
+    lo, hi = int(vv.min()), int(vv.max())
+    pw = _round_width((hi - lo).bit_length())
+    pack = ColLayout("pack", pw, lo, name) \
+        if pw is not None and pw <= max_width else None
+    if allow_dict and (pack is None or pack.width > 1):
+        uniq = np.unique(vv)
+        card = int(uniq.size)
+        if card <= DICT_CARD_CAP:
+            dw = _round_width(max(card - 1, 0).bit_length())
+            if dw is not None and dw <= max_width and \
+                    (pack is None or dw < pack.width):
+                return ColLayout("dict", dw, 0, name, card), uniq
+    return pack, None
+
+
+def _pack_codes(codes: np.ndarray, width: int) -> np.ndarray:
+    """Non-negative uint64 codes (< 2^width) → uint32 words, element j
+    of word w at bits [j*width, (j+1)*width)."""
+    per = WORD_BITS // width
+    n = codes.shape[0]
+    n_words = -(-n // per)
+    if n_words * per != n:
+        pad = np.zeros(n_words * per, dtype=np.uint64)
+        pad[:n] = codes
+        codes = pad
+    codes = codes.reshape(n_words, per)
+    shifts = np.arange(per, dtype=np.uint64) * np.uint64(width)
+    words = np.bitwise_or.reduce(codes << shifts[None, :], axis=1)
+    return words.astype(np.uint32)
+
+
+def pack_slab(layout: ColLayout, vals: np.ndarray, mask: np.ndarray,
+              dictvals: Optional[np.ndarray] = None
+              ) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side encode of ONE padded slab → (words, mask_words).
+    Invalid/padding slots pack as code 0 (decoded values there are
+    don't-care — consumers mask by validity); the mask packs the padded
+    slab exactly, so decode restores it byte-for-byte."""
+    mask = np.asarray(mask, dtype=bool)
+    mask_words = _pack_codes(mask.astype(np.uint64), 1)
+    if layout.width == 0:
+        # nothing to store: every valid value IS layout.ref
+        return np.zeros(1, dtype=np.uint32), mask_words
+    if layout.kind == "dict":
+        safe = np.where(mask, vals, dictvals[0])
+        codes = np.searchsorted(dictvals, safe).astype(np.uint64)
+    else:
+        codes = np.where(mask, vals.astype(np.int64) - np.int64(layout.ref),
+                         0).astype(np.uint64)
+    return _pack_codes(codes, layout.width), mask_words
+
+
+def _unpack_codes(words, width: int, cap: int, xp):
+    per = WORD_BITS // width
+    w = xp.asarray(words)
+    shifts = (xp.arange(per) * width).astype(np.uint32)
+    m = np.uint32(0xFFFFFFFF) if width == WORD_BITS \
+        else np.uint32((1 << width) - 1)
+    codes = (w[:, None] >> shifts[None, :]) & m
+    return codes.reshape(-1)[:cap]
+
+
+def decode_slab(layout: ColLayout, slab, cap: int, xp):
+    """One packed slab → (vals, mask) in the logical dtype. xp is numpy
+    (CPU oracle) or jnp (traced inside the fragment — a gather-free
+    broadcast shift/mask, plus one take for dict columns)."""
+    validate(layout)
+    words, mask_words = slab[0], slab[1]
+    mask = _unpack_codes(mask_words, 1, cap, xp) != 0
+    dt = layout.np_dtype
+    if layout.width == 0:
+        return xp.full(cap, layout.ref, dtype=dt), mask
+    codes = _unpack_codes(words, layout.width, cap, xp)
+    if layout.kind == "dict":
+        # dict codes are < DICT_CARD_CAP, so int32 indexing is exact
+        idx = xp.clip(codes.astype(np.int32), 0, layout.card - 1)
+        return xp.take(xp.asarray(slab[2]), idx).astype(dt), mask
+    return (codes.astype(np.int64) + np.int64(layout.ref)).astype(dt), mask
+
+
+def raw_slab_bytes(layout: ColLayout, cap: int) -> int:
+    """Logical bytes one slab WOULD occupy uncompressed: values at the
+    logical dtype plus the 1-byte-per-row bool validity mask."""
+    return cap * (layout.np_dtype.itemsize + 1)

@@ -114,6 +114,16 @@ class ShardFailure(ExecutionError):
     retryable = True
 
 
+class LayoutError(ExecutionError):
+    """A compressed column's physical-layout descriptor is invalid or
+    inconsistent with the data it describes (corrupted kind/width/ref).
+    Raised BEFORE any decode runs so a bad descriptor can never produce
+    silently wrong rows; the executor's generic fallback ladder re-runs
+    the fragment on the CPU oracle path."""
+
+    code = 1105
+
+
 class DivisionByZero(TiDBTPUError):
     code = 1365  # ER_DIVISION_BY_ZERO
 

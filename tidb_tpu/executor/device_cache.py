@@ -40,13 +40,22 @@ DEFAULT_HBM_BUDGET_BYTES = 8 << 30
 
 
 class CachedTable:
-    """Per-table device payload: per-column slab lists + dictionaries."""
+    """Per-table device payload: per-column slab lists + dictionaries.
+
+    With compression on, a column's slabs may be PACKED tuples
+    (words, mask_words[, dictvals]) per chunk/compress.py — `layouts`
+    records the per-column descriptor (None = raw), and the dictvals
+    device array of a dict-layout column is the SAME object in every
+    slab tuple, so byte accounting and deletion dedupe it by identity.
+    hbm_bytes() therefore charges PHYSICAL (compressed) bytes — the
+    budget/eviction accounting sees what HBM actually holds."""
 
     __slots__ = ("td", "max_slab", "total", "slab_cap", "n_slabs",
-                 "parts", "dicts", "dev", "bounds", "n_cols")
+                 "parts", "dicts", "dev", "bounds", "n_cols", "layouts",
+                 "compressed")
 
     def __init__(self, td, max_slab: int, total: int, slab_cap: int,
-                 n_slabs: int, parts, n_cols: int):
+                 n_slabs: int, parts, n_cols: int, compressed: bool = False):
         self.td = td                    # TableData identity token (or None)
         self.n_cols = n_cols            # schema width at build (DDL guard)
         self.max_slab = max_slab
@@ -54,8 +63,11 @@ class CachedTable:
         self.slab_cap = slab_cap
         self.n_slabs = n_slabs
         self.parts = parts              # [(aligned chunk, alive or None)]
+        self.compressed = compressed    # tidb_tpu_compression at build
         self.dicts: Dict[int, Optional[np.ndarray]] = {}
         self.dev: Dict[int, List[Tuple]] = {}  # col → [(vals, valid)] slabs
+        # col → ColLayout for packed columns; None/absent = raw layout
+        self.layouts: Dict[int, Optional[object]] = {}
         # col → (lo, hi) over valid values; None for floats/empty — feeds
         # the perfect-hash group-by domain gate (fragment._agg_key_bounds)
         self.bounds: Dict[int, Optional[Tuple[int, int]]] = {}
@@ -65,9 +77,30 @@ class CachedTable:
 
     def hbm_bytes(self) -> int:
         total = 0
+        seen = set()
         for slabs in self.dev.values():
-            for v, m in slabs:
-                total += v.nbytes + m.nbytes
+            for t in slabs:
+                for a in t:
+                    if id(a) in seen:
+                        continue        # shared dictvals counted once
+                    seen.add(id(a))
+                    total += a.nbytes
+        return total
+
+    def logical_bytes(self, cols=None) -> int:
+        """Bytes the selected columns WOULD occupy uncompressed (raw
+        columns: physical == logical)."""
+        from tidb_tpu.chunk import compress
+        total = 0
+        for i, slabs in self.dev.items():
+            if cols is not None and i not in cols:
+                continue
+            lay = self.layouts.get(i)
+            if lay is None:
+                total += sum(a.nbytes for t in slabs for a in t)
+            else:
+                total += compress.raw_slab_bytes(lay, self.slab_cap) \
+                    * len(slabs)
         return total
 
     def delete(self) -> None:
@@ -75,10 +108,14 @@ class CachedTable:
         entry must not keep HBM resident until the GC happens to run —
         a recompile right after eviction would otherwise double the
         high-water mark."""
+        seen = set()
         for slabs in self.dev.values():
-            for v, m in slabs:
-                _delete_array(v)
-                _delete_array(m)
+            for t in slabs:
+                for a in t:
+                    if id(a) in seen:
+                        continue        # shared dictvals deleted once
+                    seen.add(id(a))
+                    _delete_array(a)
         self.dev.clear()
 
 
@@ -326,11 +363,12 @@ def _col_prep(ent: CachedTable, col_idx: int, ftype) -> dict:
     byte-identical to encoding the whole column at once, because the
     dictionary is global and searchsorted on the sorted unique keys IS
     np.unique's return_inverse."""
+    from tidb_tpu.chunk import compress
     vals, valid = _materialize_col(ent, col_idx)
     if ftype.is_wide_decimal:
         return {"kind": "wide", "vals": vals, "valid": valid,
                 "n_limbs": ftype.wide_limb_count,
-                "dict": None, "bounds": None}
+                "dict": None, "bounds": None, "layout": None}
     if ftype.is_varlen:
         str_vals = np.array([str(v) for v in vals], dtype=object)
         if ftype.is_ci:
@@ -346,14 +384,29 @@ def _col_prep(ent: CachedTable, col_idx: int, ftype) -> dict:
                     "keys": dictionary}
         prep["dict"] = dictionary
         prep["bounds"] = (0, len(dictionary) - 1) if len(dictionary) else None
+        prep["layout"] = None
+        if ent.compressed:
+            # string columns already carry global dictionary codes
+            # (int32, 0..card-1) — bit-pack the CODES at the observed
+            # width (FoR with ref 0; a second dict layer would be noise)
+            card = len(dictionary)
+            pw = compress._round_width(max(card - 1, 0).bit_length())
+            if pw is not None and pw <= 16:
+                prep["layout"] = compress.ColLayout("pack", pw, 0, "int32")
         return prep
     if vals.dtype == np.dtype(np.float64):
         from tidb_tpu.ops.jax_env import device_float_dtype
         return {"kind": "float", "vals": vals, "valid": valid,
                 "dtype": np.dtype(device_float_dtype()),
-                "dict": None, "bounds": None}
-    return {"kind": "num", "vals": vals, "valid": valid,
-            "dict": None, "bounds": _col_bounds(vals, valid, None)}
+                "dict": None, "bounds": None, "layout": None}
+    prep = {"kind": "num", "vals": vals, "valid": valid,
+            "dict": None, "bounds": _col_bounds(vals, valid, None),
+            "layout": None}
+    if ent.compressed:
+        layout, dictvals = compress.choose_layout(vals, valid)
+        prep["layout"] = layout
+        prep["dictvals"] = dictvals
+    return prep
 
 
 def _slab_host(prep: dict, start: int, stop: int, slab_cap: int):
@@ -384,19 +437,61 @@ def _slab_host(prep: dict, start: int, stop: int, slab_cap: int):
         pm = np.zeros(slab_cap, dtype=bool)
         pm[:n] = m
         m = pm
+    layout = prep.get("layout")
+    if layout is not None:
+        from tidb_tpu.chunk import compress
+        return compress.pack_slab(layout, v, m, prep.get("dictvals"))
     return v, m
+
+
+def _tuple_nbytes(t) -> int:
+    """Physical bytes of one slab tuple (raw or packed)."""
+    return sum(a.nbytes for a in t)
+
+
+def _logical_tuple_bytes(ent: CachedTable, i: int, t) -> int:
+    """Logical (uncompressed-equivalent) bytes of one slab tuple."""
+    lay = ent.layouts.get(i)
+    if lay is None:
+        return _tuple_nbytes(t)
+    from tidb_tpu.chunk import compress
+    return compress.raw_slab_bytes(lay, ent.slab_cap)
+
+
+def _note_storage_metrics(ent: CachedTable, key) -> None:
+    if key is None:
+        return
+    from tidb_tpu.util.observability import REGISTRY
+    REGISTRY.observe("tidb_tpu_table_physical_bytes",
+                     float(ent.hbm_bytes()), {"table": str(key[1])})
+    REGISTRY.observe("tidb_tpu_table_logical_bytes",
+                     float(ent.logical_bytes()), {"table": str(key[1])})
 
 
 def _stream_slabs(ctx, ent: CachedTable, key, used_cols, preps, phases):
     """Generator behind open_table: per slab, encode the missing columns
     (host), issue their uploads (async device_put), and yield
-    (slab_idx, {col: (vals, valid)}) covering EVERY used column so the
+    (slab_idx, {col: slab tuple}) covering EVERY used column so the
     caller can dispatch that slab's compute before the next encode —
-    encode(k+1) ∥ upload(k) ∥ compute(k-1). Completed columns commit to
-    the cache entry only after the LAST slab: a stream abandoned by an
-    error or a CPU fallback never leaves a half-uploaded column behind."""
+    encode(k+1) ∥ upload(k) ∥ compute(k-1). Compressed columns encode to
+    packed (words, mask_words[, dictvals]) tuples — only the PHYSICAL
+    bytes cross PCIe; the PhaseTimer is charged both counts. Completed
+    columns commit to the cache entry only after the LAST slab: a stream
+    abandoned by an error or a CPU fallback never leaves a half-uploaded
+    column behind."""
     from tidb_tpu.ops.jax_env import jnp
     new_slabs = {i: [] for i in preps}
+    # dict-layout columns upload their dictionary values ONCE; the same
+    # device array rides every slab tuple (deduped by identity in
+    # hbm_bytes/delete). Raw encode has no dictionary → logical 0.
+    dict_dev = {}
+    with phases.phase("upload"):
+        for i, prep in preps.items():
+            lay = prep.get("layout")
+            if lay is not None and lay.kind == "dict":
+                dict_dev[i] = jnp.asarray(prep["dictvals"])
+    if dict_dev:
+        phases.add_h2d(sum(a.nbytes for a in dict_dev.values()), logical=0)
     for s in range(ent.n_slabs):
         start = s * ent.slab_cap
         stop = min(start + ent.slab_cap, ent.total)
@@ -405,17 +500,23 @@ def _stream_slabs(ctx, ent: CachedTable, key, used_cols, preps, phases):
             for i, prep in preps.items():
                 host[i] = _slab_host(prep, start, stop, ent.slab_cap)
         with phases.phase("upload"):
-            for i, (hv, hm) in host.items():
-                new_slabs[i].append((jnp.asarray(hv), jnp.asarray(hm)))
-        phases.add_h2d(sum(hv.nbytes + hm.nbytes
-                           for hv, hm in host.values()))
+            for i, ht in host.items():
+                dev_t = tuple(jnp.asarray(a) for a in ht)
+                if i in dict_dev:
+                    dev_t = dev_t + (dict_dev[i],)
+                new_slabs[i].append(dev_t)
+        phases.add_h2d(sum(_tuple_nbytes(ht) for ht in host.values()),
+                       logical=sum(_logical_tuple_bytes(ent, i, ht)
+                                   for i, ht in host.items()))
         phases.mark_in_flight()
         cols = {i: (new_slabs[i][s] if i in new_slabs else ent.dev[i][s])
                 for i in used_cols}
         # HBM bytes this slab's compute will read — warm columns included,
         # so roofline scan_bytes covers the whole program, not just the
         # cold uploads
-        phases.add_scan(sum(v.nbytes + m.nbytes for v, m in cols.values()))
+        phases.add_scan(sum(_tuple_nbytes(t) for t in cols.values()),
+                        logical=sum(_logical_tuple_bytes(ent, i, t)
+                                    for i, t in cols.items()))
         yield s, cols
     with _LOCK:
         for i, slabs in new_slabs.items():
@@ -426,10 +527,77 @@ def _stream_slabs(ctx, ent: CachedTable, key, used_cols, preps, phases):
             if i not in ent.dev:
                 ent.dev[i] = slabs
     phases.clear_in_flight()
+    _note_storage_metrics(ent, key)
     if key is not None:
         budget = int(ctx.vars.get("tidb_tpu_hbm_budget",
                                   DEFAULT_HBM_BUDGET_BYTES))
         _evict_to_budget(budget, keep=key, keep_tables=_protected(ctx))
+
+
+def _validate_layouts(ent: CachedTable, used_cols) -> None:
+    """Validate the layout descriptor of every column the statement is
+    about to decode — on the serving path, BEFORE any program is built,
+    so a corrupted descriptor surfaces as a typed LayoutError (warned CPU
+    fallback in the executor) and never as silently wrong rows. The
+    failpoint models the corruption: any armed value stands in for a
+    descriptor that no longer matches the packed data."""
+    from tidb_tpu.chunk import compress
+    from tidb_tpu.errors import LayoutError
+    from tidb_tpu.util import failpoint
+    corrupted = failpoint.inject("compressed-decode-mismatch")
+    if corrupted is not None:
+        raise LayoutError(
+            f"compressed column layout descriptor corrupted "
+            f"(failpoint: {corrupted!r}) — refusing to decode")
+    for i in used_cols:
+        lay = ent.layouts.get(i)
+        if lay is not None:
+            compress.validate(lay)
+
+
+def _decoded_slabs(ent: CachedTable, col: int):
+    """Column slabs DECODED to raw (vals, valid) tuples — the one-off
+    eager decode for aligned-join builds, whose outputs (midx/matched
+    and gathered build columns) are cached raw in the fact slab layout,
+    so the per-query tree/fused consumers of aligned columns never
+    carry an in-trace decode."""
+    slabs = ent.dev[col]
+    lay = ent.layouts.get(col)
+    if lay is None:
+        return slabs
+    from tidb_tpu.chunk import compress
+    from tidb_tpu.ops.jax_env import jnp
+    return [compress.decode_slab(lay, t, ent.slab_cap, jnp)
+            for t in slabs]
+
+
+def storage_stats() -> List[dict]:
+    """Per-(table, column) physical/logical residency of every cached
+    entry — the information_schema.table_storage source. Snapshot under
+    the lock; byte math (which touches device array metadata only)
+    happens outside it."""
+    with _LOCK:
+        entries = [(k, e) for k, e in _CACHE.items()]
+    rows = []
+    for key, ent in entries:
+        for i in sorted(ent.dev):
+            lay = ent.layouts.get(i)
+            seen = set()
+            phys = 0
+            for t in ent.dev[i]:
+                for a in t:
+                    if id(a) in seen:
+                        continue
+                    seen.add(id(a))
+                    phys += a.nbytes
+            rows.append({
+                "table_id": key[1],
+                "column": i,
+                "layout": "raw" if lay is None else lay.sig(),
+                "physical_bytes": int(phys),
+                "logical_bytes": int(ent.logical_bytes(cols={i})),
+            })
+    return rows
 
 
 def _protected(ctx) -> frozenset:
@@ -460,6 +628,8 @@ def open_table(ctx, scan, used_cols, max_slab: int, phases=None):
     from tidb_tpu.util import failpoint
     from tidb_tpu.util.phases import PhaseTimer
     table_id = scan.table.id
+    comp_on = str(ctx.vars.get("tidb_tpu_compression", "on")).lower() \
+        not in ("off", "0", "false")
     cacheable = getattr(ctx, "txn", None) is None
     td = ctx.snapshot.table_data(table_id) if cacheable else None
     # key by owning store too: distinct engines may reuse table ids; a
@@ -475,9 +645,12 @@ def open_table(ctx, scan, used_cols, max_slab: int, phases=None):
                 store, _evict_store, id(store))
 
     def _usable(e):
-        # td identity = data freshness; n_cols = DDL (ADD/DROP COLUMN) guard
+        # td identity = data freshness; n_cols = DDL (ADD/DROP COLUMN)
+        # guard; compressed must match the session's tidb_tpu_compression
+        # so toggling it rebuilds the entry (the A/B comparison knob)
         return (e.td is td and e.max_slab == max_slab
-                and e.n_cols == len(scan.schema))
+                and e.n_cols == len(scan.schema)
+                and e.compressed == comp_on)
 
     stale = None
     with _LOCK:
@@ -495,7 +668,7 @@ def open_table(ctx, scan, used_cols, max_slab: int, phases=None):
         slab_cap = _pow2(min(total, max_slab)) if total else 1024
         n_slabs = (total + slab_cap - 1) // slab_cap
         built = CachedTable(td, max_slab, total, slab_cap, n_slabs, parts,
-                            len(scan.schema))
+                            len(scan.schema), compressed=comp_on)
         if cacheable:
             victims = []
             with _LOCK:
@@ -531,10 +704,15 @@ def open_table(ctx, scan, used_cols, max_slab: int, phases=None):
     if not missing:
         # fully warm: the program still READS every resident slab — charge
         # those HBM bytes to the statement so roofline accounting holds on
-        # hot re-runs, not just cold first touches
-        ph.add_scan(sum(v.nbytes + m.nbytes
+        # hot re-runs, not just cold first touches (physical bytes is what
+        # actually streams; logical feeds the effective-roofline metric)
+        _validate_layouts(ent, used_cols)
+        ph.add_scan(sum(_tuple_nbytes(t)
                         for i in used_cols if i in ent.dev
-                        for v, m in ent.dev[i]))
+                        for t in ent.dev[i]),
+                    logical=sum(_logical_tuple_bytes(ent, i, t)
+                                for i in used_cols if i in ent.dev
+                                for t in ent.dev[i]))
         return ent, None
     failpoint.inject("device-transfer")
     ftypes = scan.schema.field_types
@@ -544,6 +722,11 @@ def open_table(ctx, scan, used_cols, max_slab: int, phases=None):
             preps[i] = _col_prep(ent, i, ftypes[i])
             ent.dicts[i] = preps[i]["dict"]
             ent.bounds[i] = preps[i]["bounds"]
+            # layout commits eagerly with dicts/bounds: program
+            # construction (signatures, decode emission) needs it before
+            # the first slab streams
+            ent.layouts[i] = preps[i]["layout"]
+    _validate_layouts(ent, used_cols)
     return ent, _stream_slabs(ctx, ent, key, list(used_cols), preps, ph)
 
 
@@ -685,9 +868,11 @@ def _fresh(ctx, tds) -> bool:
 
 def _build_cat(ent: CachedTable, col: int):
     """Build-side column slabs concatenated (build tables are usually one
-    slab; concat is a no-op then). Wide decimals concat on the row axis."""
+    slab; concat is a no-op then). Wide decimals concat on the row axis.
+    Compressed slabs decode here — the LUT/gather builds below run once
+    per cached structure, so the eager decode is off the per-query path."""
     from tidb_tpu.ops.jax_env import jnp
-    slabs = ent.dev[col]
+    slabs = _decoded_slabs(ent, col)
     if len(slabs) == 1:
         return slabs[0]
     return (jnp.concatenate([s[0] for s in slabs], axis=-1),

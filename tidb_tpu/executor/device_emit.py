@@ -21,6 +21,18 @@ from tidb_tpu.expression import EvalContext
 from tidb_tpu.expression.aggfuncs import AggFunc
 
 
+def emit_decode(layout, slab, cap: int):
+    """Traced decode of one compressed column slab INSIDE the fragment:
+    (words, mask_words[, dictvals]) → (vals, valid) in the logical
+    dtype. A gather-free broadcast shift/mask (plus one take for dict
+    layouts) fused by XLA into the consuming scan→filter→…→agg program,
+    so decode adds zero extra launches and raw bytes never exist on the
+    device either — only in registers mid-program."""
+    from tidb_tpu.chunk import compress
+    from tidb_tpu.ops.jax_env import jnp
+    return compress.decode_slab(layout, slab, cap, jnp)
+
+
 def emit_root(ctx: EvalContext, live, root, aggs=None, group_cap: int = 0,
               key_bounds=None, pairs_out: bool = False, slab_cap: int = 0):
     """Root reduction dispatch for a fused pipeline: the single emit

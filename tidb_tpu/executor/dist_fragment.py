@@ -62,14 +62,16 @@ class DistTreeProgram(TreeProgram):
 
     def __init__(self, plan: PhysicalPlan, caps: Dict[int, int],
                  group_cap: int, mesh, bucket_caps: Dict[int, int],
-                 join_cfgs: Optional[Sequence[JoinCfg]] = None):
+                 join_cfgs: Optional[Sequence[JoinCfg]] = None,
+                 scan_layouts=None):
         from tidb_tpu.ops.jax_env import jax, shard_map
         self.mesh = mesh
         self.n_shards = mesh.devices.size
         self.bucket_caps = bucket_caps    # id(exchange-node) → bucket cap
         # TreeProgram.__init__ builds prep_nodes and jits self._run; we
         # re-wrap with shard_map afterwards.
-        super().__init__(plan, caps, group_cap, join_cfgs)
+        super().__init__(plan, caps, group_cap, join_cfgs,
+                         scan_layouts=scan_layouts)
         P = jax.sharding.PartitionSpec
         root = plan
         flags = {"join_unique": P(), "join_need": P(),
@@ -149,7 +151,18 @@ class DistTreeProgram(TreeProgram):
             # per-shard row count arrives as a (1,) slice of (n_shards,)
             n_local = scan_rows[slot][0]
             live = jnp.arange(cap, dtype=jnp.int32) < n_local
-            col_list = [in_cols.get(i) for i in range(len(node.schema))]
+            lays = dict(self.scan_layouts[slot]) \
+                if slot < len(self.scan_layouts) else {}
+            col_list = []
+            for i in range(len(node.schema)):
+                c = in_cols.get(i)
+                if c is not None and lays.get(i) is not None:
+                    # compressed shard slab: decode inside the
+                    # shard_map body, so PCIe/ICI only ever carried
+                    # the packed words
+                    from tidb_tpu.executor import device_emit
+                    c = device_emit.emit_decode(lays[i], c, cap)
+                col_list.append(c)
             ctx = self._ctx(col_list)
             for f in node.filters:
                 v, m = f.eval(ctx)
@@ -295,12 +308,12 @@ class StagedDistAgg:
 
     def __init__(self, root, chain, mesh, rank_cols, rank_rows, dicts,
                  used_cols, in_types, slab_cap: int, group_cap: int,
-                 cap_limit: int, ctx, ladder):
+                 cap_limit: int, ctx, ladder, layouts=None):
         self.root = root
         self.chain = chain
         self.devices = list(mesh.devices.flat)
         self.nd = len(self.devices)
-        self.rank_cols = rank_cols    # rank → {col: (np vals, np valid)}
+        self.rank_cols = rank_cols    # rank → {col: packed/raw arrays}
         self.rank_rows = rank_rows    # (nd,) int32 true per-rank rows
         self.dicts = dicts            # col → dictionary (collect_preps)
         self.used_cols = used_cols
@@ -310,6 +323,9 @@ class StagedDistAgg:
         self.cap_limit = cap_limit
         self.ctx = ctx
         self.ladder = ladder
+        # col → ColLayout for compressed rank slabs (decode happens
+        # inside the per-rank chain partial)
+        self.layouts = dict(layouts) if layouts else {}
 
     def execute(self) -> List[dict]:
         """→ per-rank host checkpoints in rank order, each a pass_out
@@ -326,7 +342,8 @@ class StagedDistAgg:
             # query must not queue another per-rank compile
             self.ctx.check_killed("device-dispatch")
             prog = get_program(self.chain, self.used_cols, self.in_types,
-                               self.slab_cap, self.group_cap)
+                               self.slab_cap, self.group_cap,
+                               layouts=self.layouts or None)
             prep_vals = prog.collect_preps(self.dicts)
             for r in to_run:
                 ckpts[r], ng_true[r] = self._run_rank(r, prog, prep_vals)
@@ -411,14 +428,20 @@ class StagedDistAgg:
                 # committed transfers pin the jitted partial to `dev` —
                 # this is how one rank's program lands on one device (and
                 # how a re-dispatch lands on a DIFFERENT one)
-                dcols = {i: (jax.device_put(self.rank_cols[r][i][0], dev),
-                             jax.device_put(self.rank_cols[r][i][1], dev))
+                dcols = {i: tuple(jax.device_put(a, dev)
+                                  for a in self.rank_cols[r][i])
                          for i in prog.used_cols}
-            _rank_b = sum(self.rank_cols[r][i][0].nbytes +
-                          self.rank_cols[r][i][1].nbytes
-                          for i in prog.used_cols)
-            ph.add_h2d(_rank_b)
-            ph.add_scan(_rank_b)    # the rank's partial streams these slabs
+            from tidb_tpu.chunk import compress as _compress
+            _rank_b = sum(a.nbytes for i in prog.used_cols
+                          for a in self.rank_cols[r][i])
+            _rank_lb = sum(
+                (_compress.raw_slab_bytes(self.layouts[i], self.slab_cap)
+                 if self.layouts.get(i) is not None
+                 else sum(a.nbytes for a in self.rank_cols[r][i]))
+                for i in prog.used_cols)
+            ph.add_h2d(_rank_b, logical=_rank_lb)
+            # the rank's partial streams these slabs
+            ph.add_scan(_rank_b, logical=_rank_lb)
             with self.ctx.device_slot():
                 with ph.phase("compute"):
                     out = prog.partial(dcols,

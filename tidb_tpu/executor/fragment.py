@@ -319,10 +319,16 @@ def _cache_put(sig: str, prog) -> None:
 
 def _chain_signature(chain: List[PhysicalPlan], used_cols: Sequence[int],
                      in_types: Sequence[FieldType], slab_cap: int,
-                     group_cap: int, key_bounds=None) -> str:
+                     group_cap: int, key_bounds=None,
+                     layouts=None) -> str:
     parts = [f"slab={slab_cap}", f"gcap={group_cap}", f"kb={key_bounds}",
              "cols=" + ",".join(f"{i}:{ft}" for i, ft in
-                                zip(used_cols, in_types))]
+                                zip(used_cols, in_types)),
+             # compressed physical layouts change the traced decode and
+             # the input pytree, so they key the compile cache
+             "lay=" + (",".join(f"{i}:{l.sig()}"
+                                for i, l in sorted(layouts.items()))
+                       if layouts else "-")]
     for node in chain:
         if isinstance(node, PhysTableScan):
             parts.append(f"Scan(filters={node.filters!r}, "
@@ -426,7 +432,7 @@ class _FragmentProgram:
 
     def __init__(self, chain: List[PhysicalPlan], used_cols: List[int],
                  in_types: List[FieldType], slab_cap: int, group_cap: int,
-                 key_bounds=None, want_pairs: bool = False):
+                 key_bounds=None, want_pairs: bool = False, layouts=None):
         from tidb_tpu.ops.jax_env import jax
         self.chain = chain
         self.used_cols = used_cols
@@ -434,6 +440,9 @@ class _FragmentProgram:
         self.slab_cap = slab_cap
         self.group_cap = group_cap
         self.key_bounds = key_bounds   # [(lo, hi)] → perfect-hash grouping
+        # col → ColLayout for compressed input slabs: decode is traced
+        # into the chain ahead of every other stage
+        self.layouts = dict(layouts) if layouts else {}
         self.root = chain[0]
         if isinstance(self.root, PhysHashAgg):
             self.aggs: List[AggFunc] = [build_agg(d) for d in self.root.aggs]
@@ -491,6 +500,12 @@ class _FragmentProgram:
         prepared = {id(node): v for node, v in zip(self.prep_nodes, prep_vals)
                     if v is not None}
         live = jnp.arange(self.slab_cap, dtype=jnp.int32) < n_rows
+        if self.layouts:
+            from tidb_tpu.executor import device_emit
+            cols = {i: (device_emit.emit_decode(self.layouts[i], t,
+                                                self.slab_cap)
+                        if self.layouts.get(i) is not None else t)
+                    for i, t in cols.items()}
         max_idx = max(cols) if cols else -1
         col_list: List = [cols.get(i) for i in range(max_idx + 1)]
         ctx = EvalContext(jnp, col_list, prepared=prepared, on_device=True,
@@ -558,9 +573,10 @@ def _charge_compile(kind: str, t0: float) -> None:
 
 
 def get_program(chain, used_cols, in_types, slab_cap, group_cap,
-                key_bounds=None, want_pairs=False) -> _FragmentProgram:
+                key_bounds=None, want_pairs=False,
+                layouts=None) -> _FragmentProgram:
     sig = _chain_signature(chain, used_cols, in_types, slab_cap, group_cap,
-                           key_bounds) + f"|pairs={want_pairs}"
+                           key_bounds, layouts) + f"|pairs={want_pairs}"
     prog = _cache_get(sig)
     if prog is None:
         with _build_lock(sig):
@@ -569,14 +585,14 @@ def get_program(chain, used_cols, in_types, slab_cap, group_cap,
                 t0 = time.perf_counter()
                 prog = _FragmentProgram(chain, used_cols, in_types,
                                         slab_cap, group_cap, key_bounds,
-                                        want_pairs)
+                                        want_pairs, layouts)
                 _cache_put(sig, prog)
                 _charge_compile("chain", t0)
     return prog
 
 
 def _get_dist_program(root, caps, group_cap, mesh, bucket_caps,
-                      join_cfgs=None):
+                      join_cfgs=None, scan_layouts=None):
     from tidb_tpu.executor.dist_fragment import DistTreeProgram
     from tidb_tpu.executor.tree_fragment import (_walk_nodes,
                                                  tree_signature)
@@ -584,7 +600,8 @@ def _get_dist_program(root, caps, group_cap, mesh, bucket_caps,
     bux = ",".join(str(bucket_caps[id(n)]) for n in _walk_nodes(root)
                    if isinstance(n, PhysExchange) and n.kind == "hash")
     sig = (f"dist={mesh.devices.size}|bux={bux}|" +
-           tree_signature(root, caps, group_cap, join_cfgs))
+           tree_signature(root, caps, group_cap, join_cfgs,
+                          scan_layouts=scan_layouts))
     prog = _cache_get(sig)
     if prog is None:
         with _build_lock(sig):
@@ -592,16 +609,18 @@ def _get_dist_program(root, caps, group_cap, mesh, bucket_caps,
             if prog is None:
                 t0 = time.perf_counter()
                 prog = DistTreeProgram(root, caps, group_cap, mesh,
-                                       dict(bucket_caps), join_cfgs)
+                                       dict(bucket_caps), join_cfgs,
+                                       scan_layouts)
                 _cache_put(sig, prog)
                 _charge_compile("dist", t0)
     return prog
 
 
 def get_tree_program(root, caps, group_cap, join_cfgs=None,
-                     agg_key_bounds=None):
+                     agg_key_bounds=None, scan_layouts=None):
     from tidb_tpu.executor.tree_fragment import TreeProgram, tree_signature
-    sig = tree_signature(root, caps, group_cap, join_cfgs, agg_key_bounds)
+    sig = tree_signature(root, caps, group_cap, join_cfgs, agg_key_bounds,
+                         scan_layouts)
     prog = _cache_get(sig)
     if prog is None:
         with _build_lock(sig):
@@ -609,14 +628,14 @@ def get_tree_program(root, caps, group_cap, join_cfgs=None,
             if prog is None:
                 t0 = time.perf_counter()
                 prog = TreeProgram(root, caps, group_cap, join_cfgs,
-                                   agg_key_bounds)
+                                   agg_key_bounds, scan_layouts)
                 _cache_put(sig, prog)
                 _charge_compile("tree", t0)
     return prog
 
 
 def get_pipeline_program(root, caps, group_cap, join_cfgs=None,
-                         agg_key_bounds=None):
+                         agg_key_bounds=None, scan_layouts=None):
     """Fused per-slab pipeline program: a TreeProgram whose probe-anchor
     scan capacity is ONE slab, so scan → filter → project → join-probe →
     partial-agg over that slab trace as a single jitted XLA program whose
@@ -626,7 +645,7 @@ def get_pipeline_program(root, caps, group_cap, join_cfgs=None,
     builds charge the `compile:fused` timeline lane."""
     from tidb_tpu.executor.tree_fragment import TreeProgram, tree_signature
     sig = "fused|" + tree_signature(root, caps, group_cap, join_cfgs,
-                                    agg_key_bounds)
+                                    agg_key_bounds, scan_layouts)
     prog = _cache_get(sig)
     if prog is None:
         with _build_lock(sig):
@@ -634,7 +653,7 @@ def get_pipeline_program(root, caps, group_cap, join_cfgs=None,
             if prog is None:
                 t0 = time.perf_counter()
                 prog = TreeProgram(root, caps, group_cap, join_cfgs,
-                                   agg_key_bounds)
+                                   agg_key_bounds, scan_layouts)
                 _cache_put(sig, prog)
                 _charge_compile("fused", t0)
     return prog, sig
@@ -733,6 +752,15 @@ def _agg_key_bounds(chain: List[PhysicalPlan], ent) -> Optional[List[Tuple[int, 
     return bounds
 
 
+def _ent_layouts(ent, used):
+    """col → ColLayout for the used columns that are stored compressed;
+    None when every used column is raw (keeps signatures byte-identical
+    to the pre-compression cache keys)."""
+    lays = {i: ent.layouts.get(i) for i in used
+            if ent.layouts.get(i) is not None}
+    return lays or None
+
+
 # ---------------------------------------------------------------------------
 # Executor
 # ---------------------------------------------------------------------------
@@ -795,7 +823,7 @@ def _plan_aligned_joins(ctx, root, scans, ents):
                     return None
                 if ent.dicts.get(idx) is not None:
                     return None        # string probe key: KeyRemap path
-                slabs = ent.dev[idx]
+                slabs = device_cache._decoded_slabs(ent, idx)
                 if any(v.ndim != 1 for v, _ in slabs):
                     return None        # wide-decimal planes can't be keys
                 return ([v for v, _ in slabs], [m for _, m in slabs],
@@ -1000,6 +1028,13 @@ class TpuFragmentExec:
             frac = roofline.fraction(ph.scan_bytes, ph.wall_s)
             if frac > 0.0:
                 rf = f", roofline_fraction:{frac:.3f}"
+            if ph.scan_logical_bytes != ph.scan_bytes:
+                # compression active: the logical-bytes figure may
+                # legitimately exceed 1.0 (that's the win)
+                ef = roofline.effective_fraction(ph.scan_logical_bytes,
+                                                 ph.wall_s)
+                if ef > 0.0:
+                    rf += f", effective_roofline_fraction:{ef:.3f}"
         if self.used_device:
             return f"device:yes{esc}{phs}{qw}{rf}"
         if self.fallback_reason:
@@ -1172,14 +1207,16 @@ class TpuFragmentExec:
         elif isinstance(root, PhysHashAgg):
             group_cap = _initial_group_cap(root, group_cap, slab_cap)
 
+        layouts = _ent_layouts(ent, used)
         if isinstance(root, PhysHashAgg):
             # grouped aggregation owns its ladder loop: overflow retries
             # are RESUMABLE (only overflowed slab partials re-execute)
             return self._execute_agg(chain, root, ent, dicts, stream,
                                      used, in_types, slab_cap, group_cap,
-                                     key_bounds)
+                                     key_bounds, layouts)
         # order/filter roots have no group capacity to overflow — one pass
-        prog = get_program(chain, used, in_types, slab_cap, group_cap)
+        prog = get_program(chain, used, in_types, slab_cap, group_cap,
+                           layouts=layouts)
         prep_vals = prog.collect_preps(dicts)
         if isinstance(root, (PhysTopN, PhysSort)):
             return self._execute_order(prog, root, ent, dicts, prep_vals,
@@ -1226,6 +1263,16 @@ class TpuFragmentExec:
             ents.append((ent, used))
         caps = {id(s): (e.slab_cap, e.n_slabs)
                 for s, (e, _) in zip(scans, ents)}
+        # per-scan-slot ((col, ColLayout), ...) for compressed columns —
+        # parallel to TF._scans(root) order, which matches the `scans`
+        # walk order here (both left-to-right DFS)
+        scan_layouts = tuple(
+            tuple(sorted(((i, e.layouts[i]) for i in u
+                          if e.layouts.get(i) is not None),
+                         key=lambda t: t[0]))
+            for e, u in ents)
+        if not any(scan_layouts):
+            scan_layouts = None
         scan_dicts = {id(s): {i: e.dicts.get(i) for i in u}
                       for s, (e, u) in zip(scans, ents)}
         scan_bounds = {id(s): e.bounds for s, (e, _) in zip(scans, ents)}
@@ -1289,7 +1336,7 @@ class TpuFragmentExec:
                     root, caps, scans, ents, scan_inputs, scan_rows,
                     flow_list, flows, aligned_inputs, join_cfgs,
                     walk_joins, akb, gcap, max_cap, out_cap_max, ladder,
-                    anchor_i)
+                    anchor_i, scan_layouts)
                 if res is not None:
                     return res
                 # a join's fan-out exceeded out_cap_max inside the fused
@@ -1297,7 +1344,8 @@ class TpuFragmentExec:
                 # over-max rung escalates to blocked multi-pass execution
                 # (learned flips/resizes persist in join_cfgs)
         while True:
-            prog = get_tree_program(root, caps, gcap, join_cfgs, akb)
+            prog = get_tree_program(root, caps, gcap, join_cfgs, akb,
+                                    scan_layouts)
             prep_vals = prog.collect_preps(flow_list)
             # scheduler slot spans DISPATCH only (jax queues the program
             # asynchronously); the blocking fetches below run outside it,
@@ -1355,7 +1403,7 @@ class TpuFragmentExec:
                         root, caps, join_cfgs, ji, walk_joins, akb,
                         gcap, max_cap, scans, ents, scan_inputs,
                         scan_rows, flow_list, aligned_inputs, flows,
-                        tot)
+                        tot, scan_layouts)
                 if new_cfg is not None:
                     join_cfgs[ji] = new_cfg
                     retry = True
@@ -1410,8 +1458,8 @@ class TpuFragmentExec:
     def _run_fused_pipeline(self, root, caps, scans, ents, scan_inputs,
                             scan_rows, flow_list, flows, aligned_inputs,
                             join_cfgs, walk_joins, akb, gcap, max_cap,
-                            out_cap_max, ladder, anchor_i
-                            ) -> Optional[Chunk]:
+                            out_cap_max, ladder, anchor_i,
+                            scan_layouts=None) -> Optional[Chunk]:
         """Whole-pipeline fusion: ONE traced XLA program per probe-anchor
         slab covering scan → filter → project → join-probe → partial-agg,
         plus one shared root-merge program — intermediates never leave
@@ -1486,7 +1534,8 @@ class TpuFragmentExec:
         n_joins = len(walk_joins)
         while True:
             prog, pipe_sig = get_pipeline_program(root, pipe_caps, gcap,
-                                                  join_cfgs, akb)
+                                                  join_cfgs, akb,
+                                                  scan_layouts)
             prep_vals = prog.collect_preps(flow_list)
             sig12 = hashlib.sha1(pipe_sig.encode()).hexdigest()[:12]
             for s in (range(n_slabs) if to_run is None else to_run):
@@ -1615,7 +1664,7 @@ class TpuFragmentExec:
     def _run_tree_blocked(self, root, caps, join_cfgs, bji, walk_joins,
                           akb, gcap, max_cap, scans, ents, scan_inputs,
                           scan_rows, flow_list, aligned_inputs, flows,
-                          est_total) -> Chunk:
+                          est_total, scan_layouts=None) -> Chunk:
         """Blocked (multi-pass) expand: a many-to-many join whose fan-out
         exceeds JOIN_OUT_CAP runs as K row-range passes over its probe
         anchor scan, each pass expanding at most JOIN_OUT_CAP rows on
@@ -1686,7 +1735,8 @@ class TpuFragmentExec:
 
         K = max(2, math.ceil(est_total * 1.2 / JOIN_OUT_CAP))
         while K <= 128:
-            prog = get_tree_program(root, caps, gcap, join_cfgs, akb)
+            prog = get_tree_program(root, caps, gcap, join_cfgs, akb,
+                                    scan_layouts)
             prep_vals = prog.collect_preps(flow_list)
             step = (total_cap + K - 1) // K
             pass_outs = []
@@ -1839,6 +1889,23 @@ class TpuFragmentExec:
             return None
         nd = mesh.devices.size
         cap = _pow2((total + nd - 1) // nd, lo=8)
+        # per-column compressed layouts, chosen GLOBALLY (one layout must
+        # serve every rank's slab — the per-rank chain partials share one
+        # traced program). Each rank packs its own slab independently, so
+        # no cap/word-alignment constraint applies here; dictionaries
+        # would need per-device replication, so allow_dict=False.
+        from tidb_tpu.chunk import compress as _compress
+        comp_on = _var_bool(self.ctx.vars.get("tidb_tpu_compression", "on"))
+        layouts = {}
+        if comp_on:
+            for i in used_cols:
+                vals, valid, _d = host_cols[(id(scan), i)]
+                if vals.ndim != 1:
+                    continue
+                lay, _dv = _compress.choose_layout(vals, valid,
+                                                   allow_dict=False)
+                if lay is not None and lay.width > 0:
+                    layouts[i] = lay
         # per-rank host slices — the checkpoint story's source of truth:
         # a retry or re-dispatch re-uploads ONLY its rank's slice
         rank_cols = []
@@ -1853,7 +1920,9 @@ class TpuFragmentExec:
                 pv[:seg.shape[0]] = seg
                 segm = valid[lo:lo + cap]
                 pm[:segm.shape[0]] = segm
-                cols[i] = (pv, pm)
+                lay = layouts.get(i)
+                cols[i] = _compress.pack_slab(lay, pv, pm) \
+                    if lay is not None else (pv, pm)
             rank_cols.append(cols)
         rank_rows = np.clip(total - np.arange(nd) * cap, 0,
                             cap).astype(np.int32)
@@ -1868,7 +1937,8 @@ class TpuFragmentExec:
                                 stats=self.ctx.escalation)
         runner = StagedDistAgg(root, chain, mesh, rank_cols, rank_rows,
                                dicts, used_cols, in_types, cap, gcap,
-                               cap_limit, self.ctx, ladder)
+                               cap_limit, self.ctx, ladder,
+                               layouts=layouts or None)
         pass_outs = runner.execute()
         flows, _root_dicts = TF.dictionary_flows(root, {id(scan): dicts})
         inp_dicts = {i: d for i, d in
@@ -1939,31 +2009,59 @@ class TpuFragmentExec:
                                                scan_meta)
             if staged is not None:
                 return staged
+        from tidb_tpu.chunk import compress as _compress
         from tidb_tpu.executor.device_cache import _col_bounds
+        comp_on = _var_bool(self.ctx.vars.get("tidb_tpu_compression", "on"))
+        dist_layouts = []
         for scan, used, total in scan_meta:
             cap = _pow2((total + nd - 1) // nd, lo=8)
             caps[id(scan)] = cap
             cols = {}
             dicts = {}
             bounds: Dict[int, Tuple[int, int]] = {}
+            lay_pairs = []
             for i in used:
                 vals, valid, dictionary = host_cols[(id(scan), i)]
                 dicts[i] = dictionary
                 b = _col_bounds(vals, valid, dictionary)
                 if b is not None:
                     bounds[i] = b
+                # the single packed array shards across the mesh, so word
+                # boundaries must coincide with shard boundaries: cap a
+                # multiple of WORD_BITS makes every per ∈ {1,2,4,8,32}
+                # divide the shard evenly. Dictionaries would need
+                # replication, and a width-0 (1,) stub can't shard.
+                lay = None
+                if comp_on and vals.ndim == 1 and \
+                        cap % _compress.WORD_BITS == 0:
+                    lay, _dv = _compress.choose_layout(vals, valid,
+                                                       allow_dict=False)
+                    if lay is not None and lay.width == 0:
+                        lay = None
                 with ph.phase("encode"):
                     pv = np.zeros(nd * cap, dtype=vals.dtype)
                     pv[:total] = vals
                     pm = np.zeros(nd * cap, dtype=bool)
                     pm[:total] = valid
+                    packed = _compress.pack_slab(lay, pv, pm) \
+                        if lay is not None else None
+                logical_b = pv.nbytes + pm.nbytes
                 with ph.phase("upload"):
-                    cols[i] = (jax.device_put(pv, sharding),
-                               jax.device_put(pm, sharding))
-                ph.add_h2d(pv.nbytes + pm.nbytes)
+                    if packed is not None:
+                        cols[i] = tuple(jax.device_put(a, sharding)
+                                        for a in packed)
+                    else:
+                        cols[i] = (jax.device_put(pv, sharding),
+                                   jax.device_put(pm, sharding))
+                phys_b = sum(a.nbytes for a in packed) \
+                    if packed is not None else logical_b
+                ph.add_h2d(phys_b, logical=logical_b)
                 # the dist program streams these shards from HBM too
-                ph.add_scan(pv.nbytes + pm.nbytes)
+                ph.add_scan(phys_b, logical=logical_b)
                 ph.mark_in_flight()
+                if lay is not None:
+                    lay_pairs.append((i, lay))
+            dist_layouts.append(tuple(lay_pairs))
             rows = np.clip(total - np.arange(nd) * cap, 0,
                            cap).astype(np.int32)
             scan_inputs.append(cols)
@@ -1972,6 +2070,7 @@ class TpuFragmentExec:
             scan_bounds[id(scan)] = bounds
         scan_inputs = tuple(scan_inputs)
         scan_rows = tuple(scan_rows)
+        dist_layouts = tuple(dist_layouts) if any(dist_layouts) else None
 
         flows, root_dicts = TF.dictionary_flows(root, scan_dicts)
         flow_list = [flows.get(id(n), []) for n in TF._walk_nodes(root)]
@@ -2020,7 +2119,7 @@ class TpuFragmentExec:
             # queue another multi-shard compile
             self.ctx.check_killed("device-dispatch")
             prog = _get_dist_program(root, caps, gcap, mesh, bucket_caps,
-                                     join_cfgs)
+                                     join_cfgs, dist_layouts)
             prep_vals = prog.collect_preps(flow_list)
             try:
                 # a shard fault (failpoint or real device error) can
@@ -2167,7 +2266,7 @@ class TpuFragmentExec:
     # -- hash agg ------------------------------------------------------------
     def _execute_agg(self, chain, root: PhysHashAgg, ent, dicts, stream,
                      used, in_types, slab_cap, group_cap,
-                     key_bounds) -> Chunk:
+                     key_bounds, layouts=None) -> Chunk:
         """Grouped aggregation with RESUMABLE capacity escalation.
 
         Per-slab partials are the checkpoint: on a group-cap overflow,
@@ -2195,7 +2294,7 @@ class TpuFragmentExec:
         to_run: Optional[List[int]] = None     # None = cold first pass
         while True:
             prog = get_program(chain, used, in_types, slab_cap, group_cap,
-                               key_bounds, want_pairs)
+                               key_bounds, want_pairs, layouts)
             prep_vals = prog.collect_preps(dicts)
             if to_run is None:
                 for s, (cols, n) in enumerate(

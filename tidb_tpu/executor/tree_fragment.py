@@ -541,18 +541,24 @@ def tree_agg_key_bounds(root: PhysicalPlan, scan_bounds,
 
 def tree_signature(plan: PhysicalPlan, caps: Dict[int, Tuple[int, int]],
                    group_cap: int, join_cfgs: Optional[Sequence[JoinCfg]] = None,
-                   agg_key_bounds=None) -> str:
+                   agg_key_bounds=None, scan_layouts=None) -> str:
     parts = ["tree", f"gcap={group_cap}", f"akb={agg_key_bounds}"]
     ji = 0
+    si = 0
     for node in _walk_nodes(plan):
         if isinstance(node, PhysTableScan):
             cap = caps[id(node)]
             cap = cap if isinstance(cap, tuple) else (cap, 1)
+            # compressed physical layouts change the scan's traced decode
+            # (and its input pytree), so they key the compile cache
+            lays = scan_layouts[si] if scan_layouts else ()
+            si += 1
             parts.append(
                 f"Scan(id={node.table.id}, cap={cap[0]}x{cap[1]}, "
                 f"types={[str(ft) for ft in node.schema.field_types]}, "
                 f"filters={node.filters!r}, "
-                f"parts={getattr(node, 'partitions', None)})")
+                f"parts={getattr(node, 'partitions', None)}, "
+                f"lay={[(i, l.sig()) for i, l in lays]})")
         elif isinstance(node, PhysHashJoin):
             cfg = join_cfgs[ji] if join_cfgs else None
             ji += 1
@@ -602,7 +608,7 @@ class TreeProgram:
     def __init__(self, plan: PhysicalPlan, caps: Dict[int, object],
                  group_cap: int,
                  join_cfgs: Optional[Sequence[JoinCfg]] = None,
-                 agg_key_bounds=None):
+                 agg_key_bounds=None, scan_layouts=None):
         from tidb_tpu.ops.jax_env import jax
         self.plan = plan
         # id(scan-node) → (slab capacity, n_slabs); plain ints accepted
@@ -616,6 +622,11 @@ class TreeProgram:
         self.join_cfgs = {id(n): c for n, c in zip(joins, join_cfgs)}
         self.join_order = {id(n): i for i, n in enumerate(joins)}
         self.scan_order = _scans(plan)
+        # per-scan-slot ((col, ColLayout), ...) pairs, parallel to
+        # scan_order: compressed columns decode INSIDE the trace at the
+        # scan emit — raw bytes never crossed PCIe
+        self.scan_layouts = tuple(scan_layouts) if scan_layouts \
+            else tuple(() for _ in self.scan_order)
         # blocked expand: the probe anchor scans whose rows are range-
         # masked per pass (derived from plan structure — deterministic)
         self.ranged_scans = set()
@@ -692,9 +703,23 @@ class TreeProgram:
                         if s is node)
             in_cols = scan_inputs[slot]
             slab_cap, n_slabs = self.caps[id(node)]
+            lays = dict(self.scan_layouts[slot]) \
+                if slot < len(self.scan_layouts) else {}
             col_list: List = []
             for i in range(len(node.schema)):
                 c = in_cols.get(i)
+                lay = lays.get(i)
+                if c is not None and lay is not None:
+                    # compressed slab(s): traced decode (gather-free
+                    # shift/mask, fused by XLA into the scan it feeds)
+                    from tidb_tpu.executor import device_emit
+                    if isinstance(c, (list, tuple)) and c and \
+                            isinstance(c[0], tuple):
+                        c = [device_emit.emit_decode(lay, s, slab_cap)
+                             for s in c]
+                    else:
+                        c = device_emit.emit_decode(lay, c,
+                                                    slab_cap * n_slabs)
                 if c is None:
                     col_list.append(None)
                 elif isinstance(c, (list, tuple)) and c and \

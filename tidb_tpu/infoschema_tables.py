@@ -214,6 +214,8 @@ def _partitions(session):
            ("H2D_BYTES", T.bigint()),
            ("D2H_BYTES", T.bigint()),
            ("SCAN_BYTES", T.bigint()),
+           ("H2D_LOGICAL_BYTES", T.bigint()),
+           ("SCAN_LOGICAL_BYTES", T.bigint()),
            ("COMPILES", T.bigint()),
            ("PROGRAMS_LAUNCHED", T.bigint()),
            ("FUSED_PIPELINES", T.bigint()),
@@ -229,7 +231,8 @@ def _statements_summary(session):
     from tidb_tpu.util.observability import REGISTRY
     return [(p["digest"], p["count"], p["sum_s"], p["avg_s"], p["max_s"],
              p["rows"], p["engine"], p["device_s"], p["h2d_bytes"],
-             p["d2h_bytes"], p["scan_bytes"], p["compiles"],
+             p["d2h_bytes"], p["scan_bytes"], p["h2d_logical_bytes"],
+             p["scan_logical_bytes"], p["compiles"],
              p["programs_launched"], p["fused_pipelines"],
              p["queue_wait_s"], p["queue_waits"], p["queue_p50_ms"],
              p["queue_p99_ms"])
@@ -250,6 +253,33 @@ def _slow_query(session):
     slow log file) with per-entry device attribution."""
     from tidb_tpu.util.observability import REGISTRY
     return REGISTRY.slow_rows_full()
+
+
+@register("table_storage", [("TABLE_NAME", T.varchar()),
+                            ("COLUMN_NAME", T.varchar()),
+                            ("LAYOUT", T.varchar()),
+                            ("PHYSICAL_BYTES", T.bigint()),
+                            ("LOGICAL_BYTES", T.bigint())])
+def _table_storage(session):
+    """Per-(table, column) device residency of the HBM column cache:
+    the physical (compressed) bytes actually held in HBM next to the
+    raw-equivalent logical bytes, plus the layout signature that
+    produced them ('raw', 'pack:wW:rREF:...', 'dict:wW:...'). The
+    physical column reconciles with statements_summary's H2D/SCAN
+    counters: a cold scan's H2D_BYTES is exactly the physical bytes of
+    the columns it uploaded."""
+    from tidb_tpu.executor import device_cache
+    names = {t.id: t.name for t in _user_tables(session)}
+    cols = {t.id: [c.name for c in t.columns] for t in _user_tables(session)}
+    out = []
+    for r in device_cache.storage_stats():
+        tid = r["table_id"]
+        cnames = cols.get(tid, [])
+        cname = cnames[r["column"]] if r["column"] < len(cnames) \
+            else str(r["column"])
+        out.append((names.get(tid, str(tid)), cname, r["layout"],
+                    r["physical_bytes"], r["logical_bytes"]))
+    return sorted(out)
 
 
 @register("engine_metrics", [("METRIC", T.varchar()),

@@ -44,6 +44,9 @@ DEFAULT_VARS: Dict[str, object] = {
     # staged (checkpointable, per-shard recoverable) distributed agg;
     # off = always the monolithic shard_map program
     "tidb_tpu_dist_staged": "on",
+    # compressed device-resident columns (bit-pack / frame-of-reference /
+    # dictionary) with decode fused into the scan; off = raw layouts
+    "tidb_tpu_compression": "on",
     "tidb_mem_quota_query": 8 << 30,
     "sql_mode": "STRICT_TRANS_TABLES",
     "autocommit": 1,

@@ -166,6 +166,16 @@ def _scenarios(mesh: Optional[int] = None) -> List[Scenario]:
                  dict(raise_=RuntimeError("chaos: fused boundary"),
                       times=9),
                  run="fused", vars=dict(device_on)),
+        # a corrupted compressed-layout descriptor: the serving path's
+        # validation failpoint stands in for a descriptor that no longer
+        # matches its packed words — open_table raises a typed
+        # LayoutError, the executor converts it into a warned CPU
+        # fallback, and rows stay byte-equal to the oracle (NEVER a
+        # silent wrong decode)
+        Scenario("compressed descriptor corrupt → CPU fallback",
+                 "compressed-decode-mismatch",
+                 dict(value="chaos: descriptor drift", times=9),
+                 vars=dict(device_on)),
         # -- DDL -----------------------------------------------------------
         Scenario("unique backfill dies mid-reorg", "index-backfill",
                  dict(raise_=ExecutionError("chaos: backfill"), times=1),

@@ -114,6 +114,11 @@ register("fused-pipeline-overflow", "capacity boundary of the fused "
          "per-slab pipeline driver — hit after every round's batched flag "
          "fetch, right before join/group overflows are classified into "
          "rerun sets (executor/fragment.py _run_fused_pipeline)")
+register("compressed-decode-mismatch", "layout-descriptor validation of "
+         "the compressed device-resident columns a statement is about to "
+         "decode — a value here models a corrupted descriptor, which must "
+         "surface as a typed LayoutError + CPU fallback, never silent "
+         "wrong rows (executor/device_cache.py _validate_layouts)")
 
 
 def enable(name: str, *, raise_: Optional[BaseException] = None,

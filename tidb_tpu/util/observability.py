@@ -154,7 +154,8 @@ class Registry:
                 s = {"digest": digest, "count": 0, "sum_s": 0.0,
                      "max_s": 0.0, "rows": 0, "last_seen": 0.0,
                      "device_s": 0.0, "h2d_bytes": 0, "d2h_bytes": 0,
-                     "scan_bytes": 0, "compiles": 0,
+                     "scan_bytes": 0, "h2d_logical_bytes": 0,
+                     "scan_logical_bytes": 0, "compiles": 0,
                      "programs_launched": 0, "fused_pipelines": 0,
                      "queue_wait_s": 0.0, "queue_waits": 0,
                      "queue_hist": _hist_new(),
@@ -177,6 +178,10 @@ class Registry:
                 s["h2d_bytes"] += ph.h2d_bytes
                 s["d2h_bytes"] += ph.d2h_bytes
                 s["scan_bytes"] += ph.scan_bytes
+                s["h2d_logical_bytes"] += getattr(
+                    ph, "h2d_logical_bytes", ph.h2d_bytes)
+                s["scan_logical_bytes"] += getattr(
+                    ph, "scan_logical_bytes", ph.scan_bytes)
                 s["compiles"] += ph.compiles
                 s["programs_launched"] += ph.programs_launched
                 s["fused_pipelines"] += ph.fused_pipelines
@@ -243,6 +248,8 @@ class Registry:
                     "h2d_bytes": s["h2d_bytes"],
                     "d2h_bytes": s["d2h_bytes"],
                     "scan_bytes": s["scan_bytes"],
+                    "h2d_logical_bytes": s.get("h2d_logical_bytes", 0),
+                    "scan_logical_bytes": s.get("scan_logical_bytes", 0),
                     "compiles": s["compiles"],
                     "programs_launched": s.get("programs_launched", 0),
                     "fused_pipelines": s.get("fused_pipelines", 0),

@@ -66,16 +66,22 @@ class PhaseTimer:
 
     __slots__ = ("seconds", "overlapped_s", "wall_s", "_in_flight",
                  "h2d_bytes", "d2h_bytes", "scan_bytes", "compiles",
-                 "programs_launched", "fused_pipelines", "conn_id")
+                 "programs_launched", "fused_pipelines", "conn_id",
+                 "h2d_logical_bytes", "scan_logical_bytes")
 
     def __init__(self, conn_id: int = 0):
         self.seconds: Dict[str, float] = {p: 0.0 for p in PHASES}
         self.overlapped_s = 0.0   # encode seconds with device work in flight
         self.wall_s = 0.0         # device-path wall (set by the executor)
         self._in_flight = False
-        self.h2d_bytes = 0        # host→device upload bytes
+        self.h2d_bytes = 0        # host→device upload bytes (physical)
         self.d2h_bytes = 0        # device→host fetch bytes
         self.scan_bytes = 0       # HBM column bytes the program read
+        # logical twins: bytes the same transfers/reads WOULD have been
+        # with raw (uncompressed) column layouts — physical == logical
+        # when compression is off, so the pair quantifies bytes saved
+        self.h2d_logical_bytes = 0
+        self.scan_logical_bytes = 0
         self.compiles = 0         # XLA program traces charged to this stmt
         self.programs_launched = 0  # jitted device program dispatches
         self.fused_pipelines = 0    # of those, whole-pipeline slab launches
@@ -111,14 +117,18 @@ class PhaseTimer:
         self.wall_s += dt
 
     # -- byte / compile attribution -----------------------------------------
-    def add_h2d(self, n: int) -> None:
+    def add_h2d(self, n: int, logical: int = None) -> None:
+        """`logical` is the raw-layout equivalent of the `n` physical
+        bytes (defaults to n — uncompressed transfers are 1:1)."""
         self.h2d_bytes += int(n)
+        self.h2d_logical_bytes += int(n if logical is None else logical)
 
     def add_d2h(self, n: int) -> None:
         self.d2h_bytes += int(n)
 
-    def add_scan(self, n: int) -> None:
+    def add_scan(self, n: int, logical: int = None) -> None:
         self.scan_bytes += int(n)
+        self.scan_logical_bytes += int(n if logical is None else logical)
 
     def note_compile(self) -> None:
         self.compiles += 1
@@ -159,6 +169,8 @@ class PhaseTimer:
         out["h2d_bytes"] = self.h2d_bytes
         out["d2h_bytes"] = self.d2h_bytes
         out["scan_bytes"] = self.scan_bytes
+        out["h2d_logical_bytes"] = self.h2d_logical_bytes
+        out["scan_logical_bytes"] = self.scan_logical_bytes
         out["compiles"] = self.compiles
         out["programs_launched"] = self.programs_launched
         out["fused_pipelines"] = self.fused_pipelines
@@ -176,6 +188,11 @@ class PhaseTimer:
         parts.append(f"ov={self.overlap_efficiency():.2f}")
         if self.h2d_bytes or self.d2h_bytes:
             parts.append(f"h2d={self.h2d_bytes}B d2h={self.d2h_bytes}B")
+        if self.h2d_logical_bytes != self.h2d_bytes or \
+                self.scan_logical_bytes != self.scan_bytes:
+            # compression active: show the raw-equivalent byte counts
+            parts.append(f"h2d_logical={self.h2d_logical_bytes}B "
+                         f"scan_logical={self.scan_logical_bytes}B")
         if self.compiles:
             parts.append(f"compiles={self.compiles}")
         if self.programs_launched:

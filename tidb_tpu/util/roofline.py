@@ -89,4 +89,22 @@ def fraction(scan_bytes: int, device_wall_s: float,
     return max(0.0, min(1.0, floor_s / device_wall_s))
 
 
-__all__ = ["measured_gbs", "set_measured_gbs", "fraction"]
+def effective_fraction(logical_bytes: int, device_wall_s: float,
+                       gbs: float = None) -> float:
+    """Roofline fraction against LOGICAL (uncompressed-equivalent)
+    bytes. Deliberately NOT clamped above 1: a compressed scan that
+    delivers logical bytes faster than the raw stream floor shows up as
+    >1x effective bandwidth — that's the win, not a measurement error.
+    `fraction()` (physical bytes actually streamed) stays the honest
+    hardware-utilization figure; this one is the workload-throughput
+    figure. 0.0 when unmeasurable."""
+    if gbs is None:
+        gbs = measured_gbs()
+    if logical_bytes <= 0 or device_wall_s <= 0.0 or gbs <= 0.0:
+        return 0.0
+    floor_s = logical_bytes / (gbs * 1e9)
+    return max(0.0, floor_s / device_wall_s)
+
+
+__all__ = ["measured_gbs", "set_measured_gbs", "fraction",
+           "effective_fraction"]
