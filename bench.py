@@ -561,7 +561,7 @@ def main():
     s.vars["tidb_tpu_engine"] = "on"
     s.vars["tidb_tpu_row_threshold"] = 32768
     log("warming device path (compile + first-touch stream)…")
-    time_query(s, 1)
+    q1_cold_t, _, _ = time_query(s, 1)
     # phase split of the COLD run — the one with real encode/upload work;
     # capture before check_device_used overwrites LAST_PHASES
     ph = frag_mod.LAST_PHASES
@@ -580,6 +580,23 @@ def main():
                   "q1_device_exec_s": round(dev_exec, 3),
                   "q1_vs_roofline": round(roofline_s / dev_t, 3),
                   "q1_roofline_fraction": query_roofline_fraction(s, gbs)})
+    # warm/cold latency: the cold wall paid trace+stream once; the warm
+    # ratio is what the compile + specialization caches buy a re-run
+    if q1_cold_t > 0:
+        extra["q1_warm_over_cold_latency_ratio"] = round(dev_t / q1_cold_t, 4)
+    # fused launch accounting from the LAST warm rep — the whole-query
+    # target is slabs + 1 programs (slab partials + ONE fused finalize),
+    # i.e. programs_per_slab → ~1 as slab count grows
+    q1ph = frag_mod.LAST_PHASES
+    if q1ph is not None and q1ph.fused_pipelines:
+        extra.update({
+            "q1_fused_pipelines": q1ph.fused_pipelines,
+            "q1_programs_launched": q1ph.programs_launched,
+            "q1_programs_per_slab": round(
+                q1ph.programs_launched / q1ph.fused_pipelines, 2)})
+        log(f"q1 fused: {q1ph.fused_pipelines} slab programs, "
+            f"{q1ph.programs_launched} launches warm "
+            f"({extra['q1_programs_per_slab']}/slab)")
     # shard-recovery accounting (util/escalation.py): on a healthy run
     # all three stay 0 — nonzero values flag that the timing above
     # includes rank re-execution or a degraded mesh
@@ -702,7 +719,7 @@ def main():
                 cpu_cache_store(sf, name, c_t, c_walls)
             s.vars["tidb_tpu_engine"] = "on"
             cc0 = dict(frag_mod.COMPILE_COUNTS)
-            time_query(s, 1, sql)          # compile warmup
+            cold_t, _, _ = time_query(s, 1, sql)   # compile warmup
             used = check_device_used(s, sql)
             d_t, d_exec, _ = time_query(s, reps, sql)
             # per-kind compile split for this query's cold trace: a fused
@@ -728,9 +745,13 @@ def main():
                 f"{name}_roofline_fraction":
                     query_roofline_fraction(s, gbs),
                 f"{name}_compiles": cc_delta})
+            if cold_t > 0:
+                extra[f"{name}_warm_over_cold_latency_ratio"] = round(
+                    d_t / cold_t, 4)
             # fused-pipeline launch accounting from the LAST warm rep:
-            # programs_per_slab = (slab partials + root merge) / slabs —
-            # the issue's warm target is ≤2 launches per slab
+            # programs_per_slab = (slab partials + the ONE fused
+            # finalize that replaced the root merge) / slabs — the warm
+            # whole-query target is slabs + 1 programs total
             qph = frag_mod.LAST_PHASES
             if qph is not None and qph.fused_pipelines:
                 extra.update({

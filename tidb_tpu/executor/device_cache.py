@@ -571,13 +571,17 @@ def _decoded_slabs(ent: CachedTable, col: int):
             for t in slabs]
 
 
-def storage_stats() -> List[dict]:
+def storage_stats(store_id: Optional[int] = None) -> List[dict]:
     """Per-(table, column) physical/logical residency of every cached
     entry — the information_schema.table_storage source. Snapshot under
     the lock; byte math (which touches device array metadata only)
-    happens outside it."""
+    happens outside it. `store_id` scopes the report to one store: a
+    dead engine's entries linger until its store finalizer runs, and
+    table ids restart per engine, so an unscoped dump can attribute a
+    stale entry to an unrelated live table."""
     with _LOCK:
-        entries = [(k, e) for k, e in _CACHE.items()]
+        entries = [(k, e) for k, e in _CACHE.items()
+                   if store_id is None or k[0] == store_id]
     rows = []
     for key, ent in entries:
         for i in sorted(ent.dev):

@@ -33,8 +33,53 @@ def emit_decode(layout, slab, cap: int):
     return compress.decode_slab(layout, slab, cap, jnp)
 
 
+def emit_sort(keys, descs, live):
+    """Traced full-sort permutation under ORDER BY semantics → (perm,
+    n_live). Thin named wrapper over ops/factorize.sort_perm: keys are
+    rank-encoded per column exactly like executor/sort.py's host
+    rank_keys, so direction + MySQL NULL ordering (NULLs first ASC,
+    last DESC) behave identically on device and host."""
+    from tidb_tpu.ops import factorize as F
+    return F.sort_perm(keys, descs, live)
+
+
+def emit_topk(keys, descs, live, k: int):
+    """Traced top-k row selection → (idx (k,), n_out). Same rank
+    encoding as emit_sort; k is static (min(count+offset, cap))."""
+    from tidb_tpu.ops import factorize as F
+    return F.topn(keys, descs, live, k)
+
+
+def emit_distinct(gids, v, m, live, n: int, keys, pairs_out: bool,
+                  pair_cap: int = 0):
+    """Traced per-batch DISTINCT dedup for one aggregate argument →
+    (first_mask, pairs). `first_mask` marks the first live occurrence of
+    each (group, value) pair — the state-update mask. With `pairs_out`,
+    `pairs` is (cols, n_pairs): the deduped (group-keys, value) tuples
+    for the cross-slab host merge, truncated to `pair_cap` output slots
+    (0 = no truncation). The factorize itself ALWAYS runs at the full
+    batch capacity so first_mask stays exact; only the pair OUTPUT
+    arrays shrink — n_pairs reports the TRUE count, so the driver can
+    detect a truncated pair set and resize through the capacity
+    ladder."""
+    from tidb_tpu.ops.jax_env import jnp
+    from tidb_tpu.ops import factorize as F
+    first, _pg, n_pairs, rep = F.distinct_pair_factorize(
+        gids, v, m, live, n)
+    if not pairs_out:
+        return first, None
+    pc = min(pair_cap, n) if pair_cap else n
+    rep_p = rep[:pc]
+    pslot = jnp.arange(pc, dtype=jnp.int32) < n_pairs
+    cols = [(jnp.asarray(kv)[rep_p], jnp.asarray(km)[rep_p] & pslot)
+            for kv, km in keys]
+    cols.append((v[rep_p], pslot))
+    return first, (cols, n_pairs)
+
+
 def emit_root(ctx: EvalContext, live, root, aggs=None, group_cap: int = 0,
-              key_bounds=None, pairs_out: bool = False, slab_cap: int = 0):
+              key_bounds=None, pairs_out: bool = False, slab_cap: int = 0,
+              pair_cap: int = 0):
     """Root reduction dispatch for a fused pipeline: the single emit
     point every device program (linear chain, join tree, fused per-slab
     pipeline, distributed shard) routes its root operator through.
@@ -44,20 +89,19 @@ def emit_root(ctx: EvalContext, live, root, aggs=None, group_cap: int = 0,
       k for TopN); Window: emit_window's {cols, live}; any row root
       (Selection/Projection/Join): padded {cols, live}."""
     from tidb_tpu.ops.jax_env import jnp
-    from tidb_tpu.ops import factorize as F
     from tidb_tpu.planner.physical import (PhysHashAgg, PhysSort,
                                            PhysTopN, PhysWindow)
     if isinstance(root, PhysHashAgg):
         return emit_agg(ctx, live, root, aggs, group_cap, key_bounds,
-                        pairs_out=pairs_out)
+                        pairs_out=pairs_out, pair_cap=pair_cap)
     if isinstance(root, (PhysTopN, PhysSort)):
         keys = [e.eval(ctx) for e in root.by]
         out_cols = [ctx.column(i) for i in range(len(root.schema))]
         if isinstance(root, PhysTopN):
             k = min(root.count + root.offset, slab_cap or live.shape[0])
-            idx, n_out = F.topn(keys, root.descs, live, k)
+            idx, n_out = emit_topk(keys, root.descs, live, k)
         else:
-            idx, n_out = F.sort_perm(keys, root.descs, live)
+            idx, n_out = emit_sort(keys, root.descs, live)
         gathered = [(jnp.asarray(v)[idx], jnp.asarray(m)[idx])
                     for v, m in out_cols]
         return {"cols": gathered, "n_out": n_out}
@@ -100,8 +144,50 @@ def emit_merge(root, aggs: List[AggFunc], group_cap: int, key_cols,
     return {"keys": key_out, "states": out_states, "n_groups": n_final}
 
 
+def emit_finalize(root, order_root, aggs: List[AggFunc], group_cap: int,
+                  key_cols, states, slot_live):
+    """Fused finalize: agg merge → finalize expressions → root ORDER BY /
+    TopN as ONE trace, so a warm analytic query is `slabs + 1` programs
+    total. Order keys referencing group keys read the merged key slots;
+    keys referencing aggregate outputs evaluate AggFunc.final IN-TRACE
+    (the fragment gate only admits count/sum/avg/min/max over narrow
+    results — wide-decimal finals are host-only). The sort/TopN runs on
+    the rank encoding of emit_sort/emit_topk, so direction + MySQL NULL
+    ordering match executor/sort.py exactly.
+
+    → {keys, states, n_groups, n_out}: keys/states gathered in output
+    order (truncated to k for TopN); n_groups is the TRUE merged group
+    count for the caller's capacity-ladder validation."""
+    from tidb_tpu.ops.jax_env import jnp
+    from tidb_tpu.planner.physical import PhysTopN
+    merged = emit_merge(root, aggs, group_cap, key_cols, states, slot_live)
+    cap = group_cap
+    live = jnp.arange(cap, dtype=jnp.int32) < merged["n_groups"]
+    nk = len(root.group_exprs)
+    okeys = []
+    for e in order_root.by:
+        if e.index < nk:
+            v, m = merged["keys"][e.index]
+        else:
+            v, m = aggs[e.index - nk].final(
+                jnp, tuple(merged["states"][e.index - nk]))
+        okeys.append((jnp.asarray(v), jnp.asarray(m) & live))
+    if isinstance(order_root, PhysTopN):
+        k = min(order_root.count + order_root.offset, cap)
+        idx, n_out = emit_topk(okeys, order_root.descs, live, k)
+    else:
+        idx, n_out = emit_sort(okeys, order_root.descs, live)
+    keys_o = [(jnp.asarray(v)[idx], jnp.asarray(m)[idx])
+              for v, m in merged["keys"]]
+    states_o = [tuple(jnp.asarray(a)[idx] for a in st)
+                for st in merged["states"]]
+    return {"keys": keys_o, "states": states_o,
+            "n_groups": merged["n_groups"], "n_out": n_out}
+
+
 def emit_agg(ctx: EvalContext, live, root, aggs: List[AggFunc],
-             group_cap: int, key_bounds=None, pairs_out: bool = False):
+             group_cap: int, key_bounds=None, pairs_out: bool = False,
+             pair_cap: int = 0):
     """Grouped-aggregation partial over one batch → {keys, states,
     n_groups, slot_live}. With `key_bounds` (per-group-key (lo, hi)
     domains) grouping is a direct packed code + segment ops — no sort
@@ -144,15 +230,11 @@ def emit_agg(ctx: EvalContext, live, root, aggs: List[AggFunc],
         v = jnp.asarray(v)
         m = jnp.asarray(m) & live
         dvals[ai] = (v, m)
-        first, _pg, n_pairs, rep = F.distinct_pair_factorize(
-            gids, v, m, live, n)
+        first, pairs = emit_distinct(gids, v, m, live, n, keys,
+                                     pairs_out, pair_cap)
         dfirst[ai] = first
-        if pairs_out:
-            pslot = jnp.arange(n, dtype=jnp.int32) < n_pairs
-            cols = [(jnp.asarray(kv)[rep], jnp.asarray(km)[rep] & pslot)
-                    for kv, km in keys]
-            cols.append((v[rep], pslot))
-            dpairs[ai] = (cols, n_pairs)
+        if pairs is not None:
+            dpairs[ai] = pairs
     states = _agg_states(ctx, live, root, aggs, gids, cap, n,
                          dfirst, dvals)
     out = {"keys": key_out, "states": states, "n_groups": n_groups,

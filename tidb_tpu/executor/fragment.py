@@ -87,8 +87,66 @@ def _var_bool(v) -> bool:
 # ---------------------------------------------------------------------------
 
 
+def _order_over_agg_ok(order: PhysicalPlan, agg: PhysicalPlan) -> bool:
+    """Can this ORDER BY / TopN root fuse into the device finalize of the
+    HashAgg beneath it (device_emit.emit_finalize)?  Every sort key must
+    be a bare ColumnRef into the agg's output row; keys referencing
+    aggregate outputs additionally require a final() that traces (the
+    count/sum/avg/min/max allowlist — wide-decimal finals run host-side
+    via numpy limb math) and a non-DISTINCT aggregate (device-merged
+    DISTINCT states dedup per-slab only; the exact cross-slab counts
+    exist solely in the host pair merge, AFTER ordering would run)."""
+    if not isinstance(agg, PhysHashAgg):
+        return False
+    if isinstance(order, PhysTopN) and \
+            getattr(order, "count", None) is None:
+        return False
+    nk = len(agg.group_exprs)
+    for e in order.by:
+        if not isinstance(e, ColumnRef):
+            return False
+        if e.index < nk:
+            continue
+        if e.index >= nk + len(agg.aggs):
+            return False
+        d = agg.aggs[e.index - nk]
+        if d.distinct:
+            return False
+        if d.name not in ("count", "sum", "avg", "min", "max"):
+            return False
+        if d.ftype.is_wide_decimal or d.ftype.kind.is_string:
+            return False
+    return True
+
+
+def _identity_projection(p: PhysicalPlan) -> bool:
+    """A planner-inserted pass-through (col#i → i, in order, dropping
+    nothing): transparent between an ORDER BY / TopN root and the agg it
+    orders, because its output row IS the agg's output row."""
+    return (isinstance(p, PhysProjection) and p.children and
+            len(p.exprs) == len(p.children[0].schema.field_types) and
+            all(isinstance(e, ColumnRef) and e.index == i
+                for i, e in enumerate(p.exprs)))
+
+
+def _strip_order_root(root: PhysicalPlan):
+    """(order_root, agg) when `root` is an ORDER BY / TopN over the agg
+    (identity projections between them are transparent), else (None,
+    root)."""
+    if isinstance(root, (PhysTopN, PhysSort)) and root.children:
+        below = root.children[0]
+        while _identity_projection(below) and below.children:
+            below = below.children[0]
+        if isinstance(below, PhysHashAgg):
+            return root, below
+    return None, root
+
+
 def _linearize(root: PhysicalPlan) -> Optional[List[PhysicalPlan]]:
-    """root→leaf chain [root, ..., scan], or None if the shape is wrong."""
+    """root→leaf chain [root, ..., scan], or None if the shape is wrong.
+    An ORDER BY / TopN root directly over a fusable HashAgg linearizes as
+    [order, agg, ..., scan] — the driver strips the order root and runs
+    it as the agg's fused finalize (or a host re-order)."""
     nodes: List[PhysicalPlan] = []
     cur = root
     while True:
@@ -98,7 +156,11 @@ def _linearize(root: PhysicalPlan) -> Optional[List[PhysicalPlan]]:
         mid_ok = isinstance(cur, (PhysSelection, PhysProjection))
         root_ok = cur is root and isinstance(cur, (PhysHashAgg, PhysTopN,
                                                    PhysSort, PhysWindow))
-        if not (mid_ok or root_ok) or len(cur.children) != 1:
+        order_agg = (isinstance(cur, PhysHashAgg)
+                     and isinstance(root, (PhysTopN, PhysSort))
+                     and all(_identity_projection(n) for n in nodes[1:-1])
+                     and _order_over_agg_ok(root, cur))
+        if not (mid_ok or root_ok or order_agg) or len(cur.children) != 1:
             return None
         cur = cur.children[0]
 
@@ -432,13 +494,15 @@ class _FragmentProgram:
 
     def __init__(self, chain: List[PhysicalPlan], used_cols: List[int],
                  in_types: List[FieldType], slab_cap: int, group_cap: int,
-                 key_bounds=None, want_pairs: bool = False, layouts=None):
+                 key_bounds=None, want_pairs: bool = False, layouts=None,
+                 pair_cap: int = 0):
         from tidb_tpu.ops.jax_env import jax
         self.chain = chain
         self.used_cols = used_cols
         self.in_types = in_types
         self.slab_cap = slab_cap
         self.group_cap = group_cap
+        self.pair_cap = pair_cap   # distinct pair-set output capacity
         self.key_bounds = key_bounds   # [(lo, hi)] → perfect-hash grouping
         # col → ColLayout for compressed input slabs: decode is traced
         # into the chain ahead of every other stage
@@ -536,7 +600,8 @@ class _FragmentProgram:
         return device_emit.emit_root(
             ctx, live, self.root, aggs=getattr(self, "aggs", None),
             group_cap=self.group_cap, key_bounds=self.key_bounds,
-            pairs_out=self.has_distinct, slab_cap=self.slab_cap)
+            pairs_out=self.has_distinct, slab_cap=self.slab_cap,
+            pair_cap=self.pair_cap)
 
     def _merge(self, key_cols, states, slot_live):
         """Merge stacked slab partials: re-factorize partial keys, sanitize
@@ -574,9 +639,14 @@ def _charge_compile(kind: str, t0: float) -> None:
 
 def get_program(chain, used_cols, in_types, slab_cap, group_cap,
                 key_bounds=None, want_pairs=False,
-                layouts=None) -> _FragmentProgram:
-    sig = _chain_signature(chain, used_cols, in_types, slab_cap, group_cap,
-                           key_bounds, layouts) + f"|pairs={want_pairs}"
+                layouts=None, pair_cap=0, sig=None) -> _FragmentProgram:
+    """`sig` lets a specialization-cache hit skip signature construction
+    entirely — valid because the spec key pins the same geometry, layout
+    set and key bounds the signature would encode."""
+    if sig is None:
+        sig = _chain_signature(chain, used_cols, in_types, slab_cap,
+                               group_cap, key_bounds, layouts) + \
+            f"|pairs={want_pairs},{pair_cap}"
     prog = _cache_get(sig)
     if prog is None:
         with _build_lock(sig):
@@ -585,7 +655,7 @@ def get_program(chain, used_cols, in_types, slab_cap, group_cap,
                 t0 = time.perf_counter()
                 prog = _FragmentProgram(chain, used_cols, in_types,
                                         slab_cap, group_cap, key_bounds,
-                                        want_pairs, layouts)
+                                        want_pairs, layouts, pair_cap)
                 _cache_put(sig, prog)
                 _charge_compile("chain", t0)
     return prog
@@ -635,7 +705,8 @@ def get_tree_program(root, caps, group_cap, join_cfgs=None,
 
 
 def get_pipeline_program(root, caps, group_cap, join_cfgs=None,
-                         agg_key_bounds=None, scan_layouts=None):
+                         agg_key_bounds=None, scan_layouts=None,
+                         pairs_out=False, pair_cap=0, sig=None):
     """Fused per-slab pipeline program: a TreeProgram whose probe-anchor
     scan capacity is ONE slab, so scan → filter → project → join-probe →
     partial-agg over that slab trace as a single jitted XLA program whose
@@ -644,8 +715,10 @@ def get_pipeline_program(root, caps, group_cap, join_cfgs=None,
     per-slab anchor shape from the mega-slab tree program — and cold
     builds charge the `compile:fused` timeline lane."""
     from tidb_tpu.executor.tree_fragment import TreeProgram, tree_signature
-    sig = "fused|" + tree_signature(root, caps, group_cap, join_cfgs,
-                                    agg_key_bounds, scan_layouts)
+    if sig is None:
+        sig = (f"fused|pairs={pairs_out},{pair_cap}|" +
+               tree_signature(root, caps, group_cap, join_cfgs,
+                              agg_key_bounds, scan_layouts))
     prog = _cache_get(sig)
     if prog is None:
         with _build_lock(sig):
@@ -653,7 +726,8 @@ def get_pipeline_program(root, caps, group_cap, join_cfgs=None,
             if prog is None:
                 t0 = time.perf_counter()
                 prog = TreeProgram(root, caps, group_cap, join_cfgs,
-                                   agg_key_bounds, scan_layouts)
+                                   agg_key_bounds, scan_layouts,
+                                   pairs_out, pair_cap)
                 _cache_put(sig, prog)
                 _charge_compile("fused", t0)
     return prog, sig
@@ -696,6 +770,120 @@ def get_merge_program(root, group_cap: int,
                 _cache_put(sig, prog)
                 _charge_compile("fused", t0)
     return prog
+
+
+def _order_sig(order_root) -> str:
+    k = getattr(order_root, "count", None)
+    off = getattr(order_root, "offset", 0)
+    return (f"{type(order_root).__name__}(by={order_root.by!r}, "
+            f"descs={order_root.descs}, k={k}, off={off})")
+
+
+class _FusedFinalizeProgram:
+    """Whole-query tail in ONE launch: agg merge → finalize expressions →
+    root ORDER BY / TopN (device_emit.emit_finalize). Replaces the plain
+    merge launch when the statement root is an eligible Sort/TopN over the
+    agg, keeping a warm analytic query at `slabs + 1` programs total."""
+
+    def __init__(self, agg_root, order_root, group_cap: int):
+        from tidb_tpu.ops.jax_env import jax, on_tpu
+        self.agg_root = agg_root
+        self.order_root = order_root
+        self.group_cap = group_cap
+        self.aggs = [build_agg(d) for d in agg_root.aggs]
+        if on_tpu():
+            # stacked partials are dead after the finalize — donate them
+            self.run = jax.jit(self._run, donate_argnums=(0, 1, 2))
+        else:
+            self.run = jax.jit(self._run)
+
+    def _run(self, key_cols, states, slot_live):
+        from tidb_tpu.executor import device_emit
+        _count_trace()
+        return device_emit.emit_finalize(self.agg_root, self.order_root,
+                                         self.aggs, self.group_cap,
+                                         key_cols, states, slot_live)
+
+
+def get_finalize_program(agg_root, order_root, group_cap: int,
+                         base_sig: str):
+    """→ (program, sig). Cold builds charge the `compile:finalize`
+    timeline lane; `base_sig` is the partial/pipeline signature so the
+    finalize specializes per upstream shape."""
+    sig = "fusedfinal|" + _order_sig(order_root) + "|" + base_sig
+    prog = _cache_get(sig)
+    if prog is None:
+        with _build_lock(sig):
+            prog = _cache_get(sig)      # double-checked: one trace per sig
+            if prog is None:
+                t0 = time.perf_counter()
+                prog = _FusedFinalizeProgram(agg_root, order_root,
+                                             group_cap)
+                _cache_put(sig, prog)
+                _charge_compile("finalize", t0)
+    return prog, sig
+
+
+# ---------------------------------------------------------------------------
+# Per-digest specialization cache
+# ---------------------------------------------------------------------------
+# Sits IN FRONT of the single-flight compile cache: keyed by the
+# statement's normalize_sql digest plus everything the runtime otherwise
+# re-derives per execution (slab geometry, compressed-layout set, cached
+# key bounds, pair mode), it remembers the FINAL capacities a previous
+# execution settled on and the exact compile-cache signature it ran with.
+# A hit adopts those caps (skipping the overflow ladder's discovery
+# climb) and passes the stored signature straight to the program getter
+# (skipping signature construction), so the second execution of any
+# statement shape dispatches fully fused warm programs directly.
+
+_SPEC_CACHE: "OrderedDict[tuple, dict]" = OrderedDict()
+MAX_SPECIALIZATIONS = 256
+
+
+def _spec_key(guard, kind: str, extra: tuple):
+    """None when the statement has no SQL text attached or the gate is
+    off — ad-hoc plan executions don't specialize."""
+    sql = getattr(guard, "sql", None) if guard is not None else None
+    if not sql:
+        return None
+    from tidb_tpu.util.observability import normalize_sql
+    # Raw SQL rides along with the digest: literals are baked into the
+    # traced programs (filter/projection exprs are trace constants), so
+    # two statements sharing a digest but differing in literals must NOT
+    # share a specialization entry.
+    return (kind, normalize_sql(sql), sql) + extra
+
+
+def _spec_lookup(key) -> Optional[dict]:
+    if key is None:
+        return None
+    with _CC_LOCK:
+        ent = _SPEC_CACHE.get(key)
+        if ent is not None:
+            _SPEC_CACHE.move_to_end(key)
+        return ent
+
+
+def _spec_store(key, ent: dict) -> None:
+    if key is None:
+        return
+    with _CC_LOCK:
+        _SPEC_CACHE[key] = ent
+        while len(_SPEC_CACHE) > MAX_SPECIALIZATIONS:
+            _SPEC_CACHE.popitem(last=False)
+
+
+def _spec_note(ph, hit: bool) -> None:
+    from tidb_tpu.util.observability import REGISTRY
+    if hit:
+        if ph is not None:
+            ph.note_spec_hit()
+        REGISTRY.inc("tidb_tpu_specialization_hits_total",
+                     {"engine": "device"})
+    else:
+        REGISTRY.inc("tidb_tpu_specialization_misses_total",
+                     {"engine": "device"})
 
 
 def _initial_group_cap(root: PhysHashAgg, default_cap: int,
@@ -1159,6 +1347,16 @@ class TpuFragmentExec:
             if has_join(self.plan.root):
                 return self._run_device_tree()
             raise FragmentFallback("not a chain")
+        # ORDER BY / TopN directly over the agg: strip the order root and
+        # run the rest agg-rooted — the ordering becomes the agg's fused
+        # device finalize (or a host re-order when the gate is off)
+        order_root = None
+        if len(chain) > 1 and isinstance(chain[0], (PhysTopN, PhysSort)):
+            k = 1
+            while k < len(chain) and _identity_projection(chain[k]):
+                k += 1
+            if k < len(chain) and isinstance(chain[k], PhysHashAgg):
+                order_root, chain = chain[0], chain[k:]
         scan: PhysTableScan = chain[-1]
         vars_ = self.ctx.vars
         max_slab = int(vars_.get("tidb_tpu_max_slab_rows",
@@ -1213,7 +1411,7 @@ class TpuFragmentExec:
             # are RESUMABLE (only overflowed slab partials re-execute)
             return self._execute_agg(chain, root, ent, dicts, stream,
                                      used, in_types, slab_cap, group_cap,
-                                     key_bounds, layouts)
+                                     key_bounds, layouts, order_root)
         # order/filter roots have no group capacity to overflow — one pass
         prog = get_program(chain, used, in_types, slab_cap, group_cap,
                            layouts=layouts)
@@ -1240,6 +1438,10 @@ class TpuFragmentExec:
         from tidb_tpu.ops.jax_env import jax, jnp
 
         root = self.plan.root
+        # ORDER BY / TopN over the agg runs as the agg's fused device
+        # finalize (or a host re-order on the mega-slab path): everything
+        # below — flows, signatures, key bounds — stays agg-rooted
+        order_root, root = _strip_order_root(root)
         vars_ = self.ctx.vars
         max_slab = int(vars_.get("tidb_tpu_max_slab_rows",
                                  DEFAULT_MAX_SLAB_ROWS))
@@ -1323,11 +1525,14 @@ class TpuFragmentExec:
         # ---- fused per-slab pipeline -----------------------------------
         # Agg-rooted trees (the Q3/Q5 shape) run scan → filter → project →
         # join-probe → partial-agg as ONE program PER PROBE SLAB plus one
-        # root merge, instead of one mega-slab program: intermediates stay
-        # in registers/HBM and warm launches drop to ≤2 per slab. DISTINCT
-        # aggs keep the mega-slab path (their pair sets dedupe globally).
+        # root merge/finalize, instead of one mega-slab program:
+        # intermediates stay in registers/HBM and warm launches drop to
+        # slabs + 1. Single-arg DISTINCT aggs fuse too — the per-slab
+        # programs emit capped (group, value) pair sets the host merges
+        # exactly; only multi-arg DISTINCT keeps the mega-slab path.
         if is_agg and _var_bool(vars_.get("tidb_tpu_fused_pipeline", "on")) \
-                and not any(d.distinct and d.args for d in root.aggs):
+                and not any(d.distinct and len(d.args) != 1
+                            for d in root.aggs):
             anchor = TF.aligned_chain(root.children[0])[0]
             anchor_i = next((i for i, s in enumerate(scans)
                              if s is anchor), None)
@@ -1336,7 +1541,7 @@ class TpuFragmentExec:
                     root, caps, scans, ents, scan_inputs, scan_rows,
                     flow_list, flows, aligned_inputs, join_cfgs,
                     walk_joins, akb, gcap, max_cap, out_cap_max, ladder,
-                    anchor_i, scan_layouts)
+                    anchor_i, scan_layouts, order_root)
                 if res is not None:
                     return res
                 # a join's fan-out exceeded out_cap_max inside the fused
@@ -1434,8 +1639,15 @@ class TpuFragmentExec:
                          enumerate(flows.get(id(root), []))}
             host_tree = (flags["keys"], flags["states"]) \
                 if "keys" in flags else None
-            return self._agg_chunk(root, out, inp_dicts, max(n_final, 1),
-                                   host_tree=host_tree)
+            chunk = self._agg_chunk(root, out, inp_dicts, max(n_final, 1),
+                                    host_tree=host_tree)
+            if order_root is not None:
+                # mega-slab fallback: the (small) final group rows
+                # re-order on host; the fused per-slab path orders them
+                # on device inside the finalize launch instead
+                chunk = _host_order(chunk, order_root, root.schema)
+                chunk = _topn_slice(chunk, order_root)
+            return chunk
         if isinstance(root, (PhysTopN, PhysSort)):
             n_out = int(flags["no"])
             if "cols" in flags:
@@ -1459,7 +1671,8 @@ class TpuFragmentExec:
                             scan_rows, flow_list, flows, aligned_inputs,
                             join_cfgs, walk_joins, akb, gcap, max_cap,
                             out_cap_max, ladder, anchor_i,
-                            scan_layouts=None) -> Optional[Chunk]:
+                            scan_layouts=None,
+                            order_root=None) -> Optional[Chunk]:
         """Whole-pipeline fusion: ONE traced XLA program per probe-anchor
         slab covering scan → filter → project → join-probe → partial-agg,
         plus one shared root-merge program — intermediates never leave
@@ -1487,12 +1700,43 @@ class TpuFragmentExec:
         from tidb_tpu.ops.jax_env import jax, jnp
 
         ph = self.ctx.phases
+        vars_ = self.ctx.vars
         anchor = scans[anchor_i]
         a_ent = ents[anchor_i][0]
         n_slabs, slab_cap = a_ent.n_slabs, a_ent.slab_cap
         pipe_caps = dict(caps)
         pipe_caps[id(anchor)] = (slab_cap, 1)
         anchor_rows = scan_rows[anchor_i]
+        has_distinct = any(d.distinct and d.args for d in root.aggs)
+        want_pairs = has_distinct and n_slabs > 1
+        pair_cap = min(int(vars_.get("tidb_tpu_distinct_pair_cap", 65536)),
+                       slab_cap) if want_pairs else 0
+        use_fin = order_root is not None and \
+            _var_bool(vars_.get("tidb_tpu_fused_finalize", "on"))
+        # per-digest specialization (see _execute_agg): adopt the caps and
+        # learned join configs a previous execution of this statement
+        # shape settled on and reuse its exact pipeline signature
+        skey = None
+        if _var_bool(vars_.get("tidb_tpu_specialization_cache", "on")):
+            lay_sig = ",".join(
+                f"{si}/{i}:{l.sig()}"
+                for si, slot in enumerate(scan_layouts or ())
+                for i, l in slot) if scan_layouts else "-"
+            skey = _spec_key(
+                getattr(self.ctx, "guard", None), "tree",
+                (tuple((id(e.td), e.slab_cap, e.n_slabs) for e, _ in ents),
+                 anchor_i, lay_sig, repr(akb), want_pairs, use_fin,
+                 _order_sig(order_root) if order_root is not None
+                 else None))
+        spec = _spec_lookup(skey)
+        if skey is not None:
+            _spec_note(ph, spec is not None)
+        spec_sig = None
+        if spec is not None:
+            gcap = spec["group_cap"]
+            pair_cap = spec["pair_cap"] if want_pairs else 0
+            join_cfgs[:] = list(spec["join_cfgs"])
+            spec_sig = spec["sig"]
 
         # Joins whose aligned inputs live in the ANCHOR's row space — the
         # only ones whose matched/column slabs may be sliced per anchor
@@ -1530,12 +1774,17 @@ class TpuFragmentExec:
         from tidb_tpu.util import failpoint
         partials: List = [None] * n_slabs
         caps_ran = [0] * n_slabs       # group cap each partial ran at
+        pcaps = [0] * n_slabs          # pair cap each partial ran at
+        pairs_cache: List = [None] * n_slabs   # host distinct-pair sets
         to_run: Optional[List[int]] = None     # None = cold first pass
         n_joins = len(walk_joins)
         while True:
             prog, pipe_sig = get_pipeline_program(root, pipe_caps, gcap,
                                                   join_cfgs, akb,
-                                                  scan_layouts)
+                                                  scan_layouts,
+                                                  want_pairs, pair_cap,
+                                                  sig=spec_sig)
+            spec_sig = None
             prep_vals = prog.collect_preps(flow_list)
             sig12 = hashlib.sha1(pipe_sig.encode()).hexdigest()[:12]
             for s in (range(n_slabs) if to_run is None else to_run):
@@ -1549,16 +1798,65 @@ class TpuFragmentExec:
                 ph.note_launch()
                 ph.note_fused()
                 caps_ran[s] = gcap
+                pcaps[s] = pair_cap
+                pairs_cache[s] = None
                 if stale is not None:
                     _tree_delete(stale)
-            # per-slab partials + root merge build the whole device graph
-            # first; every control value returns in ONE batched fetch
+            if want_pairs:
+                # distinct (group, value) pair sets: fetch true counts,
+                # validate against the cap each slab ran at, then slice +
+                # fetch (mirrors _execute_agg — resumable "pairs" rung)
+                need = [s for s in range(n_slabs)
+                        if pairs_cache[s] is None]
+                if need:
+                    with ph.phase("fetch"):
+                        counts = jax.device_get(
+                            [{ai: partials[s]["pairs"][ai][1]
+                              for ai in partials[s]["pairs"]}
+                             for s in need])
+                    ph.add_d2h(tree_nbytes(counts))
+                    failpoint.inject("fused-finalize-overflow")
+                    pover = [s for si, s in enumerate(need)
+                             if any(int(c) > pcaps[s]
+                                    for c in counts[si].values())]
+                    if pover:
+                        if pair_cap >= slab_cap:
+                            ladder.fallback("pairs")
+                            raise FragmentFallback(
+                                "distinct pair overflow")
+                        worst = max(int(c) for si, s in enumerate(need)
+                                    if s in pover
+                                    for c in counts[si].values())
+                        pair_cap = ladder.resize("pairs", pair_cap,
+                                                 need=worst,
+                                                 max_cap=slab_cap)
+                        ladder.attempt("pairs", _GroupCapOverflow(worst))
+                        ladder.partial_resume(
+                            "pairs", rerun=len(pover),
+                            reused=n_slabs - len(pover))
+                        to_run = pover
+                        continue
+                    with ph.phase("fetch"):
+                        sliced = [
+                            {ai: [(v[:int(counts[si][ai])],
+                                   m[:int(counts[si][ai])])
+                                  for v, m in partials[s]["pairs"][ai][0]]
+                             for ai in partials[s]["pairs"]}
+                            for si, s in enumerate(need)]
+                        per_slab = jax.device_get(sliced)
+                    ph.add_d2h(tree_nbytes(per_slab))
+                    for s, ps in zip(need, per_slab):
+                        pairs_cache[s] = ps
+            # per-slab partials + root merge/finalize build the whole
+            # device graph first; every control value returns in ONE
+            # batched fetch
             with self.ctx.device_slot():
                 with ph.phase("compute"):
-                    if n_slabs == 1:
-                        out = partials[0]
-                    else:
-                        mp = get_merge_program(root, gcap, pipe_sig)
+                    if use_fin or n_slabs > 1:
+                        # concatenate even for one slab: the finalize
+                        # donates its inputs, and fresh buffers keep the
+                        # checkpointed partials alive for resumable
+                        # retries
                         key_cols = []
                         for kc in range(len(root.group_exprs)):
                             key_cols.append(tuple(
@@ -1574,12 +1872,32 @@ class TpuFragmentExec:
                                     len(partials[0]["states"][ai_]))))
                         slot_live = jnp.concatenate([p["slot_live"]
                                                      for p in partials])
+                    if use_fin:
+                        pass          # launched below, in its own span
+                    elif n_slabs == 1:
+                        out = partials[0]
+                    else:
+                        mp = get_merge_program(root, gcap, pipe_sig)
                         out = mp.merge(key_cols, states, slot_live)
                         ph.note_launch()
+            if use_fin:
+                # ONE launch for the whole query tail: agg merge →
+                # finalize expressions → root ORDER BY / TopN
+                fprog, fsig = get_finalize_program(root, order_root,
+                                                   gcap, pipe_sig)
+                fsig12 = hashlib.sha1(fsig.encode()).hexdigest()[:12]
+                with self.ctx.device_slot():
+                    with ph.phase("compute", sig=f"fused-final:{fsig12}"):
+                        out = fprog.run(key_cols, states, slot_live)
+                ph.note_launch()
+            with self.ctx.device_slot():
+                with ph.phase("compute"):
                     fetch = {"ngs": [p["n_groups"] for p in partials],
                              "ng": out["n_groups"],
                              "jus": [p["join_unique"] for p in partials],
                              "jts": [p["join_totals"] for p in partials]}
+                    if use_fin:
+                        fetch["no"] = out["n_out"]
                     small = _piggyback_agg(fetch, out, gcap)
             with ph.phase("compute"):
                 jax.block_until_ready(fetch)
@@ -1589,6 +1907,12 @@ class TpuFragmentExec:
             # the fused-program capacity boundary: everything below
             # classifies this round's overflows into rerun sets
             failpoint.inject("fused-pipeline-overflow")
+            if use_fin:
+                # TopN k is a static trace constant, so the finalize
+                # itself cannot overflow — this site is defensive, and
+                # chaos injection proves a fault at the finalize
+                # boundary degrades to the CPU oracle
+                failpoint.inject("fused-finalize-overflow")
             jts = np.asarray(got["jts"]).reshape(n_slabs, n_joins) \
                 if n_joins else np.zeros((n_slabs, 0), dtype=np.int64)
             jus = np.asarray(got["jus"]).reshape(n_slabs, n_joins) \
@@ -1606,7 +1930,7 @@ class TpuFragmentExec:
                 if action == "over-max":
                     for p in partials:
                         _tree_delete(p)
-                    if n_slabs > 1:
+                    if n_slabs > 1 or use_fin:
                         _tree_delete(out)
                     return None
                 if new_cfg is not None:
@@ -1647,19 +1971,38 @@ class TpuFragmentExec:
                     # budget + guard checkpoint between recompiles (the
                     # join rungs above already recorded their own stats)
                     ladder.attempt("fused")
-                if n_slabs > 1:
+                if n_slabs > 1 or use_fin:
                     _tree_delete(out)     # stale merge generation
                 to_run = sorted(rerun)
                 continue
             break
+        if skey is not None and (spec is None
+                                 or spec["group_cap"] != gcap
+                                 or spec["pair_cap"] != pair_cap
+                                 or list(spec["join_cfgs"]) != join_cfgs):
+            _spec_store(skey, {"group_cap": gcap, "pair_cap": pair_cap,
+                               "join_cfgs": tuple(join_cfgs),
+                               "sig": pipe_sig})
         if root.group_exprs and n_final == 0:
             from tidb_tpu.executor import _empty_chunk
             return _empty_chunk(self.schema)
+        host_pairs = None
+        if want_pairs:
+            host_pairs = {ai: [pairs_cache[s][ai]
+                               for s in range(n_slabs)]
+                          for ai in pairs_cache[0]} \
+                if pairs_cache[0] else {}
         inp_dicts = {i: d for i, d in enumerate(flows.get(id(root), []))}
         host_tree = (got["keys"], got["states"]) if small else None
+        n_rows = int(got["no"]) if use_fin else n_final
         with ph.phase("decode"):
-            return self._agg_chunk(root, out, inp_dicts, max(n_final, 1),
-                                   host_tree=host_tree)
+            chunk = self._agg_chunk(root, out, inp_dicts, max(n_rows, 1),
+                                    host_pairs, host_tree=host_tree)
+        if order_root is not None:
+            if not use_fin:
+                chunk = _host_order(chunk, order_root, root.schema)
+            chunk = _topn_slice(chunk, order_root)
+        return chunk
 
     def _run_tree_blocked(self, root, caps, join_cfgs, bji, walk_joins,
                           akb, gcap, max_cap, scans, ents, scan_inputs,
@@ -1947,6 +2290,18 @@ class TpuFragmentExec:
             return self._merge_tree_agg_passes(root, pass_outs, inp_dicts)
 
     def _run_device_dist(self) -> Chunk:
+        # ORDER BY / TopN over the agg: shard programs compute the agg
+        # only — the ordering stays a host concern after the shard merge
+        # (the fused finalize is a single-device shape; a shard program
+        # would pass the agg through and emit un-aggregated rows)
+        order_root, root = _strip_order_root(self.plan.root)
+        chunk = self._dist_exec(root)
+        if order_root is not None:
+            chunk = _host_order(chunk, order_root, root.schema)
+            chunk = _topn_slice(chunk, order_root)
+        return chunk
+
+    def _dist_exec(self, root) -> Chunk:
         """Planner-fragmented tree as one shard_map program over the mesh
         (executor/dist_fragment.py; the MPPGather role of
         executor/mpp_gather.go:42 lives in this method)."""
@@ -1961,7 +2316,6 @@ class TpuFragmentExec:
         from tidb_tpu.parallel import make_mesh
         from tidb_tpu.planner.physical import PhysExchange
 
-        root = self.plan.root
         nd = self.plan.dist
         import jax as _jax
         if len(_jax.devices()) < nd:
@@ -2266,7 +2620,7 @@ class TpuFragmentExec:
     # -- hash agg ------------------------------------------------------------
     def _execute_agg(self, chain, root: PhysHashAgg, ent, dicts, stream,
                      used, in_types, slab_cap, group_cap,
-                     key_bounds, layouts=None) -> Chunk:
+                     key_bounds, layouts=None, order_root=None) -> Chunk:
         """Grouped aggregation with RESUMABLE capacity escalation.
 
         Per-slab partials are the checkpoint: on a group-cap overflow,
@@ -2279,22 +2633,67 @@ class TpuFragmentExec:
         device time; each retry is still charged ONE recompile against
         the ladder's backoff budget. EscalationStats.slabs_rerun/
         slabs_reused make the reuse observable (EXPLAIN ANALYZE)."""
+        import hashlib
+
         from tidb_tpu.ops.jax_env import jax, jnp
+        from tidb_tpu.util import failpoint
         from tidb_tpu.util.escalation import CapacityLadder
         ph = self.ctx.phases
+        vars_ = self.ctx.vars
         ladder = CapacityLadder(guard=getattr(self.ctx, "guard", None),
                                 stats=self.ctx.escalation)
         n_slabs = ent.n_slabs
         cap_limit = slab_cap * max(n_slabs, 1)
         has_distinct = any(d.distinct and d.args for d in root.aggs)
         want_pairs = n_slabs > 1 and has_distinct
+        # pair-set output capacity: a slab can't emit more pairs than it
+        # has rows, so slab_cap is both the default clamp and the ladder's
+        # hard ceiling (resize through "pairs" rungs, never truncate)
+        pair_cap = min(int(vars_.get("tidb_tpu_distinct_pair_cap", 65536)),
+                       slab_cap) if want_pairs else 0
+        use_fin = order_root is not None and \
+            _var_bool(vars_.get("tidb_tpu_fused_finalize", "on"))
+        # per-digest specialization: the second execution of this
+        # statement shape adopts the caps the first settled on and reuses
+        # its exact compile-cache signature, skipping both the ladder's
+        # discovery climb and signature construction. The key pins raw
+        # SQL (literals are trace constants), the data token (writes
+        # invalidate), geometry, layouts and key bounds — everything the
+        # signature would otherwise re-derive.
+        skey = None
+        if _var_bool(vars_.get("tidb_tpu_specialization_cache", "on")):
+            lay_sig = ",".join(f"{i}:{l.sig()}"
+                               for i, l in sorted(layouts.items())) \
+                if layouts else "-"
+            skey = _spec_key(
+                getattr(self.ctx, "guard", None), "chain",
+                (id(ent.td), slab_cap, n_slabs, lay_sig,
+                 repr(key_bounds), want_pairs, use_fin,
+                 _order_sig(order_root) if order_root is not None
+                 else None))
+        spec = _spec_lookup(skey)
+        if skey is not None:
+            _spec_note(ph, spec is not None)
+        spec_sig = None
+        if spec is not None:
+            group_cap = spec["group_cap"]
+            pair_cap = spec["pair_cap"] if want_pairs else 0
+            spec_sig = spec["sig"]
         partials: List = [None] * n_slabs
         caps = [0] * n_slabs            # group cap each partial ran at
+        pcaps = [0] * n_slabs           # pair cap each partial ran at
         pairs_cache: List = [None] * n_slabs   # host distinct-pair sets
         to_run: Optional[List[int]] = None     # None = cold first pass
         while True:
+            if spec_sig is not None:
+                psig, spec_sig = spec_sig, None
+            else:
+                psig = _chain_signature(chain, used, in_types, slab_cap,
+                                        group_cap, key_bounds, layouts) + \
+                    f"|pairs={want_pairs},{pair_cap}"
             prog = get_program(chain, used, in_types, slab_cap, group_cap,
-                               key_bounds, want_pairs, layouts)
+                               key_bounds, want_pairs, layouts, pair_cap,
+                               sig=psig)
             prep_vals = prog.collect_preps(dicts)
             if to_run is None:
                 for s, (cols, n) in enumerate(
@@ -2309,6 +2708,7 @@ class TpuFragmentExec:
                     ph.note_launch()
                     ph.note_fused()   # a chain partial IS a fused pipeline
                     caps[s] = group_cap
+                    pcaps[s] = pair_cap
             else:
                 for s in to_run:
                     stale = partials[s]
@@ -2320,6 +2720,7 @@ class TpuFragmentExec:
                     ph.note_launch()
                     ph.note_fused()
                     caps[s] = group_cap
+                    pcaps[s] = pair_cap
                     pairs_cache[s] = None
                     _tree_delete(stale)
             if want_pairs:
@@ -2335,6 +2736,32 @@ class TpuFragmentExec:
                             [{ai: partials[s]["pairs"][ai][1]
                               for ai in partials[s]["pairs"]}
                              for s in need])
+                    ph.add_d2h(tree_nbytes(counts))
+                    # distinct-pair-cap validation: n_pairs reports the
+                    # TRUE per-slab pair count, the output arrays hold
+                    # only pcaps[s] — a clipped slab must resize and
+                    # re-run, never silently truncate
+                    failpoint.inject("fused-finalize-overflow")
+                    pover = [s for si, s in enumerate(need)
+                             if any(int(c) > pcaps[s]
+                                    for c in counts[si].values())]
+                    if pover:
+                        if pair_cap >= slab_cap:
+                            ladder.fallback("pairs")
+                            raise FragmentFallback("distinct pair overflow")
+                        worst = max(int(c) for si, s in enumerate(need)
+                                    if s in pover
+                                    for c in counts[si].values())
+                        pair_cap = ladder.resize("pairs", pair_cap,
+                                                 need=worst,
+                                                 max_cap=slab_cap)
+                        ladder.attempt("pairs", _GroupCapOverflow(worst))
+                        ladder.partial_resume(
+                            "pairs", rerun=len(pover),
+                            reused=n_slabs - len(pover))
+                        to_run = pover
+                        continue
+                    with ph.phase("fetch"):
                         sliced = [
                             {ai: [(v[:int(counts[si][ai])],
                                    m[:int(counts[si][ai])])
@@ -2342,7 +2769,7 @@ class TpuFragmentExec:
                              for ai in partials[s]["pairs"]}
                             for si, s in enumerate(need)]
                         per_slab = jax.device_get(sliced)
-                    ph.add_d2h(tree_nbytes(counts) + tree_nbytes(per_slab))
+                    ph.add_d2h(tree_nbytes(per_slab))
                     for s, ps in zip(need, per_slab):
                         pairs_cache[s] = ps
             # build the whole device graph FIRST (per-slab partials +
@@ -2355,9 +2782,11 @@ class TpuFragmentExec:
             # n_groups alone can look fine.
             with self.ctx.device_slot():
                 with ph.phase("compute"):
-                    if n_slabs == 1:
-                        out = partials[0]
-                    else:
+                    if use_fin or n_slabs > 1:
+                        # concatenate even for one slab: the finalize
+                        # donates its inputs, and fresh buffers keep the
+                        # checkpointed partials alive for resumable
+                        # retries
                         key_cols = []
                         for kc in range(len(root.group_exprs)):
                             v = jnp.concatenate([p["keys"][kc][0]
@@ -2374,10 +2803,29 @@ class TpuFragmentExec:
                                     len(partials[0]["states"][ai]))))
                         slot_live = jnp.concatenate([p["slot_live"]
                                                      for p in partials])
+                    if use_fin:
+                        pass          # launched below, in its own span
+                    elif n_slabs == 1:
+                        out = partials[0]
+                    else:
                         out = prog.merge(key_cols, states, slot_live)
                         ph.note_launch()
+            if use_fin:
+                # ONE launch for the whole query tail: agg merge →
+                # finalize expressions → root ORDER BY / TopN
+                fprog, fsig = get_finalize_program(root, order_root,
+                                                   group_cap, psig)
+                fsig12 = hashlib.sha1(fsig.encode()).hexdigest()[:12]
+                with self.ctx.device_slot():
+                    with ph.phase("compute", sig=f"fused-final:{fsig12}"):
+                        out = fprog.run(key_cols, states, slot_live)
+                ph.note_launch()
+            with self.ctx.device_slot():
+                with ph.phase("compute"):
                     fetch = {"ngs": [p["n_groups"] for p in partials],
                              "ng": out["n_groups"]}
+                    if use_fin:
+                        fetch["no"] = out["n_out"]
                     small = _piggyback_agg(fetch, out, prog.group_cap)
             with ph.phase("compute"):
                 # drain inside "compute" so the flag fetch below measures
@@ -2388,6 +2836,14 @@ class TpuFragmentExec:
             with ph.phase("fetch"):
                 got = jax.device_get(fetch)
             ph.add_d2h(tree_nbytes(got))
+            if use_fin:
+                # TopN k-overflow validation: k = min(count+offset, cap)
+                # is static and n_groups overflow resizes through the
+                # group rung below, so this site is defensive — but it is
+                # the fused finalize's capacity boundary, and chaos
+                # injection proves the raise path degrades to the CPU
+                # oracle
+                failpoint.inject("fused-finalize-overflow")
             # overflow iff a slab's TRUE count exceeded the cap IT ran at
             # (factorize counts before clamping, so per-slab ngs are true;
             # reused partials ran at an older, smaller cap and stay valid)
@@ -2408,7 +2864,7 @@ class TpuFragmentExec:
                 ladder.attempt("group", _GroupCapOverflow(need_cap))
                 ladder.partial_resume("group", rerun=len(over),
                                       reused=n_slabs - len(over))
-                if n_slabs > 1:
+                if n_slabs > 1 or use_fin:
                     _tree_delete(out)     # stale merge generation
                 to_run = over
                 continue
@@ -2424,11 +2880,16 @@ class TpuFragmentExec:
                                           max_cap=cap_limit)
                 ladder.attempt("group", _GroupCapOverflow(n_final))
                 ladder.partial_resume("group", rerun=0, reused=n_slabs)
-                if n_slabs > 1:
+                if n_slabs > 1 or use_fin:
                     _tree_delete(out)
                 to_run = []
                 continue
             break
+        if skey is not None and (spec is None
+                                 or spec["group_cap"] != group_cap
+                                 or spec["pair_cap"] != pair_cap):
+            _spec_store(skey, {"group_cap": group_cap,
+                               "pair_cap": pair_cap, "sig": psig})
         host_pairs = None
         if want_pairs:
             host_pairs = {ai: [pairs_cache[s][ai]
@@ -2439,9 +2900,17 @@ class TpuFragmentExec:
             from tidb_tpu.executor import _empty_chunk
             return _empty_chunk(self.schema)
         host_tree = (got["keys"], got["states"]) if small else None
+        n_rows = int(got["no"]) if use_fin else n_final
         with ph.phase("decode"):
-            return self._agg_chunk(root, out, dicts, max(n_final, 1),
-                                   host_pairs, host_tree=host_tree)
+            chunk = self._agg_chunk(root, out, dicts, max(n_rows, 1),
+                                    host_pairs, host_tree=host_tree)
+        if order_root is not None:
+            if not use_fin:
+                # finalize gate off: device agg as before, then a host
+                # re-order of the (small) final group rows
+                chunk = _host_order(chunk, order_root, root.schema)
+            chunk = _topn_slice(chunk, order_root)
+        return chunk
 
     def _agg_chunk(self, root: PhysHashAgg, out, dicts, n_final,
                    distinct_pairs=None, host_tree=None) -> Chunk:

@@ -4,6 +4,11 @@ Keys are rank-encoded per column (sorted-unique codes) so one integer
 lexsort handles every type, every direction, and MySQL NULL ordering
 (NULLs first ASC, last DESC) uniformly — and the same rank encoding is
 what the device TopN kernel consumes.
+
+When an ORDER BY / TopN root sits directly over an aggregate, the
+fused finalize (`executor/device_emit.py` ``emit_sort`` /
+``emit_topk``) runs the ordering inside the same traced program as the
+agg merge+finalize, and these host executors never see the rows.
 """
 
 from __future__ import annotations

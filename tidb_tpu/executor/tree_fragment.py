@@ -122,6 +122,20 @@ def tree_ok(plan: PhysicalPlan, threshold: int) -> bool:
         if is_root and isinstance(node, (PhysTopN, PhysSort)):
             if not _string_exprs_are_refs(node.by):
                 return False
+            from tidb_tpu.executor.fragment import (_identity_projection,
+                                                    _order_over_agg_ok)
+            child = node.children[0]
+            while _identity_projection(child) and child.children:
+                child = child.children[0]
+            if isinstance(child, PhysHashAgg):
+                # ORDER BY / TopN over the agg (identity projections are
+                # transparent): the driver strips the order root and runs
+                # it as the agg's fused device finalize
+                # (device_emit.emit_finalize), so the agg keeps its root
+                # role here
+                if not _order_over_agg_ok(node, child):
+                    return False
+                return walk(child, True)
             return walk(node.children[0], False)
         if is_root and isinstance(node, PhysWindow):
             from tidb_tpu.executor.fragment import _window_device_ok
@@ -144,6 +158,16 @@ def dist_ok(plan: PhysicalPlan, threshold: int) -> bool:
     from tidb_tpu.planner.physical import PhysExchange
     if isinstance(plan, PhysExchange):
         return False               # already fragmented
+    if isinstance(plan, (PhysTopN, PhysSort)) and plan.children:
+        from tidb_tpu.executor.fragment import _identity_projection
+        below = plan.children[0]
+        while _identity_projection(below) and below.children:
+            below = below.children[0]
+        if isinstance(below, PhysHashAgg):
+            # ORDER-over-agg: _run_device_dist strips the order root
+            # before compiling (the shard program computes the agg; the
+            # host orders after the merge) — eligibility is the agg's
+            return dist_ok(below, threshold)
     if isinstance(plan, PhysHashAgg):
         if any(d.distinct for d in plan.aggs):
             # DISTINCT distributes by re-keying the exchange so every
@@ -608,9 +632,15 @@ class TreeProgram:
     def __init__(self, plan: PhysicalPlan, caps: Dict[int, object],
                  group_cap: int,
                  join_cfgs: Optional[Sequence[JoinCfg]] = None,
-                 agg_key_bounds=None, scan_layouts=None):
+                 agg_key_bounds=None, scan_layouts=None,
+                 pairs_out: bool = False, pair_cap: int = 0):
         from tidb_tpu.ops.jax_env import jax
         self.plan = plan
+        # DISTINCT aggs under a multi-slab driver: the partial also emits
+        # per-slab (group, value) pair sets (capped at pair_cap) so the
+        # host can merge exact cross-slab distinct states
+        self.pairs_out = pairs_out
+        self.pair_cap = pair_cap
         # id(scan-node) → (slab capacity, n_slabs); plain ints accepted
         self.caps = {k: (v if isinstance(v, tuple) else (v, 1))
                      for k, v in caps.items()}
@@ -999,7 +1029,9 @@ class TreeProgram:
             ctx = self._ctx(cols)
             out = device_emit.emit_root(ctx, live, root, aggs=self.aggs,
                                         group_cap=self.group_cap,
-                                        key_bounds=self.agg_key_bounds)
+                                        key_bounds=self.agg_key_bounds,
+                                        pairs_out=self.pairs_out,
+                                        pair_cap=self.pair_cap)
             out.update(out_flags)
             return out
         # non-agg roots emit every schema column; unused (None) positions

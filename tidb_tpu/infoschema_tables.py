@@ -219,6 +219,7 @@ def _partitions(session):
            ("COMPILES", T.bigint()),
            ("PROGRAMS_LAUNCHED", T.bigint()),
            ("FUSED_PIPELINES", T.bigint()),
+           ("SPECIALIZATION_HITS", T.bigint()),
            ("QUEUE_WAIT_S", T.double()),
            ("QUEUE_WAITS", T.bigint()),
            ("QUEUE_P50_MS", T.double()),
@@ -234,6 +235,7 @@ def _statements_summary(session):
              p["d2h_bytes"], p["scan_bytes"], p["h2d_logical_bytes"],
              p["scan_logical_bytes"], p["compiles"],
              p["programs_launched"], p["fused_pipelines"],
+             p["specialization_hits"],
              p["queue_wait_s"], p["queue_waits"], p["queue_p50_ms"],
              p["queue_p99_ms"])
             for p in REGISTRY.summary_profiles()]
@@ -272,7 +274,7 @@ def _table_storage(session):
     names = {t.id: t.name for t in _user_tables(session)}
     cols = {t.id: [c.name for c in t.columns] for t in _user_tables(session)}
     out = []
-    for r in device_cache.storage_stats():
+    for r in device_cache.storage_stats(id(session.engine.store)):
         tid = r["table_id"]
         cnames = cols.get(tid, [])
         cname = cnames[r["column"]] if r["column"] < len(cnames) \

@@ -65,6 +65,16 @@ RECOMPILE_QUERY = "select d + 0.0, count(*) from cs_facts group by d + 0.0"
 FUSED_QUERY = ("select f.a + 0, count(*) from cs_facts f "
                "join cs_dim d on f.b = d.id group by f.a + 0")
 
+# single-arg DISTINCT agg under an ORDER BY root: the shape that rides
+# the fused finalize (agg merge → finalize exprs → root ORDER BY in ONE
+# launch) with per-slab (group, value) pair sets for the DISTINCT.
+# Squeezing tidb_tpu_distinct_pair_cap below the per-slab distinct pair
+# count (~1000 pairs per 1024-row slab here) makes the pair transfer cap
+# overflow, which must resize through the resumable 'pairs' ladder rung
+# — a clipped pair set must never be consumed
+FINALIZE_QUERY = ("select b, count(distinct a) from cs_facts "
+                  "group by b order by b")
+
 # distributed shapes — integer results, so dist vs CPU comparison is
 # exact. The DISTINCT agg matters: a plain group-by distributes through
 # gather_partials (no re-key), so only the DISTINCT re-key exchange (and
@@ -72,7 +82,7 @@ FUSED_QUERY = ("select f.a + 0, count(*) from cs_facts f "
 # the mesh coverage gate wants hot
 MESH_QUERIES = [
     QUERIES[1],
-    "select b, count(distinct a) from cs_facts group by b order by b",
+    FINALIZE_QUERY,
     QUERIES[2],
 ]
 
@@ -166,6 +176,24 @@ def _scenarios(mesh: Optional[int] = None) -> List[Scenario]:
                  dict(raise_=RuntimeError("chaos: fused boundary"),
                       times=9),
                  run="fused", vars=dict(device_on)),
+        # the fused finalize's distinct-pair transfer cap: armed with NO
+        # action, the site purely meters that the per-slab pair-count
+        # validation round ran — while the squeezed pair cap forces the
+        # resumable 'pairs' escalation (exact resize to the true pair
+        # count, only clipped slabs re-run) and the ordered result stays
+        # byte-equal to the oracle
+        Scenario("fused finalize pair overflow → resumable resize",
+                 "fused-finalize-overflow", dict(), run="finalize",
+                 vars={**device_on, "tidb_tpu_max_slab_rows": "1024",
+                       "tidb_tpu_distinct_pair_cap": "64"}),
+        # a fault AT the finalize boundary: the per-statement guard
+        # converts it to a warned CPU fallback — oracle rows, never a
+        # truncated ORDER BY/TopN result
+        Scenario("fused finalize fault → CPU fallback",
+                 "fused-finalize-overflow",
+                 dict(raise_=RuntimeError("chaos: finalize boundary"),
+                      times=9),
+                 run="finalize", vars=dict(device_on)),
         # a corrupted compressed-layout descriptor: the serving path's
         # validation failpoint stands in for a descriptor that no longer
         # matches its packed words — open_table raises a typed
@@ -381,6 +409,31 @@ def run_sweep(verbose: bool = False, mesh: Optional[int] = None,
                             f"resumable retry (slabs_rerun="
                             f"{esc.slabs_rerun} exact_resizes="
                             f"{esc.exact_resizes})")
+            elif sc.run == "finalize":
+                q = FINALIZE_QUERY
+                rows, err, dt = _run_statement(s, q)
+                if dt > DEADLINE_S:
+                    slow += 1
+                    failures.append(f"{sc.name}: {q!r} took {dt:.1f}s")
+                if err is not None:
+                    errors += 1
+                elif rows != oracle[q]:
+                    wrong += 1
+                    failures.append(f"{sc.name}: {q!r} SILENT WRONG RESULT")
+                elif sc.enable_kw.get("raise_") is None:
+                    # site armed with no action → the driver must have
+                    # taken the resumable 'pairs' escalation: the
+                    # squeezed pair cap clips every slab's pair set, the
+                    # ladder records one exact resize to the true count,
+                    # and the clipped slabs re-run against the original
+                    # resident columns
+                    esc = s.last_guard.escalation
+                    if esc.slabs_rerun == 0 or esc.exact_resizes == 0:
+                        failures.append(
+                            f"{sc.name}: finalize driver skipped the "
+                            f"resumable pairs retry (slabs_rerun="
+                            f"{esc.slabs_rerun} exact_resizes="
+                            f"{esc.exact_resizes})")
             elif sc.run in ("mesh-read", "mesh-agg"):
                 # mesh-agg: only the staged-eligible plain group-by —
                 # the DISTINCT/join shapes run monolithic, where a
@@ -529,7 +582,7 @@ def run_sweep(verbose: bool = False, mesh: Optional[int] = None,
         after = s.query("select count(*) from cs_facts").scalar()
         if after != base_count:
             failures.append(f"{sc.name}: count drifted after scenario")
-        if sc.run not in ("read", "recompile", "fused",
+        if sc.run not in ("read", "recompile", "fused", "finalize",
                           "mesh-read", "mesh-agg"):
             # mutating scenarios move the goalposts: refresh the oracle
             oracle = {q: s.query(q).rows for q in oracle_qs}

@@ -119,6 +119,13 @@ register("compressed-decode-mismatch", "layout-descriptor validation of "
          "decode — a value here models a corrupted descriptor, which must "
          "surface as a typed LayoutError + CPU fallback, never silent "
          "wrong rows (executor/device_cache.py _validate_layouts)")
+register("fused-finalize-overflow", "TopN / distinct-pair-cap validation "
+         "of the fused whole-query finalize — hit at the per-slab "
+         "distinct-pair count check (before clipped pair sets could be "
+         "consumed) and after the finalize's flag fetch; overflow resizes "
+         "through the resumable 'pairs' ladder rung, re-running only the "
+         "slabs that clipped (executor/fragment.py _execute_agg / "
+         "_run_fused_pipeline)")
 
 
 def enable(name: str, *, raise_: Optional[BaseException] = None,

@@ -157,6 +157,7 @@ class Registry:
                      "scan_bytes": 0, "h2d_logical_bytes": 0,
                      "scan_logical_bytes": 0, "compiles": 0,
                      "programs_launched": 0, "fused_pipelines": 0,
+                     "specialization_hits": 0,
                      "queue_wait_s": 0.0, "queue_waits": 0,
                      "queue_hist": _hist_new(),
                      "phase_s": {}, "engine": engine}
@@ -185,6 +186,8 @@ class Registry:
                 s["compiles"] += ph.compiles
                 s["programs_launched"] += ph.programs_launched
                 s["fused_pipelines"] += ph.fused_pipelines
+                s["specialization_hits"] += getattr(
+                    ph, "specialization_hits", 0)
                 for p, v in ph.seconds.items():
                     s["phase_s"][p] = s["phase_s"].get(p, 0.0) + v
             if seconds >= threshold:
@@ -253,6 +256,7 @@ class Registry:
                     "compiles": s["compiles"],
                     "programs_launched": s.get("programs_launched", 0),
                     "fused_pipelines": s.get("fused_pipelines", 0),
+                    "specialization_hits": s.get("specialization_hits", 0),
                     "queue_wait_s": round(s["queue_wait_s"], 6),
                     "queue_waits": s["queue_waits"],
                     "queue_p50_ms": round(

@@ -66,7 +66,8 @@ class PhaseTimer:
 
     __slots__ = ("seconds", "overlapped_s", "wall_s", "_in_flight",
                  "h2d_bytes", "d2h_bytes", "scan_bytes", "compiles",
-                 "programs_launched", "fused_pipelines", "conn_id",
+                 "programs_launched", "fused_pipelines",
+                 "specialization_hits", "conn_id",
                  "h2d_logical_bytes", "scan_logical_bytes")
 
     def __init__(self, conn_id: int = 0):
@@ -85,6 +86,7 @@ class PhaseTimer:
         self.compiles = 0         # XLA program traces charged to this stmt
         self.programs_launched = 0  # jitted device program dispatches
         self.fused_pipelines = 0    # of those, whole-pipeline slab launches
+        self.specialization_hits = 0  # per-digest plan-cache hits
         self.conn_id = conn_id    # timeline pid (0 = unattributed)
 
     @contextmanager
@@ -142,6 +144,12 @@ class PhaseTimer:
         (scan→filter→join-probe→partial-agg in one traced XLA program)."""
         self.fused_pipelines += int(n)
 
+    def note_spec_hit(self, n: int = 1) -> None:
+        """The per-digest specialization cache served this statement's
+        caps + compile-cache signature (no signature construction, no
+        capacity-discovery ladder climb)."""
+        self.specialization_hits += int(n)
+
     def fetch(self, tree):
         """jax.device_get under the fetch phase, with the transferred
         bytes charged to d2h_bytes — the one chokepoint every result
@@ -174,6 +182,7 @@ class PhaseTimer:
         out["compiles"] = self.compiles
         out["programs_launched"] = self.programs_launched
         out["fused_pipelines"] = self.fused_pipelines
+        out["specialization_hits"] = self.specialization_hits
         return out
 
     def summary(self) -> str:
@@ -198,6 +207,8 @@ class PhaseTimer:
         if self.programs_launched:
             parts.append(f"launches={self.programs_launched} "
                          f"fused={self.fused_pipelines}")
+        if self.specialization_hits:
+            parts.append(f"spec_hits={self.specialization_hits}")
         return " ".join(parts)
 
 
