@@ -213,8 +213,9 @@ def test_merged_count_overflow_reruns_zero_slabs():
 def _held_arrays(ent):
     out = []
     for slabs in ent.dev.values():
-        for v, m in slabs:
-            out.extend((v, m))
+        for t in slabs:
+            if t is not None:        # zone-map hole: never uploaded
+                out.extend(t)        # raw (v, m) or packed 2/3-tuple
     return out
 
 

@@ -132,3 +132,59 @@ def test_repeat_query_zero_retraces_and_no_reupload(session):
     # warm run uploads nothing: its phase record shows no upload seconds
     ph = fragment.LAST_PHASES
     assert ph is not None and ph.as_dict()["upload_s"] == 0.0
+
+
+def test_warm_selective_scan_launches_only_surviving_slabs():
+    """Zone-map slab skipping on the warm path: a selective predicate
+    over a sorted column launches exactly `surviving_slabs + 1` programs
+    (one partial per surviving slab + the merge), re-uploads ZERO bytes,
+    and the Chrome trace carries NO compute spans for the skipped slabs
+    — the skip is free, not merely cheap."""
+    import json
+    eng = Engine()
+    eng.global_vars["tidb_enable_auto_analyze"] = False
+    s = eng.new_session()
+    s.execute("CREATE TABLE q (a BIGINT, b BIGINT)")
+    s.execute("INSERT INTO q VALUES " +
+              ",".join(f"({i}, {i % 7})" for i in range(3072)))
+    s.vars["tidb_tpu_engine"] = "on"
+    s.vars["tidb_tpu_row_threshold"] = 1
+    s.vars["tidb_tpu_max_slab_rows"] = 1024   # 3 slabs, sorted → partitioned
+    sel = "SELECT COUNT(*), SUM(a) FROM q WHERE a >= 1024"
+    full = "SELECT COUNT(*), SUM(a) FROM q"
+    rows_cold = s.query(sel).rows              # cold: encode + upload
+    tid = eng.catalog.info_schema.table("q").id
+    ent = next(e for (sid, t, _p), e in dc._CACHE.items()
+               if sid == id(eng.store) and t == tid)
+    # cold-pruned slab 0 committed as a hole (None placeholder): its
+    # encode+upload never happened at all
+    assert any(t is None for slabs in ent.dev.values() for t in slabs), \
+        "cold prune must leave holes, not upload pruned slabs"
+    dev_ids = {i: [None if t is None else id(t[0]) for t in slabs]
+               for i, slabs in ent.dev.items()}
+    traces = fragment.PROGRAM_TRACES
+
+    rows_warm = s.query(sel).rows
+    assert rows_warm == rows_cold
+    ph = s.last_guard.phases
+    assert ph.slabs_skipped == 1, "slab 0 (a in [0,1023]) must be pruned"
+    surviving = 2
+    assert ph.programs_launched == surviving + 1, \
+        f"warm selective launches: {ph.programs_launched}"
+    assert ph.h2d_bytes == 0 and ph.as_dict()["upload_s"] == 0.0
+    assert fragment.PROGRAM_TRACES == traces, "warm repeat re-traced"
+    for i, ids in dev_ids.items():
+        now = [None if t is None else id(t[0]) for t in ent.dev[i]]
+        assert now == ids, \
+            f"column {i} re-uploaded on a pruned warm repeat"
+
+    # Chrome trace: skipping removes exactly the pruned slabs' compute
+    # spans (the unfiltered warm run is the 3-slab baseline)
+    s.query(full)                              # warm the unfiltered shape
+
+    def compute_spans(sql):
+        doc = json.loads(s.query("TRACE FORMAT='chrome' " + sql).rows[0][0])
+        return len([e for e in doc["traceEvents"]
+                    if e.get("ph") != "M" and e["cat"] == "compute"])
+
+    assert compute_spans(full) - compute_spans(sel) == ph.slabs_skipped

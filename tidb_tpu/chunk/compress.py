@@ -9,7 +9,17 @@ decision is the highest-leverage lever for scan-bound analytics):
     packed at the observed bit width into uint32 words;
   * dict — low-cardinality int columns store sorted-dictionary rank
     codes (the string-dictionary idea extended to ints), packed at the
-    code width, with ONE shared dictionary values array per column.
+    code width, with ONE shared dictionary values array per column;
+  * delta — monotonically non-decreasing, fully-valid int columns
+    (sorted PKs, event timestamps) store successive differences packed
+    at the max-gap width, with a per-slab base value; decode is one
+    cumulative sum. Constant runs pack at the zero-diff width, so delta
+    subsumes run-length encoding for sorted data.
+
+The layout decision is workload-adaptive: `choose_layout` accepts
+hints distilled from the Registry's per-digest profiles (group-by
+heavy workloads raise the dictionary cardinality cap — dictionary
+codes feed group factorization directly).
 
 Width is rounded up to {0, 1, 2, 4, 8, 16, 32} so codes never straddle
 a word boundary and the device decode is a gather-free broadcast
@@ -48,9 +58,9 @@ class ColLayout:
     """Static per-column layout descriptor — hashable and data-light so
     it keys program signatures (escalation recompiles stay exact-need)."""
 
-    kind: str      # "pack" (FoR bit-pack) | "dict" (dictionary codes)
+    kind: str      # "pack" (FoR) | "dict" (dictionary) | "delta" (diffs)
     width: int     # bits per packed code — one of WIDTHS
-    ref: int       # frame-of-reference base (pack); 0 for dict
+    ref: int       # frame-of-reference base (pack); 0 for dict/delta
     dtype: str     # logical numpy dtype name the decode restores
     card: int = 0  # dictionary cardinality (dict kind only)
 
@@ -70,8 +80,11 @@ def validate(layout) -> None:
     if not isinstance(layout, ColLayout):
         raise LayoutError(
             f"column layout descriptor is not a ColLayout: {layout!r}")
-    if layout.kind not in ("pack", "dict"):
+    if layout.kind not in ("pack", "dict", "delta"):
         raise LayoutError(f"unknown layout kind {layout.kind!r}")
+    if layout.kind == "delta" and layout.width == 0:
+        raise LayoutError("delta layout with width 0 (constant columns "
+                          "must use pack width 0)")
     if layout.width not in WIDTHS:
         raise LayoutError(
             f"illegal packed width {layout.width} (legal: {WIDTHS})")
@@ -96,7 +109,7 @@ def _round_width(bits: int) -> Optional[int]:
 
 
 def choose_layout(vals: np.ndarray, valid: np.ndarray,
-                  allow_dict: bool = True
+                  allow_dict: bool = True, hints: Optional[dict] = None
                   ) -> Tuple[Optional[ColLayout], Optional[np.ndarray]]:
     """GLOBAL per-column layout decision → (layout or None, dictvals).
 
@@ -104,13 +117,22 @@ def choose_layout(vals: np.ndarray, valid: np.ndarray,
     program signature). Floats, wide decimals (never integer dtype
     here) and columns whose observed range needs more than half the
     logical width stay raw — compression must at least halve the value
-    bytes to be worth a layout."""
+    bytes to be worth a layout.
+
+    `hints` carries workload evidence distilled from the per-digest
+    statement profiles (device_cache.workload_hints): a group-by-heavy
+    workload sets "group_heavy", which raises the dictionary
+    cardinality cap 4× and lets dictionary win width ties — dict codes
+    double as pre-factorized group ids, so the wider cap pays for
+    itself on the agg side even when pack would be byte-equal."""
+    hints = hints or {}
     dt = vals.dtype
     if dt.kind not in "iu" or dt.itemsize > 8:
         return None, None
     max_width = dt.itemsize * 8 // 2
     name = dt.name
-    vv = vals if valid.all() else vals[valid]
+    all_valid = bool(valid.all())
+    vv = vals if all_valid else vals[valid]
     if vv.size == 0:
         # all-NULL column: width 0, nothing stored but the packed mask
         return ColLayout("pack", 0, 0, name), None
@@ -118,13 +140,28 @@ def choose_layout(vals: np.ndarray, valid: np.ndarray,
     pw = _round_width((hi - lo).bit_length())
     pack = ColLayout("pack", pw, lo, name) \
         if pw is not None and pw <= max_width else None
+    # sorted fully-valid columns (PKs, timestamps): successive diffs
+    # need max-gap bits, not range bits — a dense sorted PK packs at
+    # width 1-2 regardless of its absolute range
+    if all_valid and vv.size >= 2:
+        v64 = vv.astype(np.int64)
+        diffs = np.diff(v64)
+        if diffs.size and int(diffs.min()) >= 0 and int(diffs.max()) > 0:
+            xw = _round_width(int(diffs.max()).bit_length())
+            if xw is not None and 0 < xw <= max_width and \
+                    (pack is None or xw < pack.width):
+                pack = ColLayout("delta", xw, 0, name)
     if allow_dict and (pack is None or pack.width > 1):
         uniq = np.unique(vv)
         card = int(uniq.size)
-        if card <= DICT_CARD_CAP:
+        dict_cap = DICT_CARD_CAP * (4 if hints.get("group_heavy") else 1)
+        if card <= dict_cap:
             dw = _round_width(max(card - 1, 0).bit_length())
-            if dw is not None and dw <= max_width and \
-                    (pack is None or dw < pack.width):
+            better = dw is not None and dw <= max_width and (
+                pack is None or dw < pack.width or
+                (hints.get("group_heavy") and dw == pack.width and
+                 pack.kind == "pack"))
+            if better:
                 return ColLayout("dict", dw, 0, name, card), uniq
     return pack, None
 
@@ -146,12 +183,12 @@ def _pack_codes(codes: np.ndarray, width: int) -> np.ndarray:
 
 
 def pack_slab(layout: ColLayout, vals: np.ndarray, mask: np.ndarray,
-              dictvals: Optional[np.ndarray] = None
-              ) -> Tuple[np.ndarray, np.ndarray]:
-    """Host-side encode of ONE padded slab → (words, mask_words).
-    Invalid/padding slots pack as code 0 (decoded values there are
-    don't-care — consumers mask by validity); the mask packs the padded
-    slab exactly, so decode restores it byte-for-byte."""
+              dictvals: Optional[np.ndarray] = None):
+    """Host-side encode of ONE padded slab → (words, mask_words) — plus
+    a trailing per-slab base array for delta slabs. Invalid/padding
+    slots pack as code 0 (decoded values there are don't-care —
+    consumers mask by validity); the mask packs the padded slab
+    exactly, so decode restores it byte-for-byte."""
     mask = np.asarray(mask, dtype=bool)
     mask_words = _pack_codes(mask.astype(np.uint64), 1)
     if layout.width == 0:
@@ -160,6 +197,17 @@ def pack_slab(layout: ColLayout, vals: np.ndarray, mask: np.ndarray,
     if layout.kind == "dict":
         safe = np.where(mask, vals, dictvals[0])
         codes = np.searchsorted(dictvals, safe).astype(np.uint64)
+    elif layout.kind == "delta":
+        # delta columns are fully valid, so the valid prefix IS the
+        # slab's rows; padding diffs stay 0 (cumsum holds the last
+        # value there, masked out by the packed validity)
+        n = int(mask.sum())
+        v64 = vals.astype(np.int64)
+        codes = np.zeros(vals.shape[0], dtype=np.uint64)
+        if n > 1:
+            codes[1:n] = np.diff(v64[:n]).astype(np.uint64)
+        base = np.asarray([v64[0] if n else 0], dtype=np.int64)
+        return _pack_codes(codes, layout.width), mask_words, base
     else:
         codes = np.where(mask, vals.astype(np.int64) - np.int64(layout.ref),
                          0).astype(np.uint64)
@@ -191,6 +239,9 @@ def decode_slab(layout: ColLayout, slab, cap: int, xp):
         # dict codes are < DICT_CARD_CAP, so int32 indexing is exact
         idx = xp.clip(codes.astype(np.int32), 0, layout.card - 1)
         return xp.take(xp.asarray(slab[2]), idx).astype(dt), mask
+    if layout.kind == "delta":
+        base = xp.asarray(slab[2]).astype(np.int64)[0]
+        return (base + xp.cumsum(codes.astype(np.int64))).astype(dt), mask
     return (codes.astype(np.int64) + np.int64(layout.ref)).astype(dt), mask
 
 
@@ -198,3 +249,18 @@ def raw_slab_bytes(layout: ColLayout, cap: int) -> int:
     """Logical bytes one slab WOULD occupy uncompressed: values at the
     logical dtype plus the 1-byte-per-row bool validity mask."""
     return cap * (layout.np_dtype.itemsize + 1)
+
+
+def packed_slab_bytes(layout: ColLayout, cap: int) -> int:
+    """Physical bytes one packed slab occupies (words + mask words +
+    the delta base), computable WITHOUT encoding it — the upload-bytes
+    figure for slabs that zone-map pruning never encodes. Excludes the
+    dict-layout dictionary array (uploaded once per column, not per
+    slab)."""
+    mask_bytes = 4 * (-(-cap // WORD_BITS))
+    if layout.width == 0:
+        return 4 + mask_bytes                 # the 1-word stub
+    per = WORD_BITS // layout.width
+    word_bytes = 4 * (-(-cap // per))
+    base_bytes = 8 if layout.kind == "delta" else 0
+    return word_bytes + mask_bytes + base_bytes

@@ -52,7 +52,7 @@ class CachedTable:
 
     __slots__ = ("td", "max_slab", "total", "slab_cap", "n_slabs",
                  "parts", "dicts", "dev", "bounds", "n_cols", "layouts",
-                 "compressed")
+                 "compressed", "zmaps", "holes")
 
     def __init__(self, td, max_slab: int, total: int, slab_cap: int,
                  n_slabs: int, parts, n_cols: int, compressed: bool = False):
@@ -71,6 +71,22 @@ class CachedTable:
         # col → (lo, hi) over valid values; None for floats/empty — feeds
         # the perfect-hash group-by domain gate (fragment._agg_key_bounds)
         self.bounds: Dict[int, Optional[Tuple[int, int]]] = {}
+        # col → zonemap.ColumnZoneMap (compressed tables only): the
+        # per-slab min/max/null-count ledger the host-side slab pruner
+        # consults before any upload or dispatch
+        self.zmaps: Dict[int, object] = {}
+        # col → frozenset of slab ids whose device slabs are HOLES
+        # (pruned away on cold first touch — dev[col][s] is None there);
+        # a later statement whose prune set does not cover a column's
+        # holes re-streams that column in full
+        self.holes: Dict[int, frozenset] = {}
+
+    def resident(self, col: int, skip=frozenset()) -> bool:
+        """Column `col` is usable for a statement skipping `skip`: its
+        device slabs exist and any holes fall inside the skip set."""
+        if col not in self.dev:
+            return False
+        return self.holes.get(col, frozenset()) <= skip
 
     def slab_rows(self, s: int) -> int:
         return min(self.slab_cap, self.total - s * self.slab_cap)
@@ -80,6 +96,8 @@ class CachedTable:
         seen = set()
         for slabs in self.dev.values():
             for t in slabs:
+                if t is None:
+                    continue            # pruned-away cold slab (hole)
                 for a in t:
                     if id(a) in seen:
                         continue        # shared dictvals counted once
@@ -97,10 +115,11 @@ class CachedTable:
                 continue
             lay = self.layouts.get(i)
             if lay is None:
-                total += sum(a.nbytes for t in slabs for a in t)
+                total += sum(a.nbytes for t in slabs if t is not None
+                             for a in t)
             else:
                 total += compress.raw_slab_bytes(lay, self.slab_cap) \
-                    * len(slabs)
+                    * sum(1 for t in slabs if t is not None)
         return total
 
     def delete(self) -> None:
@@ -111,6 +130,8 @@ class CachedTable:
         seen = set()
         for slabs in self.dev.values():
             for t in slabs:
+                if t is None:
+                    continue            # pruned-away cold slab (hole)
                 for a in t:
                     if id(a) in seen:
                         continue        # shared dictvals deleted once
@@ -403,10 +424,83 @@ def _col_prep(ent: CachedTable, col_idx: int, ftype) -> dict:
             "dict": None, "bounds": _col_bounds(vals, valid, None),
             "layout": None}
     if ent.compressed:
-        layout, dictvals = compress.choose_layout(vals, valid)
+        layout, dictvals = compress.choose_layout(vals, valid,
+                                                  hints=workload_hints())
         prep["layout"] = layout
         prep["dictvals"] = dictvals
     return prep
+
+
+def workload_hints() -> Optional[dict]:
+    """Distill the Registry's per-digest statement profiles into layout
+    hints for compress.choose_layout — the workload-adaptive half of
+    the encoder. The one robust signal the profiles carry about the
+    read side is result cardinality: a device workload that returns few
+    rows per execution is dominated by aggregation/selective scans, so
+    dictionary layouts earn their keep (dict codes feed group
+    factorization directly) and the cardinality cap loosens."""
+    try:
+        from tidb_tpu.util.observability import REGISTRY
+        profs = REGISTRY.summary_profiles()
+    except Exception:  # noqa: BLE001 — hints are advisory, never fatal
+        return None
+    dev = [p for p in profs
+           if p.get("engine") == "device" and p.get("count")]
+    if not dev:
+        return None
+    calls = sum(p["count"] for p in dev)
+    rows = sum(p["rows"] for p in dev)
+    return {"group_heavy": rows <= 1024 * calls}
+
+
+def _col_zone_stats(ent: CachedTable, prep: dict):
+    """Per-slab zone map for one prepped column, in the space the
+    pruner compares in (see executor/zonemap.py). Wide decimals carry
+    none — their limb planes have no totally-ordered slab stats."""
+    from tidb_tpu.executor import zonemap
+    k = prep["kind"]
+    if k == "wide":
+        return None
+    if k == "str":
+        codes = np.searchsorted(prep["keys"],
+                                prep["vals"]).astype(np.int32)
+        return zonemap.column_stats(codes, prep["valid"], ent.slab_cap,
+                                    ent.total, "code")
+    kind = "float" if k == "float" else "num"
+    return zonemap.column_stats(prep["vals"], prep["valid"],
+                                ent.slab_cap, ent.total, kind)
+
+
+def _est_slab_phys(prep: dict, slab_cap: int) -> int:
+    """Physical bytes ONE slab of a prepped column would upload —
+    computable without encoding it (the h2d_skipped ledger for slabs
+    that never encode)."""
+    from tidb_tpu.chunk import compress
+    lay = prep.get("layout")
+    if lay is not None:
+        return compress.packed_slab_bytes(lay, slab_cap)
+    k = prep["kind"]
+    if k == "wide":
+        return prep["n_limbs"] * slab_cap * 8 + slab_cap
+    if k == "float":
+        return slab_cap * np.dtype(prep["dtype"]).itemsize + slab_cap
+    if k == "str":
+        return slab_cap * 4 + slab_cap
+    return slab_cap * prep["vals"].dtype.itemsize + slab_cap
+
+
+def _slab_logical_est(ent: CachedTable, i: int, preps=None) -> int:
+    """Logical (raw-equivalent) bytes ONE slab of column `i` answers
+    for — resolvable even when the device tuple is a pruned hole."""
+    from tidb_tpu.chunk import compress
+    lay = ent.layouts.get(i)
+    if lay is not None:
+        return compress.raw_slab_bytes(lay, ent.slab_cap)
+    if preps and i in preps:
+        # raw layout: physical == logical
+        return _est_slab_phys(preps[i], ent.slab_cap)
+    t = next((t for t in ent.dev.get(i, ()) if t is not None), None)
+    return _tuple_nbytes(t) if t is not None else 0
 
 
 def _slab_host(prep: dict, start: int, stop: int, slab_cap: int):
@@ -468,7 +562,8 @@ def _note_storage_metrics(ent: CachedTable, key) -> None:
                      float(ent.logical_bytes()), {"table": str(key[1])})
 
 
-def _stream_slabs(ctx, ent: CachedTable, key, used_cols, preps, phases):
+def _stream_slabs(ctx, ent: CachedTable, key, used_cols, preps, phases,
+                  skip=frozenset()):
     """Generator behind open_table: per slab, encode the missing columns
     (host), issue their uploads (async device_put), and yield
     (slab_idx, {col: slab tuple}) covering EVERY used column so the
@@ -478,7 +573,14 @@ def _stream_slabs(ctx, ent: CachedTable, key, used_cols, preps, phases):
     bytes cross PCIe; the PhaseTimer is charged both counts. Completed
     columns commit to the cache entry only after the LAST slab: a stream
     abandoned by an error or a CPU fallback never leaves a half-uploaded
-    column behind."""
+    column behind.
+
+    Slabs in `skip` were zone-map-pruned for the opening statement:
+    they are never encoded, never uploaded, and never yielded — the
+    committed column carries None holes there (ent.holes records them,
+    so later statements with weaker predicates re-stream the column in
+    full)."""
+    from tidb_tpu.executor import zonemap
     from tidb_tpu.ops.jax_env import jnp
     new_slabs = {i: [] for i in preps}
     # dict-layout columns upload their dictionary values ONCE; the same
@@ -493,6 +595,19 @@ def _stream_slabs(ctx, ent: CachedTable, key, used_cols, preps, phases):
     if dict_dev:
         phases.add_h2d(sum(a.nbytes for a in dict_dev.values()), logical=0)
     for s in range(ent.n_slabs):
+        if s in skip:
+            # pruned cold slab: no encode, no PCIe, no dispatch — the
+            # statement still answered for its rows, so the logical
+            # scan ledger (effective-roofline numerator) is charged
+            for i in new_slabs:
+                new_slabs[i].append(None)
+            zonemap.note_h2d_skipped(
+                phases, sum(_est_slab_phys(p, ent.slab_cap)
+                            for p in preps.values()),
+                table=str(key[1]) if key is not None else "")
+            phases.add_scan(0, logical=sum(_slab_logical_est(ent, i, preps)
+                                           for i in used_cols))
+            continue
         start = s * ent.slab_cap
         stop = min(start + ent.slab_cap, ent.total)
         host = {}
@@ -526,6 +641,10 @@ def _stream_slabs(ctx, ent: CachedTable, key, used_cols, preps, phases):
             # refcounting frees them — never a half-overwritten column
             if i not in ent.dev:
                 ent.dev[i] = slabs
+                if skip:
+                    ent.holes[i] = frozenset(skip)
+                else:
+                    ent.holes.pop(i, None)
     phases.clear_in_flight()
     _note_storage_metrics(ent, key)
     if key is not None:
@@ -589,17 +708,31 @@ def storage_stats(store_id: Optional[int] = None) -> List[dict]:
             seen = set()
             phys = 0
             for t in ent.dev[i]:
+                if t is None:
+                    continue            # pruned-away cold slab (hole)
                 for a in t:
                     if id(a) in seen:
                         continue
                     seen.add(id(a))
                     phys += a.nbytes
+            zm = ent.zmaps.get(i)
+            zlo = zhi = None
+            if zm is not None:
+                known_lo = [v for v in zm.lo if v is not None]
+                known_hi = [v for v in zm.hi if v is not None]
+                if known_lo:
+                    zlo, zhi = min(known_lo), max(known_hi)
             rows.append({
                 "table_id": key[1],
                 "column": i,
                 "layout": "raw" if lay is None else lay.sig(),
                 "physical_bytes": int(phys),
                 "logical_bytes": int(ent.logical_bytes(cols={i})),
+                "zone_map_slabs": 0 if zm is None else zm.n_slabs,
+                "zone_map_min": zlo,
+                "zone_map_max": zhi,
+                "zone_map_nulls": None if zm is None
+                else int(sum(zm.nulls)),
             })
     return rows
 
@@ -614,7 +747,8 @@ def _protected(ctx) -> frozenset:
     return frozenset(own) | _all_protected()
 
 
-def open_table(ctx, scan, used_cols, max_slab: int, phases=None):
+def open_table(ctx, scan, used_cols, max_slab: int, phases=None,
+               prune: bool = False):
     """→ (CachedTable, slab stream or None) — the streamed first-touch.
 
     Warm path (every used column already resident) returns stream=None.
@@ -628,6 +762,15 @@ def open_table(ctx, scan, used_cols, max_slab: int, phases=None):
     Cacheable only for snapshot reads (ctx.txn is None); transaction reads
     build a transient entry so staged rows are visible without poisoning
     the shared cache.
+
+    `prune=True` (the chain executor's streamed path) consults the
+    zone maps: cold first touch streams ONLY the slabs the scan's
+    conjuncts cannot prove empty (pruned slabs commit as None holes),
+    and warm accounting charges physical scan bytes only for surviving
+    slabs while still charging the full logical bytes the statement
+    answered for. Callers that need complete columns (the tree/dist
+    mega-slab paths, aligned builds) leave prune off — a column whose
+    holes exceed the statement's prune set is re-streamed in full.
     """
     from tidb_tpu.util import failpoint
     from tidb_tpu.util.phases import PhaseTimer
@@ -704,19 +847,44 @@ def open_table(ctx, scan, used_cols, max_slab: int, phases=None):
     if not ent.total:
         return ent, None
     ph = phases if phases is not None else PhaseTimer()
-    missing = [i for i in used_cols if i not in ent.dev]
+    from tidb_tpu.executor import zonemap
+    skip = zonemap.prune_slabs(ent, scan) if prune else frozenset()
+    missing = []
+    refill = []
+    for i in used_cols:
+        if i in ent.dev and ent.holes.get(i, frozenset()) <= skip:
+            continue
+        missing.append(i)
+        if i in ent.dev:
+            refill.append(i)
+    if refill:
+        with _LOCK:
+            for i in refill:
+                # this statement's predicates reach slabs an earlier,
+                # more selective statement pruned away on cold touch:
+                # drop the holey generation and re-stream the column in
+                # full (refcounting frees the old device buffers)
+                ent.dev.pop(i, None)
+                ent.holes.pop(i, None)
     if not missing:
-        # fully warm: the program still READS every resident slab — charge
-        # those HBM bytes to the statement so roofline accounting holds on
-        # hot re-runs, not just cold first touches (physical bytes is what
-        # actually streams; logical feeds the effective-roofline metric)
+        # fully warm: the program READS every surviving resident slab —
+        # charge those HBM bytes to the statement so roofline accounting
+        # holds on hot re-runs; pruned slabs charge logical bytes only
+        # (the statement answered for their rows without streaming them
+        # — the effective-roofline numerator)
         _validate_layouts(ent, used_cols)
-        ph.add_scan(sum(_tuple_nbytes(t)
-                        for i in used_cols if i in ent.dev
-                        for t in ent.dev[i]),
-                    logical=sum(_logical_tuple_bytes(ent, i, t)
-                                for i in used_cols if i in ent.dev
-                                for t in ent.dev[i]))
+        phys = 0
+        logi = 0
+        for i in used_cols:
+            slabs = ent.dev[i]
+            for s in range(ent.n_slabs):
+                logi += _slab_logical_est(ent, i)
+                if s in skip:
+                    continue
+                t = slabs[s] if s < len(slabs) else None
+                if t is not None:
+                    phys += _tuple_nbytes(t)
+        ph.add_scan(phys, logical=logi)
         return ent, None
     failpoint.inject("device-transfer")
     ftypes = scan.schema.field_types
@@ -728,10 +896,20 @@ def open_table(ctx, scan, used_cols, max_slab: int, phases=None):
             ent.bounds[i] = preps[i]["bounds"]
             # layout commits eagerly with dicts/bounds: program
             # construction (signatures, decode emission) needs it before
-            # the first slab streams
+            # the first slab streams; zone maps ride along so the prune
+            # decision below already sees the new columns' statistics
             ent.layouts[i] = preps[i]["layout"]
+            if ent.compressed:
+                zm = _col_zone_stats(ent, preps[i])
+                if zm is not None:
+                    ent.zmaps[i] = zm
     _validate_layouts(ent, used_cols)
-    return ent, _stream_slabs(ctx, ent, key, list(used_cols), preps, ph)
+    if prune:
+        # re-consult with the freshly prepped columns' statistics — the
+        # skip set only ever grows, so warm columns' holes stay covered
+        skip = zonemap.prune_slabs(ent, scan)
+    return ent, _stream_slabs(ctx, ent, key, list(used_cols), preps, ph,
+                              skip=skip)
 
 
 def get_table(ctx, scan, used_cols, max_slab: int,

@@ -308,7 +308,8 @@ class StagedDistAgg:
 
     def __init__(self, root, chain, mesh, rank_cols, rank_rows, dicts,
                  used_cols, in_types, slab_cap: int, group_cap: int,
-                 cap_limit: int, ctx, ladder, layouts=None):
+                 cap_limit: int, ctx, ladder, layouts=None,
+                 skip_ranks=None):
         self.root = root
         self.chain = chain
         self.devices = list(mesh.devices.flat)
@@ -326,17 +327,25 @@ class StagedDistAgg:
         # col → ColLayout for compressed rank slabs (decode happens
         # inside the per-rank chain partial)
         self.layouts = dict(layouts) if layouts else {}
+        # rank ids zone-map pruning proved empty under the scan's
+        # conjuncts: never uploaded, never dispatched — their
+        # checkpoints are pre-filled with the ng=0 merge identity
+        self.skip_ranks = frozenset(skip_ranks or ())
 
     def execute(self) -> List[dict]:
         """→ per-rank host checkpoints in rank order, each a pass_out
-        {"ng", "keys", "states"} ready for _merge_tree_agg_passes."""
+        {"ng", "keys", "states"} ready for _merge_tree_agg_passes.
+        Pruned ranks carry the ng=0 identity checkpoint (the merge
+        skips ng==0 passes)."""
         from tidb_tpu.executor.fragment import (FragmentFallback,
                                                 _GroupCapOverflow,
                                                 get_program)
         ckpts: List[Optional[dict]] = [None] * self.nd
         ng_true = [0] * self.nd
         caps_ran = [0] * self.nd
-        to_run = list(range(self.nd))
+        for r in self.skip_ranks:
+            ckpts[r] = {"ng": 0, "keys": [], "states": []}
+        to_run = [r for r in range(self.nd) if r not in self.skip_ranks]
         while True:
             # between dispatch rounds is a guard checkpoint: a killed
             # query must not queue another per-rank compile

@@ -855,11 +855,20 @@ def _spec_key(guard, kind: str, extra: tuple):
     return (kind, normalize_sql(sql), sql) + extra
 
 
-def _spec_lookup(key) -> Optional[dict]:
+def _spec_lookup(key, lay_sig: Optional[str] = None) -> Optional[dict]:
+    """`lay_sig` is the statement's CURRENT layout-set signature. It is
+    deliberately NOT part of the key: a table re-encode (compression
+    toggled, workload-adaptive re-choice) must EVICT the stale entry —
+    its cached compile-cache signature names programs that decode the
+    old layouts — not orphan it under a dead key while a lookup with
+    the old signature could still hit it."""
     if key is None:
         return None
     with _CC_LOCK:
         ent = _SPEC_CACHE.get(key)
+        if ent is not None and ent.get("lay_sig") != lay_sig:
+            del _SPEC_CACHE[key]    # layout changed: stale, evict
+            return None
         if ent is not None:
             _SPEC_CACHE.move_to_end(key)
         return ent
@@ -1372,11 +1381,23 @@ class TpuFragmentExec:
         # slab k+1 pipelines behind the (async) upload/compute of slab k.
         ent, stream = device_cache.open_table(self.ctx, scan, used,
                                               max_slab,
-                                              phases=self.ctx.phases)
+                                              phases=self.ctx.phases,
+                                              prune=True)
         if ent.total == 0:
             raise FragmentFallback("empty input")
         dicts = {i: ent.dicts.get(i) for i in used}
         total, slab_cap, n_slabs = ent.total, ent.slab_cap, ent.n_slabs
+
+        # zone-map slab pruning: the scan's conjuncts evaluated host-side
+        # against per-slab stats (over dict codes / encoded ints, no
+        # decode). A pruned slab costs NOTHING downstream: the cold
+        # stream already skipped its encode+upload, and slab_ids keeps it
+        # out of every program launch and escalation checkpoint.
+        from tidb_tpu.executor import zonemap
+        skip = zonemap.prune_slabs(ent, scan)
+        slab_ids = [s for s in range(n_slabs) if s not in skip]
+        if skip:
+            zonemap.note_skipped(self.ctx.phases, len(skip))
 
         root = chain[0]
         # multi-slab Sort: each slab sorts on device; the host performs the
@@ -1393,6 +1414,25 @@ class TpuFragmentExec:
                 for _ in stream:    # commit the upload; the tree path
                     pass            # re-opens the table warm
             return self._run_device_tree()
+
+        if not slab_ids:
+            # every slab pruned: ZERO launches. Drain the stream so the
+            # skip accounting + hole placeholders still commit, then
+            # synthesize the result the device would have produced:
+            # grouped agg → empty, global agg → the CPU oracle's
+            # identity row (COUNT 0, SUM/MIN/MAX NULL — merge of zero
+            # passes), order/filter roots → empty.
+            if stream is not None:
+                for _ in stream:
+                    pass
+            if isinstance(root, PhysHashAgg):
+                chunk = self._merge_tree_agg_passes(root, [], dicts)
+                if order_root is not None:
+                    chunk = _host_order(chunk, order_root, root.schema)
+                    chunk = _topn_slice(chunk, order_root)
+                return chunk
+            from tidb_tpu.executor import _empty_chunk
+            return _empty_chunk(self.schema)
 
         # stats-informed grouping: small known key domains skip the sort
         # (open_table commits dictionaries/bounds EAGERLY — before the
@@ -1411,16 +1451,17 @@ class TpuFragmentExec:
             # are RESUMABLE (only overflowed slab partials re-execute)
             return self._execute_agg(chain, root, ent, dicts, stream,
                                      used, in_types, slab_cap, group_cap,
-                                     key_bounds, layouts, order_root)
+                                     key_bounds, layouts, order_root,
+                                     slab_ids=slab_ids)
         # order/filter roots have no group capacity to overflow — one pass
         prog = get_program(chain, used, in_types, slab_cap, group_cap,
                            layouts=layouts)
         prep_vals = prog.collect_preps(dicts)
         if isinstance(root, (PhysTopN, PhysSort)):
             return self._execute_order(prog, root, ent, dicts, prep_vals,
-                                       stream)
+                                       stream, slab_ids=slab_ids)
         return self._execute_filter(prog, root, ent, dicts, prep_vals,
-                                    stream)
+                                    stream, slab_ids=slab_ids)
 
     # ---- join-tree / mega-slab device pipeline -----------------------------
     def _run_device_tree(self) -> Chunk:
@@ -1484,6 +1525,21 @@ class TpuFragmentExec:
         scan_rows = tuple(
             np.array([e.slab_rows(s) for s in range(e.n_slabs)],
                      dtype=np.int32) for e, _ in ents)
+        # zone-map slab pruning, tree flavor: scan_rows is a RUNTIME
+        # input (the per-slab live mask reads it), so zeroing a pruned
+        # slab's row count removes its rows with NO signature change —
+        # the mega-slab program stays byte-identical while pruned rows
+        # never enter filters/joins/aggs. The fused per-slab driver
+        # reads the zeroed counts and skips those slabs' launches
+        # entirely.
+        from tidb_tpu.executor import zonemap
+        n_zeroed = 0
+        for sc, (e, _u), rows in zip(scans, ents, scan_rows):
+            for s in zonemap.prune_slabs(e, sc):
+                rows[s] = 0
+                n_zeroed += 1
+        if n_zeroed:
+            zonemap.note_skipped(self.ctx.phases, n_zeroed)
         max_cap = max(e.slab_cap * e.n_slabs for e, _ in ents)
 
         flow_list = [flows.get(id(n), []) for n in TF._walk_nodes(root)]
@@ -1707,6 +1763,25 @@ class TpuFragmentExec:
         pipe_caps = dict(caps)
         pipe_caps[id(anchor)] = (slab_cap, 1)
         anchor_rows = scan_rows[anchor_i]
+        # zone-map pruning: _run_device_tree already zeroed the
+        # scan_rows entries of slabs the anchor scan's conjuncts prune
+        # (and charged the skip ledger), so a zero row count IS the
+        # skip signal — those slabs get no fused launch at all.
+        # run_ids are the surviving physical slab ids; every per-slab
+        # array below indexes POSITIONS in run_ids.
+        run_ids = [s for s in range(n_slabs) if int(anchor_rows[s]) > 0]
+        n_run = len(run_ids)
+        if not run_ids:
+            # every anchor slab pruned: zero fused launches — grouped
+            # agg → empty, global agg → the merge-of-zero-passes
+            # identity row (matches the CPU oracle)
+            inp_dicts = {i: d
+                         for i, d in enumerate(flows.get(id(root), []))}
+            chunk = self._merge_tree_agg_passes(root, [], inp_dicts)
+            if order_root is not None:
+                chunk = _host_order(chunk, order_root, root.schema)
+                chunk = _topn_slice(chunk, order_root)
+            return chunk
         has_distinct = any(d.distinct and d.args for d in root.aggs)
         want_pairs = has_distinct and n_slabs > 1
         pair_cap = min(int(vars_.get("tidb_tpu_distinct_pair_cap", 65536)),
@@ -1717,18 +1792,22 @@ class TpuFragmentExec:
         # learned join configs a previous execution of this statement
         # shape settled on and reuse its exact pipeline signature
         skey = None
+        lay_sig = ",".join(
+            f"{si}/{i}:{l.sig()}"
+            for si, slot in enumerate(scan_layouts or ())
+            for i, l in slot) if scan_layouts else "-"
         if _var_bool(vars_.get("tidb_tpu_specialization_cache", "on")):
-            lay_sig = ",".join(
-                f"{si}/{i}:{l.sig()}"
-                for si, slot in enumerate(scan_layouts or ())
-                for i, l in slot) if scan_layouts else "-"
+            # layouts are NOT part of the key: a workload-adaptive
+            # re-choice must EVICT the stale entry (same statement shape,
+            # different physical layout), not shadow it — _spec_lookup
+            # compares the stored lay_sig and drops mismatches
             skey = _spec_key(
                 getattr(self.ctx, "guard", None), "tree",
                 (tuple((id(e.td), e.slab_cap, e.n_slabs) for e, _ in ents),
-                 anchor_i, lay_sig, repr(akb), want_pairs, use_fin,
+                 anchor_i, repr(akb), want_pairs, use_fin,
                  _order_sig(order_root) if order_root is not None
                  else None))
-        spec = _spec_lookup(skey)
+        spec = _spec_lookup(skey, lay_sig)
         if skey is not None:
             _spec_note(ph, spec is not None)
         spec_sig = None
@@ -1772,10 +1851,10 @@ class TpuFragmentExec:
             return tuple(si), tuple(sr), tuple(ai)
 
         from tidb_tpu.util import failpoint
-        partials: List = [None] * n_slabs
-        caps_ran = [0] * n_slabs       # group cap each partial ran at
-        pcaps = [0] * n_slabs          # pair cap each partial ran at
-        pairs_cache: List = [None] * n_slabs   # host distinct-pair sets
+        partials: List = [None] * n_run
+        caps_ran = [0] * n_run         # group cap each partial ran at
+        pcaps = [0] * n_run            # pair cap each partial ran at
+        pairs_cache: List = [None] * n_run     # host distinct-pair sets
         to_run: Optional[List[int]] = None     # None = cold first pass
         n_joins = len(walk_joins)
         while True:
@@ -1787,9 +1866,9 @@ class TpuFragmentExec:
             spec_sig = None
             prep_vals = prog.collect_preps(flow_list)
             sig12 = hashlib.sha1(pipe_sig.encode()).hexdigest()[:12]
-            for s in (range(n_slabs) if to_run is None else to_run):
+            for s in (range(n_run) if to_run is None else to_run):
                 stale = partials[s]
-                si, sr, ai = slab_args(s)
+                si, sr, ai = slab_args(run_ids[s])
                 # slot per slab DISPATCH (async queue) — one labeled
                 # compute span per fused slab program in the trace
                 with self.ctx.device_slot():
@@ -1806,7 +1885,7 @@ class TpuFragmentExec:
                 # distinct (group, value) pair sets: fetch true counts,
                 # validate against the cap each slab ran at, then slice +
                 # fetch (mirrors _execute_agg — resumable "pairs" rung)
-                need = [s for s in range(n_slabs)
+                need = [s for s in range(n_run)
                         if pairs_cache[s] is None]
                 if need:
                     with ph.phase("fetch"):
@@ -1833,7 +1912,7 @@ class TpuFragmentExec:
                         ladder.attempt("pairs", _GroupCapOverflow(worst))
                         ladder.partial_resume(
                             "pairs", rerun=len(pover),
-                            reused=n_slabs - len(pover))
+                            reused=n_run - len(pover))
                         to_run = pover
                         continue
                     with ph.phase("fetch"):
@@ -1852,7 +1931,7 @@ class TpuFragmentExec:
             # batched fetch
             with self.ctx.device_slot():
                 with ph.phase("compute"):
-                    if use_fin or n_slabs > 1:
+                    if use_fin or n_run > 1:
                         # concatenate even for one slab: the finalize
                         # donates its inputs, and fresh buffers keep the
                         # checkpointed partials alive for resumable
@@ -1874,7 +1953,7 @@ class TpuFragmentExec:
                                                      for p in partials])
                     if use_fin:
                         pass          # launched below, in its own span
-                    elif n_slabs == 1:
+                    elif n_run == 1:
                         out = partials[0]
                     else:
                         mp = get_merge_program(root, gcap, pipe_sig)
@@ -1913,16 +1992,16 @@ class TpuFragmentExec:
                 # chaos injection proves a fault at the finalize
                 # boundary degrades to the CPU oracle
                 failpoint.inject("fused-finalize-overflow")
-            jts = np.asarray(got["jts"]).reshape(n_slabs, n_joins) \
-                if n_joins else np.zeros((n_slabs, 0), dtype=np.int64)
-            jus = np.asarray(got["jus"]).reshape(n_slabs, n_joins) \
-                if n_joins else np.zeros((n_slabs, 0), dtype=bool)
+            jts = np.asarray(got["jts"]).reshape(n_run, n_joins) \
+                if n_joins else np.zeros((n_run, 0), dtype=np.int64)
+            jus = np.asarray(got["jus"]).reshape(n_run, n_joins) \
+                if n_joins else np.zeros((n_run, 0), dtype=bool)
             retry = False
             charged = False
             rerun: set = set()
             for ji, cfg in enumerate(join_cfgs):
                 uq = bool(jus[:, ji].all())
-                tot = int(jts[:, ji].max()) if n_slabs else 0
+                tot = int(jts[:, ji].max()) if n_run else 0
                 new_cfg, action = TF.escalate_join(
                     cfg, uq, tot, out_cap_max,
                     flip_out_cap=_pow2(int(cfg.est * 1.3), lo=1024),
@@ -1930,7 +2009,7 @@ class TpuFragmentExec:
                 if action == "over-max":
                     for p in partials:
                         _tree_delete(p)
-                    if n_slabs > 1 or use_fin:
+                    if n_run > 1 or use_fin:
                         _tree_delete(out)
                     return None
                 if new_cfg is not None:
@@ -1939,15 +2018,15 @@ class TpuFragmentExec:
                     if action == "flip":
                         # the join's trace changed: every checkpoint is
                         # from the wrong program — full re-run
-                        rerun.update(range(n_slabs))
+                        rerun.update(range(n_run))
                     else:
                         # exact resize: only slabs whose OWN fan-out
                         # overflowed the old cap re-run
-                        rerun.update(s for s in range(n_slabs)
+                        rerun.update(s for s in range(n_run)
                                      if int(jts[s, ji]) > cfg.out_cap)
             n_final = int(got["ng"])
             if akb is None:
-                over = [s for s in range(n_slabs)
+                over = [s for s in range(n_run)
                         if int(got["ngs"][s]) > caps_ran[s]]
                 if over or n_final > gcap:
                     if gcap >= max_cap:
@@ -1962,7 +2041,7 @@ class TpuFragmentExec:
                                          max_cap=max_cap)
                     ladder.attempt("group", _GroupCapOverflow(need_cap))
                     ladder.partial_resume("group", rerun=len(over),
-                                          reused=n_slabs - len(over))
+                                          reused=n_run - len(over))
                     charged = True
                     rerun.update(over)
                     retry = True
@@ -1971,7 +2050,7 @@ class TpuFragmentExec:
                     # budget + guard checkpoint between recompiles (the
                     # join rungs above already recorded their own stats)
                     ladder.attempt("fused")
-                if n_slabs > 1 or use_fin:
+                if n_run > 1 or use_fin:
                     _tree_delete(out)     # stale merge generation
                 to_run = sorted(rerun)
                 continue
@@ -1982,14 +2061,14 @@ class TpuFragmentExec:
                                  or list(spec["join_cfgs"]) != join_cfgs):
             _spec_store(skey, {"group_cap": gcap, "pair_cap": pair_cap,
                                "join_cfgs": tuple(join_cfgs),
-                               "sig": pipe_sig})
+                               "sig": pipe_sig, "lay_sig": lay_sig})
         if root.group_exprs and n_final == 0:
             from tidb_tpu.executor import _empty_chunk
             return _empty_chunk(self.schema)
         host_pairs = None
         if want_pairs:
             host_pairs = {ai: [pairs_cache[s][ai]
-                               for s in range(n_slabs)]
+                               for s in range(n_run)]
                           for ai in pairs_cache[0]} \
                 if pairs_cache[0] else {}
         inp_dicts = {i: d for i, d in enumerate(flows.get(id(root), []))}
@@ -2249,10 +2328,51 @@ class TpuFragmentExec:
                                                    allow_dict=False)
                 if lay is not None and lay.width > 0:
                     layouts[i] = lay
+        dicts = {i: host_cols[(id(scan), i)][2] for i in used_cols}
+        # rank-level zone maps: the per-rank slice is this path's
+        # dispatch unit, so stats are built per rank (slab_cap=cap) and
+        # the scan's conjuncts evaluate exactly as on the slab path. A
+        # pruned rank packs nothing, uploads nothing and runs nothing —
+        # its checkpoint is the ng=0 merge identity.
+        skip_ranks: frozenset = frozenset()
+        if comp_on and getattr(scan, "filters", None):
+            from tidb_tpu.executor import zonemap
+            zmaps = {}
+            for i in used_cols:
+                vals, valid, _d = host_cols[(id(scan), i)]
+                if vals.ndim != 1:
+                    continue
+                kind = "code" if _d is not None else \
+                    ("float" if vals.dtype.kind == "f" else "num")
+                zmaps[i] = zonemap.column_stats(vals, valid, cap, total,
+                                                kind=kind)
+            shim = _RankZoneEnt(nd, zmaps, dicts)
+            skip_ranks = zonemap.prune_slabs(shim, scan)
+            if skip_ranks:
+                zonemap.note_skipped(self.ctx.phases, len(skip_ranks))
+                phys_b = logi_b = 0
+                for i in used_cols:
+                    vals, valid, _d = host_cols[(id(scan), i)]
+                    lay = layouts.get(i)
+                    if lay is not None:
+                        phys_b += _compress.packed_slab_bytes(lay, cap)
+                        logi_b += _compress.raw_slab_bytes(lay, cap)
+                    else:
+                        b = cap * vals.dtype.itemsize + cap
+                        phys_b += b
+                        logi_b += b
+                zonemap.note_h2d_skipped(self.ctx.phases,
+                                         phys_b * len(skip_ranks))
+                self.ctx.phases.add_scan(
+                    0, logical=logi_b * len(skip_ranks))
         # per-rank host slices — the checkpoint story's source of truth:
         # a retry or re-dispatch re-uploads ONLY its rank's slice
+        # (pruned ranks hold None: never packed, never touched)
         rank_cols = []
         for r in range(nd):
+            if r in skip_ranks:
+                rank_cols.append(None)
+                continue
             lo = r * cap
             cols = {}
             for i in used_cols:
@@ -2269,7 +2389,6 @@ class TpuFragmentExec:
             rank_cols.append(cols)
         rank_rows = np.clip(total - np.arange(nd) * cap, 0,
                             cap).astype(np.int32)
-        dicts = {i: host_cols[(id(scan), i)][2] for i in used_cols}
         in_types = [scan.schema.field_types[i] for i in used_cols]
         vars_ = self.ctx.vars
         group_cap = int(vars_.get("tidb_tpu_group_cap",
@@ -2281,7 +2400,8 @@ class TpuFragmentExec:
         runner = StagedDistAgg(root, chain, mesh, rank_cols, rank_rows,
                                dicts, used_cols, in_types, cap, gcap,
                                cap_limit, self.ctx, ladder,
-                               layouts=layouts or None)
+                               layouts=layouts or None,
+                               skip_ranks=skip_ranks)
         pass_outs = runner.execute()
         flows, _root_dicts = TF.dictionary_flows(root, {id(scan): dicts})
         inp_dicts = {i: d for i, d in
@@ -2384,13 +2504,16 @@ class TpuFragmentExec:
                 # boundaries must coincide with shard boundaries: cap a
                 # multiple of WORD_BITS makes every per ∈ {1,2,4,8,32}
                 # divide the shard evenly. Dictionaries would need
-                # replication, and a width-0 (1,) stub can't shard.
+                # replication, a width-0 (1,) stub can't shard, and a
+                # delta slab can't either — its (1,) base is global while
+                # each shard's cumsum would need its OWN running base.
                 lay = None
                 if comp_on and vals.ndim == 1 and \
                         cap % _compress.WORD_BITS == 0:
                     lay, _dv = _compress.choose_layout(vals, valid,
                                                        allow_dict=False)
-                    if lay is not None and lay.width == 0:
+                    if lay is not None and (lay.width == 0
+                                            or lay.kind == "delta"):
                         lay = None
                 with ph.phase("encode"):
                     pv = np.zeros(nd * cap, dtype=vals.dtype)
@@ -2604,14 +2727,18 @@ class TpuFragmentExec:
         cols = {i: ent.dev[i][slab_idx] for i in used}
         return cols, ent.slab_rows(slab_idx)
 
-    def _slab_iter(self, ent, stream, used: Sequence[int]):
+    def _slab_iter(self, ent, stream, used: Sequence[int], slab_ids=None):
         """Per-slab (cols, n_rows) source: the open_table stream on a cold
         first touch (driving it between dispatches is what overlaps encode
         with device work), the resident cache otherwise. A consumed stream
         has committed its arrays to ent.dev, so ladder retries always take
-        the warm branch."""
+        the warm branch. `slab_ids` restricts the warm branch to the
+        zone-map survivors; the stream needs no restriction — it already
+        skipped pruned slabs, and both sides enumerate survivors in the
+        same ascending physical order, so positional consumers align."""
         if stream is None:
-            for s in range(ent.n_slabs):
+            ids = slab_ids if slab_ids is not None else range(ent.n_slabs)
+            for s in ids:
                 yield self._slab(ent, s, used)
         else:
             for s, cols in stream:
@@ -2620,7 +2747,8 @@ class TpuFragmentExec:
     # -- hash agg ------------------------------------------------------------
     def _execute_agg(self, chain, root: PhysHashAgg, ent, dicts, stream,
                      used, in_types, slab_cap, group_cap,
-                     key_bounds, layouts=None, order_root=None) -> Chunk:
+                     key_bounds, layouts=None, order_root=None,
+                     slab_ids=None) -> Chunk:
         """Grouped aggregation with RESUMABLE capacity escalation.
 
         Per-slab partials are the checkpoint: on a group-cap overflow,
@@ -2643,6 +2771,13 @@ class TpuFragmentExec:
         ladder = CapacityLadder(guard=getattr(self.ctx, "guard", None),
                                 stats=self.ctx.escalation)
         n_slabs = ent.n_slabs
+        # zone-map survivors: partials/caps/pairs arrays index POSITIONS
+        # in slab_ids (ascending physical order — matches the cold
+        # stream's yield order); n_slabs stays the table geometry so
+        # signatures and capacity ceilings don't depend on pruning
+        slab_ids = list(slab_ids) if slab_ids is not None \
+            else list(range(n_slabs))
+        n_run = len(slab_ids)
         cap_limit = slab_cap * max(n_slabs, 1)
         has_distinct = any(d.distinct and d.args for d in root.aggs)
         want_pairs = n_slabs > 1 and has_distinct
@@ -2661,17 +2796,21 @@ class TpuFragmentExec:
         # invalidate), geometry, layouts and key bounds — everything the
         # signature would otherwise re-derive.
         skey = None
+        lay_sig = ",".join(f"{i}:{l.sig()}"
+                           for i, l in sorted(layouts.items())) \
+            if layouts else "-"
         if _var_bool(vars_.get("tidb_tpu_specialization_cache", "on")):
-            lay_sig = ",".join(f"{i}:{l.sig()}"
-                               for i, l in sorted(layouts.items())) \
-                if layouts else "-"
+            # layouts deliberately NOT in the key: a workload-adaptive
+            # layout re-choice must EVICT the old specialization (its
+            # cached signature names the stale physical layout), so
+            # _spec_lookup matches the stored lay_sig and evicts on drift
             skey = _spec_key(
                 getattr(self.ctx, "guard", None), "chain",
-                (id(ent.td), slab_cap, n_slabs, lay_sig,
+                (id(ent.td), slab_cap, n_slabs,
                  repr(key_bounds), want_pairs, use_fin,
                  _order_sig(order_root) if order_root is not None
                  else None))
-        spec = _spec_lookup(skey)
+        spec = _spec_lookup(skey, lay_sig)
         if skey is not None:
             _spec_note(ph, spec is not None)
         spec_sig = None
@@ -2679,10 +2818,10 @@ class TpuFragmentExec:
             group_cap = spec["group_cap"]
             pair_cap = spec["pair_cap"] if want_pairs else 0
             spec_sig = spec["sig"]
-        partials: List = [None] * n_slabs
-        caps = [0] * n_slabs            # group cap each partial ran at
-        pcaps = [0] * n_slabs           # pair cap each partial ran at
-        pairs_cache: List = [None] * n_slabs   # host distinct-pair sets
+        partials: List = [None] * n_run
+        caps = [0] * n_run              # group cap each partial ran at
+        pcaps = [0] * n_run             # pair cap each partial ran at
+        pairs_cache: List = [None] * n_run     # host distinct-pair sets
         to_run: Optional[List[int]] = None     # None = cold first pass
         while True:
             if spec_sig is not None:
@@ -2697,7 +2836,8 @@ class TpuFragmentExec:
             prep_vals = prog.collect_preps(dicts)
             if to_run is None:
                 for s, (cols, n) in enumerate(
-                        self._slab_iter(ent, stream, prog.used_cols)):
+                        self._slab_iter(ent, stream, prog.used_cols,
+                                        slab_ids)):
                     # slot per slab DISPATCH: the streamed encode of the
                     # next slab (inside _slab_iter) runs slot-free, so a
                     # sibling's dispatch interleaves with our host work
@@ -2712,7 +2852,8 @@ class TpuFragmentExec:
             else:
                 for s in to_run:
                     stale = partials[s]
-                    cols, n = self._slab(ent, s, prog.used_cols)
+                    cols, n = self._slab(ent, slab_ids[s],
+                                         prog.used_cols)
                     with self.ctx.device_slot():
                         with ph.phase("compute"):
                             partials[s] = prog.partial(cols, jnp.int32(n),
@@ -2728,7 +2869,7 @@ class TpuFragmentExec:
                 # the partial outputs; slice to their true counts on
                 # device and fetch in one round trip. Cached host-side
                 # per slab: a resumable retry refetches only re-run slabs
-                need = [s for s in range(n_slabs)
+                need = [s for s in range(n_run)
                         if pairs_cache[s] is None]
                 if need:
                     with ph.phase("fetch"):
@@ -2758,7 +2899,7 @@ class TpuFragmentExec:
                         ladder.attempt("pairs", _GroupCapOverflow(worst))
                         ladder.partial_resume(
                             "pairs", rerun=len(pover),
-                            reused=n_slabs - len(pover))
+                            reused=n_run - len(pover))
                         to_run = pover
                         continue
                     with ph.phase("fetch"):
@@ -2782,7 +2923,7 @@ class TpuFragmentExec:
             # n_groups alone can look fine.
             with self.ctx.device_slot():
                 with ph.phase("compute"):
-                    if use_fin or n_slabs > 1:
+                    if use_fin or n_run > 1:
                         # concatenate even for one slab: the finalize
                         # donates its inputs, and fresh buffers keep the
                         # checkpointed partials alive for resumable
@@ -2805,7 +2946,7 @@ class TpuFragmentExec:
                                                      for p in partials])
                     if use_fin:
                         pass          # launched below, in its own span
-                    elif n_slabs == 1:
+                    elif n_run == 1:
                         out = partials[0]
                     else:
                         out = prog.merge(key_cols, states, slot_live)
@@ -2847,7 +2988,7 @@ class TpuFragmentExec:
             # overflow iff a slab's TRUE count exceeded the cap IT ran at
             # (factorize counts before clamping, so per-slab ngs are true;
             # reused partials ran at an older, smaller cap and stay valid)
-            over = [s for s in range(n_slabs)
+            over = [s for s in range(n_run)
                     if int(got["ngs"][s]) > caps[s]]
             n_final = int(got["ng"])
             if over:
@@ -2863,8 +3004,8 @@ class TpuFragmentExec:
                                           max_cap=cap_limit)
                 ladder.attempt("group", _GroupCapOverflow(need_cap))
                 ladder.partial_resume("group", rerun=len(over),
-                                      reused=n_slabs - len(over))
-                if n_slabs > 1 or use_fin:
+                                      reused=n_run - len(over))
+                if n_run > 1 or use_fin:
                     _tree_delete(out)     # stale merge generation
                 to_run = over
                 continue
@@ -2879,8 +3020,8 @@ class TpuFragmentExec:
                                           need=n_final,
                                           max_cap=cap_limit)
                 ladder.attempt("group", _GroupCapOverflow(n_final))
-                ladder.partial_resume("group", rerun=0, reused=n_slabs)
-                if n_slabs > 1 or use_fin:
+                ladder.partial_resume("group", rerun=0, reused=n_run)
+                if n_run > 1 or use_fin:
                     _tree_delete(out)
                 to_run = []
                 continue
@@ -2889,11 +3030,12 @@ class TpuFragmentExec:
                                  or spec["group_cap"] != group_cap
                                  or spec["pair_cap"] != pair_cap):
             _spec_store(skey, {"group_cap": group_cap,
-                               "pair_cap": pair_cap, "sig": psig})
+                               "pair_cap": pair_cap, "sig": psig,
+                               "lay_sig": lay_sig})
         host_pairs = None
         if want_pairs:
             host_pairs = {ai: [pairs_cache[s][ai]
-                               for s in range(n_slabs)]
+                               for s in range(n_run)]
                           for ai in pairs_cache[0]} \
                 if pairs_cache[0] else {}
         if root.group_exprs and n_final == 0:
@@ -2953,11 +3095,12 @@ class TpuFragmentExec:
 
     # -- topn / sort ---------------------------------------------------------
     def _execute_order(self, prog, root, ent, dicts, prep_vals,
-                       stream=None) -> Chunk:
+                       stream=None, slab_ids=None) -> Chunk:
         from tidb_tpu.ops.jax_env import jax, jnp
         ph = self.ctx.phases
         outs = []
-        for cols, n in self._slab_iter(ent, stream, prog.used_cols):
+        for cols, n in self._slab_iter(ent, stream, prog.used_cols,
+                                       slab_ids):
             with self.ctx.device_slot():
                 with ph.phase("compute"):
                     outs.append(prog.partial(cols, jnp.int32(n),
@@ -2995,11 +3138,12 @@ class TpuFragmentExec:
 
     # -- selection / projection ----------------------------------------------
     def _execute_filter(self, prog, root, ent, dicts, prep_vals,
-                        stream=None) -> Chunk:
+                        stream=None, slab_ids=None) -> Chunk:
         from tidb_tpu.ops.jax_env import jax, jnp
         ph = self.ctx.phases
         outs = []
-        for cols, n in self._slab_iter(ent, stream, prog.used_cols):
+        for cols, n in self._slab_iter(ent, stream, prog.used_cols,
+                                       slab_ids):
             with self.ctx.device_slot():
                 with ph.phase("compute"):
                     outs.append(prog.partial(cols, jnp.int32(n),
@@ -3033,6 +3177,20 @@ def _strip_exchanges(plan: PhysicalPlan) -> PhysicalPlan:
     if isinstance(plan, PhysExchange):
         return plan.children[0]
     return plan
+
+
+class _RankZoneEnt:
+    """Duck-typed zone-map carrier for staged-dist rank pruning: the
+    per-rank slice plays the slab role, so zonemap.prune_slabs runs
+    unchanged over rank-granular stats."""
+
+    __slots__ = ("compressed", "n_slabs", "zmaps", "dicts")
+
+    def __init__(self, nd: int, zmaps: dict, dicts: dict):
+        self.compressed = True
+        self.n_slabs = nd
+        self.zmaps = zmaps
+        self.dicts = dicts
 
 
 class _GroupCapOverflow(Exception):

@@ -220,6 +220,8 @@ def _partitions(session):
            ("PROGRAMS_LAUNCHED", T.bigint()),
            ("FUSED_PIPELINES", T.bigint()),
            ("SPECIALIZATION_HITS", T.bigint()),
+           ("SLABS_SKIPPED", T.bigint()),
+           ("H2D_SKIPPED_BYTES", T.bigint()),
            ("QUEUE_WAIT_S", T.double()),
            ("QUEUE_WAITS", T.bigint()),
            ("QUEUE_P50_MS", T.double()),
@@ -236,6 +238,7 @@ def _statements_summary(session):
              p["scan_logical_bytes"], p["compiles"],
              p["programs_launched"], p["fused_pipelines"],
              p["specialization_hits"],
+             p.get("slabs_skipped", 0), p.get("h2d_skipped_bytes", 0),
              p["queue_wait_s"], p["queue_waits"], p["queue_p50_ms"],
              p["queue_p99_ms"])
             for p in REGISTRY.summary_profiles()]
@@ -261,7 +264,11 @@ def _slow_query(session):
                             ("COLUMN_NAME", T.varchar()),
                             ("LAYOUT", T.varchar()),
                             ("PHYSICAL_BYTES", T.bigint()),
-                            ("LOGICAL_BYTES", T.bigint())])
+                            ("LOGICAL_BYTES", T.bigint()),
+                            ("ZONE_MAP_SLABS", T.bigint()),
+                            ("ZONE_MAP_MIN", T.varchar()),
+                            ("ZONE_MAP_MAX", T.varchar()),
+                            ("ZONE_MAP_NULLS", T.bigint())])
 def _table_storage(session):
     """Per-(table, column) device residency of the HBM column cache:
     the physical (compressed) bytes actually held in HBM next to the
@@ -269,7 +276,9 @@ def _table_storage(session):
     produced them ('raw', 'pack:wW:rREF:...', 'dict:wW:...'). The
     physical column reconciles with statements_summary's H2D/SCAN
     counters: a cold scan's H2D_BYTES is exactly the physical bytes of
-    the columns it uploaded."""
+    the columns it uploaded. The ZONE_MAP_* columns expose the
+    encode-time per-slab statistics slab pruning consults (slab count,
+    global min/max over known slabs, total null count)."""
     from tidb_tpu.executor import device_cache
     names = {t.id: t.name for t in _user_tables(session)}
     cols = {t.id: [c.name for c in t.columns] for t in _user_tables(session)}
@@ -280,7 +289,13 @@ def _table_storage(session):
         cname = cnames[r["column"]] if r["column"] < len(cnames) \
             else str(r["column"])
         out.append((names.get(tid, str(tid)), cname, r["layout"],
-                    r["physical_bytes"], r["logical_bytes"]))
+                    r["physical_bytes"], r["logical_bytes"],
+                    r["zone_map_slabs"],
+                    None if r["zone_map_min"] is None
+                    else str(r["zone_map_min"]),
+                    None if r["zone_map_max"] is None
+                    else str(r["zone_map_max"]),
+                    r["zone_map_nulls"]))
     return sorted(out)
 
 

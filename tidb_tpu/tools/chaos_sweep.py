@@ -75,6 +75,12 @@ FUSED_QUERY = ("select f.a + 0, count(*) from cs_facts f "
 FINALIZE_QUERY = ("select b, count(distinct a) from cs_facts "
                   "group by b order by b")
 
+# selective scan whose WHERE rides the zone maps: with compression on
+# (the default) the host consults per-slab min/max BEFORE dispatch, so
+# this query walks the prune decision — the zone-map-stale site —
+# on every device attempt
+PRUNE_QUERY = "select count(*), sum(a) from cs_facts where a > 100"
+
 # distributed shapes — integer results, so dist vs CPU comparison is
 # exact. The DISTINCT agg matters: a plain group-by distributes through
 # gather_partials (no re-key), so only the DISTINCT re-key exchange (and
@@ -204,6 +210,14 @@ def _scenarios(mesh: Optional[int] = None) -> List[Scenario]:
                  "compressed-decode-mismatch",
                  dict(value="chaos: descriptor drift", times=9),
                  vars=dict(device_on)),
+        # a stale zone map at the host-side slab-prune decision: the
+        # consult raises a typed LayoutError, the per-statement guard
+        # converts it into a warned CPU fallback, and the selective
+        # query still answers the oracle — a stale map must NEVER
+        # silently skip slabs that hold passing rows
+        Scenario("stale zone map → CPU fallback", "zone-map-stale",
+                 dict(value="chaos: stale zone map", times=9),
+                 run="prune", vars=dict(device_on)),
         # -- DDL -----------------------------------------------------------
         Scenario("unique backfill dies mid-reorg", "index-backfill",
                  dict(raise_=ExecutionError("chaos: backfill"), times=1),
@@ -339,7 +353,7 @@ def run_sweep(verbose: bool = False, mesh: Optional[int] = None,
     # oracle recorded AFTER the probe write; re-recorded after every
     # mutating scenario, so "correct result" always means "what a clean
     # run over the CURRENT data returns"
-    oracle_qs = QUERIES + [RECOMPILE_QUERY, FUSED_QUERY] + \
+    oracle_qs = QUERIES + [RECOMPILE_QUERY, FUSED_QUERY, PRUNE_QUERY] + \
         [q for q in MESH_QUERIES if q not in QUERIES]
     oracle = {q: s.query(q).rows for q in oracle_qs}
     base_count = s.query("select count(*) from cs_facts").scalar()
@@ -381,6 +395,17 @@ def run_sweep(verbose: bool = False, mesh: Optional[int] = None,
                 if err is not None:
                     errors += 1
                 elif sorted(rows) != sorted(oracle[q]):
+                    wrong += 1
+                    failures.append(f"{sc.name}: {q!r} SILENT WRONG RESULT")
+            elif sc.run == "prune":
+                q = PRUNE_QUERY
+                rows, err, dt = _run_statement(s, q)
+                if dt > DEADLINE_S:
+                    slow += 1
+                    failures.append(f"{sc.name}: {q!r} took {dt:.1f}s")
+                if err is not None:
+                    errors += 1
+                elif rows != oracle[q]:
                     wrong += 1
                     failures.append(f"{sc.name}: {q!r} SILENT WRONG RESULT")
             elif sc.run == "fused":

@@ -158,6 +158,7 @@ class Registry:
                      "scan_logical_bytes": 0, "compiles": 0,
                      "programs_launched": 0, "fused_pipelines": 0,
                      "specialization_hits": 0,
+                     "slabs_skipped": 0, "h2d_skipped_bytes": 0,
                      "queue_wait_s": 0.0, "queue_waits": 0,
                      "queue_hist": _hist_new(),
                      "phase_s": {}, "engine": engine}
@@ -188,6 +189,9 @@ class Registry:
                 s["fused_pipelines"] += ph.fused_pipelines
                 s["specialization_hits"] += getattr(
                     ph, "specialization_hits", 0)
+                s["slabs_skipped"] += getattr(ph, "slabs_skipped", 0)
+                s["h2d_skipped_bytes"] += getattr(
+                    ph, "h2d_skipped_bytes", 0)
                 for p, v in ph.seconds.items():
                     s["phase_s"][p] = s["phase_s"].get(p, 0.0) + v
             if seconds >= threshold:
@@ -257,6 +261,8 @@ class Registry:
                     "programs_launched": s.get("programs_launched", 0),
                     "fused_pipelines": s.get("fused_pipelines", 0),
                     "specialization_hits": s.get("specialization_hits", 0),
+                    "slabs_skipped": s.get("slabs_skipped", 0),
+                    "h2d_skipped_bytes": s.get("h2d_skipped_bytes", 0),
                     "queue_wait_s": round(s["queue_wait_s"], 6),
                     "queue_waits": s["queue_waits"],
                     "queue_p50_ms": round(

@@ -68,7 +68,8 @@ class PhaseTimer:
                  "h2d_bytes", "d2h_bytes", "scan_bytes", "compiles",
                  "programs_launched", "fused_pipelines",
                  "specialization_hits", "conn_id",
-                 "h2d_logical_bytes", "scan_logical_bytes")
+                 "h2d_logical_bytes", "scan_logical_bytes",
+                 "slabs_skipped", "h2d_skipped_bytes")
 
     def __init__(self, conn_id: int = 0):
         self.seconds: Dict[str, float] = {p: 0.0 for p in PHASES}
@@ -87,6 +88,11 @@ class PhaseTimer:
         self.programs_launched = 0  # jitted device program dispatches
         self.fused_pipelines = 0    # of those, whole-pipeline slab launches
         self.specialization_hits = 0  # per-digest plan-cache hits
+        # zone-map pruning ledger: dispatch units (slabs / staged-dist
+        # ranks) skipped entirely, and upload bytes a pruned cold slab
+        # never moved across PCIe
+        self.slabs_skipped = 0
+        self.h2d_skipped_bytes = 0
         self.conn_id = conn_id    # timeline pid (0 = unattributed)
 
     @contextmanager
@@ -150,6 +156,18 @@ class PhaseTimer:
         capacity-discovery ladder climb)."""
         self.specialization_hits += int(n)
 
+    def note_slabs_skipped(self, n: int = 1) -> None:
+        """Zone maps proved `n` dispatch units (slabs or staged-dist
+        rank slices) empty under the scan's conjuncts — no upload, no
+        launch, no escalation bookkeeping for them."""
+        self.slabs_skipped += int(n)
+
+    def note_h2d_skipped(self, n: int) -> None:
+        """A pruned cold slab skipped its encode+upload: `n` physical
+        bytes never crossed PCIe (the ledger the bench's zero-H2D
+        assertion reads)."""
+        self.h2d_skipped_bytes += int(n)
+
     def fetch(self, tree):
         """jax.device_get under the fetch phase, with the transferred
         bytes charged to d2h_bytes — the one chokepoint every result
@@ -183,6 +201,8 @@ class PhaseTimer:
         out["programs_launched"] = self.programs_launched
         out["fused_pipelines"] = self.fused_pipelines
         out["specialization_hits"] = self.specialization_hits
+        out["slabs_skipped"] = self.slabs_skipped
+        out["h2d_skipped_bytes"] = self.h2d_skipped_bytes
         return out
 
     def summary(self) -> str:
@@ -209,6 +229,9 @@ class PhaseTimer:
                          f"fused={self.fused_pipelines}")
         if self.specialization_hits:
             parts.append(f"spec_hits={self.specialization_hits}")
+        if self.slabs_skipped:
+            parts.append(f"skipped={self.slabs_skipped} "
+                         f"h2d_skipped={self.h2d_skipped_bytes}B")
         return " ".join(parts)
 
 
