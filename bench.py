@@ -37,6 +37,13 @@ trip is latency-bound, so 8 threads overlapping host encode/decode with
 each other's device waits should scale ≥2x; on a single-core CPU host
 the numbers land but the scaling is compute-bound.
 
+A "Priority serving tier" section then mixes interactive point reads
+against batch Q1 scans at concurrency 1/8/64 and reports per-class
+p50/p99 plus the micro-batch coalescing rate, with a same-process
+flag-off FIFO baseline at the top contention level: the PR's acceptance
+claim is interactive p99 (classification on) ≤ interactive p99 (FIFO),
+emitted as priority_serving.interactive_p99_improves.
+
 Env: BENCH_SF (default 10) scales row count (SF=1 → 6,001,215 lineitem
 rows); BENCH_REPS / BENCH_CPU_REPS as above; BENCH_TIME_BUDGET_S
 (default 840) is the wall-clock budget for the WHOLE run — when it runs
@@ -349,6 +356,13 @@ def build_engine(n_rows: int):
     s.execute("ANALYZE TABLE lineitem")
     s.execute("ANALYZE TABLE orders")
     s.execute("ANALYZE TABLE customer")
+    # small point-read table for the priority serving-tier section:
+    # same-digest `WHERE k = ?` probes are the interactive class and the
+    # micro-batch coalescing substrate
+    s.execute("CREATE TABLE pr (k BIGINT, v BIGINT)")
+    s.execute("INSERT INTO pr VALUES " +
+              ", ".join(f"({i}, {i * i})" for i in range(1024)))
+    s.execute("ANALYZE TABLE pr")
     return eng, s
 
 
@@ -448,6 +462,77 @@ def run_mix(eng, conc: int, total: int, section_budget_s: float):
     wall = time.perf_counter() - t0
     all_lat = sorted(x for per in lat_s for x in per)
     return sum(done), wall, SCHEDULER.stats(), errors, all_lat
+
+
+def run_priority_mix(eng, conc: int, total: int, section_budget_s: float,
+                     prio_on: bool):
+    """Mixed-priority serving window: interactive point reads racing
+    batch Q1 scans through the device scheduler, with classification on
+    or off (off = the plain FIFO baseline). At conc == 1 a single thread
+    interleaves 3 points : 1 scan; at conc > 1, conc//8 (min 1) threads
+    loop scans and the rest serve points — same-digest probes, so queued
+    bursts coalesce through the micro-batcher. → (completed, wall
+    seconds, per-class latency lists, scheduler stats, micro-batch
+    counter deltas, [errors])."""
+    from tidb_tpu.executor.scheduler import SCHEDULER
+    from tidb_tpu.util.observability import REGISTRY
+    sessions = []
+    for _ in range(conc):
+        ss = eng.new_session()
+        ss.vars["tidb_tpu_engine"] = "on"
+        ss.vars["tidb_tpu_row_threshold"] = 1
+        ss.vars["tidb_tpu_priority_scheduling"] = \
+            "on" if prio_on else "off"
+        sessions.append(ss)
+    counter = itertools.count()
+    lat = {"interactive": [], "batch": []}
+    lat_lock = threading.Lock()
+    errors: list = []
+    stop_at = time.monotonic() + section_budget_s
+    n_batch = max(1, conc // 8) if conc > 1 else 0
+
+    def worker(k: int):
+        ss = sessions[k]
+        scan_role = k < n_batch
+        try:
+            while True:
+                i = next(counter)
+                if i >= total or time.monotonic() > stop_at:
+                    break
+                cls = "batch" if (scan_role
+                                  or (conc == 1 and i % 4 == 3)) \
+                    else "interactive"
+                sql = Q1 if cls == "batch" \
+                    else f"SELECT v FROM pr WHERE k = {i % 1024}"
+                q0 = time.perf_counter()
+                rs = ss.query(sql)
+                dt = time.perf_counter() - q0
+                assert rs.rows, "priority mix query returned no rows"
+                with lat_lock:
+                    lat[cls].append(dt)
+        except Exception as e:  # noqa: BLE001 — reported in the JSON
+            errors.append(f"{type(e).__name__}: {e}"[:200])
+
+    def mb():
+        return (REGISTRY.counters.get(
+                    ("tidb_tpu_microbatch_batches_total", ()), 0),
+                REGISTRY.counters.get(
+                    ("tidb_tpu_microbatch_members_total", ()), 0))
+
+    SCHEDULER.reset_stats()
+    b0, m0 = mb()
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=worker, args=(k,), daemon=True)
+               for k in range(conc)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    b1, m1 = mb()
+    done = len(lat["interactive"]) + len(lat["batch"])
+    return done, wall, lat, SCHEDULER.stats(), \
+        {"batches": b1 - b0, "members": m1 - m0}, errors
 
 
 def query_roofline_fraction(s, gbs: float) -> float:
@@ -752,6 +837,82 @@ def main():
         log(f"concurrent serving section failed: {e}")
         extra.update({"qps_c1": 0.0, "qps_c8": 0.0,
                       "qps_error": f"{type(e).__name__}: {e}"[:200]})
+
+    # ---- priority serving tier: per-class tails + micro-batching ----------
+    # interactive point reads vs batch Q1 scans at c1/c8/c64, then the
+    # same contention with classification OFF (plain FIFO) in the same
+    # process: the acceptance claim is that strict priority + coalescing
+    # keeps interactive p99 at or under the FIFO baseline's.
+    try:
+        left = remaining_s()
+        if left < 75.0:
+            raise RuntimeError(f"{left:.0f}s left in wall budget")
+        log("priority serving tier: warming point-read path…")
+        s.vars["tidb_tpu_row_threshold"] = 1
+        s.query("SELECT v FROM pr WHERE k = 17")   # parametrized compile
+        level_s = max(6.0, min(30.0, remaining_s() * 0.06))
+        prio: dict = {}
+        for conc in (1, 8, 64):
+            done, wall, lat, sched, mbd, errs = run_priority_mix(
+                eng, conc, 100000, level_s, prio_on=True)
+            pts = len(lat["interactive"])
+            prio[f"c{conc}"] = {
+                "qps": round(done / wall, 2) if wall > 0 and done else 0.0,
+                "interactive": latency_percentiles_ms(
+                    sorted(lat["interactive"])),
+                "batch": latency_percentiles_ms(sorted(lat["batch"])),
+                "queries": {"interactive": pts, "batch": len(lat["batch"])},
+                # fraction of point reads served through a micro-batch
+                # (coalesced members / point queries)
+                "microbatch_rate": round(mbd["members"] / pts, 4)
+                if pts else 0.0,
+                "microbatch": mbd,
+                "scheduler": sched}
+            if errs:
+                prio[f"c{conc}"]["errors"] = errs[:4]
+            log(f"priority c{conc}: {prio[f'c{conc}']['qps']} qps, "
+                f"interactive p99 "
+                f"{prio[f'c{conc}']['interactive']['latency_p99_ms']}ms, "
+                f"batch p99 "
+                f"{prio[f'c{conc}']['batch']['latency_p99_ms']}ms, "
+                f"mb rate {prio[f'c{conc}']['microbatch_rate']}")
+        done0, wall0, lat0, sched0, mbd0, errs0 = run_priority_mix(
+            eng, 64, 100000, level_s, prio_on=False)
+        base = latency_percentiles_ms(sorted(lat0["interactive"]))
+        prio["fifo_baseline_c64"] = {
+            "qps": round(done0 / wall0, 2) if wall0 > 0 and done0 else 0.0,
+            "interactive": base,
+            "batch": latency_percentiles_ms(sorted(lat0["batch"])),
+            "microbatch": mbd0}
+        # acceptance: interactive tails (classification on) at or under
+        # the FIFO baseline's. On a single-core CPU host the batched
+        # vmap program serializes (a 16-wide batch costs ~16 solo
+        # launches), so coalescing can inflate p99 there while p50
+        # still shows the priority win; both land in the artifact.
+        on_i = prio["c64"]["interactive"]
+        prio["interactive_p50_improves"] = \
+            bool(on_i["latency_p50_ms"] <= base["latency_p50_ms"])
+        prio["interactive_p99_improves"] = \
+            bool(on_i["latency_p99_ms"] <= base["latency_p99_ms"])
+        if not prio["interactive_p99_improves"]:
+            log(f"WARNING: interactive p99 {on_i['latency_p99_ms']}ms "
+                f"did not beat the FIFO baseline "
+                f"{base['latency_p99_ms']}ms "
+                f"(p50 {on_i['latency_p50_ms']}ms vs "
+                f"{base['latency_p50_ms']}ms)")
+        else:
+            log(f"priority tier: interactive p99 "
+                f"{on_i['latency_p99_ms']}ms vs FIFO "
+                f"{base['latency_p99_ms']}ms — acceptance holds")
+        extra["priority_serving"] = prio
+    except Exception as e:  # noqa: BLE001 — fields must still land
+        if backend_error(e):
+            raise
+        log(f"priority serving tier section skipped: {e}")
+        extra["priority_serving"] = {
+            "error": f"{type(e).__name__}: {e}"[:200]}
+    finally:
+        s.vars["tidb_tpu_row_threshold"] = 32768
 
     # secondary metrics: Q3 join and Q5 3-table join (configs #3/#5) —
     # each checks the wall budget first: skip entirely under ~90s left,

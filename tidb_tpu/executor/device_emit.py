@@ -417,3 +417,22 @@ def _window_value(ctx, live, d, n, perm, pstart, peerstart):
     return W.compute(jnp, d.name, vals, valid, pstart, peerstart,
                      bool(d.order), d.offset, fill, frame=frame,
                      range_key=range_key)
+
+
+def emit_batched(partial_fn):
+    """Same-plan micro-batching entry: vmap one fragment's traced
+    per-slab partial over a LEADING MEMBER AXIS of the prepared inputs
+    (each member = one queued statement's stacked parameters), with the
+    slab columns and row count broadcast unmapped. XLA compiles ONE
+    program whose every output leaf grows a leading member axis; the
+    micro-batcher (executor/microbatch.py) slices that axis back out,
+    one lane per waiting session. → the jitted batched callable
+    `(cols, n_rows, stacked_preps) -> outputs`."""
+    from tidb_tpu.ops.jax_env import jax
+
+    def batched(cols, n_rows, stacked_preps):
+        return jax.vmap(partial_fn,
+                        in_axes=(None, None, 0))(cols, n_rows,
+                                                 stacked_preps)
+
+    return jax.jit(batched)

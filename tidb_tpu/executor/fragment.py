@@ -541,20 +541,7 @@ class _FragmentProgram:
         (enforced by _fragment_ok), so the scan dictionaries survive every
         stage unchanged modulo index remapping.
         """
-        vals = []
-        dicts = _dict_list(dicts_by_index)
-        stage_dicts = dicts
-        for node in reversed(self.chain):
-            for e in _stage_exprs(node):
-                for sub in e.walk():
-                    if type(sub).prepare is not Expression.prepare:
-                        vals.append(sub.prepare(stage_dicts))
-            if isinstance(node, PhysProjection):
-                stage_dicts = [
-                    stage_dicts[e.index] if isinstance(e, ColumnRef)
-                    and e.index < len(stage_dicts) else None
-                    for e in node.exprs]
-        return vals
+        return collect_chain_preps(self.chain, dicts_by_index)
 
     # -- traced stages -------------------------------------------------------
     def _eval_chain(self, cols, n_rows, prep_vals):
@@ -620,6 +607,103 @@ def _dict_list(dicts_by_index: Dict[int, Optional[np.ndarray]]) -> List:
     return [dicts_by_index.get(i) for i in range(n)]
 
 
+def collect_chain_preps(chain: List[PhysicalPlan],
+                        dicts_by_index: Dict[int, Optional[np.ndarray]]):
+    """Prepared host inputs for `chain`, positionally aligned with the
+    prep_nodes of ANY structurally identical chain's program.
+
+    Module-level on purpose: with parametrized chains the compile cache
+    returns a program built from ANOTHER statement's chain (their
+    value-free signatures collide — that's the point), so the parameter
+    values must be collected from the CURRENT statement's own ParamExpr
+    nodes. The traversal is purely structural (same walk as
+    _FragmentProgram.__init__), so position k here is position k there.
+    """
+    vals = []
+    dicts = _dict_list(dicts_by_index)
+    stage_dicts = dicts
+    for node in reversed(chain):
+        for e in _stage_exprs(node):
+            for sub in e.walk():
+                if type(sub).prepare is not Expression.prepare:
+                    vals.append(sub.prepare(stage_dicts))
+        if isinstance(node, PhysProjection):
+            stage_dicts = [
+                stage_dicts[e.index] if isinstance(e, ColumnRef)
+                and e.index < len(stage_dicts) else None
+                for e in node.exprs]
+    return vals
+
+
+# comparison ops whose numeric literals are safe to parametrize: the
+# kernels evaluate both sides as arrays with no host fast path keyed on
+# the python value. "in" is deliberately excluded — its integer fast
+# path builds a host-side sorted table from Constant values, and its
+# string preparation is variable-length.
+_PARAM_CMP_OPS = frozenset({"eq", "ne", "lt", "le", "gt", "ge"})
+
+
+def _parametrize_expr(e: Expression):
+    """→ (expr, changed): `expr` with numeric comparison literals
+    replaced by ParamExpr leaves (value rides prep_vals, repr is
+    value-free). Non-comparison structure is cloned only when a child
+    changed."""
+    from tidb_tpu.expression import Constant, ParamExpr, ScalarFunc
+    if not isinstance(e, ScalarFunc):
+        return e, False
+    changed = False
+    new_args: List[Expression] = []
+    for a in e.args:
+        if (e.op in _PARAM_CMP_OPS and type(a) is Constant
+                and a.value is not None
+                and not a.ftype.kind.is_string
+                and a.ftype.np_dtype != np.dtype(object)):
+            new_args.append(ParamExpr(a.value, a.ftype))
+            changed = True
+        else:
+            na, ch = _parametrize_expr(a)
+            new_args.append(na)
+            changed = changed or ch
+    if not changed:
+        return e, False
+    return e.rebuild(new_args), True
+
+
+def _parametrize_chain(chain: List[PhysicalPlan]):
+    """Clone the chain with scan-filter / selection comparison literals
+    lifted into ParamExpr parameters, so `WHERE k = 17` and `= 42`
+    share one compiled program and can micro-batch. → the cloned chain,
+    or None when nothing was parametrizable (caller keeps the original
+    literal-baked path). Nodes are shallow-copied; the original plan is
+    never mutated (the CPU fallback re-executes it)."""
+    import copy
+    out: List[PhysicalPlan] = []
+    any_changed = False
+    for node in chain:
+        if isinstance(node, PhysTableScan) and node.filters:
+            new_f, ch = [], False
+            for f in node.filters:
+                nf, c = _parametrize_expr(f)
+                new_f.append(nf)
+                ch = ch or c
+            if ch:
+                node = copy.copy(node)
+                node.filters = new_f
+                any_changed = True
+        elif isinstance(node, PhysSelection) and node.conditions:
+            new_c, ch = [], False
+            for f in node.conditions:
+                nf, c = _parametrize_expr(f)
+                new_c.append(nf)
+                ch = ch or c
+            if ch:
+                node = copy.copy(node)
+                node.conditions = new_c
+                any_changed = True
+        out.append(node)
+    return out if any_changed else None
+
+
 def _charge_compile(kind: str, t0: float) -> None:
     """Attribute one cold program build to the running statement: bump its
     PhaseTimer compile counter (thread-local — the single-flight builders
@@ -658,6 +742,36 @@ def get_program(chain, used_cols, in_types, slab_cap, group_cap,
                                         want_pairs, layouts, pair_cap)
                 _cache_put(sig, prog)
                 _charge_compile("chain", t0)
+    return prog
+
+
+class _BatchedProgram:
+    """A base fragment program vmapped over a leading member axis: one
+    launch serves `b_pad` statements whose prepared parameters are
+    stacked along axis 0 (executor/microbatch.py). Shares the compile
+    cache/LRU with scalar programs under sig `batched[B]|<base sig>`."""
+
+    __slots__ = ("base", "b_pad", "partial")
+
+    def __init__(self, base: _FragmentProgram, b_pad: int):
+        from tidb_tpu.executor import device_emit
+        self.base = base
+        self.b_pad = b_pad
+        self.partial = device_emit.emit_batched(base._partial)
+
+
+def get_batched_program(base: _FragmentProgram, b_pad: int,
+                        base_sig: str) -> _BatchedProgram:
+    sig = f"batched[{b_pad}]|{base_sig}"
+    prog = _cache_get(sig)
+    if prog is None:
+        with _build_lock(sig):
+            prog = _cache_get(sig)      # double-checked: one trace per sig
+            if prog is None:
+                t0 = time.perf_counter()
+                prog = _BatchedProgram(base, b_pad)
+                _cache_put(sig, prog)
+                _charge_compile("batched", t0)
     return prog
 
 
@@ -1454,12 +1568,38 @@ class TpuFragmentExec:
                                      key_bounds, layouts, order_root,
                                      slab_ids=slab_ids)
         # order/filter roots have no group capacity to overflow — one pass
-        prog = get_program(chain, used, in_types, slab_cap, group_cap,
-                           layouts=layouts)
-        prep_vals = prog.collect_preps(dicts)
         if isinstance(root, (PhysTopN, PhysSort)):
+            prog = get_program(chain, used, in_types, slab_cap, group_cap,
+                               layouts=layouts)
+            prep_vals = prog.collect_preps(dicts)
             return self._execute_order(prog, root, ent, dicts, prep_vals,
                                        stream, slab_ids=slab_ids)
+        # filter roots: lift comparison literals into prepared parameters
+        # so `k = 17` and `k = 42` share one compiled program — and, when
+        # several such statements are queued at once, ONE batched launch
+        # (executor/microbatch.py). Falls back to the literal-baked
+        # program when nothing is parametrizable.
+        mb_max = int(vars_.get("tidb_tpu_microbatch_max", 16) or 0)
+        chain_p = _parametrize_chain(chain) if mb_max >= 1 else None
+        if chain_p is not None:
+            sig = _chain_signature(chain_p, used, in_types, slab_cap,
+                                   group_cap, None, layouts) \
+                + "|pairs=False,0"
+            prog = get_program(chain_p, used, in_types, slab_cap,
+                               group_cap, layouts=layouts, sig=sig)
+            # prep values MUST come from THIS statement's chain: the
+            # cached program may hold another statement's ParamExpr nodes
+            prep_vals = collect_chain_preps(chain_p, dicts)
+            if mb_max >= 2 and stream is None:
+                from tidb_tpu.executor import microbatch
+                res = microbatch.execute(self, prog, root, ent, dicts,
+                                         prep_vals, slab_ids, sig, mb_max)
+                if res is not None:
+                    return res
+        else:
+            prog = get_program(chain, used, in_types, slab_cap, group_cap,
+                               layouts=layouts)
+            prep_vals = prog.collect_preps(dicts)
         return self._execute_filter(prog, root, ent, dicts, prep_vals,
                                     stream, slab_ids=slab_ids)
 

@@ -1,15 +1,45 @@
-"""Admission-controlled device scheduler: one dispatch slot per process.
+"""Priority-aware serving tier: per-device admission queues in front of
+device dispatch.
 
 The wire server runs one OS thread per connection (server/__init__.py),
-but the engine owns ONE accelerator. Left alone, concurrent statements
-would interleave their XLA dispatches arbitrarily: no fairness, no
-queue-time observability, and a KILL aimed at a statement stuck behind a
-long device program would only land after the device freed up.
+but the engine owns a small number of accelerators (usually one). Left
+alone, concurrent statements would interleave their XLA dispatches
+arbitrarily: no fairness, no queue-time observability, and a KILL aimed
+at a statement stuck behind a long device program would only land after
+the device freed up.
 
-This module is the TiDB-side analog of a coprocessor request scheduler
-(the reference bounds in-flight cop tasks per store; accelerator SQL
-engines like the Presto-on-GPU work batch many small queries onto one
-device the same way): a FIFO ticket queue in front of *device dispatch*.
+Architecture (the multi-queue design):
+
+  SchedulerPool ── one DeviceScheduler per visible device ── per-class
+  priority queues inside each scheduler.
+
+* `SchedulerPool` owns one `DeviceScheduler(device_index)` per device
+  slot. Statements are routed by `placement()` — round-robin by
+  connection id for now (cost-based routing informed by digest profiles
+  stays a ROADMAP item). The pool is sized 1 unless
+  `tidb_tpu_device_queues=on`, so a single-accelerator process keeps
+  the PR 5 single-slot semantics exactly.
+
+* Each `DeviceScheduler` keeps ONE logical queue whose grant order is
+  computed per wakeup from (priority level, arrival ticket):
+
+    level 0  interactive — point reads, prepared COM_STMT_EXECUTE,
+             metadata queries (classified by session/__init__.py from
+             the statement AST + digest profile), and any waiter whose
+             aging credit expired;
+    level 1  cheap batch — scans/joins whose digest's historical device
+             seconds fall under CHEAP_BATCH_S;
+    level 2  heavy batch — everything else.
+
+  Strict priority between levels, FIFO (arrival ticket) within a level.
+  Anti-starvation: a batch waiter queued longer than AGING_S is
+  promoted to level 0, so a flood of interactive statements bounds a
+  scan's extra wait at AGING_S per slot acquisition, never unbounded.
+  Statements with no class (priority scheduling off, or internal
+  acquires) rank at level 0 by ticket — with classification disabled
+  the grant order therefore degenerates to EXACTLY the PR 5 FIFO,
+  including which admissions count as waits and when fairness yields
+  fire.
 
 Scope of the slot — dispatch, not residency:
 
@@ -23,16 +53,22 @@ Scope of the slot — dispatch, not residency:
     OUTSIDE the slot. Query B's encode therefore overlaps query A's XLA
     execution exactly as the phase machinery (util/phases.py) names it.
 
-Fairness: tickets grant FIFO, except that a connection which has taken
+Fairness (orthogonal to class): a connection which has taken
 FAIRNESS_CAP consecutive grants while another connection waits yields to
-the oldest waiter from a different connection — a tight repeated-query
-loop cannot starve a sibling session.
+the best-ranked waiter from a different connection — a tight
+repeated-query loop cannot starve a sibling session.
 
 Lifecycle: a queued waiter polls its ExecutionGuard every POLL_S, so
 KILL / deadline / OOM land as typed errors (1317 et al.) WHILE QUEUED,
 before the statement ever reaches the device. Queue-wait seconds are
 charged to the guard (queue_wait_s / queue_waits) and surfaced through
-information_schema.processlist and EXPLAIN ANALYZE runtime info.
+information_schema.processlist, EXPLAIN ANALYZE runtime info, and the
+per-class `sched-queue:<class>` timeline lanes.
+
+Counters: `stats()` / `reset_stats()` snapshot and clear under the same
+condition lock every mutation takes, so bench.py and tests never read a
+torn admissions/wait_s_total pair against concurrent dispatchers. Each
+counter also keeps a per-class breakdown (`stats()["classes"]`).
 """
 
 from __future__ import annotations
@@ -40,7 +76,7 @@ from __future__ import annotations
 import threading
 import time
 from contextlib import contextmanager
-from typing import Optional
+from typing import Dict, List, Optional
 
 from tidb_tpu.util import timeline
 
@@ -49,40 +85,72 @@ DEFAULT_FAIRNESS_CAP = 4
 # guard-poll cadence while queued (KILL latency bound when the holder
 # does not release for a long time; release itself wakes waiters)
 POLL_S = 0.02
+# anti-starvation: a batch waiter queued this long ranks as interactive
+AGING_S = 0.5
+# historical avg device seconds under which a batch digest is "cheap"
+CHEAP_BATCH_S = 0.05
+
+# priority classes (guard.sched_class values); None = unclassified/FIFO
+CLASSES = ("interactive", "batch")
+
+# queue-entry field indices (kept as a list for in-place mutation)
+_TICKET, _CONN, _TID, _CLASS, _ENQ_T, _COST = range(6)
 
 
 class DeviceScheduler:
-    """FIFO + fairness-capped admission queue for device dispatch."""
+    """Priority-class + fairness-capped admission queue for the dispatch
+    slot of ONE device."""
 
-    def __init__(self, fairness_cap: int = DEFAULT_FAIRNESS_CAP):
+    def __init__(self, device_index: int = 0,
+                 fairness_cap: int = DEFAULT_FAIRNESS_CAP):
+        self.device_index = device_index
         self._cv = threading.Condition()
         self._holder: Optional[int] = None     # thread ident
         self._depth = 0                        # reentrant holds
         self._next_ticket = 0
-        self._queue: list = []                 # [ticket, conn_id, tid]
+        self._queue: list = []   # [ticket, conn_id, tid, cls, enq_t, cost]
         self._last_conn: Optional[int] = None
         self._consecutive = 0
         self.fairness_cap = fairness_cap
-        # cumulative counters (read by bench.py and tests; reset via
-        # reset_stats — monotonic within a process otherwise)
+        # cumulative counters (bench.py and tests read them through
+        # stats() — every mutation AND every read happens under _cv)
         self.admissions = 0
         self.waits = 0               # admissions that actually queued
         self.wait_s_total = 0.0
         self.yields = 0              # fairness-cap rotations
+        # per-class breakdowns, keyed by class name ("interactive" /
+        # "batch"); unclassified admissions don't appear here
+        self.class_admissions: Dict[str, int] = {}
+        self.class_waits: Dict[str, int] = {}
+        self.class_wait_s: Dict[str, float] = {}
 
     # -- grant policy --------------------------------------------------------
+    def _rank(self, e, now: float):
+        """(priority level, arrival ticket) — the grant order key.
+        Unclassified entries rank level 0 by ticket, which makes the
+        whole policy collapse to plain FIFO when classification is off."""
+        cls = e[_CLASS]
+        if cls is None or cls == "interactive":
+            return (0, e[_TICKET])
+        if now - e[_ENQ_T] >= AGING_S:         # aged batch → interactive
+            return (0, e[_TICKET])
+        if e[_COST] is not None and e[_COST] < CHEAP_BATCH_S:
+            return (1, e[_TICKET])
+        return (2, e[_TICKET])
+
     def _grantee(self):
-        """Entry to admit next: FIFO head, unless the head's connection
-        just exhausted its consecutive-grant cap while a different
-        connection waits behind it."""
+        """Entry to admit next: the best-ranked waiter, unless its
+        connection just exhausted its consecutive-grant cap while a
+        different connection waits behind it."""
         if not self._queue:
             return None
-        head = min(self._queue, key=lambda e: e[0])
+        now = time.monotonic()
+        head = min(self._queue, key=lambda e: self._rank(e, now))
         if self._consecutive >= self.fairness_cap \
-                and head[1] == self._last_conn:
-            other = [e for e in self._queue if e[1] != self._last_conn]
+                and head[_CONN] == self._last_conn:
+            other = [e for e in self._queue if e[_CONN] != self._last_conn]
             if other:
-                return min(other, key=lambda e: e[0])
+                return min(other, key=lambda e: self._rank(e, now))
         return head
 
     # -- acquire / release ---------------------------------------------------
@@ -90,13 +158,20 @@ class DeviceScheduler:
         """Block until admitted; → seconds spent queued. Reentrant per
         thread. Raises the guard's typed error (QueryInterrupted /
         QueryTimeout / OOM action) if the statement is killed or expires
-        while queued."""
+        while queued. The priority class and cost hint ride on the guard
+        (guard.sched_class / guard.sched_cost, set by the session's
+        admission classifier)."""
         tid = threading.get_ident()
+        cls = getattr(guard, "sched_class", None) if guard is not None \
+            else None
+        cost = getattr(guard, "sched_cost", None) if guard is not None \
+            else None
         with self._cv:
             if self._holder == tid:
                 self._depth += 1
                 return 0.0
-            ent = [self._next_ticket, conn_id, tid]
+            ent = [self._next_ticket, conn_id, tid, cls,
+                   time.monotonic(), cost]
             self._next_ticket += 1
             self._queue.append(ent)
             t0 = time.monotonic()
@@ -124,9 +199,16 @@ class DeviceScheduler:
                 self._last_conn = conn_id
                 self._consecutive = 1
             self.admissions += 1
+            if cls is not None:
+                self.class_admissions[cls] = \
+                    self.class_admissions.get(cls, 0) + 1
             if queued:
                 self.waits += 1
                 self.wait_s_total += waited
+                if cls is not None:
+                    self.class_waits[cls] = self.class_waits.get(cls, 0) + 1
+                    self.class_wait_s[cls] = \
+                        self.class_wait_s.get(cls, 0.0) + waited
             # uncontended admissions report zero wait: the few-µs lock
             # acquisition is not queue time and must not show up in
             # processlist / EXPLAIN ANALYZE as one
@@ -145,10 +227,14 @@ class DeviceScheduler:
 
     @contextmanager
     def slot(self, guard=None, conn_id: int = 0):
-        """Admission-scoped context. Charges queue wait to the guard."""
+        """Admission-scoped context. Charges queue wait to the guard and
+        records the wait on the class-labelled timeline lane."""
         waited = self.acquire(guard=guard, conn_id=conn_id)
+        cls = getattr(guard, "sched_class", None) if guard is not None \
+            else None
         if timeline.ENABLED and waited > 0.0:
-            timeline.record("sched-queue", "sched", dur_us=waited * 1e6,
+            lane = "sched-queue" if cls is None else f"sched-queue:{cls}"
+            timeline.record(lane, "sched", dur_us=waited * 1e6,
                             pid=conn_id)
         hold_t0 = timeline.now_us() if timeline.ENABLED else 0.0
         try:
@@ -168,10 +254,20 @@ class DeviceScheduler:
             return len(self._queue) + (1 if self._holder is not None else 0)
 
     def stats(self) -> dict:
+        """Consistent snapshot of every counter — taken under _cv, so a
+        reader racing concurrent dispatchers never sees a torn
+        admissions/wait_s_total pair."""
         with self._cv:
             return {"admissions": self.admissions, "waits": self.waits,
                     "wait_s_total": round(self.wait_s_total, 6),
-                    "yields": self.yields}
+                    "yields": self.yields,
+                    "classes": {
+                        c: {"admissions": self.class_admissions.get(c, 0),
+                            "waits": self.class_waits.get(c, 0),
+                            "wait_s_total": round(
+                                self.class_wait_s.get(c, 0.0), 6)}
+                        for c in sorted(set(self.class_admissions)
+                                        | set(self.class_waits))}}
 
     def reset_stats(self) -> None:
         with self._cv:
@@ -179,9 +275,50 @@ class DeviceScheduler:
             self.waits = 0
             self.wait_s_total = 0.0
             self.yields = 0
+            self.class_admissions = {}
+            self.class_waits = {}
+            self.class_wait_s = {}
 
 
-SCHEDULER = DeviceScheduler()
+class SchedulerPool:
+    """One DeviceScheduler per visible device slot, with a placement
+    hook routing statements to a queue. Round-robin by connection id —
+    deterministic and stable for a statement's whole lifetime (every
+    slab acquire of one statement lands on the same queue). Cost-based
+    placement from digest profiles is the ROADMAP follow-up."""
+
+    def __init__(self, n: int = 1,
+                 fairness_cap: int = DEFAULT_FAIRNESS_CAP):
+        self._lock = threading.Lock()
+        self.schedulers: List[DeviceScheduler] = [
+            DeviceScheduler(i, fairness_cap) for i in range(max(1, n))]
+
+    def ensure(self, n: int) -> None:
+        """Grow to `n` slots (never shrinks: a statement may still hold
+        a ticket on an existing queue)."""
+        with self._lock:
+            while len(self.schedulers) < n:
+                self.schedulers.append(
+                    DeviceScheduler(len(self.schedulers)))
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self.schedulers)
+
+    def placement(self, conn_id: int = 0) -> DeviceScheduler:
+        """The placement hook: statement → device queue."""
+        with self._lock:
+            return self.schedulers[conn_id % len(self.schedulers)]
+
+    def stats(self) -> dict:
+        return {f"device{s.device_index}": s.stats()
+                for s in list(self.schedulers)}
+
+
+POOL = SchedulerPool(1)
+# the single-device default queue — the module-level handle tests and
+# bench.py address directly (POOL.schedulers[0] is always this object)
+SCHEDULER = POOL.schedulers[0]
 
 
 @contextmanager
@@ -189,16 +326,34 @@ def _null_slot():
     yield 0.0
 
 
+def _visible_devices() -> int:
+    try:
+        from tidb_tpu.ops.jax_env import jax
+        return int(jax.local_device_count())
+    except Exception:  # noqa: BLE001 — no backend yet
+        return 1
+
+
 def device_slot(ctx):
-    """The executor-facing entry: SCHEDULER.slot bound to the statement's
-    guard/conn, or a no-op when `tidb_tpu_scheduler=off`."""
+    """The executor-facing entry: the routed scheduler's slot bound to
+    the statement's guard/conn, or a no-op when `tidb_tpu_scheduler=off`.
+    With `tidb_tpu_device_queues=on` the pool grows to one queue per
+    visible device and statements route through the placement hook;
+    otherwise everything shares the device-0 queue (the PR 5 shape)."""
     mode = str(ctx.vars.get("tidb_tpu_scheduler", "on")).lower()
     if mode in ("off", "0", "false"):
         return _null_slot()
     guard = getattr(ctx, "guard", None)
     conn_id = getattr(guard, "conn_id", 0) if guard is not None else 0
-    return SCHEDULER.slot(guard=guard, conn_id=conn_id)
+    queues = str(ctx.vars.get("tidb_tpu_device_queues", "off")).lower()
+    if queues in ("on", "1", "true"):
+        POOL.ensure(_visible_devices())
+        sched = POOL.placement(conn_id)
+    else:
+        sched = SCHEDULER
+    return sched.slot(guard=guard, conn_id=conn_id)
 
 
-__all__ = ["DeviceScheduler", "SCHEDULER", "device_slot",
-           "DEFAULT_FAIRNESS_CAP", "POLL_S"]
+__all__ = ["DeviceScheduler", "SchedulerPool", "SCHEDULER", "POOL",
+           "device_slot", "DEFAULT_FAIRNESS_CAP", "POLL_S", "AGING_S",
+           "CHEAP_BATCH_S", "CLASSES"]

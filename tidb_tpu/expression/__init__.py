@@ -174,6 +174,41 @@ def _xp_dtype(xp, ftype: FieldType, on_device: bool):
     return npdt
 
 
+class ParamExpr(Constant):
+    """A Constant whose VALUE rides the prepared-inputs channel instead
+    of being baked into the traced program (ref: expression/constant.go
+    ParamMarker — the plan-cache parameter placeholder).
+
+    The fragment layer substitutes these for comparison literals so that
+    `WHERE k = 17` and `WHERE k = 42` share ONE compiled XLA executable
+    (the repr is value-free, so they produce the same chain signature)
+    and so the micro-batcher can stack many statements' parameters along
+    a leading batch axis of one program. `prepare()` returns the encoded
+    scalar — it travels positionally with the dictionary preparations —
+    and `eval()` broadcasts the traced scalar instead of a literal."""
+
+    def prepare(self, dictionaries):
+        raw = self.ftype.encode_value(self.value)
+        return np.asarray(raw, dtype=self.ftype.np_dtype)
+
+    def eval(self, ctx: EvalContext):
+        prep = ctx.prepared.get(id(self))
+        if prep is None:
+            # host oracle / un-prepared context: behave as the literal
+            return Constant.eval(self, ctx)
+        xp = ctx.xp
+        n = ctx.num_rows
+        dt = _xp_dtype(xp, self.ftype, ctx.on_device)
+        return (xp.full(n, prep, dtype=dt) if dt is not None
+                else np.full(n, prep, dtype=object)), \
+            xp.ones(n, dtype=bool)
+
+    def __repr__(self):
+        # value-free on purpose: parametrized chains of different
+        # literals must hash to one compile-cache signature
+        return f"param({self.ftype})"
+
+
 # ---------------------------------------------------------------------------
 # Scalar function framework
 # ---------------------------------------------------------------------------

@@ -225,7 +225,8 @@ def _partitions(session):
            ("QUEUE_WAIT_S", T.double()),
            ("QUEUE_WAITS", T.bigint()),
            ("QUEUE_P50_MS", T.double()),
-           ("QUEUE_P99_MS", T.double())])
+           ("QUEUE_P99_MS", T.double()),
+           ("SCHED_CLASS", T.varchar())])
 def _statements_summary(session):
     """TopSQL-style per-digest device-time attribution (ref:
     util/stmtsummary — here extended with the PhaseTimer ledger): every
@@ -240,7 +241,7 @@ def _statements_summary(session):
              p["specialization_hits"],
              p.get("slabs_skipped", 0), p.get("h2d_skipped_bytes", 0),
              p["queue_wait_s"], p["queue_waits"], p["queue_p50_ms"],
-             p["queue_p99_ms"])
+             p["queue_p99_ms"], p.get("sched_class"))
             for p in REGISTRY.summary_profiles()]
 
 

@@ -639,7 +639,9 @@ class _Conn:
         if st.n_params:
             params = decode_binary_params(data, i, st)
         sql = substitute_placeholders(st.sql, params)
-        results = self.session.execute(sql)
+        # COM_STMT_EXECUTE admissions classify as interactive in the
+        # priority scheduler regardless of statement shape
+        results = self.session.execute(sql, from_prepared=True)
         for k, rs in enumerate(results):
             status = 0x0002 | (SERVER_MORE_RESULTS_EXISTS
                                if k + 1 < len(results) else 0)

@@ -58,6 +58,18 @@ DEFAULT_VARS: Dict[str, object] = {
     # eviction events into ONE Chrome-trace JSON under this directory
     # (util/timeline.py) — load it in chrome://tracing or Perfetto
     "tidb_tpu_trace_dir": "",
+    # priority-aware serving tier (executor/scheduler.py): classify each
+    # admission as interactive/batch and grant the device slot by class;
+    # off = the plain FIFO admission order, byte-identical to classless
+    "tidb_tpu_priority_scheduling": "on",
+    # same-plan micro-batching (executor/microbatch.py): coalesce up to
+    # this many queued same-digest statements into ONE batched device
+    # program. 1 = parametrize only (shared programs, no coalescing),
+    # 0 = literal-baked programs (the pre-serving-tier behavior)
+    "tidb_tpu_microbatch_max": 16,
+    # one admission queue per visible device with round-robin placement
+    # (SchedulerPool); off = every statement shares the device-0 queue
+    "tidb_tpu_device_queues": "off",
 }
 
 
@@ -218,6 +230,78 @@ def _stmt_is_read_only_select(s) -> bool:
     if isinstance(s, ast.WithStmt):
         return _stmt_is_read_only_select(s.stmt)
     return False
+
+
+# aggregate function names whose presence makes a SELECT a "batch"
+# admission (it reduces a scan, it doesn't look up a handful of rows)
+_AGG_NAMES = frozenset({
+    "count", "sum", "avg", "min", "max", "group_concat", "bit_and",
+    "bit_or", "bit_xor", "std", "stddev", "stddev_pop", "stddev_samp",
+    "var_pop", "var_samp", "variance", "approx_count_distinct"})
+
+# statement kinds answered from catalogs/registries, never the device —
+# always interactive, their admission must not sit behind a scan
+_META_STMTS = (ast.ShowStmt, ast.Explain, ast.SetStmt, ast.UseStmt,
+               ast.BeginStmt, ast.CommitStmt, ast.RollbackStmt,
+               ast.KillStmt, ast.TraceStmt)
+
+
+def _expr_has_agg(node) -> bool:
+    """Any aggregate FuncCall (or windowed aggregate) under `node`?
+    Generic dataclass walk — the AST has no visitor, and admission
+    classification must not require one per node kind."""
+    import dataclasses as _dc
+    if isinstance(node, ast.FuncCall) \
+            and node.name.lower() in _AGG_NAMES:
+        return True
+    if isinstance(node, ast.Node) and _dc.is_dataclass(node):
+        for f in _dc.fields(node):
+            v = getattr(node, f.name)
+            if isinstance(v, ast.Node):
+                if _expr_has_agg(v):
+                    return True
+            elif isinstance(v, (list, tuple)):
+                for item in v:
+                    it = item[0] if isinstance(item, tuple) and item \
+                        else item
+                    if isinstance(it, ast.Node) and _expr_has_agg(it):
+                        return True
+    return False
+
+
+def _classify_admission(s, sql: str, from_prepared: bool):
+    """Admission class for the device scheduler's priority queues —
+    → (class, cost_hint):
+
+      interactive — metadata/control statements, prepared
+                    COM_STMT_EXECUTE, and point-shaped reads (single
+                    table, no aggregate/GROUP BY/DISTINCT, a WHERE or
+                    LIMIT bounding the result);
+      batch       — scans, joins and aggregations, with the digest's
+                    historical average device seconds as the cost hint
+                    (executor/scheduler.py CHEAP_BATCH_S splits cheap
+                    from heavy batch);
+      None        — everything else (DML/DDL), which keeps plain FIFO
+                    admission semantics.
+    """
+    from tidb_tpu.util.observability import REGISTRY
+    if isinstance(s, _META_STMTS):
+        return "interactive", None
+    if from_prepared:
+        return "interactive", None
+    if isinstance(s, (ast.WithStmt, ast.SetOpStmt)):
+        return "batch", REGISTRY.digest_cost(sql)
+    if not isinstance(s, ast.SelectStmt):
+        return None, None
+    point_shaped = (
+        (s.from_ is None or isinstance(s.from_, ast.TableName))
+        and not s.group_by and s.having is None and not s.distinct
+        and (s.where is not None or s.limit is not None
+             or s.from_ is None)
+        and not any(_expr_has_agg(it.expr) for it in s.items))
+    if point_shaped:
+        return "interactive", None
+    return "batch", REGISTRY.digest_cost(sql)
 
 
 def _operator_spans(tr, exec_root) -> None:
@@ -476,10 +560,13 @@ class Session:
         PROCESS_REGISTRY.register(self)
 
     # ---- public API --------------------------------------------------------
-    def execute(self, sql: str) -> List[ResultSet]:
+    def execute(self, sql: str,
+                from_prepared: bool = False) -> List[ResultSet]:
         """Parse + run every statement, recording per-statement metrics,
         slow-log entries and the processlist (ref: session.ExecuteStmt's
-        observability hooks, session/session.go:1614)."""
+        observability hooks, session/session.go:1614). `from_prepared`
+        marks a COM_STMT_EXECUTE dispatch (server/__init__.py) — those
+        admissions classify as interactive regardless of shape."""
         import time as _time
 
         from tidb_tpu.errors import QueryInterrupted
@@ -508,6 +595,14 @@ class Session:
             guard = ExecutionGuard(self.conn_id, one[:256],
                                    timeout_ms / 1000.0,
                                    Tracker("query", quota))
+            # admission classification for the priority-aware scheduler:
+            # the class + cost hint ride the guard into every
+            # device_slot() acquire of this statement
+            prio = str(self.vars.get("tidb_tpu_priority_scheduling",
+                                     "on")).lower()
+            if prio not in ("off", "0", "false"):
+                guard.sched_class, guard.sched_cost = \
+                    _classify_admission(s, one, from_prepared)
             self._guard = guard
             self.last_guard = guard
             PROCESS_REGISTRY.stmt_begin(self.conn_id, guard)

@@ -218,6 +218,16 @@ def _scenarios(mesh: Optional[int] = None) -> List[Scenario]:
         Scenario("stale zone map → CPU fallback", "zone-map-stale",
                  dict(value="chaos: stale zone map", times=9),
                  run="prune", vars=dict(device_on)),
+        # a fault at the micro-batch result de-multiplex: 8 concurrent
+        # same-digest point reads coalesce into ONE batched launch, the
+        # demux raises once — every member must degrade to warned
+        # individual re-execution with ITS OWN oracle rows; a member must
+        # never see a sibling's rows or a shared typed error
+        Scenario("micro-batch demux fault → warned per-member fallback",
+                 "microbatch-demux",
+                 dict(raise_=RuntimeError("chaos: demux"), times=1),
+                 run="microbatch",
+                 vars={**device_on, "tidb_tpu_microbatch_max": "8"}),
         # -- DDL -----------------------------------------------------------
         Scenario("unique backfill dies mid-reorg", "index-backfill",
                  dict(raise_=ExecutionError("chaos: backfill"), times=1),
@@ -539,6 +549,72 @@ def run_sweep(verbose: bool = False, mesh: Optional[int] = None,
                 if b_done[0] == 0 and not b_fail:
                     failures.append(
                         f"{sc.name}: sibling session made no progress")
+            elif sc.run == "microbatch":
+                from tidb_tpu.executor import microbatch as _mb
+                from tidb_tpu.executor.scheduler import SCHEDULER
+                from tidb_tpu.util.observability import REGISTRY
+                # oracle per member, run SOLO (a solo leader takes the
+                # individual path, so the armed demux site never fires)
+                # no ORDER BY: order roots don't micro-batch; the filter
+                # path emits rows in slab order, which is deterministic,
+                # so raw row-list comparison is exact
+                mb_qs = [f"select a, c from cs_facts where b = {k}"
+                         for k in range(8)]
+                mb_sessions = []
+                for _ in mb_qs:
+                    s_i = eng.new_session()
+                    s_i.vars.update(sc.vars)
+                    mb_sessions.append(s_i)
+                mb_oracle = [s.query(q).rows for q in mb_qs]
+                mb_rows: List[Optional[list]] = [None] * len(mb_qs)
+                mb_errs: List[Optional[BaseException]] = \
+                    [None] * len(mb_qs)
+
+                def mb_run(i):
+                    try:
+                        mb_rows[i] = mb_sessions[i].query(mb_qs[i]).rows
+                    except BaseException as e:  # noqa: BLE001
+                        mb_errs[i] = e
+
+                fb0 = REGISTRY.counters.get(
+                    ("tidb_tpu_microbatch_fallbacks_total", ()), 0)
+                # hold the device slot so every dispatcher queues, then
+                # release once the followers are parked on the batch
+                SCHEDULER.acquire(conn_id=-1)
+                try:
+                    ths = [threading.Thread(target=mb_run, args=(i,))
+                           for i in range(len(mb_qs))]
+                    for th in ths:
+                        th.start()
+                    t_park = time.monotonic()
+                    while _mb.queued_members() < len(mb_qs) - 1 and \
+                            time.monotonic() - t_park < 5.0:
+                        time.sleep(0.01)
+                finally:
+                    SCHEDULER.release()
+                for th in ths:
+                    th.join(DEADLINE_S)
+                    if th.is_alive():
+                        slow += 1
+                        failures.append(f"{sc.name}: member HUNG")
+                for i, (rows, err) in enumerate(zip(mb_rows, mb_errs)):
+                    if err is not None:
+                        errors += 1
+                        failures.append(
+                            f"{sc.name}: member {i} surfaced "
+                            f"{type(err).__name__}: {err} — a demux "
+                            f"fault must never fail a member")
+                    elif rows != mb_oracle[i]:
+                        wrong += 1
+                        failures.append(
+                            f"{sc.name}: member {i} SILENT WRONG ROWS")
+                if failpoint.hits("microbatch-demux") > 0:
+                    fb1 = REGISTRY.counters.get(
+                        ("tidb_tpu_microbatch_fallbacks_total", ()), 0)
+                    if fb1 <= fb0:
+                        failures.append(
+                            f"{sc.name}: demux faulted but no fallback "
+                            f"was recorded")
             elif sc.run == "write":
                 write_seq += 1
                 ins = (f"insert into cs_facts values "

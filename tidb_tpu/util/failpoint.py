@@ -126,6 +126,12 @@ register("fused-finalize-overflow", "TopN / distinct-pair-cap validation "
          "through the resumable 'pairs' ladder rung, re-running only the "
          "slabs that clipped (executor/fragment.py _execute_agg / "
          "_run_fused_pipeline)")
+register("microbatch-demux", "result de-multiplex of a same-plan "
+         "micro-batch — hit after the batched program's fetch, before "
+         "per-member rows are sliced off the leading batch axis; a raise "
+         "here models a demux fault, which must degrade to warned "
+         "per-member individual re-execution, never a shared typed error "
+         "(executor/microbatch.py)")
 
 
 def enable(name: str, *, raise_: Optional[BaseException] = None,

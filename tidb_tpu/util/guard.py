@@ -43,7 +43,8 @@ class ExecutionGuard:
 
     __slots__ = ("conn_id", "sql", "started", "deadline", "mem_tracker",
                  "checkpoints", "_killed", "escalation", "warnings",
-                 "queue_wait_s", "queue_waits", "phases")
+                 "queue_wait_s", "queue_waits", "phases",
+                 "sched_class", "sched_cost")
 
     def __init__(self, conn_id: int = 0, sql: str = "",
                  timeout_s: float = 0.0, mem_tracker=None):
@@ -75,6 +76,11 @@ class ExecutionGuard:
         # information_schema.processlist and EXPLAIN ANALYZE
         self.queue_wait_s = 0.0
         self.queue_waits = 0
+        # admission classification (executor/scheduler.py priority
+        # queues): "interactive" | "batch" | None (classification off),
+        # plus the digest's historical device-seconds cost hint
+        self.sched_class: Optional[str] = None
+        self.sched_cost: Optional[float] = None
         # (level, code, message) rows the statement accumulated — e.g.
         # a degraded-mesh completion — read back by SHOW WARNINGS
         self.warnings: list = []

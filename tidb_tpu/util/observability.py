@@ -161,6 +161,7 @@ class Registry:
                      "slabs_skipped": 0, "h2d_skipped_bytes": 0,
                      "queue_wait_s": 0.0, "queue_waits": 0,
                      "queue_hist": _hist_new(),
+                     "sched_class": None,
                      "phase_s": {}, "engine": engine}
                 self.stmt_summary[digest] = s
                 while len(self.stmt_summary) > 512:
@@ -175,6 +176,17 @@ class Registry:
             s["queue_waits"] += int(getattr(guard, "queue_waits", 0) or 0) \
                 if guard is not None else 0
             _hist_observe(s["queue_hist"], queue_wait_s)
+            cls = getattr(guard, "sched_class", None) \
+                if guard is not None else None
+            if cls is not None:
+                # last-writer wins: the digest's class is stable by
+                # construction (same digest → same classification)
+                s["sched_class"] = cls
+                key = ("tidb_tpu_queue_wait_seconds", (("class", cls),))
+                h = self.hists.get(key)
+                if h is None:
+                    h = self.hists[key] = _hist_new()
+                _hist_observe(h, queue_wait_s)
             if ph is not None:
                 s["device_s"] += ph.wall_s
                 s["h2d_bytes"] += ph.h2d_bytes
@@ -209,6 +221,17 @@ class Registry:
                     entry["h2d_bytes"] = 0
                     entry["compiles"] = 0
                 self.slow_log.append(entry)
+
+    def digest_cost(self, sql: str) -> Optional[float]:
+        """Historical average device seconds of this statement's digest —
+        the scheduler's batch cost hint (None until the digest has run
+        with device attribution at least once)."""
+        digest = normalize_sql(sql)
+        with self._lock:
+            s = self.stmt_summary.get(digest)
+            if s is None or not s["count"] or s["device_s"] <= 0.0:
+                return None
+            return s["device_s"] / s["count"]
 
     def slow_rows(self) -> List[tuple]:
         with self._lock:
@@ -269,6 +292,7 @@ class Registry:
                         hist_quantile(qh, 0.50) * 1000.0, 3),
                     "queue_p99_ms": round(
                         hist_quantile(qh, 0.99) * 1000.0, 3),
+                    "sched_class": s.get("sched_class"),
                     "phase_s": {k: round(v, 6)
                                 for k, v in s["phase_s"].items()},
                     "last_seen": s["last_seen"],
