@@ -991,6 +991,99 @@ def main():
             log(f"{name} bench failed (headline unaffected): {e}")
             extra[f"{name}_error"] = str(e)[:200]
 
+    # ---- mesh Q3: distributed join + staged-exchange recovery -------------
+    # Q3 again, but as a DISTRIBUTED join over every visible device: the
+    # staged exchange partitions each rank's rows, checkpoints the bucket
+    # buffers device→host, routes them, and probes per rank. The JSON
+    # carries the per-shard recovery counters (both 0 on a healthy run)
+    # and one chaos-injected rep that must produce either the clean
+    # result or a typed error within the deadline — never a hang, never
+    # silent truncation.
+    try:
+        import jax as _jax
+        mesh_n = min(8, len(_jax.devices()))
+        left = remaining_s()
+        if mesh_n < 2:
+            log(f"mesh Q3 skipped: {mesh_n} device(s) visible")
+            extra["q3_mesh_skipped_devices"] = mesh_n
+        elif left < 90.0:
+            log(f"mesh Q3 skipped: {left:.0f}s left in wall budget")
+            extra["q3_mesh_skipped_budget"] = True
+        else:
+            from tidb_tpu.errors import ShardFailure, TiDBTPUError
+            from tidb_tpu.util import failpoint
+            saved_mesh = {k: s.vars.get(k) for k in
+                          ("tidb_tpu_dist_devices",
+                           "tidb_tpu_row_threshold")}
+            s.vars["tidb_tpu_engine"] = "on"
+            s.vars["tidb_tpu_row_threshold"] = 1
+            s.vars["tidb_tpu_dist_devices"] = mesh_n
+            try:
+                clean_rows = s.query(Q3).rows      # compile warmup
+                m_t, _, _ = time_query(s, 1, Q3, reserve_s=60.0)
+                esc = s.last_guard.escalation \
+                    if s.last_guard is not None else None
+                extra.update({
+                    "q3_mesh_devices": mesh_n,
+                    "q3_mesh_wall_s": round(m_t, 3),
+                    "q3_mesh_shards_rerun":
+                        esc.shards_rerun if esc else 0,
+                    "q3_mesh_degraded":
+                        esc.degraded_mesh if esc else 0})
+                log(f"mesh Q3: {m_t:.3f}s over {mesh_n} ranks "
+                    f"(shards_rerun={extra['q3_mesh_shards_rerun']} "
+                    f"degraded={extra['q3_mesh_degraded']})")
+                # chaos rep: one rank's device fails its dispatch AND the
+                # same-device retry — the run must heal onto a surviving
+                # device (re-running ONLY that rank) or surface a typed
+                # error, inside the deadline
+                t0 = time.monotonic()
+                with failpoint.enabled(
+                        "shard-step",
+                        raise_=ShardFailure("bench chaos: device bad"),
+                        times=2):
+                    try:
+                        chaos_rows = s.query(Q3).rows
+                        chaos_err = None
+                    except TiDBTPUError as e:
+                        chaos_rows, chaos_err = None, e
+                chaos_dt = time.monotonic() - t0
+                esc = s.last_guard.escalation \
+                    if s.last_guard is not None else None
+                ok = chaos_dt <= 30.0 and (
+                    chaos_err is not None or chaos_rows == clean_rows)
+                extra.update({
+                    "q3_mesh_chaos_wall_s": round(chaos_dt, 3),
+                    "q3_mesh_chaos_ok": ok,
+                    "q3_mesh_chaos_typed_error":
+                        type(chaos_err).__name__ if chaos_err else None,
+                    "q3_mesh_chaos_shards_rerun":
+                        esc.shards_rerun if esc else 0,
+                    "q3_mesh_chaos_degraded":
+                        esc.degraded_mesh if esc else 0})
+                if not ok:
+                    raise RuntimeError(
+                        f"mesh Q3 chaos rep violated the lifecycle "
+                        f"contract: wall {chaos_dt:.1f}s, "
+                        f"rows_match={chaos_rows == clean_rows}")
+                log(f"mesh Q3 chaos rep: {chaos_dt:.3f}s, "
+                    f"{'typed ' + type(chaos_err).__name__ if chaos_err else 'healed to clean rows'} "
+                    f"(shards_rerun="
+                    f"{extra['q3_mesh_chaos_shards_rerun']} degraded="
+                    f"{extra['q3_mesh_chaos_degraded']})")
+            finally:
+                failpoint.disable_all()
+                for k, v in saved_mesh.items():
+                    if v is None:
+                        s.vars.pop(k, None)
+                    else:
+                        s.vars[k] = v
+    except Exception as e:  # noqa: BLE001 — must not sink the headline
+        if backend_error(e):
+            raise
+        log(f"mesh Q3 section failed (headline unaffected): {e}")
+        extra["q3_mesh_error"] = str(e)[:200]
+
     if hasattr(signal, "SIGALRM"):
         signal.alarm(0)
     if trace_dir:

@@ -177,7 +177,9 @@ def test_skewed_exchange_retries_exactly_once(session):
     # 3 distinct group keys hash onto ≤3 of 8 shards: the re-key exchange
     # overflows a deliberately tiny initial bucket cap; the exchange
     # reports its exact need, so recovery is ONE recompile (per-exchange
-    # needs, VERDICT r2 weak #7)
+    # needs, VERDICT r2 weak #7). This pins the MONOLITHIC oracle path —
+    # the staged exchange's per-rank equivalent (one skewed rank = one
+    # recompile) is pinned in tests/test_staged_exchange.py
     from tidb_tpu.executor import dist_fragment as DF
     sql = ("SELECT l_flag, COUNT(DISTINCT l_oid) FROM li GROUP BY l_flag")
     compiles = []
@@ -189,6 +191,7 @@ def test_skewed_exchange_retries_exactly_once(session):
 
     DF.DistTreeProgram.__init__ = counting
     session.vars["tidb_tpu_exchange_bucket_cap"] = 64
+    session.vars["tidb_tpu_dist_staged_exchange"] = "off"
     try:
         from tidb_tpu.executor.fragment import _COMPILE_CACHE
         _COMPILE_CACHE.clear()
@@ -196,6 +199,7 @@ def test_skewed_exchange_retries_exactly_once(session):
     finally:
         DF.DistTreeProgram.__init__ = orig
         session.vars.pop("tidb_tpu_exchange_bucket_cap", None)
+        session.vars.pop("tidb_tpu_dist_staged_exchange", None)
     assert_same(got, session.query(sql).rows)
     assert len(compiles) == 2, compiles    # initial + exactly one retry
 

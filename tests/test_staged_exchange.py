@@ -1,0 +1,311 @@
+"""Checkpointable staged exchanges (dist_fragment.StagedDistExchange):
+distributed joins, DISTINCT re-keys and window shapes restructured into
+per-rank partition programs → device→host bucket checkpoints + host
+routing → per-rank probe programs, with per-shard fault recovery.
+
+Three invariants pinned here:
+
+  * byte-exactness — the staged path must reproduce the monolithic
+    shard_map program (the oracle, `tidb_tpu_dist_staged_exchange=off`)
+    and the CPU engine exactly, including skewed and ci-collation keys;
+  * single-rank recovery — a fault at any stage re-executes ONLY the
+    failed rank (shards_rerun==1, shards_reused==N-1), the degraded-mesh
+    path completes on N-1 devices with exactly ONE retryable warning,
+    and an exhausted ladder is ONE typed ShardFailure;
+  * bounded cost — one skewed rank's bucket overflow costs one exact-need
+    recompile (never a whole-step retrace), and abandoned device buffers
+    are deleted before every retry (no HBM growth across injected
+    faults)."""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.errors import ShardFailure
+from tidb_tpu.util import failpoint
+
+
+@pytest.fixture(scope="module")
+def s(eight_devices):
+    from tidb_tpu.session import Engine
+    eng = Engine()
+    s = eng.new_session()
+    s.execute("create table xf (a bigint, b bigint, v bigint)")
+    rows = ", ".join(f"({i % 97}, {i % 7}, {i % 101})" for i in range(4000))
+    s.execute(f"insert into xf values {rows}")
+    s.execute("create table xd (id bigint, w bigint)")
+    rows = ", ".join(f"({i}, {i * i})" for i in range(3000))
+    s.execute(f"insert into xd values {rows}")
+    s.execute("create table xs (nm varchar(8) collate utf8mb4_general_ci,"
+              " v bigint)")
+    rows = ", ".join(f"('{'AbC' if i % 3 else 'aBc'}{i % 11}', {i % 13})"
+                     for i in range(2000))
+    s.execute(f"insert into xs values {rows}")
+    s.execute("analyze table xf")
+    s.execute("analyze table xd")
+    s.execute("analyze table xs")
+    s.vars.update({"tidb_tpu_engine": "on", "tidb_tpu_row_threshold": 1,
+                   "tidb_tpu_dist_devices": 4})
+    yield s
+    eng.close()
+
+
+JOIN_SQL = ("select xd.w, count(*), sum(xf.v) from xf join xd "
+            "on xf.a = xd.id group by xd.w order by xd.w")
+DISTINCT_SQL = "select b, count(distinct a) from xf group by b order by b"
+WINDOW_SQL = "select a, v, sum(v) over (partition by a) from xf"
+
+
+def _rows(rs):
+    return [tuple(x for x in r) for r in rs.rows]
+
+
+def _run(s, sql, **vars_):
+    old = {k: s.vars.get(k) for k in vars_}
+    s.vars.update(vars_)
+    try:
+        out = _rows(s.query(sql))
+    finally:
+        for k, v in old.items():
+            if v is None:
+                s.vars.pop(k, None)
+            else:
+                s.vars[k] = v
+    return out
+
+
+def _three_ways(s, sql, sort=False):
+    """(staged, monolithic, cpu) result rows for one statement; asserts
+    the staged path actually engaged (its checkpoint site was hit)."""
+    failpoint.reset_counters()       # counts survive enabled() scopes
+    with failpoint.counting():
+        staged = _run(s, sql, tidb_tpu_dist_staged_exchange="on")
+        hits = failpoint.counters()
+    failpoint.reset_counters()
+    assert hits.get("exchange-checkpoint-write", 0) > 0, \
+        "statement did not take the staged exchange path"
+    mono = _run(s, sql, tidb_tpu_dist_staged_exchange="off")
+    cpu = _run(s, sql, tidb_tpu_engine="off")
+    if sort:
+        staged, mono, cpu = sorted(staged), sorted(mono), sorted(cpu)
+    return staged, mono, cpu
+
+
+# ---- byte-exactness against the monolithic oracle and the CPU --------------
+
+def test_distributed_join_byte_exact(s):
+    staged, mono, cpu = _three_ways(s, JOIN_SQL)
+    assert staged == mono == cpu
+    assert len(staged) == 97
+
+
+def test_broadcast_join_byte_exact(s):
+    # the tiny build side makes insert_exchanges pick a broadcast
+    # exchange: stage 1 checkpoints each rank's filtered build rows, the
+    # host replicates the concatenation to every destination
+    s.execute("create table xdim (id bigint, w bigint)")
+    s.execute("insert into xdim values " +
+              ", ".join(f"({i}, {10 * i})" for i in range(8)))
+    s.execute("analyze table xdim")
+    sql = ("select xdim.w, count(*), sum(xf.v) from xf join xdim "
+           "on xf.b % 8 = xdim.id group by xdim.w order by xdim.w")
+    staged, mono, cpu = _three_ways(s, sql)
+    assert staged == mono == cpu
+
+
+def test_distinct_rekey_byte_exact(s):
+    staged, mono, cpu = _three_ways(s, DISTINCT_SQL)
+    assert staged == mono == cpu
+    assert staged == [(b, len({a for a in range(97)
+                               if any(i % 97 == a and i % 7 == b
+                                      for i in range(4000))}))
+                      for b in range(7)]
+
+
+def test_global_distinct_byte_exact(s):
+    staged, mono, cpu = _three_ways(s, "select count(distinct a) from xf")
+    assert staged == mono == cpu == [(97,)]
+
+
+def test_window_byte_exact(s):
+    staged, mono, cpu = _three_ways(s, WINDOW_SQL)
+    # identical INCLUDING row order: the host-routed buckets preserve
+    # (source rank, source row) order exactly like the all_to_all
+    assert staged == mono
+    assert sorted(staged) == sorted(cpu)
+    assert len(staged) == 4000
+
+
+def test_skewed_keys_byte_exact(s):
+    # ~90% of probe rows share one join key: one rank owns a giant
+    # receive payload — padding under the shared recv cap, not drops
+    s.execute("create table xk (k bigint, v bigint)")
+    rows = ", ".join(
+        f"({7 if i % 10 else i % 97}, {i % 13})" for i in range(3000))
+    s.execute(f"insert into xk values {rows}")
+    s.execute("analyze table xk")
+    sql = ("select xk.k, count(*), sum(xd.w) from xk join xd "
+           "on xk.k = xd.id group by xk.k order by xk.k")
+    staged, mono, cpu = _three_ways(s, sql)
+    assert staged == mono == cpu
+
+
+def test_ci_collation_distinct_keys_byte_exact(s):
+    # ci string keys hash by dictionary code after fold normalization —
+    # equal-under-ci strings co-locate, so per-rank dedup stays exact.
+    # The staged path must match the monolithic oracle byte-for-byte;
+    # the CPU engine may pick a different (equally valid) case variant
+    # as the group representative, so it is compared fold-insensitively
+    sql = "select nm, count(distinct v) from xs group by nm order by nm"
+    staged, mono, cpu = _three_ways(s, sql)
+    assert staged == mono
+    fold = lambda rs: sorted((nm.lower(), c) for nm, c in rs)
+    assert fold(staged) == fold(cpu)
+    assert len(staged) == 11        # 'abc0'..'abc10' fold together
+
+
+# ---- satellite: one skewed rank costs ONE recompile -------------------------
+
+def test_skewed_rank_overflow_is_one_exact_resize(s):
+    # rank 0's slice is all one key (its bucket needs ~1000 rows); the
+    # other ranks stay under the forced 512 cap. Only rank 0 must resize
+    # — at exact need, one ladder charge — while ranks 1..3 keep their
+    # cached stage-1 program and their committed checkpoints
+    s.execute("create table xsk (k bigint, v bigint)")
+    rows = ", ".join(
+        f"({7 if i < 1000 else i % 89}, {i % 13})" for i in range(4000))
+    s.execute(f"insert into xsk values {rows}")
+    s.execute("analyze table xsk")
+    sql = ("select xsk.k, count(*), sum(xd.w) from xsk join xd "
+           "on xsk.k = xd.id group by xsk.k order by xsk.k")
+    cpu = _run(s, sql, tidb_tpu_engine="off")
+    out = _run(s, sql, tidb_tpu_exchange_bucket_cap=512)
+    assert out == cpu
+    esc = s.last_guard.escalation
+    assert esc.by_kind.get("exchange:exact") == 1
+    assert esc.recompiles == 1               # one charge, not per rank
+    assert esc.slabs_rerun == 1              # only the skewed rank re-ran
+    assert esc.slabs_reused == 3
+
+
+# ---- chaos: per-rank recovery at the new failpoints -------------------------
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("sql", [JOIN_SQL, DISTINCT_SQL],
+                         ids=["join", "distinct"])
+def test_checkpoint_loss_heals_one_rank(s, sql):
+    # losing one rank's stage-1 bucket checkpoint re-runs only that
+    # rank's partition program; the other ranks' checkpoints are reused
+    cpu = _run(s, sql, tidb_tpu_engine="off")
+    with failpoint.enabled("exchange-checkpoint-write",
+                           raise_=ShardFailure("chaos: checkpoint lost"),
+                           times=1):
+        rows = _run(s, sql)
+    assert rows == cpu
+    esc = s.last_guard.escalation
+    assert esc.shard_retries == 1
+    assert esc.shards_rerun == 1
+    assert esc.shards_reused == 3
+    assert esc.degraded_mesh == 0
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("sql", [JOIN_SQL, DISTINCT_SQL],
+                         ids=["join", "distinct"])
+def test_degraded_mesh_heals_one_rank(s, sql):
+    # one rank's device fails its stage dispatch AND the same-device
+    # retry: the rank re-dispatches onto a surviving device through the
+    # exchange-degraded-replan / exchange-redispatch rungs, the query
+    # completes byte-exactly on N-1 devices, and exactly ONE retryable
+    # warning is left (per recovered rank, NOT per surviving rank)
+    cpu = _run(s, sql, tidb_tpu_engine="off")
+    failpoint.reset_counters()       # counts survive enabled() scopes
+    with failpoint.counting():
+        with failpoint.enabled("shard-step",
+                               raise_=ShardFailure("chaos: device bad"),
+                               after_hits=2, times=2):
+            rows = _run(s, sql)
+        hits = failpoint.counters()
+    failpoint.reset_counters()
+    assert rows == cpu
+    assert hits.get("exchange-degraded-replan", 0) == 1
+    assert hits.get("exchange-redispatch", 0) == 1
+    esc = s.last_guard.escalation
+    assert esc.degraded_mesh == 1
+    assert esc.shards_rerun == 1
+    assert esc.shards_reused == 3
+    warns = s.query("SHOW WARNINGS").rows
+    assert len(warns) == 1, warns
+    level, code, msg = warns[0]
+    assert level == "Warning" and int(code) == ShardFailure.code
+    assert "degraded mesh" in msg and "re-dispatched" in msg
+
+
+@pytest.mark.chaos
+def test_fully_dead_rank_is_one_typed_error(s):
+    # the rank fails on its own device AND on re-dispatch to a surviving
+    # device: ONE typed retryable ShardFailure, never truncated rows —
+    # and the session stays usable
+    with failpoint.enabled("shard-step",
+                           raise_=ShardFailure("chaos: device down"),
+                           after_hits=2):
+        with failpoint.enabled("exchange-redispatch",
+                               raise_=ShardFailure("chaos: spare down")):
+            with pytest.raises(ShardFailure) as ei:
+                s.query(JOIN_SQL)
+    assert ei.value.code == 1105
+    assert ei.value.retryable
+    assert "re-dispatch" in str(ei.value)
+    cpu = _run(s, JOIN_SQL, tidb_tpu_engine="off")
+    assert _run(s, JOIN_SQL) == cpu
+    assert s.query("select count(*) from xf").scalar() == 4000
+
+
+@pytest.mark.chaos
+def test_degraded_warning_surfaces_once_in_explain_analyze(s):
+    # EXPLAIN ANALYZE executes the statement: a degraded-mesh retry must
+    # surface the retryable warning EXACTLY once (not per surviving
+    # rank) and the runtime escalation summary must carry the per-shard
+    # reuse split
+    with failpoint.enabled("shard-step",
+                           raise_=ShardFailure("chaos: device bad"),
+                           after_hits=2, times=2):
+        ea = s.query("EXPLAIN ANALYZE " + JOIN_SQL).rows
+    text = "\n".join(" ".join(str(c) for c in r) for r in ea)
+    assert "degraded_mesh=1" in text
+    assert "shards_rerun=1" in text and "shards_reused=3" in text
+    warns = [w for w in s.last_guard.warnings
+             if int(w[1]) == ShardFailure.code]
+    assert len(warns) == 1, warns
+    assert "degraded mesh" in warns[0][2]
+
+
+@pytest.mark.chaos
+def test_no_hbm_growth_across_injected_faults(s):
+    # abandoned device buffers must be delete()d BEFORE every retry /
+    # re-dispatch uploads its generation: three injected faults in a row
+    # must not grow the set of live device arrays
+    import gc
+    import jax
+    cpu = _run(s, JOIN_SQL, tidb_tpu_engine="off")
+    assert _run(s, JOIN_SQL) == cpu         # warm caches first
+    gc.collect()
+    base = len(jax.live_arrays())
+    for _ in range(3):
+        with failpoint.enabled("exchange-checkpoint-write",
+                               raise_=ShardFailure("chaos: ckpt lost"),
+                               times=1):
+            assert _run(s, JOIN_SQL) == cpu
+    gc.collect()
+    assert len(jax.live_arrays()) <= base
+
+
+def test_staged_exchange_gate_off_uses_monolithic(s):
+    # the flag is a real gate: off → the monolithic shard_map program
+    # runs (no staged-exchange checkpoint site is ever reached)
+    failpoint.reset_counters()       # counts survive enabled() scopes
+    with failpoint.counting():
+        rows = _run(s, JOIN_SQL, tidb_tpu_dist_staged_exchange="off")
+        hits = failpoint.counters()
+    failpoint.reset_counters()
+    assert hits.get("exchange-checkpoint-write", 0) == 0
+    assert rows == _run(s, JOIN_SQL, tidb_tpu_engine="off")

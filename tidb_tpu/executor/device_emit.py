@@ -419,6 +419,48 @@ def _window_value(ctx, live, d, n, perm, pstart, peerstart):
                      range_key=range_key)
 
 
+def emit_partition(arrays: Sequence, dest, live, n_shards: int,
+                   bucket_cap: int):
+    """Traced per-rank bucket scatter — stage 1 of the staged exchange.
+
+    The scatter half of parallel/collective.exchange() with the in-trace
+    all_to_all removed: ONE rank's rows land in `n_shards` fixed-capacity
+    destination buckets, ready for a device→host checkpoint and
+    host-mediated routing (collective.route_buckets). Identical rank /
+    slot / drop arithmetic to exchange(), so the staged path inherits the
+    monolithic path's exact-need overflow contract: rows past bucket_cap
+    are dropped and `need` (= counts.max()) reports the true per-bucket
+    requirement for the capacity ladder's ONE exact resize.
+
+    arrays: per-row payload [(N,)...]; dest (N,) int32; live (N,) bool.
+    → (bufs [(n_shards*bucket_cap,)...], sent_live, counts (n_shards,),
+       need ()). Within bucket d the prefix [0:counts[d]] is contiguous
+    live rows (rows are ranked densely per destination)."""
+    from tidb_tpu.ops.jax_env import jax, jnp, lax
+    n = dest.shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    d = jnp.where(live, dest, jnp.int32(n_shards))  # dead rows → no bucket
+    sorted_d, sorted_row = lax.sort((d, iota), num_keys=1)
+    first_of_d = jax.ops.segment_min(jnp.arange(n, dtype=jnp.int32),
+                                     sorted_d, num_segments=n_shards + 1)
+    rank_sorted = jnp.arange(n, dtype=jnp.int32) - \
+        jnp.take(first_of_d, jnp.clip(sorted_d, 0, n_shards))
+    rank = jnp.zeros(n, dtype=jnp.int32).at[sorted_row].set(rank_sorted)
+    counts = jax.ops.segment_sum(jnp.ones(n, dtype=jnp.int32), d,
+                                 num_segments=n_shards + 1)[:n_shards]
+    slot = d * bucket_cap + rank
+    ok = live & (rank < bucket_cap)
+    slot = jnp.where(ok, slot, n_shards * bucket_cap)  # OOB → dropped
+    total = n_shards * bucket_cap
+    sent_live = jnp.zeros(total, dtype=bool).at[slot].set(ok, mode="drop")
+    bufs = []
+    for a in arrays:
+        a = jnp.asarray(a)
+        bufs.append(jnp.zeros(total, dtype=a.dtype).at[slot].set(
+            jnp.where(ok, a, jnp.zeros((), dtype=a.dtype)), mode="drop"))
+    return bufs, sent_live, counts, counts.max()
+
+
 def emit_batched(partial_fn):
     """Same-plan micro-batching entry: vmap one fragment's traced
     per-slab partial over a LEADING MEMBER AXIS of the prepared inputs

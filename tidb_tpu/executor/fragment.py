@@ -2549,6 +2549,49 @@ class TpuFragmentExec:
         with self.ctx.phases.phase("decode"):
             return self._merge_tree_agg_passes(root, pass_outs, inp_dicts)
 
+    def _run_dist_exchange_staged(self, root, mesh, host_cols,
+                                  scan_meta) -> Optional[Chunk]:
+        """Staged checkpointable dist exchange (dist_fragment.
+        StagedDistExchange): per-rank partition programs → device→host
+        bucket checkpoints + host routing → per-rank fused probe/dedup
+        programs over the rewritten (exchange→leaf) plan. Returns None
+        when the plan is ineligible — the caller falls through to the
+        monolithic shard_map program, the byte-exactness oracle."""
+        from tidb_tpu.executor.dist_fragment import (StagedDistExchange,
+                                                     staged_exchange_plan)
+        from tidb_tpu.util.escalation import CapacityLadder
+        grafted = staged_exchange_plan(root)
+        if grafted is None:
+            return None
+        new_root, grafts = grafted
+        ladder = CapacityLadder(guard=getattr(self.ctx, "guard", None),
+                                stats=self.ctx.escalation)
+        runner = StagedDistExchange(root, new_root, grafts, mesh,
+                                    host_cols, scan_meta, self.ctx,
+                                    ladder)
+        outs = runner.execute()
+        if isinstance(new_root, PhysHashAgg):
+            # the exchange re-keyed on the group keys, so each group's
+            # rows landed wholly on ONE rank: the host merge never
+            # combines two partials of one group (DISTINCT states stay
+            # exact — same invariant as the monolithic owner merge)
+            inp_dicts = {i: d for i, d in
+                         enumerate(runner.flows2.get(id(new_root), []))}
+            with self.ctx.phases.phase("decode"):
+                return self._merge_tree_agg_passes(new_root, outs,
+                                                   inp_dicts)
+        dicts_root = {i: d for i, d in enumerate(runner.root_dicts2)}
+        cols_vm = [(np.concatenate([np.asarray(o["cols"][ci][0])
+                                    for o in outs]),
+                    np.concatenate([np.asarray(o["cols"][ci][1])
+                                    for o in outs]))
+                   for ci in range(len(new_root.schema))]
+        live = np.concatenate([np.asarray(o["live"]) for o in outs])
+        with self.ctx.phases.phase("decode"):
+            return _compact_decode(cols_vm, live,
+                                   new_root.schema.field_types,
+                                   dicts_root)
+
     def _run_device_dist(self) -> Chunk:
         # ORDER BY / TopN over the agg: shard programs compute the agg
         # only — the ordering stays a host concern after the shard merge
@@ -2612,15 +2655,27 @@ class TpuFragmentExec:
         # equal strings hash equal on every shard (dist_fragment doc)
         from tidb_tpu.executor.dist_fragment import unify_string_join_dicts
         unify_string_join_dicts(root, host_cols)
-        # staged checkpointable path: an exchange-free agg chain runs as
-        # per-rank single-device partials with device→host checkpoints —
-        # a shard fault re-executes ONLY the failed rank (StagedDistAgg's
-        # retry → re-dispatch → degraded-mesh ladder). Exchange-carrying
-        # plans (joins, DISTINCT re-keys, windows) keep the monolithic
-        # shard_map program below, where fault retry stays full-step.
+        # staged checkpointable paths: an exchange-free agg chain runs as
+        # per-rank single-device partials with device→host checkpoints
+        # (StagedDistAgg); exchange-carrying plans (distributed joins,
+        # DISTINCT re-keys, windows) cut at the exchange instead —
+        # per-rank partition programs, host-routed bucket checkpoints,
+        # per-rank probe programs (StagedDistExchange). Either way a
+        # shard fault re-executes ONLY the failed rank through the
+        # retry → re-dispatch → degraded-mesh ladder. Plans neither path
+        # accepts (TopN/Sort roots, non-scan-chain exchange children)
+        # keep the monolithic shard_map program below, where fault retry
+        # stays full-step — it also remains the staged paths'
+        # byte-exactness oracle.
         if _var_bool(self.ctx.vars.get("tidb_tpu_dist_staged", "on")):
             staged = self._run_dist_agg_staged(root, mesh, host_cols,
                                                scan_meta)
+            if staged is not None:
+                return staged
+        if _var_bool(self.ctx.vars.get("tidb_tpu_dist_staged_exchange",
+                                       "on")):
+            staged = self._run_dist_exchange_staged(root, mesh, host_cols,
+                                                    scan_meta)
             if staged is not None:
                 return staged
         from tidb_tpu.chunk import compress as _compress

@@ -244,6 +244,19 @@ class SumAgg(AggFunc):
                 counts + seg.segment_count(xp, validity, gid, n))
 
     def merge(self, xp, state, gid, n, partial):
+        if self._wide and len(partial) > 2 and len(state) <= 2:
+            # a device limb-formulation partial (per-plane sums + counts)
+            # meeting the host's exact object-int narrow state — the
+            # staged distributed merges land here with wide object-column
+            # args. Recombining the limbs is exact (no carries, see
+            # _init_wide), and the scale correction mirrors _sum_of: the
+            # limb update accumulated RAW input limbs without _cast_in
+            from tidb_tpu.executor.device_cache import wide_decimal_unlimb
+            limbs = np.stack([np.asarray(a) for a in partial[:-1]])
+            psums = wide_decimal_unlimb(limbs)
+            if self._out_scale > self._in_scale:
+                psums = psums * 10 ** (self._out_scale - self._in_scale)
+            partial = (psums, np.asarray(partial[-1]))
         if self._wide and len(state) > 2:
             return self._merge_wide(xp, state, gid, n, partial)
         return self._merge_narrow(xp, state, gid, n, partial)

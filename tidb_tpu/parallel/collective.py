@@ -108,6 +108,46 @@ def require_capacity(need: int, bucket_cap: int, what: str = "exchange"):
             f"(escalation ladder) or raise the cap")
 
 
+def route_buckets(checkpoints: Sequence[dict], n_shards: int):
+    """Host-mediated bucket routing — the staged-exchange replacement for
+    exchange()'s in-trace all_to_all. `checkpoints[r]` is rank r's
+    device→host checkpoint of its stage-1 partition output:
+
+        {"bufs":   {col_idx: (values, validity)},   # n_shards*cap_r rows
+         "counts": (n_shards,) int — live rows per destination bucket,
+         "cap":    int — rank r's per-destination bucket capacity}
+
+    Per-rank caps may differ (a skewed rank resizes alone — the exact-need
+    ladder contract), so routing slices each source's buckets at ITS cap.
+    Within bucket d the prefix [0:counts[d]] is contiguous live rows (the
+    scatter ranks rows densely per destination), so the routed payload is
+    front-packed by construction.
+
+    Returns (routed, recv_rows): routed[d] = {col_idx: (values, validity)}
+    concatenated over source ranks in rank order; recv_rows[d] = total live
+    rows destined for rank d."""
+    import numpy as np
+    cols = list(checkpoints[0]["bufs"].keys()) if checkpoints else []
+    routed = []
+    recv_rows = []
+    for d in range(n_shards):
+        bufs = {}
+        for i in cols:
+            vs, ms = [], []
+            for cp in checkpoints:
+                cap = int(cp["cap"])
+                k = int(cp["counts"][d])
+                v, m = cp["bufs"][i]
+                vs.append(np.asarray(v)[d * cap:d * cap + k])
+                ms.append(np.asarray(m)[d * cap:d * cap + k])
+            bufs[i] = (np.concatenate(vs) if vs else np.zeros(0),
+                       np.concatenate(ms) if ms else np.zeros(0, bool))
+        routed.append(bufs)
+        recv_rows.append(int(sum(int(cp["counts"][d])
+                              for cp in checkpoints)))
+    return routed, recv_rows
+
+
 def broadcast_build(arrays: Sequence, live, axis: str = "shard"):
     """Broadcast-join pattern: every shard receives the full build side
     (ExchangeType_Broadcast) — one all_gather along the mesh axis."""

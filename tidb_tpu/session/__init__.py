@@ -44,6 +44,11 @@ DEFAULT_VARS: Dict[str, object] = {
     # staged (checkpointable, per-shard recoverable) distributed agg;
     # off = always the monolithic shard_map program
     "tidb_tpu_dist_staged": "on",
+    # staged exchange-carrying fragments (distributed joins, DISTINCT
+    # re-keys, windows): partition → device→host bucket checkpoint →
+    # per-rank probe, each stage re-dispatchable per rank; off = the
+    # monolithic in-trace all_to_all program (the byte-exactness oracle)
+    "tidb_tpu_dist_staged_exchange": "on",
     # compressed device-resident columns (bit-pack / frame-of-reference /
     # dictionary) with decode fused into the scan; off = raw layouts
     "tidb_tpu_compression": "on",

@@ -82,10 +82,13 @@ FINALIZE_QUERY = ("select b, count(distinct a) from cs_facts "
 PRUNE_QUERY = "select count(*), sum(a) from cs_facts where a > 100"
 
 # distributed shapes — integer results, so dist vs CPU comparison is
-# exact. The DISTINCT agg matters: a plain group-by distributes through
-# gather_partials (no re-key), so only the DISTINCT re-key exchange (and
-# a non-broadcast join) actually traces collective.exchange — the site
-# the mesh coverage gate wants hot
+# exact. The DISTINCT agg and the join matter: a plain group-by
+# distributes through gather_partials (no re-key), so only the DISTINCT
+# re-key exchange and a non-broadcast join carry exchanges — by default
+# these now run STAGED (per-rank partition programs, device→host bucket
+# checkpoints, host routing, per-rank probes), which is what puts the
+# exchange-checkpoint-write / exchange-redispatch /
+# exchange-degraded-replan sites in reach of the mesh coverage gate
 MESH_QUERIES = [
     QUERIES[1],
     FINALIZE_QUERY,
@@ -252,10 +255,11 @@ def _scenarios(mesh: Optional[int] = None) -> List[Scenario]:
                      "exchange-overflow", dict(), run="mesh-read",
                      vars={**dist_on, "tidb_tpu_exchange_bucket_cap": "8"},
                      mesh=True),
-            # one shard's step raises once: the staged agg re-runs only
-            # that rank against its checkpoint; the monolithic shapes
-            # (DISTINCT re-key, join) retry the whole step — either way
-            # the query still answers the oracle
+            # one shard's step raises once: every distributed shape now
+            # re-runs only that rank against its checkpoints — the staged
+            # agg for the plain group-by, the staged exchange for the
+            # DISTINCT re-key and the join (its stage-1 partition and
+            # stage-3 probe attempts trace the same shard-step site)
             Scenario("mesh shard fault heals after retry", "shard-step",
                      dict(raise_=ShardFailure("chaos: shard down"),
                           times=1),
@@ -282,13 +286,56 @@ def _scenarios(mesh: Optional[int] = None) -> List[Scenario]:
             # the fault persists through every recovery rung — the
             # same-device retry AND the re-dispatch onto a spare: ONE
             # typed ShardFailure must surface (a silent CPU re-run would
-            # hide a dead shard)
+            # hide a dead shard). Both re-dispatch rungs are armed: the
+            # staged agg's shard-redispatch AND the staged exchange's
+            # exchange-redispatch (the DISTINCT re-key / join shapes
+            # would otherwise heal onto the spare device)
             Scenario("mesh shard fault persists → typed error",
                      "shard-step",
                      dict(raise_=ShardFailure("chaos: shard down")),
                      run="mesh-read", vars=dict(dist_on), mesh=True,
                      require_error=True,
                      extra={"shard-redispatch":
+                            dict(raise_=ShardFailure("chaos: spare down")),
+                            "exchange-redispatch":
+                            dict(raise_=ShardFailure("chaos: spare down"))
+                            }),
+            # -- staged exchanges (joins, DISTINCT re-keys, windows) -----
+            # losing one rank's stage-1 bucket checkpoint re-runs only
+            # that rank's partition program; the other ranks' committed
+            # checkpoints are routed untouched. times=1 so only the FIRST
+            # exchange-carrying shape (the DISTINCT re-key) takes the
+            # fault and its same-device retry heals cleanly; the join
+            # runs clean after it (both-shapes recovery is pinned per
+            # failpoint in tests/test_staged_exchange.py)
+            Scenario("mesh exchange checkpoint lost → heals one rank",
+                     "exchange-checkpoint-write",
+                     dict(raise_=ShardFailure("chaos: bucket ckpt lost"),
+                          times=1),
+                     run="mesh-read", vars=dict(dist_on), mesh=True),
+            # a persistently bad device under a DISTRIBUTED JOIN: the
+            # rank's stage fails on its device and on the same-device
+            # retry, re-dispatches onto a surviving device through the
+            # exchange-degraded-replan / exchange-redispatch rungs
+            # (armed with no action purely to meter reachability), and
+            # the join still answers the oracle on N-1 devices
+            Scenario("mesh join device bad → degraded-mesh heal",
+                     "shard-step",
+                     dict(raise_=ShardFailure("chaos: device bad"),
+                          times=2),
+                     run="mesh-join", vars=dict(dist_on), mesh=True,
+                     extra={"exchange-degraded-replan": dict(),
+                            "exchange-redispatch": dict()}),
+            # the join's shard is fully dead — its own device AND the
+            # re-dispatch spare both fail: ONE typed retryable
+            # ShardFailure surfaces and the session stays usable (the
+            # post-scenario count probe asserts that)
+            Scenario("mesh join shard fully dead → typed error",
+                     "shard-step",
+                     dict(raise_=ShardFailure("chaos: device down")),
+                     run="mesh-join", vars=dict(dist_on), mesh=True,
+                     require_error=True,
+                     extra={"exchange-redispatch":
                             dict(raise_=ShardFailure("chaos: spare down"))
                             }),
             # two-session isolation: session A takes a shard fault on
@@ -469,13 +516,20 @@ def run_sweep(verbose: bool = False, mesh: Optional[int] = None,
                             f"resumable pairs retry (slabs_rerun="
                             f"{esc.slabs_rerun} exact_resizes="
                             f"{esc.exact_resizes})")
-            elif sc.run in ("mesh-read", "mesh-agg"):
-                # mesh-agg: only the staged-eligible plain group-by —
-                # the DISTINCT/join shapes run monolithic, where a
-                # persistent shard-step fault means a typed error, not a
-                # degraded-mesh heal
-                qs = MESH_QUERIES[:1] if sc.run == "mesh-agg" \
-                    else MESH_QUERIES
+            elif sc.run in ("mesh-read", "mesh-agg", "mesh-join"):
+                # mesh-agg: only the plain group-by (the staged-AGG
+                # checkpoint ladder); mesh-join: only the distributed
+                # join (the staged-EXCHANGE ladder — stage-1 partition
+                # checkpoints, host bucket routing, stage-3 probe).
+                # mesh-read runs all three shapes — since the staged
+                # exchange landed, the DISTINCT re-key and the join ride
+                # the same per-rank recovery as the agg
+                if sc.run == "mesh-agg":
+                    qs = MESH_QUERIES[:1]
+                elif sc.run == "mesh-join":
+                    qs = MESH_QUERIES[2:3]
+                else:
+                    qs = MESH_QUERIES
                 for q in qs:
                     rows, err, dt = _run_statement(s, q)
                     if dt > DEADLINE_S:
@@ -684,7 +738,7 @@ def run_sweep(verbose: bool = False, mesh: Optional[int] = None,
         if after != base_count:
             failures.append(f"{sc.name}: count drifted after scenario")
         if sc.run not in ("read", "recompile", "fused", "finalize",
-                          "mesh-read", "mesh-agg"):
+                          "mesh-read", "mesh-agg", "mesh-join"):
             # mutating scenarios move the goalposts: refresh the oracle
             oracle = {q: s.query(q).rows for q in oracle_qs}
             base_count = after

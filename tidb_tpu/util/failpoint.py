@@ -110,6 +110,21 @@ register("shard-redispatch", "re-dispatch of a persistently failing "
 register("degraded-mesh-replan", "entry of degraded-mesh mode: the "
          "fragment re-plans the failed rank's work on the N-1 surviving "
          "ranks (executor/dist_fragment.py)", mesh_only=True)
+register("exchange-checkpoint-write", "device→host checkpoint of one "
+         "rank's outgoing exchange buckets in the staged exchange path — "
+         "committed before ANY rank's receive stage starts, so a raise "
+         "here models losing one rank's partition output, which must "
+         "re-run only that rank's stage-1 program "
+         "(executor/dist_fragment.py StagedDistExchange)", mesh_only=True)
+register("exchange-redispatch", "re-dispatch of a persistently failing "
+         "rank's exchange stage onto a surviving device — a raise here "
+         "models the degraded-mesh recovery ALSO failing, exhausting the "
+         "ladder into a typed ShardFailure "
+         "(executor/dist_fragment.py StagedDistExchange)", mesh_only=True)
+register("exchange-degraded-replan", "entry of degraded-mesh mode for an "
+         "exchange-carrying fragment: the failed rank's partition or "
+         "probe stage re-plans onto a surviving device "
+         "(executor/dist_fragment.py StagedDistExchange)", mesh_only=True)
 register("fused-pipeline-overflow", "capacity boundary of the fused "
          "per-slab pipeline driver — hit after every round's batched flag "
          "fetch, right before join/group overflows are classified into "

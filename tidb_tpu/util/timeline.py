@@ -50,7 +50,11 @@ _T0 = time.perf_counter()          # shared epoch for every thread's ts
 # device-stream lanes: stable small tids so the viewer groups events the
 # same way run over run; thread_name metadata labels them at flush
 STREAMS = {"sched": 1, "compile": 2, "encode": 3, "upload": 4,
-           "compute": 5, "fetch": 6, "decode": 7, "cache": 8}
+           "compute": 5, "fetch": 6, "decode": 7, "cache": 8,
+           # staged-exchange per-rank stage lanes: partition (stage 1),
+           # checkpoint (stage 2 device→host + host routing), probe
+           # (stage 3 receive/probe/dedup)
+           "partition": 9, "checkpoint": 10, "probe": 11}
 
 _GLOBAL: Optional["_Collector"] = None     # tidb_tpu_trace_dir sink
 _GLOBAL_PATH: Optional[str] = None
