@@ -1084,6 +1084,180 @@ def main():
         log(f"mesh Q3 section failed (headline unaffected): {e}")
         extra["q3_mesh_error"] = str(e)[:200]
 
+    # ---- HTAP ingest: single-row writes streaming under Q1/Q6 reads -------
+    # The crash-consistent write path under its intended load: writer
+    # sessions stream autocommit single-row INSERTs (coalesced behind the
+    # per-table commit gate into shared delta-appends) while reader
+    # sessions keep answering warm Q1/Q6 over the growing base∪delta
+    # view. The JSON carries the ingest rate, the coalescing ratio
+    # (members per committed batch), read tail latency DURING ingest,
+    # delta extensions and compactions folded, and an exactly-once count
+    # probe. One fault-injected rep then arms a retryable fault at the
+    # `delta-append` boundary and must HEAL: the in-gate retry lands the
+    # row exactly once.
+    try:
+        from tidb_tpu.util import failpoint
+        left = remaining_s()
+        if left < 90.0:
+            log(f"HTAP ingest skipped: {left:.0f}s left in wall budget")
+            extra["htap_skipped_budget"] = True
+        else:
+            from tidb_tpu.errors import TxnError
+            from tidb_tpu.executor import delta as delta_mod
+            from tidb_tpu.util.observability import REGISTRY
+
+            def ctr(name: str) -> float:
+                return sum(v for (n, _l), v in REGISTRY.counters.items()
+                           if n == name)
+
+            def store_count(where: str) -> int:
+                s.vars["tidb_tpu_engine"] = "off"
+                try:
+                    return s.query("SELECT COUNT(*) FROM lineitem "
+                                   f"WHERE {where}").rows[0][0]
+                finally:
+                    s.vars["tidb_tpu_engine"] = "on"
+
+            s.vars["tidb_tpu_engine"] = "on"
+            s.vars["tidb_tpu_row_threshold"] = 32768
+            clean_q1 = s.query(Q1).rows         # warm both read shapes
+            s.query(Q6)
+            base_ctr = {k: ctr(k) for k in (
+                "tidb_tpu_write_batches_total",
+                "tidb_tpu_write_members_total",
+                "tidb_tpu_delta_extensions_total",
+                "tidb_tpu_compactions_total")}
+            # appended rows: shipdate '1998-12-29' sits at the TOP of the
+            # generated range, so both FoR-bounded and monotonic
+            # (delta-kind) base layouts accept the append, and Q1/Q6's
+            # date windows exclude it — reader results stay byte-stable
+            # while every read still crosses the delta merge
+            okey0 = 1 << 40
+            seq = itertools.count()
+            ingest_s = 8.0 if left > 240.0 else 4.0
+            n_writers, n_readers = 4, 2
+            stop_at = time.monotonic() + ingest_s
+            written = [0] * n_writers
+            read_lat: list = [[] for _ in range(n_readers)]
+            htap_errors: list = []
+
+            def htap_writer(k: int):
+                ws = eng.new_session()
+                try:
+                    while time.monotonic() < stop_at:
+                        ws.query(
+                            "INSERT INTO lineitem VALUES (25.00, "
+                            "50000.00, 0.06, 0.04, 'N', 'F', "
+                            f"'1998-12-29', {okey0 + next(seq)})")
+                        written[k] += 1
+                except Exception as e:  # noqa: BLE001 — in the JSON
+                    htap_errors.append(
+                        f"writer: {type(e).__name__}: {e}"[:200])
+
+            def htap_reader(k: int):
+                rs_ = eng.new_session()
+                rs_.vars["tidb_tpu_engine"] = "on"
+                rs_.vars["tidb_tpu_row_threshold"] = 32768
+                # a low fold threshold so compaction demonstrably fires
+                # inside the ingest window
+                rs_.vars["tidb_tpu_delta_compact_rows"] = 256
+                j = k
+                try:
+                    while time.monotonic() < stop_at:
+                        q0 = time.perf_counter()
+                        rows = rs_.query(Q1 if j % 2 == 0 else Q6).rows
+                        read_lat[k].append(time.perf_counter() - q0)
+                        if j % 2 == 0 and rows != clean_q1:
+                            raise RuntimeError(
+                                "Q1 drifted during ingest: the appended "
+                                "rows are outside its date window")
+                        j += 1
+                except Exception as e:  # noqa: BLE001 — in the JSON
+                    htap_errors.append(
+                        f"reader: {type(e).__name__}: {e}"[:200])
+
+            t0 = time.perf_counter()
+            threads = [threading.Thread(target=htap_writer, args=(k,),
+                                        daemon=True)
+                       for k in range(n_writers)]
+            threads += [threading.Thread(target=htap_reader, args=(k,),
+                                         daemon=True)
+                        for k in range(n_readers)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            compact_sync = delta_mod.run_pending_compactions()
+            total = sum(written)
+            landed = store_count(f"l_orderkey >= {okey0}")
+            batches = ctr("tidb_tpu_write_batches_total") - \
+                base_ctr["tidb_tpu_write_batches_total"]
+            members = ctr("tidb_tpu_write_members_total") - \
+                base_ctr["tidb_tpu_write_members_total"]
+            lat = sorted(x for per in read_lat for x in per)
+            pct = latency_percentiles_ms(lat)
+            extra.update({
+                "htap_ingest_rows": total,
+                "htap_ingest_rows_per_s": round(total / wall, 1),
+                "htap_write_batches": int(batches),
+                "htap_coalesce_members_per_batch":
+                    round(members / batches, 2) if batches else 0.0,
+                "htap_reads": len(lat),
+                "htap_read_p50_ms": pct["latency_p50_ms"],
+                "htap_read_p99_ms": pct["latency_p99_ms"],
+                "htap_delta_extensions": int(
+                    ctr("tidb_tpu_delta_extensions_total")
+                    - base_ctr["tidb_tpu_delta_extensions_total"]),
+                # the counter covers both the async worker's folds and
+                # the final sync drain (compact_sync of them)
+                "htap_compactions": int(
+                    ctr("tidb_tpu_compactions_total")
+                    - base_ctr["tidb_tpu_compactions_total"]),
+                "htap_compactions_drained": compact_sync,
+                "htap_write_atomic": landed == total,
+                "htap_errors": htap_errors[:5]})
+            log(f"HTAP ingest: {total} rows in {wall:.1f}s "
+                f"({extra['htap_ingest_rows_per_s']}/s, "
+                f"{extra['htap_coalesce_members_per_batch']} members/"
+                f"batch), {len(lat)} reads p99 "
+                f"{extra['htap_read_p99_ms']}ms, "
+                f"{extra['htap_delta_extensions']} extensions, "
+                f"{extra['htap_compactions']} compactions")
+            if htap_errors or landed != total:
+                raise RuntimeError(
+                    f"HTAP ingest violated exactly-once: wrote {total}, "
+                    f"store has {landed}; errors={htap_errors[:3]}")
+            # chaos rep: a transient fault at the delta-append boundary —
+            # the coalesced commit's in-gate retry must land the row
+            # exactly once, never torn, never doubled
+            fault = TxnError("bench chaos: delta append transient")
+            fault.retryable = True
+            probe_key = okey0 + next(seq)
+            with failpoint.enabled("delta-append", raise_=fault,
+                                   times=2), \
+                    failpoint.enabled("backoff-sleep", value="skip"):
+                rs = s.query("INSERT INTO lineitem VALUES (25.00, "
+                             "50000.00, 0.06, 0.04, 'N', 'F', "
+                             f"'1998-12-29', {probe_key})")
+            heal_ok = rs.affected_rows == 1 and \
+                store_count(f"l_orderkey = {probe_key}") == 1
+            extra["htap_fault_heal_ok"] = heal_ok
+            if not heal_ok:
+                raise RuntimeError(
+                    "HTAP chaos rep did not heal: the retryable "
+                    "delta-append fault must commit exactly once")
+            log("HTAP chaos rep: retryable delta-append fault healed, "
+                "row landed exactly once")
+    except Exception as e:  # noqa: BLE001 — must not sink the headline
+        if backend_error(e):
+            raise
+        log(f"HTAP ingest section failed (headline unaffected): {e}")
+        extra["htap_error"] = str(e)[:200]
+    finally:
+        from tidb_tpu.util import failpoint
+        failpoint.disable_all()
+
     if hasattr(signal, "SIGALRM"):
         signal.alarm(0)
     if trace_dir:

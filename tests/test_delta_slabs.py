@@ -1,0 +1,254 @@
+"""Delta slabs: incremental extension of device-cached tables.
+
+A committed write no longer invalidates a cached table wholesale: when
+the region diff is expressible as appended rows + tombstones, the cache
+grows a NEW generation that shares every untouched base device array
+with its predecessor, uploads one delta slab for the appended rows, and
+rewrites tombstoned base slabs in-trace (executor/delta.py +
+device_emit.emit_delta_merge). These tests pin:
+
+* oracle equality through inserts, scattered deletes, mixed
+  insert+delete on one generation, and deletes that land in the delta
+  slab itself (cumulative re-diff);
+* base-array SHARING — an extension must not re-upload base slabs;
+* the decline ladder — a value the base layouts cannot carry (a new
+  dictionary string) rebuilds from scratch, never a wrong merge;
+* the `delta-merge-stale` failpoint → typed LayoutError → warned CPU
+  fallback with oracle rows, then a clean extension once disarmed;
+* threshold-scheduled compaction: the rebuilt generation drops
+  `is_delta`, re-chooses layouts, and answers the oracle; a fault at
+  `compaction-commit` abandons the rebuild (buffers deleted) while the
+  old base+delta generation keeps serving byte-exactly, and the next
+  extension re-schedules the job (heals);
+* eviction/invalidation of a delta generation deletes the DELTA device
+  arrays too — no HBM leak (the satellite-2 guarantee).
+"""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.executor import delta
+from tidb_tpu.executor import device_cache as dc
+from tidb_tpu.session import Engine
+from tidb_tpu.util import failpoint
+from tidb_tpu.util.observability import REGISTRY
+
+
+def _engine(compression="on"):
+    eng = Engine()
+    eng.global_vars["tidb_enable_auto_analyze"] = False
+    s = eng.new_session()
+    s.execute("CREATE TABLE t (a BIGINT, b BIGINT, c VARCHAR(10))")
+    # non-monotonic b: choose_layout must pick pack/raw (delta-kind
+    # layouts decline tombstones by design)
+    s.execute("INSERT INTO t VALUES " + ",".join(
+        f"({i % 40}, {(i * 7919) % 5000}, 'k{i % 5}')"
+        for i in range(3000)))
+    s.vars["tidb_tpu_engine"] = "on"
+    s.vars["tidb_tpu_row_threshold"] = 1
+    s.vars["tidb_tpu_compression"] = compression
+    s.vars["tidb_tpu_compaction"] = "off"   # drain by hand, deterministic
+    return eng, s
+
+
+Q = "SELECT a, COUNT(*), SUM(b) FROM t GROUP BY a ORDER BY a"
+
+
+def _oracle(s, q=Q):
+    s.vars["tidb_tpu_engine"] = "off"
+    try:
+        return s.query(q).rows
+    finally:
+        s.vars["tidb_tpu_engine"] = "on"
+
+
+def _entry(eng, name="t"):
+    tid = eng.catalog.info_schema.table(name).id
+    for (sid, t, _parts), ent in dc._CACHE.items():
+        if sid == id(eng.store) and t == tid:
+            return ent
+    raise AssertionError(f"table {name} not cached")
+
+
+def _base_ids(ent):
+    """id() of every base-slab device array, per column."""
+    n_base = ent.base_slabs
+    return {i: [None if t is None else tuple(id(a) for a in t)
+                for t in slabs[:n_base]]
+            for i, slabs in ent.dev.items()}
+
+
+@pytest.mark.parametrize("compression", ["on", "off"])
+def test_insert_extends_without_reupload(compression):
+    eng, s = _engine(compression)
+    s.query(Q)
+    ent0 = _entry(eng)
+    ids0 = _base_ids(ent0)
+    s.query("INSERT INTO t VALUES (3, 1234, 'k2')")
+    rows = s.query(Q).rows
+    ent1 = _entry(eng)
+    assert ent1 is not ent0 and ent1.is_delta
+    assert ent1.delta_rows == 1
+    # no tombstones → every base device array is SHARED, not re-encoded
+    assert _base_ids(ent1) == ids0, "extension re-uploaded base slabs"
+    assert rows == _oracle(s)
+
+
+@pytest.mark.parametrize("compression", ["on", "off"])
+def test_tombstones_and_mixed_writes(compression):
+    eng, s = _engine(compression)
+    s.query(Q)
+    s.query("DELETE FROM t WHERE b % 97 = 3")
+    rows = s.query(Q).rows
+    ent = _entry(eng)
+    assert ent.is_delta and any(len(v) for v in ent.tomb.values())
+    assert rows == _oracle(s)
+    # mixed insert + delete on the SAME generation
+    s.query("INSERT INTO t VALUES (3, 1234, 'k2')")
+    s.query("DELETE FROM t WHERE b = 4998")
+    assert s.query(Q).rows == _oracle(s)
+    # delete the row that lives in the DELTA slab (cumulative re-diff)
+    s.query("DELETE FROM t WHERE b = 1234 AND a = 3")
+    assert s.query(Q).rows == _oracle(s)
+    # dictionary-string path still correct on the delta generation
+    q2 = "SELECT c, COUNT(*) FROM t WHERE a < 10 GROUP BY c ORDER BY c"
+    assert s.query(q2).rows == _oracle(s, q2)
+
+
+def test_new_dictionary_string_declines_to_rebuild():
+    eng, s = _engine()
+    q2 = "SELECT c, COUNT(*) FROM t GROUP BY c ORDER BY c"
+    s.query(q2)                     # cache covers the dictionary column
+    # 'zzz' is not in the base dictionary: the extension must DECLINE
+    # and the open falls back to a full rebuild — never a wrong merge
+    s.query("INSERT INTO t VALUES (1, 1, 'zzz')")
+    rows = s.query(q2).rows
+    ent = _entry(eng)
+    assert not ent.is_delta, "un-encodable append must rebuild, not merge"
+    assert rows == _oracle(s, q2)
+    assert s.query(Q).rows == _oracle(s)
+
+
+def test_delta_version_in_plan_keys():
+    """Two generations of the same table must never share a specialized
+    program: the fragment spec key carries delta_version."""
+    eng, s = _engine()
+    s.query(Q)
+    v0 = _entry(eng).delta_version
+    s.query("INSERT INTO t VALUES (3, 1234, 'k2')")
+    s.query(Q)
+    v1 = _entry(eng).delta_version
+    assert v1 > v0
+
+
+def test_delta_merge_stale_fault_warned_cpu_fallback():
+    eng, s = _engine()
+    s.query(Q)
+    s.query("INSERT INTO t VALUES (3, 1234, 'k2')")
+    oracle = _oracle(s)
+    failpoint.enable("delta-merge-stale", value="test: stale diff")
+    try:
+        rows = s.query(Q).rows
+        assert failpoint.hits("delta-merge-stale") > 0
+        assert rows == oracle, "fallback must still return oracle rows"
+    finally:
+        failpoint.disable("delta-merge-stale")
+    # disarmed: the extension engages and keeps answering the oracle
+    rows2 = s.query(Q).rows
+    assert rows2 == oracle
+    ent = _entry(eng)
+    assert ent.is_delta and ent.delta_rows == 1
+
+
+def test_compaction_rebuilds_and_drops_delta():
+    eng, s = _engine()
+    s.vars["tidb_tpu_delta_compact_rows"] = 4
+    s.query(Q)
+    for i in range(5):
+        s.query(f"INSERT INTO t VALUES ({i % 40}, {i * 7 % 5000}, 'k1')")
+    s.query(Q)
+    assert _entry(eng).is_delta
+    assert delta.pending_compactions() >= 1
+    oracle = _oracle(s)
+    assert delta.run_pending_compactions() == 1
+    ent = _entry(eng)
+    assert not ent.is_delta, "compaction must fold the delta into base"
+    assert ent.delta_rows == 0 and not any(
+        len(v) for v in getattr(ent, "tomb", {}).values())
+    assert s.query(Q).rows == oracle
+    key = ("tidb_tpu_compactions_total",
+           (("table", str(eng.catalog.info_schema.table("t").id)),))
+    assert REGISTRY.counters.get(key, 0) >= 1
+
+
+def test_compaction_commit_fault_old_generation_serves():
+    eng, s = _engine()
+    s.vars["tidb_tpu_delta_compact_rows"] = 4
+    s.query(Q)
+    s.query("DELETE FROM t WHERE b % 499 = 7")   # tombstones too
+    for i in range(5):
+        s.query(f"INSERT INTO t VALUES ({i % 40}, {i * 7 % 5000}, 'k1')")
+    warm = s.query(Q).rows
+    ent0 = _entry(eng)
+    assert ent0.is_delta and delta.pending_compactions() >= 1
+    failpoint.enable("compaction-commit",
+                     raise_=RuntimeError("chaos: compaction fault"))
+    try:
+        assert delta.run_pending_compactions() == 0
+    finally:
+        failpoint.disable("compaction-commit")
+    assert failpoint.hits("compaction-commit") > 0
+    # the old base+delta generation is UNTOUCHED and serves byte-exactly
+    assert _entry(eng) is ent0
+    assert s.query(Q).rows == warm == _oracle(s)
+    # the next extension past the threshold re-schedules — compaction
+    # HEALS once the fault clears
+    s.query("INSERT INTO t VALUES (9, 99, 'k0')")
+    s.query(Q)
+    assert delta.pending_compactions() >= 1
+    assert delta.run_pending_compactions() == 1
+    ent2 = _entry(eng)
+    assert not ent2.is_delta
+    assert s.query(Q).rows == _oracle(s)
+
+
+def test_compaction_skips_fresh_and_evicted_entries():
+    eng, s = _engine()
+    s.vars["tidb_tpu_delta_compact_rows"] = 1
+    s.query(Q)
+    s.query("INSERT INTO t VALUES (3, 1234, 'k2')")
+    s.query(Q)
+    assert delta.pending_compactions() == 1
+    dc.clear()                      # entry evicted before the drain runs
+    assert delta.run_pending_compactions() == 0, \
+        "an evicted entry must not be rebuilt behind the cache's back"
+
+
+def test_invalidation_frees_delta_device_arrays():
+    """Satellite: evicting a delta generation must jax.Array.delete()
+    the delta-slab and rewritten-keep arrays too — device memory for a
+    dropped generation is freed NOW, not at GC time."""
+    eng, s = _engine()
+    s.query(Q)
+    s.query("DELETE FROM t WHERE b % 97 = 3")
+    s.query("INSERT INTO t VALUES (3, 1234, 'k2')")
+    s.query(Q)
+    ent = _entry(eng)
+    assert ent.is_delta
+    arrays = [a for slabs in ent.dev.values() for t in slabs
+              if t is not None for a in t]
+    assert arrays
+    tid = eng.catalog.info_schema.table("t").id
+    dc.invalidate(tid)
+    leaked = [a for a in arrays if not a.is_deleted()]
+    assert not leaked, \
+        f"{len(leaked)} delta-generation arrays survived invalidation"
+
+
+def test_delta_rows_in_phase_accounting():
+    eng, s = _engine()
+    s.query(Q)
+    s.query("INSERT INTO t VALUES (3, 1234, 'k2')")
+    s.query(Q)
+    ph = s.last_guard.phases
+    assert ph.as_dict().get("delta_rows", 0) == 1

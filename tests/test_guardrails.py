@@ -408,3 +408,29 @@ def test_chaos_sweep_mesh_contract(eight_devices):
     assert not report["failures"], report["failures"]
     assert report["scenarios"] >= 3
     assert not report["gated_unreached"], report["gated_unreached"]
+
+
+@pytest.mark.chaos
+def test_check_failpoints_clean_on_repo_and_catches_drift(tmp_path):
+    """The failpoint drift lint (tools/check_failpoints.py) the sweep
+    runs as preflight: clean on this repo, and it actually catches both
+    drift directions on a synthetic bad file."""
+    import importlib.util
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "check_failpoints", os.path.join(repo, "tools",
+                                         "check_failpoints.py"))
+    cf = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cf)
+    assert cf.run(repo) == []
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        'from tidb_tpu.util import failpoint\n'
+        'failpoint.inject("never-registered-site")\n'
+        'failpoint.inject(some_variable)\n')
+    inj, dyn, reg, strings, errs = cf.scan_file(str(bad))
+    assert errs == []
+    assert inj == [("never-registered-site", 2)]
+    assert dyn == [3]
+    assert "never-registered-site" in strings

@@ -188,3 +188,51 @@ def test_warm_selective_scan_launches_only_surviving_slabs():
                     if e.get("ph") != "M" and e["cat"] == "compute"])
 
     assert compute_spans(full) - compute_spans(sel) == ph.slabs_skipped
+
+
+def test_warm_read_after_appends_no_base_reupload_one_extra_launch(session):
+    """The HTAP write-path pin: K single-row appends between two warm
+    reads must cost the reader ONE delta-slab upload and at most ONE
+    extra program launch — ZERO base slabs re-encoded or re-uploaded
+    (they are shared by identity across delta generations), and the
+    second warm read uploads nothing at all."""
+    eng, s = session
+    s.vars["tidb_tpu_compaction"] = "off"     # no async rebuild mid-test
+    s.query(SQL)                               # cold: trace + first touch
+    s.query(SQL)                               # warm baseline
+    base_launches = s.last_guard.phases.programs_launched
+    ent = _entry(eng)
+    n_base = ent.base_slabs
+    base_ids = {i: [id(t[0]) for t in slabs[:n_base] if t is not None]
+                for i, slabs in ent.dev.items()}
+
+    K = 4
+    for k in range(K):
+        # in-range values: a within the base FoR bounds, c in the base
+        # dictionary — the appends must EXTEND, not rebuild
+        s.query(f"INSERT INTO p VALUES ({40 + k}, 0.5, 'ant')")
+
+    rows = s.query(SQL).rows                   # pays the one delta upload
+    ent2 = _entry(eng)
+    assert ent2.is_delta and ent2.delta_rows == K, \
+        "appends must ride the delta extension, not a rebuild"
+    for i, ids in base_ids.items():
+        now = [id(t[0]) for t in ent2.dev[i][:n_base] if t is not None]
+        assert now == ids, f"column {i} base slabs re-uploaded"
+    ph = s.last_guard.phases
+    assert ph.programs_launched <= base_launches + 1, \
+        (f"delta merge cost {ph.programs_launched - base_launches} "
+         f"extra launches (max 1: the delta-slab partial)")
+
+    rows2 = s.query(SQL).rows                  # fully warm again
+    ph2 = s.last_guard.phases
+    assert ph2.h2d_bytes == 0 and ph2.as_dict()["upload_s"] == 0.0, \
+        "second warm read after appends must upload nothing"
+    assert ph2.programs_launched <= base_launches + 1
+    assert sorted(map(str, rows2)) == sorted(map(str, rows))
+    # and the rows are RIGHT: the appended 'ant' rows are visible
+    got = {r[0]: r[1] for r in rows}
+    s.vars["tidb_tpu_engine"] = "off"
+    want = {r[0]: r[1] for r in s.query(SQL).rows}
+    s.vars["tidb_tpu_engine"] = "on"
+    assert got == want

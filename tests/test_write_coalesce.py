@@ -1,0 +1,229 @@
+"""Coalesced single-row ingest (session/writebatch.py).
+
+N queued same-digest autocommit writes rendezvous behind the
+per-(store, table) commit gate and commit as ONE transaction — one
+`delta-append` crossing, one store version bump, one delta extension
+for every reader. These tests pin:
+
+* the solo path: INSERT/UPDATE/DELETE through the coalesced gate stay
+  byte-identical to the individual write path (affected_rows, typed
+  duplicate-key errors, table state);
+* the rendezvous: N concurrent writers parked behind a held commit gate
+  produce exactly ONE version bump, and every member's row lands;
+* per-member error isolation: a duplicate-key member gets ITS OWN typed
+  1062 while its batch siblings commit exactly once;
+* the lifecycle contract (the satellite): KILL (1317) and a
+  max_execution_time deadline (3024) landing on a QUEUED member
+  surface the victim's OWN typed error, its write is NEVER applied,
+  and the surviving members still commit exactly once — a follow-up
+  read sees their rows and not the victim's;
+* a commit-time fault (`delta-append`, non-retryable) fails every
+  applied member with the SAME typed error and the store version stays
+  put — all-or-nothing, never torn.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tidb_tpu.errors import TiDBTPUError, TxnError
+from tidb_tpu.session import Engine, writebatch
+from tidb_tpu.util import failpoint
+from tidb_tpu.util.guard import PROCESS_REGISTRY
+from tidb_tpu.util.observability import REGISTRY
+
+
+def _engine():
+    eng = Engine()
+    eng.global_vars["tidb_enable_auto_analyze"] = False
+    s = eng.new_session()
+    s.execute("CREATE TABLE t (a BIGINT PRIMARY KEY, b BIGINT)")
+    s.execute("INSERT INTO t VALUES (1, 10), (2, 20)")
+    return eng, s
+
+
+def _counter(name):
+    return sum(v for (n, _), v in REGISTRY.counters.items() if n == name)
+
+
+def _spawn_writers(eng, stmts, wait_parked, timeout=5.0):
+    """Start one session+thread per statement while the caller holds the
+    commit gate; wait until `wait_parked` followers are queued. →
+    (threads, sessions, results, errors)."""
+    n = len(stmts)
+    sessions = [eng.new_session() for _ in range(n)]
+    results: list = [None] * n
+    errors: list = [None] * n
+
+    def run(i):
+        try:
+            results[i] = sessions[i].query(stmts[i]).affected_rows
+        except TiDBTPUError as e:
+            errors[i] = e
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(n)]
+    for i, th in enumerate(threads):
+        th.start()
+        if i == 0:
+            # the first arrival must own the batch (leader) before the
+            # followers join, so membership is deterministic
+            deadline = time.monotonic() + timeout
+            while not any(
+                    k[2] for k in list(writebatch._BATCHES)) and \
+                    time.monotonic() < deadline:
+                time.sleep(0.002)
+    deadline = time.monotonic() + timeout
+    while writebatch.queued_members() < wait_parked and \
+            time.monotonic() < deadline:
+        time.sleep(0.002)
+    assert writebatch.queued_members() >= wait_parked, \
+        "followers never parked on the batch"
+    return threads, sessions, results, errors
+
+
+def test_solo_writes_through_the_gate():
+    eng, s = _engine()
+    assert s.query("INSERT INTO t VALUES (3, 30)").affected_rows == 1
+    assert s.query("UPDATE t SET b = 31 WHERE a = 3").affected_rows == 1
+    assert s.query("SELECT b FROM t WHERE a = 3").rows == [(31,)]
+    assert s.query("DELETE FROM t WHERE a = 3").affected_rows == 1
+    assert s.query("SELECT COUNT(*) FROM t").rows == [(2,)]
+    with pytest.raises(TiDBTPUError) as ei:
+        s.query("INSERT INTO t VALUES (1, 99)")
+    assert ei.value.code == 1062
+    assert s.query("SELECT COUNT(*) FROM t").rows == [(2,)]
+
+
+def test_rendezvous_one_commit_for_n_writers():
+    eng, s = _engine()
+    info = eng.catalog.info_schema.table("t")
+    gate = writebatch.commit_gate(eng.store, info.id)
+    v0, b0 = eng.store.version, _counter("tidb_tpu_write_batches_total")
+    m0 = _counter("tidb_tpu_write_members_total")
+    N = 6
+    gate.acquire()
+    try:
+        threads, _sessions, results, errors = _spawn_writers(
+            eng, [f"INSERT INTO t VALUES ({100 + i}, {i})"
+                  for i in range(N)], wait_parked=N - 1)
+    finally:
+        gate.release()
+    for th in threads:
+        th.join(10)
+    assert results == [1] * N and errors == [None] * N
+    assert eng.store.version - v0 == 1, \
+        "N coalesced writers must bump the version ONCE"
+    assert _counter("tidb_tpu_write_batches_total") - b0 == 1
+    assert _counter("tidb_tpu_write_members_total") - m0 == N
+    assert s.query("SELECT COUNT(*) FROM t WHERE a >= 100").rows == [(N,)]
+
+
+def test_member_error_isolation_duplicate_key():
+    eng, s = _engine()
+    info = eng.catalog.info_schema.table("t")
+    gate = writebatch.commit_gate(eng.store, info.id)
+    v0 = eng.store.version
+    gate.acquire()
+    try:
+        threads, _sessions, results, errors = _spawn_writers(
+            eng, ["INSERT INTO t VALUES (200, 7)",
+                  "INSERT INTO t VALUES (1, 7)",     # collides with seed
+                  "INSERT INTO t VALUES (201, 7)",
+                  "INSERT INTO t VALUES (202, 7)"], wait_parked=3)
+    finally:
+        gate.release()
+    for th in threads:
+        th.join(10)
+    assert results[0] == results[2] == results[3] == 1
+    assert results[1] is None and errors[1].code == 1062
+    assert eng.store.version - v0 == 1, "survivors commit exactly once"
+    assert s.query("SELECT COUNT(*) FROM t WHERE a IN (200, 201, 202)"
+                   ).rows == [(3,)]
+    assert s.query("SELECT b FROM t WHERE a = 1").rows == [(10,)]
+
+
+@pytest.mark.parametrize("mode", ["kill", "deadline"])
+def test_victim_of_queued_member_kill_and_deadline(mode):
+    """Satellite: KILL / max_execution_time against a QUEUED coalesced
+    write. The victim gets its OWN typed error (1317 / 3024), its row is
+    never applied, and the survivors commit exactly once."""
+    eng, s = _engine()
+    info = eng.catalog.info_schema.table("t")
+    gate = writebatch.commit_gate(eng.store, info.id)
+    v0 = eng.store.version
+    gate.acquire()
+    try:
+        threads, sessions, results, errors = _spawn_writers(
+            eng, [f"INSERT INTO t VALUES ({300 + i}, {i})"
+                  for i in range(4)], wait_parked=3)
+        # threads[0] is the leader (blocked on the held gate); pick a
+        # parked FOLLOWER as the victim
+        victim = sessions[1]
+        if mode == "kill":
+            assert PROCESS_REGISTRY.kill(victim.conn_id, query_only=True)
+            want_code = 1317
+        else:
+            # writes never arm an execute() deadline; model the
+            # max_execution_time expiry by expiring the statement's
+            # guard directly while it is parked
+            g = PROCESS_REGISTRY.info(victim.conn_id)["guard"]
+            assert g is not None
+            g.deadline = time.monotonic() - 0.001
+            want_code = 3024
+        threads[1].join(10)
+        assert not threads[1].is_alive(), "victim did not unwind"
+        assert errors[1] is not None and errors[1].code == want_code, \
+            (errors[1], getattr(errors[1], "code", None))
+        # the victim left the batch before the leader could claim it
+        assert writebatch.queued_members() == 2
+    finally:
+        gate.release()
+    for th in threads:
+        th.join(10)
+    assert results[0] == results[2] == results[3] == 1
+    assert all(e is None for i, e in enumerate(errors) if i != 1)
+    assert eng.store.version - v0 == 1, "survivors commit exactly once"
+    # follow-up read: survivors' rows landed, the victim's never did
+    assert s.query("SELECT a FROM t WHERE a >= 300 ORDER BY a"
+                   ).rows == [(300,), (302,), (303,)]
+
+
+def test_commit_fault_fails_all_members_atomically():
+    eng, s = _engine()
+    info = eng.catalog.info_schema.table("t")
+    gate = writebatch.commit_gate(eng.store, info.id)
+    v0 = eng.store.version
+    failpoint.enable("delta-append",
+                     raise_=TxnError("chaos: commit fault"), times=1)
+    gate.acquire()
+    try:
+        threads, _sessions, results, errors = _spawn_writers(
+            eng, [f"INSERT INTO t VALUES ({400 + i}, {i})"
+                  for i in range(3)], wait_parked=2)
+    finally:
+        gate.release()
+    for th in threads:
+        th.join(10)
+    failpoint.disable("delta-append")
+    assert results == [None] * 3, "a torn batch must not half-commit"
+    assert all(e is not None and isinstance(e, TiDBTPUError)
+               for e in errors), errors
+    assert eng.store.version == v0, "version must stay put on a fault"
+    assert s.query("SELECT COUNT(*) FROM t WHERE a >= 400").rows == [(0,)]
+    # the session and the table stay usable afterwards
+    assert s.query("INSERT INTO t VALUES (400, 0)").affected_rows == 1
+
+
+def test_coalesce_off_falls_back_to_individual_commits():
+    eng, s = _engine()
+    v0 = eng.store.version
+    sessions = [eng.new_session() for _ in range(3)]
+    for ss in sessions:
+        ss.vars["tidb_tpu_write_coalesce"] = "off"
+    for i, ss in enumerate(sessions):
+        assert ss.query(
+            f"INSERT INTO t VALUES ({500 + i}, 1)").affected_rows == 1
+    assert eng.store.version - v0 == 3, \
+        "coalescing off: every write commits alone"
+    assert s.query("SELECT COUNT(*) FROM t WHERE a >= 500").rows == [(3,)]

@@ -478,3 +478,81 @@ def emit_batched(partial_fn):
                                                  stacked_preps)
 
     return jax.jit(batched)
+
+
+# ---------------------------------------------------------------------------
+# delta merge — tombstone compaction of one resident slab, in-trace
+# ---------------------------------------------------------------------------
+
+_DELTA_MERGE_CACHE: dict = {}
+
+
+def _emit_pack_codes(codes, width: int, cap: int):
+    """Traced inverse of compress._pack_codes: uint32 codes (< 2^width)
+    → packed uint32 words, byte-identical to the host encoder. Codes
+    occupy disjoint bit ranges of their word, so the reduction is a
+    plain sum — no carries can occur."""
+    from tidb_tpu.ops.jax_env import jnp
+    per = 32 // width
+    n_words = -(-cap // per)
+    c = codes.astype(jnp.uint32).reshape(n_words, per)
+    shifts = (jnp.arange(per) * width).astype(jnp.uint32)
+    return jnp.sum(c << shifts[None, :], axis=1, dtype=jnp.uint32)
+
+
+def emit_delta_merge(layout, slab, keep, n_new: int, cap: int):
+    """Apply a tombstone set to ONE device-resident slab as a single XLA
+    program: stable-permute the surviving rows to the front (base row
+    order is preserved, so decoded values stay positionally aligned with
+    every other column of the slab) and re-establish the prefix-liveness
+    invariant (`rows < n_new` are live, the tail is padding).
+
+    Composes with the compressed layouts the same way emit_decode does —
+    packed columns unpack, permute and REPACK entirely in-trace, so raw
+    bytes never materialize in HBM and the rewritten slab is
+    byte-compatible with the host encoder (zeroed codes and a zeroed
+    validity tail beyond n_new, exactly like compress.pack_slab pads).
+
+    layout: the column's ColLayout or None (raw). slab: the resident
+    device tuple. keep: bool (cap,) — True for rows that survive
+    (already False at and beyond n_cur). Delta-kind layouts are the
+    caller's responsibility to reject: their codes are successive
+    diffs, which a permutation invalidates."""
+    from tidb_tpu.chunk import compress
+    from tidb_tpu.ops.jax_env import jax, jnp
+    kind = "raw" if layout is None else layout.kind
+    width = 0 if layout is None else layout.width
+    wide = layout is None and getattr(slab[0], "ndim", 1) == 2
+    ckey = (kind, width, cap, wide)
+
+    fn = _DELTA_MERGE_CACHE.get(ckey)
+    if fn is None:
+        def _rewrite(vals_or_words, mask_or_words, keep_dev, n_new_dev):
+            iota = jnp.arange(cap, dtype=jnp.int32)
+            perm = jnp.argsort(~keep_dev, stable=True)
+            live_new = iota < n_new_dev
+            if kind == "raw":
+                v = jnp.take(jnp.asarray(vals_or_words), perm, axis=-1)
+                m = jnp.take(jnp.asarray(mask_or_words), perm) & live_new
+                return v, m
+            mb = compress._unpack_codes(mask_or_words, 1, cap, jnp) != 0
+            mb = jnp.take(mb, perm) & live_new
+            mwords = _emit_pack_codes(mb.astype(jnp.uint32), 1, cap)
+            if width == 0:
+                # nothing stored but the stub — only the mask rewrites
+                return jnp.asarray(vals_or_words), mwords
+            codes = compress._unpack_codes(vals_or_words, width, cap, jnp)
+            codes = jnp.where(live_new, jnp.take(codes, perm),
+                              jnp.uint32(0))
+            return _emit_pack_codes(codes, width, cap), mwords
+
+        fn = _DELTA_MERGE_CACHE[ckey] = jax.jit(_rewrite)
+
+    out_v, out_m = fn(slab[0], slab[1], jnp.asarray(keep),
+                      jnp.int32(n_new))
+    if layout is not None and kind == "dict":
+        return (out_v, out_m, slab[2])     # shared dictvals ride along
+    if layout is not None and kind == "delta":
+        raise AssertionError("delta-kind layouts cannot be rewritten "
+                             "in place (diff codes)")
+    return (out_v, out_m)

@@ -1943,7 +1943,8 @@ class TpuFragmentExec:
             # compares the stored lay_sig and drops mismatches
             skey = _spec_key(
                 getattr(self.ctx, "guard", None), "tree",
-                (tuple((id(e.td), e.slab_cap, e.n_slabs) for e, _ in ents),
+                (tuple((id(e.td), getattr(e, "delta_version", 0),
+                        e.slab_cap, e.n_slabs) for e, _ in ents),
                  anchor_i, repr(akb), want_pairs, use_fin,
                  _order_sig(order_root) if order_root is not None
                  else None))
@@ -3001,8 +3002,8 @@ class TpuFragmentExec:
             # _spec_lookup matches the stored lay_sig and evicts on drift
             skey = _spec_key(
                 getattr(self.ctx, "guard", None), "chain",
-                (id(ent.td), slab_cap, n_slabs,
-                 repr(key_bounds), want_pairs, use_fin,
+                (id(ent.td), getattr(ent, "delta_version", 0), slab_cap,
+                 n_slabs, repr(key_bounds), want_pairs, use_fin,
                  _order_sig(order_root) if order_root is not None
                  else None))
         spec = _spec_lookup(skey, lay_sig)

@@ -89,13 +89,19 @@ def queued_members() -> int:
 
 
 def batch_key(guard, sig: str, ent, slab_ids) -> tuple:
-    """(digest, value-free signature, table version, survivor slabs).
-    The signature already pins the chain shape, column types, layout
-    set and slab geometry; `id(ent.td)` is the table-version token
-    (writes rebuild the TableData), and the zone-map survivor set must
-    match because members share one launch per surviving slab."""
+    """(digest, value-free signature, table + delta version, survivor
+    slabs). The signature already pins the chain shape, column types,
+    layout set and slab geometry; `id(ent.td)` is the table-version
+    token (writes rebuild the TableData) and `delta_version` is the
+    store's monotonic commit version the entry serves — id() alone is
+    an ABA hazard now that delta extension installs a NEW entry for a
+    NEW TableData whose id may be recycled, and a write landing between
+    rendezvous and launch must never serve stale rows to the whole
+    batch. The zone-map survivor set must match because members share
+    one launch per surviving slab."""
     digest = normalize_sql(getattr(guard, "sql", "") or "")
-    return (digest, sig, id(ent.td), tuple(slab_ids))
+    return (digest, sig, id(ent.td), getattr(ent, "delta_version", 0),
+            tuple(slab_ids))
 
 
 def execute(exec_, prog, root, ent, dicts, prep_vals, slab_ids, sig,

@@ -140,7 +140,13 @@ def prune_slabs(ent, scan) -> frozenset:
     stale = failpoint.inject("zone-map-stale")
     if stale is not None:
         raise LayoutError(f"zone map failed validation: {stale}")
-    n_slabs = ent.n_slabs
+    # delta generations: evaluate over the BASE slabs only — the zone
+    # maps were built at base-build time, so their stats are stale but
+    # conservative for tombstone-compacted slabs (a removed row only
+    # shrinks the true range, so the stale superset prunes strictly
+    # less), and the appended-delta slab carries no stats at all, so it
+    # is never pruned
+    n_slabs = min(ent.n_slabs, getattr(ent, "base_slabs", ent.n_slabs))
     pruned = np.zeros(n_slabs, dtype=bool)
     for f in filters:
         mask = _prune_mask(f, ent, scan, n_slabs)

@@ -75,6 +75,18 @@ DEFAULT_VARS: Dict[str, object] = {
     # one admission queue per visible device with round-robin placement
     # (SchedulerPool); off = every statement shares the device-0 queue
     "tidb_tpu_device_queues": "off",
+    # coalesced single-row ingest (session/writebatch.py): N queued
+    # same-digest autocommit writes share ONE commit — readers pay one
+    # delta extension instead of N; off = every write commits alone
+    "tidb_tpu_write_coalesce": "on",
+    # async compaction of delta-extended cache entries (executor/
+    # delta.py): rebuild base slabs with re-chosen layouts in idle
+    # batch-class slots; off = deltas accumulate until a test/bench
+    # drains them via delta.run_pending_compactions()
+    "tidb_tpu_compaction": "on",
+    # delta rows (appends + tombstones) a cached table tolerates before
+    # a compaction job is scheduled
+    "tidb_tpu_delta_compact_rows": 1024,
 }
 
 
@@ -1453,6 +1465,27 @@ class Session:
         else:
             chunk = self._rows_chunk(stmt, info, names)
         chunk = self._fill_auto_increment(info, chunk)
+        if self.txn is None and stmt.select is None and chunk.num_rows == 1:
+            # autocommit single-row INSERT: eligible for the coalesced
+            # write batch (session/writebatch.py) — N queued same-digest
+            # writers share ONE commit, so readers pay one delta
+            # extension instead of N. The closure follows the
+            # validate-then-stage discipline below exactly: a typed
+            # failure leaves the shared transaction untouched.
+            from tidb_tpu.session import writebatch
+
+            def _stage(txn, _chunk=chunk):
+                self._note_touched(txn, info)
+                self._validate_routing(info, _chunk)
+                kept = self._enforce_unique(info, _chunk, txn,
+                                            ignore=stmt.ignore,
+                                            replace=stmt.replace)
+                self._append_routed(txn, info, kept)
+                return kept.num_rows
+
+            n = writebatch.coalesce(self, info.id, _stage)
+            if n is not None:
+                return ok(n)
         txn, auto = self._write_txn()
         self._note_touched(txn, info)
         try:
@@ -1728,6 +1761,27 @@ class Session:
 
     def _delete(self, stmt: ast.Delete) -> ResultSet:
         info = self.engine.catalog.info_schema.table(stmt.table.name)
+        if self.txn is None:
+            # autocommit DELETE: coalesce-eligible (matching runs inside
+            # the shared transaction, so members see one another's
+            # staged effects in arrival order — sequential semantics)
+            from tidb_tpu.session import writebatch
+
+            def _stage(txn):
+                self._note_touched(txn, info)
+                region_masks, staged_keep, _ = self._match_masks(
+                    info, stmt.where, txn)
+                n = sum(int(m.sum()) for m in region_masks.values())
+                n += sum(int((~k).sum()) for k in staged_keep)
+                if region_masks:
+                    txn.delete(info.id, region_masks)
+                if staged_keep:
+                    txn.delete_staged(info.id, np.concatenate(staged_keep))
+                return n
+
+            n = writebatch.coalesce(self, info.id, _stage)
+            if n is not None:
+                return ok(n)
         txn, auto = self._write_txn()
         self._note_touched(txn, info)
         try:
@@ -1762,6 +1816,47 @@ class Session:
         for name, expr in stmt.assignments:
             info.column(name)  # validates the column exists
             assigns[name.lower()] = rw.rewrite(expr)
+        exprs = []
+        for i, c in enumerate(info.columns):
+            e = assigns.get(c.name.lower())
+            if e is None:
+                exprs.append(schema.column_ref(i))
+            elif (e.ftype.kind != c.ftype.kind or
+                  e.ftype.scale != c.ftype.scale):
+                exprs.append(_cast(e, c.ftype))
+            else:
+                exprs.append(e)
+        if self.txn is None:
+            # autocommit UPDATE: coalesce-eligible (see _insert); the
+            # delete+append pair stages only after NOT NULL + routing
+            # validation, so a typed failure stays member-local
+            from tidb_tpu.session import writebatch
+
+            def _stage(txn):
+                self._note_touched(txn, info)
+                region_masks, staged_keep, matched = self._match_masks(
+                    info, stmt.where, txn)
+                if not matched:
+                    return 0
+                old = Chunk.concat(matched) if len(matched) > 1 \
+                    else matched[0]
+                new_chunk = eval_on_chunk(exprs, old)
+                new_chunk = Chunk([Column(c.ftype, col.values,
+                                          col.validity)
+                                   for c, col in zip(info.columns,
+                                                     new_chunk.columns)])
+                _check_not_null_chunk(new_chunk, info)
+                self._validate_routing(info, new_chunk)
+                if region_masks:
+                    txn.delete(info.id, region_masks)
+                if staged_keep:
+                    txn.delete_staged(info.id, np.concatenate(staged_keep))
+                self._append_routed(txn, info, new_chunk)
+                return new_chunk.num_rows
+
+            n = writebatch.coalesce(self, info.id, _stage)
+            if n is not None:
+                return ok(n)
         txn, auto = self._write_txn()
         self._note_touched(txn, info)
         try:
@@ -1776,16 +1871,6 @@ class Session:
                     txn.commit()
                 return ok(0)
             old = Chunk.concat(matched) if len(matched) > 1 else matched[0]
-            exprs = []
-            for i, c in enumerate(info.columns):
-                e = assigns.get(c.name.lower())
-                if e is None:
-                    exprs.append(schema.column_ref(i))
-                elif (e.ftype.kind != c.ftype.kind or
-                      e.ftype.scale != c.ftype.scale):
-                    exprs.append(_cast(e, c.ftype))
-                else:
-                    exprs.append(e)
             new_chunk = eval_on_chunk(exprs, old)
             new_chunk = Chunk([Column(c.ftype, col.values, col.validity)
                                for c, col in zip(info.columns,

@@ -248,6 +248,13 @@ class Store:
         with self._lock:
             return Snapshot(dict(self._tables), self._version, self)
 
+    @property
+    def version(self) -> int:
+        """Monotonic commit version — bumps on every applied write/DDL.
+        The device cache stamps it on each generation (delta version)."""
+        with self._lock:
+            return self._version
+
     # ---- writes (autocommit fast path) -----------------------------------
     def append(self, table_id: int, chunk: Chunk,
                part: Optional[int] = None) -> None:
@@ -430,6 +437,13 @@ class Store:
             try:
                 failpoint.inject("store-commit")
                 failpoint.inject("commit-conflict")
+                # two-phase delta append: everything above is staging
+                # (host-side, txn-private); the locked block below is the
+                # atomic apply+version-bump. A fault HERE — the boundary —
+                # either heals through the retry loop (retryable) or
+                # surfaces typed with the old delta version intact; it can
+                # never leave a torn delta because nothing is applied yet.
+                failpoint.inject("delta-append")
                 with self._lock:
                     # first-committer-wins: validate EVERYTHING before
                     # applying anything, so a conflict leaves no partial

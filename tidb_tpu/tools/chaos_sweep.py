@@ -231,6 +231,39 @@ def _scenarios(mesh: Optional[int] = None) -> List[Scenario]:
                  dict(raise_=RuntimeError("chaos: demux"), times=1),
                  run="microbatch",
                  vars={**device_on, "tidb_tpu_microbatch_max": "8"}),
+        # -- HTAP write path (delta slabs) --------------------------------
+        # a transient fault at the two-phase delta append's atomic apply
+        # point: the commit backoff loop retries and the write lands
+        # exactly once (the post-scenario count probe asserts that)
+        Scenario("delta append transient fault (heals)", "delta-append",
+                 dict(raise_=_retryable_txn("chaos: delta append"),
+                      times=2),
+                 run="write",
+                 extra={"backoff-sleep": dict(value="skip")}),
+        # a hard fault at the same boundary: ONE typed error surfaces
+        # with the old delta version intact — the count probe proves the
+        # append was never torn (all-or-nothing)
+        Scenario("delta append hard fault → typed, never torn",
+                 "delta-append",
+                 dict(raise_=TxnError("chaos: torn append"), times=1),
+                 run="write"),
+        # a diff/encode fault at the delta-extension entry while a
+        # cached table is stale: typed LayoutError → warned CPU
+        # fallback, still the oracle answer — never a wrong merge
+        Scenario("delta merge stale → CPU fallback", "delta-merge-stale",
+                 dict(value="chaos: stale diff", times=9),
+                 run="delta", vars=dict(device_on)),
+        # a fault at the compaction's atomic install point: the rebuilt
+        # generation is abandoned (buffers deleted) and the old
+        # base+delta keeps serving byte-exactly; once the fault clears,
+        # the next extension re-schedules and the compaction heals
+        Scenario("compaction commit fault → old generation serves",
+                 "compaction-commit",
+                 dict(raise_=RuntimeError("chaos: compaction fault"),
+                      times=1),
+                 run="compact",
+                 vars={**device_on, "tidb_tpu_delta_compact_rows": "4",
+                       "tidb_tpu_compaction": "off"}),
         # -- DDL -----------------------------------------------------------
         Scenario("unique backfill dies mid-reorg", "index-backfill",
                  dict(raise_=ExecutionError("chaos: backfill"), times=1),
@@ -669,6 +702,97 @@ def run_sweep(verbose: bool = False, mesh: Optional[int] = None,
                         failures.append(
                             f"{sc.name}: demux faulted but no fallback "
                             f"was recorded")
+            elif sc.run == "delta":
+                # warm the device cache, then commit an IN-RANGE row so
+                # the next device read must extend the stale entry —
+                # with the diff fault armed the extension must fall back
+                # warned and still answer the post-write CPU oracle
+                q = QUERIES[0]
+                s.query(q)
+                write_seq += 1
+                _, werr, _ = _run_statement(
+                    s, f"insert into cs_facts values "
+                       f"(500, {write_seq % 8}, 'dl{write_seq}', 0.0)")
+                if werr is not None:
+                    failures.append(f"{sc.name}: fixture write failed "
+                                    f"{werr}")
+                else:
+                    base_count += 1
+                eng_saved = s.vars.get("tidb_tpu_engine")
+                s.vars["tidb_tpu_engine"] = "off"
+                cpu = s.query(q).rows
+                s.vars["tidb_tpu_engine"] = eng_saved
+                rows, err, dt = _run_statement(s, q)
+                if dt > DEADLINE_S:
+                    slow += 1
+                    failures.append(f"{sc.name}: {q!r} took {dt:.1f}s")
+                if err is not None:
+                    errors += 1
+                    failures.append(
+                        f"{sc.name}: {q!r} must fall back, not fail: "
+                        f"{type(err).__name__}: {err}")
+                elif rows != cpu:
+                    wrong += 1
+                    failures.append(f"{sc.name}: {q!r} SILENT WRONG RESULT")
+            elif sc.run == "compact":
+                from tidb_tpu.executor import delta as _delta
+                q = QUERIES[0]
+                s.query(q)
+                # pile IN-RANGE appends past the squeezed threshold so
+                # the next read's extension schedules a compaction job
+                for _i in range(4):
+                    write_seq += 1
+                    _, werr, _ = _run_statement(
+                        s, f"insert into cs_facts values "
+                           f"(501, {write_seq % 8}, 'cp{write_seq}', 0.0)")
+                    if werr is None:
+                        base_count += 1
+                s.query(q)
+                if _delta.pending_compactions() == 0:
+                    failures.append(
+                        f"{sc.name}: extension never scheduled a "
+                        f"compaction job")
+                committed = _delta.run_pending_compactions()
+                if committed != 0:
+                    failures.append(
+                        f"{sc.name}: compaction committed THROUGH an "
+                        f"armed commit fault")
+                eng_saved = s.vars.get("tidb_tpu_engine")
+                s.vars["tidb_tpu_engine"] = "off"
+                cpu = s.query(q).rows
+                s.vars["tidb_tpu_engine"] = eng_saved
+                rows, err, dt = _run_statement(s, q)
+                if err is not None:
+                    errors += 1
+                    failures.append(
+                        f"{sc.name}: old generation failed to serve: "
+                        f"{type(err).__name__}: {err}")
+                elif rows != cpu:
+                    wrong += 1
+                    failures.append(
+                        f"{sc.name}: old base+delta generation served "
+                        f"WRONG ROWS after an abandoned rebuild")
+                # fault clears → the next extension re-schedules and the
+                # compaction HEALS
+                failpoint.disable(sc.site)
+                write_seq += 1
+                _, werr, _ = _run_statement(
+                    s, f"insert into cs_facts values "
+                       f"(502, {write_seq % 8}, 'cp{write_seq}', 0.0)")
+                if werr is None:
+                    base_count += 1
+                s.query(q)
+                if _delta.run_pending_compactions() < 1:
+                    failures.append(
+                        f"{sc.name}: compaction did not heal after the "
+                        f"fault cleared")
+                s.vars["tidb_tpu_engine"] = "off"
+                cpu2 = s.query(q).rows
+                s.vars["tidb_tpu_engine"] = eng_saved
+                rows2, err2, _ = _run_statement(s, q)
+                if err2 is not None or rows2 != cpu2:
+                    failures.append(
+                        f"{sc.name}: compacted generation diverged")
             elif sc.run == "write":
                 write_seq += 1
                 ins = (f"insert into cs_facts values "
@@ -776,24 +900,28 @@ def main(argv=None) -> int:
     ap.add_argument("--mesh-only", action="store_true",
                     help="with --mesh: run ONLY the distributed scenarios")
     args = ap.parse_args(argv)
-    # metric-naming lint FIRST: a drifting metric name/label fails the
-    # sweep before any scenario spends wall time (tools/check_metrics.py)
+    # drift lints FIRST: a drifting metric name/label or a failpoint
+    # site missing from the catalog fails the sweep before any scenario
+    # spends wall time (tools/check_metrics.py, tools/check_failpoints.py
+    # — the latter is what keeps the coverage gate below trustworthy)
     import importlib.util as _ilu
     _repo = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "..", "..")
-    _cm_path = os.path.join(_repo, "tools", "check_metrics.py")
-    if os.path.exists(_cm_path):
-        _spec = _ilu.spec_from_file_location("check_metrics", _cm_path)
+    for _tool in ("check_metrics", "check_failpoints"):
+        _path = os.path.join(_repo, "tools", f"{_tool}.py")
+        if not os.path.exists(_path):
+            continue
+        _spec = _ilu.spec_from_file_location(_tool, _path)
         _cm = _ilu.module_from_spec(_spec)
         _spec.loader.exec_module(_cm)
         _problems = _cm.run(_repo)
         if _problems:
             for p in _problems:
                 print(p)
-            print(f"chaos sweep: metric lint failed "
+            print(f"chaos sweep: {_tool} lint failed "
                   f"({len(_problems)} violation(s))")
             return 1
-        print("chaos sweep: metric lint ok")
+        print(f"chaos sweep: {_tool} lint ok")
     t0 = time.monotonic()
     report = run_sweep(verbose=args.verbose, mesh=args.mesh or None,
                        mesh_only=args.mesh_only)

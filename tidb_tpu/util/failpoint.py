@@ -141,6 +141,22 @@ register("fused-finalize-overflow", "TopN / distinct-pair-cap validation "
          "through the resumable 'pairs' ladder rung, re-running only the "
          "slabs that clipped (executor/fragment.py _execute_agg / "
          "_run_fused_pipeline)")
+register("delta-append", "atomic apply point of a staged write — hit "
+         "inside Store.commit after validation, before the locked "
+         "apply+version bump; a retryable raise here heals through the "
+         "commit backoff loop, a non-retryable one surfaces typed with "
+         "the old delta version intact, never a torn delta "
+         "(storage/__init__.py Store.commit)")
+register("compaction-commit", "atomic install point of a compacted "
+         "device-cache generation — hit after the rebuilt base slabs are "
+         "resident, before the cache-slot swap; a raise here abandons the "
+         "rebuild (its buffers are deleted) and the old base+delta keep "
+         "serving reads byte-exactly (executor/delta.py)")
+register("delta-merge-stale", "entry of the incremental delta-extension "
+         "path when a cached table went stale — a raise here models a "
+         "diff/encode fault, which must surface as a typed LayoutError + "
+         "warned CPU fallback, never silent wrong rows "
+         "(executor/delta.py extend_entry)")
 register("microbatch-demux", "result de-multiplex of a same-plan "
          "micro-batch — hit after the batched program's fetch, before "
          "per-member rows are sliced off the leading batch axis; a raise "

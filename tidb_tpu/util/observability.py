@@ -159,6 +159,7 @@ class Registry:
                      "programs_launched": 0, "fused_pipelines": 0,
                      "specialization_hits": 0,
                      "slabs_skipped": 0, "h2d_skipped_bytes": 0,
+                     "delta_rows": 0,
                      "queue_wait_s": 0.0, "queue_waits": 0,
                      "queue_hist": _hist_new(),
                      "sched_class": None,
@@ -204,6 +205,7 @@ class Registry:
                 s["slabs_skipped"] += getattr(ph, "slabs_skipped", 0)
                 s["h2d_skipped_bytes"] += getattr(
                     ph, "h2d_skipped_bytes", 0)
+                s["delta_rows"] += getattr(ph, "delta_rows", 0)
                 for p, v in ph.seconds.items():
                     s["phase_s"][p] = s["phase_s"].get(p, 0.0) + v
             if seconds >= threshold:
@@ -286,6 +288,7 @@ class Registry:
                     "specialization_hits": s.get("specialization_hits", 0),
                     "slabs_skipped": s.get("slabs_skipped", 0),
                     "h2d_skipped_bytes": s.get("h2d_skipped_bytes", 0),
+                    "delta_rows": s.get("delta_rows", 0),
                     "queue_wait_s": round(s["queue_wait_s"], 6),
                     "queue_waits": s["queue_waits"],
                     "queue_p50_ms": round(

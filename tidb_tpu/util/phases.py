@@ -69,7 +69,8 @@ class PhaseTimer:
                  "programs_launched", "fused_pipelines",
                  "specialization_hits", "conn_id",
                  "h2d_logical_bytes", "scan_logical_bytes",
-                 "slabs_skipped", "h2d_skipped_bytes")
+                 "slabs_skipped", "h2d_skipped_bytes", "delta_rows",
+                 "_delta_seen")
 
     def __init__(self, conn_id: int = 0):
         self.seconds: Dict[str, float] = {p: 0.0 for p in PHASES}
@@ -93,6 +94,12 @@ class PhaseTimer:
         # never moved across PCIe
         self.slabs_skipped = 0
         self.h2d_skipped_bytes = 0
+        # delta-slab rows this statement's scans merged in-trace on top
+        # of the immutable base (executor/delta.py extensions); charged
+        # once per generation read — a statement may open the same
+        # cached entry several times (plan build, fragment execute)
+        self.delta_rows = 0
+        self._delta_seen = set()
         self.conn_id = conn_id    # timeline pid (0 = unattributed)
 
     @contextmanager
@@ -168,6 +175,17 @@ class PhaseTimer:
         assertion reads)."""
         self.h2d_skipped_bytes += int(n)
 
+    def note_delta_rows(self, n: int, token: int = None) -> None:
+        """This statement read a delta generation carrying `n` appended
+        live rows merged in-trace with the base slabs. `token` (the
+        generation's identity) dedupes repeat opens of the same entry
+        within one statement."""
+        if token is not None:
+            if token in self._delta_seen:
+                return
+            self._delta_seen.add(token)
+        self.delta_rows += int(n)
+
     def fetch(self, tree):
         """jax.device_get under the fetch phase, with the transferred
         bytes charged to d2h_bytes — the one chokepoint every result
@@ -203,6 +221,7 @@ class PhaseTimer:
         out["specialization_hits"] = self.specialization_hits
         out["slabs_skipped"] = self.slabs_skipped
         out["h2d_skipped_bytes"] = self.h2d_skipped_bytes
+        out["delta_rows"] = self.delta_rows
         return out
 
     def summary(self) -> str:
