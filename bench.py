@@ -423,7 +423,7 @@ def run_mix(eng, conc: int, total: int, section_budget_s: float):
     the wire server's threading model) pulling query indices from one
     shared counter — even index Q1, odd Q3. → (completed, wall seconds,
     scheduler stats over the window, [errors])."""
-    from tidb_tpu.executor.scheduler import SCHEDULER
+    from tidb_tpu.executor.scheduler import POOL
     sessions = []
     for _ in range(conc):
         ss = eng.new_session()
@@ -451,7 +451,7 @@ def run_mix(eng, conc: int, total: int, section_budget_s: float):
         except Exception as e:  # noqa: BLE001 — reported in the JSON
             errors.append(f"{type(e).__name__}: {e}"[:200])
 
-    SCHEDULER.reset_stats()
+    POOL.reset_stats()
     t0 = time.perf_counter()
     threads = [threading.Thread(target=worker, args=(k,), daemon=True)
                for k in range(conc)]
@@ -461,7 +461,7 @@ def run_mix(eng, conc: int, total: int, section_budget_s: float):
         t.join()
     wall = time.perf_counter() - t0
     all_lat = sorted(x for per in lat_s for x in per)
-    return sum(done), wall, SCHEDULER.stats(), errors, all_lat
+    return sum(done), wall, POOL.stats(), errors, all_lat
 
 
 def run_priority_mix(eng, conc: int, total: int, section_budget_s: float,
@@ -474,7 +474,7 @@ def run_priority_mix(eng, conc: int, total: int, section_budget_s: float,
     bursts coalesce through the micro-batcher. → (completed, wall
     seconds, per-class latency lists, scheduler stats, micro-batch
     counter deltas, [errors])."""
-    from tidb_tpu.executor.scheduler import SCHEDULER
+    from tidb_tpu.executor.scheduler import POOL
     from tidb_tpu.util.observability import REGISTRY
     sessions = []
     for _ in range(conc):
@@ -519,7 +519,7 @@ def run_priority_mix(eng, conc: int, total: int, section_budget_s: float,
                 REGISTRY.counters.get(
                     ("tidb_tpu_microbatch_members_total", ()), 0))
 
-    SCHEDULER.reset_stats()
+    POOL.reset_stats()
     b0, m0 = mb()
     t0 = time.perf_counter()
     threads = [threading.Thread(target=worker, args=(k,), daemon=True)
@@ -531,8 +531,64 @@ def run_priority_mix(eng, conc: int, total: int, section_budget_s: float,
     wall = time.perf_counter() - t0
     b1, m1 = mb()
     done = len(lat["interactive"]) + len(lat["batch"])
-    return done, wall, lat, SCHEDULER.stats(), \
+    return done, wall, lat, POOL.stats(), \
         {"batches": b1 - b0, "members": m1 - m0}, errors
+
+
+def run_pod_mix(eng, conc: int, total: int, section_budget_s: float,
+                device_queues: str):
+    """The PR 15 interactive-vs-batch mix with each statement's LANDING
+    device recorded — the pod-scale serving section's worker.
+    `device_queues` pins `tidb_tpu_device_queues` (`off` = the
+    single-scheduler same-process baseline, `on` = one queue per visible
+    device with locality placement + work stealing). → (per-(device,
+    class) latency lists, wall seconds, pool stats, [errors])."""
+    from tidb_tpu.executor.scheduler import POOL
+    sessions = []
+    for _ in range(conc):
+        ss = eng.new_session()
+        ss.vars["tidb_tpu_engine"] = "on"
+        ss.vars["tidb_tpu_row_threshold"] = 1
+        ss.vars["tidb_tpu_device_queues"] = device_queues
+        sessions.append(ss)
+    counter = itertools.count()
+    dev_lat: dict = {}                 # (device, class) → [wall seconds]
+    lat_lock = threading.Lock()
+    errors: list = []
+    stop_at = time.monotonic() + section_budget_s
+    n_batch = max(1, conc // 8)
+
+    def worker(k: int):
+        ss = sessions[k]
+        scan_role = k < n_batch
+        try:
+            while True:
+                i = next(counter)
+                if i >= total or time.monotonic() > stop_at:
+                    break
+                cls = "batch" if scan_role else "interactive"
+                sql = Q1 if scan_role \
+                    else f"SELECT v FROM pr WHERE k = {i % 1024}"
+                q0 = time.perf_counter()
+                rs = ss.query(sql)
+                dt = time.perf_counter() - q0
+                assert rs.rows, "pod mix query returned no rows"
+                dev = getattr(ss.last_guard, "device_index", None) or 0
+                with lat_lock:
+                    dev_lat.setdefault((dev, cls), []).append(dt)
+        except Exception as e:  # noqa: BLE001 — reported in the JSON
+            errors.append(f"{type(e).__name__}: {e}"[:200])
+
+    POOL.reset_stats()
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=worker, args=(k,), daemon=True)
+               for k in range(conc)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    return dev_lat, wall, POOL.stats(), errors
 
 
 def query_roofline_fraction(s, gbs: float) -> float:
@@ -911,6 +967,72 @@ def main():
         log(f"priority serving tier section skipped: {e}")
         extra["priority_serving"] = {
             "error": f"{type(e).__name__}: {e}"[:200]}
+    finally:
+        s.vars["tidb_tpu_row_threshold"] = 32768
+
+    # ---- pod-scale serving: per-device queues, locality, stealing ---------
+    # the PR 15 c64 mix twice in the SAME process: device_queues off
+    # (every statement through one scheduler/one device) vs on (a queue
+    # per visible device, locality placement, replication, work
+    # stealing). qps_scaling_x is the pod speedup; the >= 4x acceptance
+    # gate only arms on a real multi-device backend — on the forced
+    # multi-device CPU mesh the GIL serializes every dispatch, so the
+    # ratio is informational there.
+    try:
+        left = remaining_s()
+        if left < 60.0:
+            raise RuntimeError(f"{left:.0f}s left in wall budget")
+        import jax
+        from tidb_tpu.executor import device_cache as _dcache
+        n_dev = jax.local_device_count()
+        platform = jax.devices()[0].platform
+        log(f"pod serving: {n_dev} visible {platform} device(s)")
+        s.vars["tidb_tpu_row_threshold"] = 1
+        s.query("SELECT v FROM pr WHERE k = 17")   # warm the point path
+        level_s = max(6.0, min(30.0, remaining_s() * 0.08))
+        lat_off, w_off, sched_off, err_off = run_pod_mix(
+            eng, 64, 100000, level_s, "off")
+        lat_on, w_on, sched_on, err_on = run_pod_mix(
+            eng, 64, 100000, level_s, "on")
+        done_off = sum(len(v) for v in lat_off.values())
+        done_on = sum(len(v) for v in lat_on.values())
+        qps_off = done_off / w_off if w_off > 0 and done_off else 0.0
+        qps_on = done_on / w_on if w_on > 0 and done_on else 0.0
+        scaling = qps_on / qps_off if qps_off else 0.0
+        per_device: dict = {}
+        for (dev, cls), lats in sorted(lat_on.items()):
+            per_device.setdefault(f"device{dev}", {})[cls] = \
+                latency_percentiles_ms(sorted(lats))
+        pod = {
+            "devices": n_dev, "platform": platform,
+            "qps_1dev": round(qps_off, 2), "qps_pod": round(qps_on, 2),
+            "qps_scaling_x": round(scaling, 3),
+            "per_device": per_device,
+            "work_steals": sched_on["steals"],
+            "replica_hbm_overhead_bytes":
+                _dcache.replica_overhead_bytes(),
+            "queries": {"off": done_off, "on": done_on},
+            "scheduler": sched_on}
+        if err_off or err_on:
+            pod["errors"] = (err_off + err_on)[:4]
+        gate = platform != "cpu" and n_dev > 1
+        pod["scaling_gate_armed"] = gate
+        extra["pod_serving"] = pod
+        log(f"pod serving: 1dev {qps_off:.2f} qps, pod {qps_on:.2f} "
+            f"qps, scaling {scaling:.2f}x, steals "
+            f"{sched_on['steals']}, replica overhead "
+            f"{pod['replica_hbm_overhead_bytes']}B")
+        if gate:
+            assert scaling >= 4.0, \
+                f"pod qps_scaling_x {scaling:.2f} < 4 on {n_dev}-device " \
+                f"{platform} mesh"
+    except AssertionError:
+        raise                              # acceptance gate must FAIL loud
+    except Exception as e:  # noqa: BLE001 — fields must still land
+        if backend_error(e):
+            raise
+        log(f"pod serving section skipped: {e}")
+        extra["pod_serving"] = {"error": f"{type(e).__name__}: {e}"[:200]}
     finally:
         s.vars["tidb_tpu_row_threshold"] = 32768
 

@@ -139,9 +139,9 @@ def test_eviction_never_deletes_protected_sibling(serving):
     s.query(_dev_sql(0))                       # d0 hot in the HBM cache
     tid0 = eng.catalog.info_schema.table("d0").id
     key0 = None
-    for (sid, t, _parts) in list(dc._CACHE):
+    for (dev, sid, t, _parts) in list(dc._CACHE):
         if sid == id(eng.store) and t == tid0:
-            key0 = (sid, t, _parts)
+            key0 = (dev, sid, t, _parts)
     assert key0 is not None, "d0 not cached after its query"
     ent0 = dc._CACHE[key0]
     dev_ids = {i: [id(v) for v, _m in slabs] for i, slabs in ent0.dev.items()}
@@ -160,7 +160,12 @@ def test_eviction_never_deletes_protected_sibling(serving):
                 f"protected column {i} re-uploaded/deleted under pressure"
     # after release, normal LRU applies again on the next open
     s.query(_dev_sql(0))
-    assert len(dc._CACHE) <= dc.MAX_CACHED_TABLES + 1
+    # the LRU budget is PER DEVICE now: entries for distinct devices
+    # never pressure each other
+    per_dev: dict = {}
+    for k in dc._CACHE:
+        per_dev[k[0]] = per_dev.get(k[0], 0) + 1
+    assert all(n <= dc.MAX_CACHED_TABLES + 1 for n in per_dev.values())
 
 
 def test_kill_while_queued_returns_1317_promptly(serving):

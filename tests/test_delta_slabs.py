@@ -64,7 +64,7 @@ def _oracle(s, q=Q):
 
 def _entry(eng, name="t"):
     tid = eng.catalog.info_schema.table(name).id
-    for (sid, t, _parts), ent in dc._CACHE.items():
+    for (_dev, sid, t, _parts), ent in dc._CACHE.items():
         if sid == id(eng.store) and t == tid:
             return ent
     raise AssertionError(f"table {name} not cached")

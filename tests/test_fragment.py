@@ -189,7 +189,8 @@ def test_device_table_cache_reuse_and_invalidation():
     s.execute("INSERT INTO ct VALUES " + ",".join(
         f"({i % 7}, 'v{i % 3}')" for i in range(4000)))
     sql = "SELECT a, COUNT(*) FROM ct GROUP BY a"
-    key = (id(eng.store), eng.catalog.info_schema.table("ct").id, None)
+    # serial single-session workload → deterministically device 0
+    key = (0, id(eng.store), eng.catalog.info_schema.table("ct").id, None)
     r1 = run_device(s, sql)
     ent1 = device_cache._CACHE.get(key)
     assert ent1 is not None and 0 in ent1.dev

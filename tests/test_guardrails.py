@@ -353,14 +353,14 @@ def test_device_cache_eviction_keeps_partitioned_entries():
     saved = dict(dc._CACHE)
     dc._CACHE.clear()
     try:
-        dc._CACHE[(1, 10, None)] = _Ent()     # evictable
-        dc._CACHE[(1, 20, (0,))] = _Ent()     # partitioned, protected
-        dc._CACHE[(1, 20, (1,))] = _Ent()     # partitioned, protected
+        dc._CACHE[(0, 1, 10, None)] = _Ent()     # evictable
+        dc._CACHE[(0, 1, 20, (0,))] = _Ent()     # partitioned, protected
+        dc._CACHE[(0, 1, 20, (1,))] = _Ent()     # partitioned, protected
         dc._evict_to_budget(150, keep=None,
                             keep_tables=frozenset({(1, 20)}))
-        assert (1, 20, (0,)) in dc._CACHE
-        assert (1, 20, (1,)) in dc._CACHE
-        assert (1, 10, None) not in dc._CACHE
+        assert (0, 1, 20, (0,)) in dc._CACHE
+        assert (0, 1, 20, (1,)) in dc._CACHE
+        assert (0, 1, 10, None) not in dc._CACHE
     finally:
         dc._CACHE.clear()
         dc._CACHE.update(saved)

@@ -58,7 +58,7 @@ def run_device(s, sql, *, max_slab=None):
 
 def _cache_entry(eng, table_name):
     tid = eng.catalog.info_schema.table(table_name).id
-    for (sid, t, _parts), ent in dc._CACHE.items():
+    for (_dev, sid, t, _parts), ent in dc._CACHE.items():
         if sid == id(eng.store) and t == tid:
             return ent
     raise AssertionError(f"no cache entry for {table_name}")

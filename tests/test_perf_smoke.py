@@ -43,7 +43,7 @@ def session():
 
 def _entry(eng):
     tid = eng.catalog.info_schema.table("p").id
-    for (sid, t, _parts), ent in dc._CACHE.items():
+    for (_dev, sid, t, _parts), ent in dc._CACHE.items():
         if sid == id(eng.store) and t == tid:
             return ent
     raise AssertionError("table p not cached")
@@ -154,7 +154,7 @@ def test_warm_selective_scan_launches_only_surviving_slabs():
     full = "SELECT COUNT(*), SUM(a) FROM q"
     rows_cold = s.query(sel).rows              # cold: encode + upload
     tid = eng.catalog.info_schema.table("q").id
-    ent = next(e for (sid, t, _p), e in dc._CACHE.items()
+    ent = next(e for (_d, sid, t, _p), e in dc._CACHE.items()
                if sid == id(eng.store) and t == tid)
     # cold-pruned slab 0 committed as a hole (None placeholder): its
     # encode+upload never happened at all

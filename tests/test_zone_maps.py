@@ -156,7 +156,8 @@ def test_pruning_byte_exact_chain(pred, expect_skip):
 
 def test_pruning_counters_reach_registry():
     eng, s = _zm_engine()
-    key = ("tidb_tpu_slabs_skipped_total", (("engine", "device"),))
+    key = ("tidb_tpu_slabs_skipped_total",
+           (("device", "0"), ("engine", "device")))
     before = REGISTRY.counters.get(key, 0)
     h2d_before = sum(h[1] for (name, _l), h in REGISTRY.hists.items()
                      if name == "tidb_tpu_h2d_skipped_bytes")

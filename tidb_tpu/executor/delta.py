@@ -278,7 +278,7 @@ def _extend_locked(ctx, scan, ent, max_slab, ph):
     from tidb_tpu.chunk import compress
     from tidb_tpu.executor import device_cache as dc
     from tidb_tpu.executor import device_emit
-    from tidb_tpu.ops.jax_env import jnp
+    from tidb_tpu.ops.jax_env import jax, jnp
     table_id = scan.table.id
     td = ctx.snapshot.table_data(table_id)
     if td is None or ent.cov is None or ent.holes or not ent.dev:
@@ -353,6 +353,14 @@ def _extend_locked(ctx, scan, ent, max_slab, ph):
     new.tomb = dict(cum)
     new.delta_rows = n_append
     new.dictvals_host = ent.dictvals_host
+    # pod placement rides generations: the new entry keeps its
+    # predecessor's device pin; a pod entry's delta slab (index
+    # base_slabs) joins the last owner's span
+    new.device = getattr(ent, "device", 0)
+    owners = getattr(ent, "owners", None)
+    if owners is not None:
+        new.owners = (list(owners) + [owners[-1] if owners else 0]
+                      * n_slabs)[:n_slabs]
 
     # complete per-slab live counts: the uniform slab_cap arithmetic is
     # wrong for every slab once total shifts
@@ -378,7 +386,14 @@ def _extend_locked(ctx, scan, ent, max_slab, ph):
         keep[cur_pos] = False
         keeps[s] = keep
 
-    # encode + upload the delta slab; rewrite tombstoned base slabs
+    # encode + upload the delta slab; rewrite tombstoned base slabs.
+    # The delta slab commits to the entry's pinned device (for a pod
+    # entry: the tail owner's device — extension requires a hole-free
+    # entry, so the last base slab is resident there too).
+    if new.owners is not None:
+        pin = dc.device_handle(new.owners[-1] if new.owners else 0)
+    else:
+        pin = dc.device_handle(new.device)
     new_dev: Dict[int, List] = {}
     h2d = 0
     logical = 0
@@ -392,9 +407,16 @@ def _extend_locked(ctx, scan, ent, max_slab, ph):
             with ph.phase("encode"):
                 host_t = dc._slab_host(preps[i], 0, n_append, cap)
             with ph.phase("upload"):
-                dev_t = tuple(jnp.asarray(a) for a in host_t)
+                dev_t = tuple(jnp.asarray(a) if pin is None else
+                              jax.device_put(np.asarray(a), pin)
+                              for a in host_t)
                 if lay is not None and lay.kind == "dict":
-                    base_t = next(t for t in ent.dev[i] if t is not None)
+                    # shared dictvals from the LAST resident base slab:
+                    # on a pod entry that slab belongs to the tail
+                    # owner's span — the same device the delta slab
+                    # pins to, so the tuple stays single-device
+                    base_t = next(t for t in reversed(ent.dev[i])
+                                  if t is not None)
                     dev_t = dev_t + (base_t[-1],)   # shared dictvals
             h2d += sum(a.nbytes for a in host_t)
             logical += compress.raw_slab_bytes(lay, cap) \
@@ -419,7 +441,7 @@ def _extend_locked(ctx, scan, ent, max_slab, ph):
     if n_append + total_tombs >= max(threshold, 1):
         store = getattr(ctx.snapshot, "store", None)
         if store is not None:
-            key = (id(store), table_id,
+            key = (getattr(new, "device", 0), id(store), table_id,
                    None if pruned is None else tuple(pruned))
             schedule_compaction(store, key, scan, resident, max_slab,
                                 dict(ctx.vars))
@@ -561,6 +583,12 @@ def _compact_one(job) -> bool:
             new = dc.CachedTable(td, job["max_slab"], total, slab_cap,
                                  n_slabs, parts, cur.n_cols,
                                  compressed=cur.compressed)
+            new.device = getattr(cur, "device", 0)
+            if new.device < 0:
+                from tidb_tpu.executor import scheduler as _sched
+                nd = max(_sched.pool_devices(ctx), 1)
+                new.owners = [min(s * nd // max(n_slabs, 1), nd - 1)
+                              for s in range(n_slabs)]
             new.cov = cov
             new.max_rid = max_rid
             new.delta_version = int(getattr(snapshot, "version", 0) or 0)
@@ -594,7 +622,7 @@ def _compact_one(job) -> bool:
                 dc._CACHE.move_to_end(key)
             # the replaced generation's buffers free NOW unless a live
             # statement still computes on them (protect discipline)
-            dc._safe_delete(installed, key[:2])
+            dc._safe_delete(installed, key[1:3])
     except BaseException:
         if new is not None:
             new.delete()    # exclusively owned — frees HBM immediately

@@ -19,6 +19,19 @@ Ref: TiFlash replica selection (planner/core/find_best_task.go reads
 TiFlash availability per table); coprocessor cache
 (store/copr/coprocessor_cache.go) is the reference's other read-cache
 precedent.
+
+Pod-scale serving shards this cache BY DEVICE: keys carry the owning
+pool device index — `(dev, store_id, table_id, parts)` — each entry's
+arrays are committed to that device via jax.device_put, and the HBM
+budget / MAX_CACHED_TABLES caps are enforced per device (eight pool
+members have eight HBMs). Small tables replicate lazily: each device
+builds its own copy on first touch, so a dimension table ends up
+resident wherever its queries land. Fact tables at or above
+`tidb_tpu_partition_min_rows` build ONE pod-partitioned entry under
+dev == -1 whose slab ranges are owned by contiguous device spans
+(`CachedTable.owners`) — zone maps stay host-side per owner, and the
+scheduler never steals a statement whose partitioned working set lives
+elsewhere (locate_tables is its oracle).
 """
 
 from __future__ import annotations
@@ -32,11 +45,18 @@ import numpy as np
 
 from tidb_tpu.util import timeline
 
-MAX_CACHED_TABLES = 4
+MAX_CACHED_TABLES = 4       # PER DEVICE — each pool member's own cap
 # HBM budget for the table cache (v5e has 16 GiB; leave headroom for the
 # programs' working set). Exceeding it evicts LRU tables — the memory
-# Tracker analog for device residency (util/memory/tracker.go).
+# Tracker analog for device residency (util/memory/tracker.go). Like the
+# entry cap, the budget is per device.
 DEFAULT_HBM_BUDGET_BYTES = 8 << 30
+# pod partitioning threshold: tables at or above this many rows (by the
+# region ledger's approximate count, available before any host collect)
+# partition their slab ranges across the pool instead of replicating —
+# a per-device replica of a fact table would blow every device's budget
+# for no locality win
+DEFAULT_PARTITION_MIN_ROWS = 1 << 22
 
 
 class CachedTable:
@@ -54,7 +74,8 @@ class CachedTable:
                  "parts", "dicts", "dev", "bounds", "n_cols", "layouts",
                  "compressed", "zmaps", "holes", "base_slabs",
                  "delta_version", "rows_override", "is_delta", "cov",
-                 "max_rid", "tomb", "delta_rows", "dictvals_host")
+                 "max_rid", "tomb", "delta_rows", "dictvals_host",
+                 "device", "owners")
 
     def __init__(self, td, max_slab: int, total: int, slab_cap: int,
                  n_slabs: int, parts, n_cols: int, compressed: bool = False):
@@ -87,6 +108,11 @@ class CachedTable:
         self.tomb: Dict[int, np.ndarray] = {}
         self.delta_rows = 0
         self.dictvals_host: Dict[int, np.ndarray] = {}
+        # pod-scale placement: the pool device index owning this entry's
+        # arrays (-1 = pod-partitioned), and for pod entries the per-slab
+        # owner device list (contiguous spans — slab s lives on owners[s])
+        self.device = 0
+        self.owners: Optional[List[int]] = None
         self.dicts: Dict[int, Optional[np.ndarray]] = {}
         self.dev: Dict[int, List[Tuple]] = {}  # col → [(vals, valid)] slabs
         # col → ColLayout for packed columns; None/absent = raw layout
@@ -263,7 +289,7 @@ def clear():
         _CACHE.clear()
         _ALIGNED.clear()
     for k, e in cache:
-        _safe_delete(e, k[:2])
+        _safe_delete(e, k[1:3])
     for e in aligned:
         _safe_delete(e)
 
@@ -271,7 +297,7 @@ def clear():
 def invalidate(table_id: int):
     dead_c, dead_a = [], []
     with _LOCK:
-        for key in [k for k in _CACHE if k[1] == table_id]:
+        for key in [k for k in _CACHE if k[2] == table_id]:
             ent = _CACHE.pop(key, None)
             if ent is not None:
                 dead_c.append((key, ent))
@@ -281,7 +307,7 @@ def invalidate(table_id: int):
             if ent is not None:
                 dead_a.append(ent)
     for key, ent in dead_c:
-        _safe_delete(ent, key[:2])
+        _safe_delete(ent, key[1:3])
     for ent in dead_a:
         _safe_delete(ent)
 
@@ -292,14 +318,122 @@ _STORE_FINALIZERS: Dict[int, object] = {}
 def _evict_store(store_id: int):
     with _LOCK:
         dead_c = [(k, _CACHE.pop(k)) for k in list(_CACHE)
-                  if k[0] == store_id]
+                  if k[1] == store_id]
         dead_a = [_ALIGNED.pop(k) for k in list(_ALIGNED)
                   if k[0] == store_id]
         _STORE_FINALIZERS.pop(store_id, None)
     for key, ent in dead_c:
-        _safe_delete(ent, key[:2])
+        _safe_delete(ent, key[1:3])
     for ent in dead_a:
         _safe_delete(ent)
+
+
+# ---------------------------------------------------------------------------
+# pod placement helpers — device pinning, partitioning, the locality oracle
+# ---------------------------------------------------------------------------
+
+
+def device_handle(idx):
+    """jax.Device for pool member `idx`, or None when pinning is moot
+    (single visible device, pod sentinel, index unknown) — callers fall
+    back to the uncommitted jnp.asarray path, which is byte-identical to
+    the pre-pod behavior."""
+    if idx is None or idx < 0:
+        return None
+    try:
+        from tidb_tpu.ops.jax_env import jax
+        devs = jax.devices()
+    except Exception:  # noqa: BLE001 — no backend: pinning is moot
+        return None
+    if len(devs) <= 1:
+        return None
+    return devs[idx] if idx < len(devs) else devs[0]
+
+
+def _ctx_device(ctx) -> int:
+    """The pool device index this statement is pinned to (stamped by
+    scheduler placement on the guard, mirrored on the PhaseTimer for
+    guard-less contexts); 0 when no placement ran — the single-device
+    semantics."""
+    guard = getattr(ctx, "guard", None)
+    if guard is not None and getattr(guard, "device_index", None) is not None:
+        return int(guard.device_index)
+    ph = getattr(ctx, "phases", None)
+    return int(getattr(ph, "device_index", 0) or 0)
+
+
+def _approx_rows(td) -> int:
+    """Row count from the region ledger — available BEFORE the host
+    collect, so the partition decision can shape the cache key."""
+    try:
+        return sum(int(r.num_rows) for r in td.regions)
+    except Exception:  # noqa: BLE001 — exotic TableData: never partition
+        return 0
+
+
+def _pod_partition(ctx, td) -> bool:
+    from tidb_tpu.executor import scheduler
+    if scheduler.pool_devices(ctx) <= 1:
+        return False
+    min_rows = int(ctx.vars.get("tidb_tpu_partition_min_rows",
+                                DEFAULT_PARTITION_MIN_ROWS))
+    return _approx_rows(td) >= max(min_rows, 1)
+
+
+def locate_tables(table_ids) -> Dict[int, set]:
+    """table_id → set of pool device indices currently holding a cached
+    entry for it (-1 marks a pod-partitioned entry whose slab ranges
+    span owner devices). The scheduler's locality oracle — a snapshot,
+    advisory only: routing to a device that just evicted is a perf
+    miss, never a correctness problem."""
+    want = set(table_ids)
+    out: Dict[int, set] = {}
+    with _LOCK:
+        for k in _CACHE:
+            if k[2] in want:
+                out.setdefault(k[2], set()).add(k[0])
+    return out
+
+
+def replica_overhead_bytes() -> int:
+    """HBM bytes spent on replica copies beyond the largest resident
+    copy of each (store, table, parts) — the bench's replication-cost
+    meter. Pod-partitioned entries hold one copy by construction."""
+    with _LOCK:
+        entries = list(_CACHE.items())
+    groups: Dict[tuple, List[int]] = {}
+    for k, e in entries:
+        if k[0] < 0:
+            continue
+        groups.setdefault(k[1:], []).append(int(e.hbm_bytes()))
+    total = 0
+    for sizes in groups.values():
+        if len(sizes) > 1:
+            total += sum(sizes) - max(sizes)
+    return total
+
+
+def _entry_dev_bytes(key, ent) -> Dict[int, int]:
+    """device index → physical bytes one cache entry holds there. Local
+    entries charge their device wholesale; pod-partitioned entries walk
+    their slabs and charge each owner device what it actually holds."""
+    d = key[0]
+    owners = getattr(ent, "owners", None)
+    if d >= 0 or not owners:
+        return {d if d >= 0 else 0: int(ent.hbm_bytes())}
+    out: Dict[int, int] = {}
+    seen = set()
+    for slabs in ent.dev.values():
+        for s, t in enumerate(slabs):
+            if t is None:
+                continue            # pruned-away cold slab (hole)
+            o = owners[s] if s < len(owners) else owners[-1]
+            for a in t:
+                if id(a) in seen:
+                    continue        # shared dictvals counted once
+                seen.add(id(a))
+                out[o] = out.get(o, 0) + int(a.nbytes)
+    return out or {0: 0}
 
 
 def _pow2(n: int, lo: int = 1024) -> int:
@@ -601,9 +735,9 @@ def _note_storage_metrics(ent: CachedTable, key) -> None:
         return
     from tidb_tpu.util.observability import REGISTRY
     REGISTRY.observe("tidb_tpu_table_physical_bytes",
-                     float(ent.hbm_bytes()), {"table": str(key[1])})
+                     float(ent.hbm_bytes()), {"table": str(key[2])})
     REGISTRY.observe("tidb_tpu_table_logical_bytes",
-                     float(ent.logical_bytes()), {"table": str(key[1])})
+                     float(ent.logical_bytes()), {"table": str(key[2])})
 
 
 def _stream_slabs(ctx, ent: CachedTable, key, used_cols, preps, phases,
@@ -625,19 +759,37 @@ def _stream_slabs(ctx, ent: CachedTable, key, used_cols, preps, phases,
     so later statements with weaker predicates re-stream the column in
     full)."""
     from tidb_tpu.executor import zonemap
-    from tidb_tpu.ops.jax_env import jnp
+    from tidb_tpu.ops.jax_env import jax, jnp
     new_slabs = {i: [] for i in preps}
-    # dict-layout columns upload their dictionary values ONCE; the same
-    # device array rides every slab tuple (deduped by identity in
+    dev_idx = getattr(ent, "device", 0)
+    owners = getattr(ent, "owners", None)
+
+    def _put(a, d):
+        # commit to the owning pool device when one is pinned; the
+        # single-device fallback keeps the uncommitted jnp.asarray path
+        h = device_handle(d)
+        if h is None:
+            return jnp.asarray(a)
+        return jax.device_put(np.asarray(a), h)
+
+    # dict-layout columns upload their dictionary values ONCE PER OWNER
+    # DEVICE (pod entries span several); the same device array rides
+    # every slab tuple that device owns (deduped by identity in
     # hbm_bytes/delete). Raw encode has no dictionary → logical 0.
+    dict_cols = frozenset(
+        i for i, p in preps.items()
+        if p.get("layout") is not None and p["layout"].kind == "dict")
     dict_dev = {}
-    with phases.phase("upload"):
-        for i, prep in preps.items():
-            lay = prep.get("layout")
-            if lay is not None and lay.kind == "dict":
-                dict_dev[i] = jnp.asarray(prep["dictvals"])
-    if dict_dev:
-        phases.add_h2d(sum(a.nbytes for a in dict_dev.values()), logical=0)
+
+    def _dict_for(i, d):
+        # called under the upload phase (first slab that device owns)
+        t = dict_dev.get((i, d))
+        if t is None:
+            t = _put(preps[i]["dictvals"], d)
+            dict_dev[(i, d)] = t
+            phases.add_h2d(int(t.nbytes), logical=0)
+        return t
+
     for s in range(ent.n_slabs):
         if s in skip:
             # pruned cold slab: no encode, no PCIe, no dispatch — the
@@ -648,7 +800,7 @@ def _stream_slabs(ctx, ent: CachedTable, key, used_cols, preps, phases,
             zonemap.note_h2d_skipped(
                 phases, sum(_est_slab_phys(p, ent.slab_cap)
                             for p in preps.values()),
-                table=str(key[1]) if key is not None else "")
+                table=str(key[2]) if key is not None else "")
             phases.add_scan(0, logical=sum(_slab_logical_est(ent, i, preps)
                                            for i in used_cols))
             continue
@@ -658,11 +810,13 @@ def _stream_slabs(ctx, ent: CachedTable, key, used_cols, preps, phases,
         with phases.phase("encode"):
             for i, prep in preps.items():
                 host[i] = _slab_host(prep, start, stop, ent.slab_cap)
+        slab_dev = owners[s] if owners is not None and s < len(owners) \
+            else dev_idx
         with phases.phase("upload"):
             for i, ht in host.items():
-                dev_t = tuple(jnp.asarray(a) for a in ht)
-                if i in dict_dev:
-                    dev_t = dev_t + (dict_dev[i],)
+                dev_t = tuple(_put(a, slab_dev) for a in ht)
+                if i in dict_cols:
+                    dev_t = dev_t + (_dict_for(i, slab_dev),)
                 new_slabs[i].append(dev_t)
         phases.add_h2d(sum(_tuple_nbytes(ht) for ht in host.values()),
                        logical=sum(_logical_tuple_bytes(ent, i, ht)
@@ -744,7 +898,7 @@ def storage_stats(store_id: Optional[int] = None) -> List[dict]:
     stale entry to an unrelated live table."""
     with _LOCK:
         entries = [(k, e) for k, e in _CACHE.items()
-                   if store_id is None or k[0] == store_id]
+                   if store_id is None or k[1] == store_id]
     rows = []
     for key, ent in entries:
         for i in sorted(ent.dev):
@@ -767,7 +921,7 @@ def storage_stats(store_id: Optional[int] = None) -> List[dict]:
                 if known_lo:
                     zlo, zhi = min(known_lo), max(known_hi)
             rows.append({
-                "table_id": key[1],
+                "table_id": key[2],
                 "column": i,
                 "layout": "raw" if lay is None else lay.sig(),
                 "physical_bytes": int(phys),
@@ -819,15 +973,30 @@ def open_table(ctx, scan, used_cols, max_slab: int, phases=None,
     from tidb_tpu.util import failpoint
     from tidb_tpu.util.phases import PhaseTimer
     table_id = scan.table.id
+    tabs = getattr(phases, "tables", None)
+    if tabs is not None:
+        # the statement's table footprint — record_stmt folds it into
+        # the digest profile, closing the loop locality placement
+        # (scheduler.place_statement) routes by
+        tabs.add(table_id)
     comp_on = str(ctx.vars.get("tidb_tpu_compression", "on")).lower() \
         not in ("off", "0", "false")
     cacheable = getattr(ctx, "txn", None) is None
     td = ctx.snapshot.table_data(table_id) if cacheable else None
     # key by owning store too: distinct engines may reuse table ids; a
-    # finalizer evicts a dead engine's entries so its HBM isn't pinned
+    # finalizer evicts a dead engine's entries so its HBM isn't pinned.
+    # The leading element is the OWNING POOL DEVICE: each device keeps
+    # its own lazily-built replica of small tables, while fact tables
+    # past the partition threshold share ONE pod entry under dev == -1
+    # whose slab ranges are spread across owner devices. Pod entries
+    # only serve the pruning chain path — tree/dist/aligned callers
+    # need complete local columns and keep per-device entries.
     store = getattr(ctx.snapshot, "store", None) if cacheable else None
     parts = getattr(scan, "partitions", None)
-    key = (id(store), table_id,
+    dev = _ctx_device(ctx) if cacheable else 0
+    if cacheable and prune and td is not None and _pod_partition(ctx, td):
+        dev = -1
+    key = (dev, id(store), table_id,
            None if parts is None else tuple(parts)) if cacheable else None
     with _LOCK:
         if store is not None and id(store) not in _STORE_FINALIZERS:
@@ -865,7 +1034,7 @@ def open_table(ctx, scan, used_cols, max_slab: int, phases=None,
         elif ent is not None:
             _CACHE.move_to_end(key)
     if stale is not None:
-        _safe_delete(stale, key[:2])
+        _safe_delete(stale, key[1:3])
     if extend_from is not None:
         from tidb_tpu.executor import delta as _delta
         new_ent = _delta.extend_entry(
@@ -901,7 +1070,7 @@ def open_table(ctx, scan, used_cols, max_slab: int, phases=None,
                 elif cur is not None and _usable(cur):
                     ent = cur
             if dead is not None:
-                _safe_delete(dead, key[:2])
+                _safe_delete(dead, key[1:3])
     if ent is None:
         if cacheable:
             parts, total, cov, max_rid = _collect_parts(ctx, scan,
@@ -913,12 +1082,22 @@ def open_table(ctx, scan, used_cols, max_slab: int, phases=None,
         n_slabs = (total + slab_cap - 1) // slab_cap
         built = CachedTable(td, max_slab, total, slab_cap, n_slabs, parts,
                             len(scan.schema), compressed=comp_on)
+        built.device = dev
+        if dev < 0:
+            from tidb_tpu.executor import scheduler as _sched
+            nd = max(_sched.pool_devices(ctx), 1)
+            # contiguous slab spans per owner: slab s → owner device
+            # s*nd//n_slabs (monotone, covers every device when
+            # n_slabs >= nd)
+            built.owners = [min(s * nd // max(n_slabs, 1), nd - 1)
+                            for s in range(n_slabs)]
         built.cov = cov
         built.max_rid = max_rid
         built.delta_version = int(getattr(ctx.snapshot, "version", 0) or 0) \
             if cacheable else 0
         if cacheable:
             victims = []
+            replica = False
             with _LOCK:
                 cur = _CACHE.get(key)
                 if cur is not None and _usable(cur):
@@ -929,19 +1108,29 @@ def open_table(ctx, scan, used_cols, max_slab: int, phases=None,
                     if cur is not None:
                         victims.append(_CACHE.pop(key))
                     ent = _CACHE[key] = built
+                    # lazy replication: another device already holds this
+                    # (store, table, parts) — this install is a replica
+                    replica = dev >= 0 and any(
+                        k != key and k[0] >= 0 and k[1:] == key[1:]
+                        for k in _CACHE)
                     prot = _all_protected()
-                    over = len(_CACHE) - MAX_CACHED_TABLES
-                    for k in list(_CACHE):
+                    same = [k for k in _CACHE if k[0] == dev]
+                    over = len(same) - MAX_CACHED_TABLES
+                    for k in same:
                         if over <= 0:
                             break
-                        # LRU trim skips the new entry and any table a
-                        # live statement protects (cache may transiently
-                        # exceed the cap under heavy concurrency)
-                        if k != key and k[:2] not in prot:
+                        # per-device LRU trim skips the new entry and any
+                        # table a live statement protects (a device may
+                        # transiently exceed its cap under concurrency)
+                        if k != key and k[1:3] not in prot:
                             victims.append(_CACHE.pop(k))
                             over -= 1
             for v in victims:
                 _entry_delete(v)
+            if replica:
+                from tidb_tpu.util.observability import REGISTRY
+                REGISTRY.inc("tidb_tpu_table_replicas_total",
+                             {"device": str(dev)})
         else:
             ent = built
 
@@ -967,7 +1156,7 @@ def open_table(ctx, scan, used_cols, max_slab: int, phases=None,
         with _LOCK:
             if _CACHE.get(key) is ent:
                 _CACHE.pop(key, None)
-        _safe_delete(ent, key[:2])
+        _safe_delete(ent, key[1:3])
         return open_table(ctx, scan, used_cols, max_slab, phases=phases,
                           prune=prune)
     if refill:
@@ -1039,34 +1228,49 @@ def get_table(ctx, scan, used_cols, max_slab: int,
 
 def _evict_to_budget(budget: int, keep, keep_aligned=frozenset(),
                      keep_tables=frozenset()) -> None:
-    """Drop LRU cached entries until resident bytes fit the HBM budget
-    (never the entries in active use — the caller's keeps PLUS every live
-    thread's protect_tables registration). Aligned join structures evict
-    first — they are derived data, rebuildable from the tables."""
+    """Drop LRU cached entries until each DEVICE's resident bytes fit
+    the HBM budget (the budget is per device — eight pool members have
+    eight HBMs), never the entries in active use (the caller's keeps
+    PLUS every live thread's protect_tables registration). Aligned join
+    structures evict first — derived data, rebuildable from the tables;
+    they live on the default device, so they relieve device 0. Pod-
+    partitioned entries charge each owner device only the slabs it
+    actually holds."""
     dead_c, dead_a = [], []
     with _LOCK:
         keep_tables = frozenset(keep_tables) | _all_protected()
-        total = sum(e.hbm_bytes() for e in _CACHE.values()) + \
-            sum(e.hbm_bytes() for e in _ALIGNED.values())
-        while total > budget:
+        usage: Dict[int, int] = {}
+        for k, e in _CACHE.items():
+            for d, b in _entry_dev_bytes(k, e).items():
+                usage[d] = usage.get(d, 0) + b
+        for e in _ALIGNED.values():
+            usage[0] = usage.get(0, 0) + e.hbm_bytes()
+        while usage.get(0, 0) > budget:
             victim = next((k for k in _ALIGNED if k not in keep_aligned),
                           None)
             if victim is None:
                 break
             ent = _ALIGNED.pop(victim)
-            total -= ent.hbm_bytes()
+            usage[0] -= ent.hbm_bytes()
             dead_a.append(ent)
-        while total > budget and len(_CACHE) > 1:
+        while len(_CACHE) > 1:
+            over = {d for d, b in usage.items() if b > budget}
+            if not over:
+                break
             # keep_tables holds (store_id, table_id) pairs; cache keys
-            # carry a third partition element — match on the prefix, else
-            # partitioned entries of a protected table get evicted
-            # mid-query
-            victim = next((k for k in _CACHE
-                           if k != keep and k[:2] not in keep_tables), None)
+            # carry device and partition elements too — match on the
+            # middle slice, else partitioned entries of a protected
+            # table get evicted mid-query. LRU order: first matching
+            # entry that relieves an over-budget device.
+            victim = next(
+                (k for k in _CACHE
+                 if k != keep and k[1:3] not in keep_tables
+                 and set(_entry_dev_bytes(k, _CACHE[k])) & over), None)
             if victim is None:
                 break
             ent = _CACHE.pop(victim)
-            total -= ent.hbm_bytes()
+            for d, b in _entry_dev_bytes(victim, ent).items():
+                usage[d] = usage.get(d, 0) - b
             dead_c.append(ent)
     for ent in dead_c:
         _entry_delete(ent)

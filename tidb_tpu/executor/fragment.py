@@ -338,7 +338,20 @@ def _count_trace() -> None:
         PROGRAM_TRACES += 1
 
 
+def _sig_dev(sig: str) -> str:
+    """Scope a compile-cache signature to the statement's pool device:
+    XLA executables bind to the device they were lowered for, so each
+    pool member keeps its own compiled copy. Device 0 (and every
+    placement-free context) keeps the bare signature — single-device
+    hosts stay byte-identical to the pre-pod cache."""
+    from tidb_tpu.util import phases as _ph
+    cur = _ph.current()
+    d = getattr(cur, "device_index", 0) if cur is not None else 0
+    return f"dev{d}|{sig}" if d else sig
+
+
 def _build_lock(sig: str) -> threading.Lock:
+    sig = _sig_dev(sig)
     with _CC_LOCK:
         lk = _BUILD_LOCKS.get(sig)
         if lk is None:
@@ -365,6 +378,7 @@ def _tree_delete(tree) -> None:
 
 
 def _cache_get(sig: str):
+    sig = _sig_dev(sig)
     with _CC_LOCK:
         prog = _COMPILE_CACHE.get(sig)
         if prog is not None:
@@ -373,6 +387,7 @@ def _cache_get(sig: str):
 
 
 def _cache_put(sig: str, prog) -> None:
+    sig = _sig_dev(sig)
     with _CC_LOCK:
         _COMPILE_CACHE[sig] = prog
         while len(_COMPILE_CACHE) > MAX_COMPILED_PROGRAMS:
@@ -1458,9 +1473,15 @@ class TpuFragmentExec:
 
     # ---- device pipeline ---------------------------------------------------
     def _run_device(self) -> Chunk:
-        from tidb_tpu.executor import device_cache
+        from tidb_tpu.executor import device_cache, scheduler
         from tidb_tpu.util import failpoint
         failpoint.inject("device-fragment")
+        # pod placement + batch admission turnstile: pins the statement
+        # to its pool device BEFORE the first open_table (so every cold
+        # byte lands on the right HBM); batch-class statements queue —
+        # and may be stolen to an idle sibling — here, before any byte
+        # has picked a device
+        scheduler.admit_statement(self.ctx)
 
         if getattr(self.plan, "dist", 0) > 1:
             return self._run_device_dist()
@@ -3019,6 +3040,18 @@ class TpuFragmentExec:
         pcaps = [0] * n_run             # pair cap each partial ran at
         pairs_cache: List = [None] * n_run     # host distinct-pair sets
         to_run: Optional[List[int]] = None     # None = cold first pass
+        # pod-partitioned entry: each slab's partial computes on its
+        # owner device; re-pin every partial to the STATEMENT's device
+        # right after dispatch so the merge/finalize graph downstream
+        # (concatenate, piggyback packing, fetch) stays single-device —
+        # mixing committed arrays from different devices in one op raises
+        from tidb_tpu.executor import device_cache as _dc
+        pod_pin = _dc.device_handle(_dc._ctx_device(self.ctx)) \
+            if getattr(ent, "owners", None) is not None else None
+
+        def _pin(p):
+            return p if pod_pin is None else jax.device_put(p, pod_pin)
+
         while True:
             if spec_sig is not None:
                 psig, spec_sig = spec_sig, None
@@ -3039,8 +3072,8 @@ class TpuFragmentExec:
                     # sibling's dispatch interleaves with our host work
                     with self.ctx.device_slot():
                         with ph.phase("compute"):
-                            partials[s] = prog.partial(cols, jnp.int32(n),
-                                                       prep_vals)
+                            partials[s] = _pin(prog.partial(
+                                cols, jnp.int32(n), prep_vals))
                     ph.note_launch()
                     ph.note_fused()   # a chain partial IS a fused pipeline
                     caps[s] = group_cap
@@ -3052,8 +3085,8 @@ class TpuFragmentExec:
                                          prog.used_cols)
                     with self.ctx.device_slot():
                         with ph.phase("compute"):
-                            partials[s] = prog.partial(cols, jnp.int32(n),
-                                                       prep_vals)
+                            partials[s] = _pin(prog.partial(
+                                cols, jnp.int32(n), prep_vals))
                     ph.note_launch()
                     ph.note_fused()
                     caps[s] = group_cap

@@ -14,11 +14,33 @@ Architecture (the multi-queue design):
   priority queues inside each scheduler.
 
 * `SchedulerPool` owns one `DeviceScheduler(device_index)` per device
-  slot. Statements are routed by `placement()` — round-robin by
-  connection id for now (cost-based routing informed by digest profiles
-  stays a ROADMAP item). The pool is sized 1 unless
-  `tidb_tpu_device_queues=on`, so a single-accelerator process keeps
-  the PR 5 single-slot semantics exactly.
+  slot. Statements are routed by `place_statement()` — BY LOCALITY: the
+  device already holding the tables the statement's digest touches
+  (Registry.digest_tables × device_cache.locate_tables), falling back
+  to least-queue-depth (ties to the lowest index, so serial workloads
+  deterministically stay on device 0) for cold digests. The placement
+  is stamped once on the guard (guard.device_index) and every later
+  acquire of the statement reuses it. `tidb_tpu_device_queues` defaults
+  to `auto`: the pool activates only when >1 device is visible, so a
+  single-accelerator process keeps the PR 5 single-slot semantics
+  byte-identically.
+
+* Work stealing: when a scheduler's release leaves it IDLE (no holder,
+  empty queue) it pulls the best-ranked steal-eligible waiter from the
+  deepest sibling queue (`SchedulerPool.steal_into`). Only batch-class
+  statements parked at their ADMISSION acquire (`admit_statement`, the
+  turnstile a batch statement passes BEFORE its first table byte
+  uploads) are eligible — a statement is never migrated after it
+  started uploading or dispatching, and a statement whose partitioned
+  working set lives elsewhere is pinned (guard.sched_steal_ok=False).
+  The complementary bootstrap: a steal-eligible waiter queued past
+  STEAL_PATIENCE_S migrates itself onto a FULLY idle sibling — a
+  device that has never run anything has no release to trigger a pull,
+  so the first spill must come from the stalled queue's side.
+  The handoff passes the `steal-migrate` failpoint: an injected fault
+  re-queues the waiter on its HOME device with a Backoffer charge —
+  the thread itself migrates, so the statement is never lost and never
+  runs twice.
 
 * Each `DeviceScheduler` keeps ONE logical queue whose grant order is
   computed per wakeup from (priority level, arrival ticket):
@@ -87,14 +109,35 @@ DEFAULT_FAIRNESS_CAP = 4
 POLL_S = 0.02
 # anti-starvation: a batch waiter queued this long ranks as interactive
 AGING_S = 0.5
+# work-steal bootstrap: a steal-eligible waiter queued this long scans
+# the pool for a FULLY idle sibling and migrates itself there — the
+# release-into-empty hook alone can't start the chain when a sibling has
+# never run anything (it has nothing to release). Short waits stay local
+# (locality wins); only a stalled queue spills onto idle devices.
+STEAL_PATIENCE_S = 0.3
 # historical avg device seconds under which a batch digest is "cheap"
 CHEAP_BATCH_S = 0.05
 
 # priority classes (guard.sched_class values); None = unclassified/FIFO
 CLASSES = ("interactive", "batch")
 
-# queue-entry field indices (kept as a list for in-place mutation)
-_TICKET, _CONN, _TID, _CLASS, _ENQ_T, _COST = range(6)
+# queue-entry field indices (kept as a list for in-place mutation).
+# _STEAL: this waiter may be migrated to an idle sibling (batch-class
+# admission acquires only). _MOVED: set by the stealer (under the
+# victim's _cv) to the target device index — the waiter observes it in
+# its poll loop and raises _Migrated to re-acquire over there.
+_TICKET, _CONN, _TID, _CLASS, _ENQ_T, _COST, _STEAL, _MOVED = range(8)
+
+
+class _Migrated(BaseException):
+    """Internal: a queued waiter was stolen — re-acquire on `target`.
+    BaseException so no generic `except Exception` on the wait path can
+    swallow the handoff."""
+
+    def __init__(self, target: int, waited: float):
+        super().__init__(f"migrated to device {target}")
+        self.target = target
+        self.waited = waited
 
 
 class DeviceScheduler:
@@ -102,8 +145,15 @@ class DeviceScheduler:
     slot of ONE device."""
 
     def __init__(self, device_index: int = 0,
-                 fairness_cap: int = DEFAULT_FAIRNESS_CAP):
+                 fairness_cap: int = DEFAULT_FAIRNESS_CAP, pool=None):
         self.device_index = device_index
+        # owning SchedulerPool (None for standalone schedulers in tests):
+        # release-into-idle consults the pool's steal hook
+        self._pool = pool
+        # steal-eligible waiters currently queued — read RACILY by
+        # sibling releases as a cheap pre-screen; every mutation happens
+        # under _cv and steal_into re-verifies under the lock
+        self._stealable = 0
         self._cv = threading.Condition()
         self._holder: Optional[int] = None     # thread ident
         self._depth = 0                        # reentrant holds
@@ -118,6 +168,7 @@ class DeviceScheduler:
         self.waits = 0               # admissions that actually queued
         self.wait_s_total = 0.0
         self.yields = 0              # fairness-cap rotations
+        self.steals = 0              # waiters stolen INTO this device
         # per-class breakdowns, keyed by class name ("interactive" /
         # "batch"); unclassified admissions don't appear here
         self.class_admissions: Dict[str, int] = {}
@@ -154,13 +205,17 @@ class DeviceScheduler:
         return head
 
     # -- acquire / release ---------------------------------------------------
-    def acquire(self, guard=None, conn_id: int = 0) -> float:
+    def acquire(self, guard=None, conn_id: int = 0,
+                steal_ok: bool = False) -> float:
         """Block until admitted; → seconds spent queued. Reentrant per
         thread. Raises the guard's typed error (QueryInterrupted /
         QueryTimeout / OOM action) if the statement is killed or expires
         while queued. The priority class and cost hint ride on the guard
         (guard.sched_class / guard.sched_cost, set by the session's
-        admission classifier)."""
+        admission classifier). `steal_ok` marks the waiter migratable:
+        a sibling going idle may move it (the entry leaves this queue
+        and the blocked thread raises _Migrated — admit_statement
+        re-acquires on the target)."""
         tid = threading.get_ident()
         cls = getattr(guard, "sched_class", None) if guard is not None \
             else None
@@ -171,22 +226,58 @@ class DeviceScheduler:
                 self._depth += 1
                 return 0.0
             ent = [self._next_ticket, conn_id, tid, cls,
-                   time.monotonic(), cost]
+                   time.monotonic(), cost, bool(steal_ok), None]
             self._next_ticket += 1
             self._queue.append(ent)
+            if ent[_STEAL]:
+                self._stealable += 1
             t0 = time.monotonic()
             queued = False
             try:
-                while self._holder is not None or self._grantee() is not ent:
+                while True:
+                    if ent[_MOVED] is not None:
+                        # a stealer dequeued us (and decremented
+                        # _stealable) under this lock — hand off
+                        raise _Migrated(ent[_MOVED],
+                                        time.monotonic() - t0)
+                    if self._holder is None and self._grantee() is ent:
+                        break
+                    if ent[_STEAL] and self._pool is not None and \
+                            time.monotonic() - ent[_ENQ_T] \
+                            >= STEAL_PATIENCE_S:
+                        # patience expired with the queue still stalled:
+                        # spill onto a fully idle sibling (the bootstrap
+                        # half of work stealing — release-into-empty
+                        # keeps the chain going once a device is warm).
+                        # Ticket-mod spread keeps a woken herd from all
+                        # picking the same target.
+                        idle = self._pool.idle_siblings(self)
+                        if idle:
+                            tgt = idle[ent[_TICKET] % len(idle)]
+                            ent[_MOVED] = tgt
+                            self._queue.remove(ent)
+                            self._stealable -= 1
+                            self._cv.notify_all()
+                            raise _Migrated(tgt, time.monotonic() - t0)
                     queued = True
                     self._cv.wait(POLL_S)
                     if guard is not None:
                         guard.check("device-queue")
+            except _Migrated:
+                raise
             except BaseException:
-                self._queue.remove(ent)
+                # a steal may have already removed the entry: the typed
+                # error (KILL/deadline) wins — the statement unwinds to
+                # the client either way, never runs anywhere
+                if ent in self._queue:
+                    self._queue.remove(ent)
+                    if ent[_STEAL]:
+                        self._stealable -= 1
                 self._cv.notify_all()
                 raise
             self._queue.remove(ent)
+            if ent[_STEAL]:
+                self._stealable -= 1
             self._holder = tid
             self._depth = 1
             waited = time.monotonic() - t0
@@ -215,6 +306,7 @@ class DeviceScheduler:
             return waited if queued else 0.0
 
     def release(self) -> None:
+        idle = False
         with self._cv:
             if self._holder != threading.get_ident():
                 return                      # defensive: never held
@@ -223,7 +315,14 @@ class DeviceScheduler:
                 return
             self._depth = 0
             self._holder = None
+            idle = not self._queue
             self._cv.notify_all()
+        if idle and self._pool is not None:
+            # released into an EMPTY queue: this device is about to sit
+            # idle — pull a migratable waiter from the deepest sibling
+            # (outside our own lock; steal_into locks one victim at a
+            # time, so no two scheduler locks are ever held together)
+            self._pool.steal_into(self)
 
     @contextmanager
     def slot(self, guard=None, conn_id: int = 0):
@@ -232,9 +331,13 @@ class DeviceScheduler:
         waited = self.acquire(guard=guard, conn_id=conn_id)
         cls = getattr(guard, "sched_class", None) if guard is not None \
             else None
+        # one sched-queue/sched-slot lane SET per device: device 0 keeps
+        # the PR 5 lane names, siblings suffix @devN so the Chrome trace
+        # shows each chip's queue and occupancy separately
+        dev_sfx = f"@dev{self.device_index}" if self.device_index else ""
         if timeline.ENABLED and waited > 0.0:
             lane = "sched-queue" if cls is None else f"sched-queue:{cls}"
-            timeline.record(lane, "sched", dur_us=waited * 1e6,
+            timeline.record(lane + dev_sfx, "sched", dur_us=waited * 1e6,
                             pid=conn_id)
         hold_t0 = timeline.now_us() if timeline.ENABLED else 0.0
         try:
@@ -245,7 +348,7 @@ class DeviceScheduler:
         finally:
             self.release()
             if timeline.ENABLED:
-                timeline.record("sched-slot", "sched",
+                timeline.record("sched-slot" + dev_sfx, "sched",
                                 dur_us=timeline.now_us() - hold_t0,
                                 pid=conn_id, ts_us=hold_t0)
 
@@ -260,7 +363,7 @@ class DeviceScheduler:
         with self._cv:
             return {"admissions": self.admissions, "waits": self.waits,
                     "wait_s_total": round(self.wait_s_total, 6),
-                    "yields": self.yields,
+                    "yields": self.yields, "steals": self.steals,
                     "classes": {
                         c: {"admissions": self.class_admissions.get(c, 0),
                             "waits": self.class_waits.get(c, 0),
@@ -275,23 +378,23 @@ class DeviceScheduler:
             self.waits = 0
             self.wait_s_total = 0.0
             self.yields = 0
+            self.steals = 0
             self.class_admissions = {}
             self.class_waits = {}
             self.class_wait_s = {}
 
 
 class SchedulerPool:
-    """One DeviceScheduler per visible device slot, with a placement
-    hook routing statements to a queue. Round-robin by connection id —
-    deterministic and stable for a statement's whole lifetime (every
-    slab acquire of one statement lands on the same queue). Cost-based
-    placement from digest profiles is the ROADMAP follow-up."""
+    """One DeviceScheduler per visible device slot, with locality-aware
+    placement (place_statement) and the work-steal hook (steal_into) —
+    the pod-scale serving half of the tier."""
 
     def __init__(self, n: int = 1,
                  fairness_cap: int = DEFAULT_FAIRNESS_CAP):
         self._lock = threading.Lock()
         self.schedulers: List[DeviceScheduler] = [
-            DeviceScheduler(i, fairness_cap) for i in range(max(1, n))]
+            DeviceScheduler(i, fairness_cap, pool=self)
+            for i in range(max(1, n))]
 
     def ensure(self, n: int) -> None:
         """Grow to `n` slots (never shrinks: a statement may still hold
@@ -299,20 +402,142 @@ class SchedulerPool:
         with self._lock:
             while len(self.schedulers) < n:
                 self.schedulers.append(
-                    DeviceScheduler(len(self.schedulers)))
+                    DeviceScheduler(len(self.schedulers), pool=self))
 
     def size(self) -> int:
         with self._lock:
             return len(self.schedulers)
 
     def placement(self, conn_id: int = 0) -> DeviceScheduler:
-        """The placement hook: statement → device queue."""
+        """Legacy guard-less hook: statement → device queue by
+        connection id (stable across a statement's acquires)."""
         with self._lock:
             return self.schedulers[conn_id % len(self.schedulers)]
 
+    def place_statement(self, guard, conn_id: int = 0) -> int:
+        """→ device index for this statement, stamped once on the guard.
+
+        Priority: (1) the guard's existing pin (placement is decided
+        exactly once per statement, so every slab acquire lands on the
+        same queue); (2) the device already holding the tables the
+        statement's digest touches (guard.sched_tables, stamped by the
+        session's admission classifier from the digest profile, located
+        against the per-device HBM cache); (3) least queue depth, ties
+        to the LOWEST index — cold serial workloads deterministically
+        stay on device 0, preserving the PR 5/15 shapes. A digest whose
+        working set is pod-PARTITIONED (spans every device) pins
+        guard.sched_steal_ok=False: migrating it buys nothing and
+        strands nothing — it must simply never bounce."""
+        with self._lock:
+            n = len(self.schedulers)
+        if guard is None:
+            return conn_id % n
+        idx = getattr(guard, "device_index", None)
+        if idx is not None:
+            return min(int(idx), n - 1)
+        if n == 1:
+            idx = 0
+        else:
+            idx = None
+            tables = getattr(guard, "sched_tables", None)
+            if tables:
+                try:
+                    from tidb_tpu.executor import device_cache
+                    located = device_cache.locate_tables(tables)
+                except Exception:  # noqa: BLE001 — placement is advisory
+                    located = {}
+                votes: Dict[int, int] = {}
+                for devs in located.values():
+                    if -1 in devs:
+                        # pod-partitioned working set: resident on every
+                        # device — no vote, but pin against stealing
+                        guard.sched_steal_ok = False
+                        continue
+                    for d in devs:
+                        votes[d] = votes.get(d, 0) + 1
+                if votes:
+                    best = max(votes.values())
+                    idx = min(d for d, v in votes.items() if v == best)
+                    idx = min(idx, n - 1)
+            if idx is None:
+                depths = [s.queue_depth() for s in self.schedulers[:n]]
+                idx = depths.index(min(depths))
+        guard.device_index = idx
+        ph = getattr(guard, "phases", None)
+        if ph is not None:
+            ph.device_index = idx
+        return idx
+
+    def idle_siblings(self, sched) -> List[int]:
+        """Device indexes of FULLY idle members (no holder, empty
+        queue), lowest first. Racy attribute reads — advisory, exactly
+        like steal_into's _stealable pre-screen: a wrong answer costs a
+        queued hop, never correctness."""
+        with self._lock:
+            members = list(self.schedulers)
+        return [s.device_index for s in members
+                if s is not sched and s._holder is None and not s._queue]
+
+    def steal_into(self, target: DeviceScheduler) -> bool:
+        """Pull the best-ranked steal-eligible waiter from the deepest
+        sibling queue into the (idle) `target`. The victim entry is
+        dequeued under its own scheduler's lock with _MOVED set; the
+        blocked waiter thread observes the move and re-acquires on the
+        target itself — the statement migrates, its thread never
+        changes. → True when a waiter was moved."""
+        with self._lock:
+            sibs = [s for s in self.schedulers if s is not target]
+        # racy pre-screen (plain int reads): the common all-idle release
+        # costs N-1 attribute loads and zero lock traffic
+        sibs = [s for s in sibs if s._stealable > 0]
+        if not sibs:
+            return False
+        sibs.sort(key=lambda s: -len(s._queue))
+        now = time.monotonic()
+        for sib in sibs:
+            with sib._cv:
+                elig = [e for e in sib._queue
+                        if e[_STEAL] and e[_MOVED] is None]
+                if not elig:
+                    continue
+                e = min(elig, key=lambda e: sib._rank(e, now))
+                e[_MOVED] = target.device_index
+                sib._queue.remove(e)
+                sib._stealable -= 1
+                sib._cv.notify_all()
+            return True
+        return False
+
     def stats(self) -> dict:
-        return {f"device{s.device_index}": s.stats()
-                for s in list(self.schedulers)}
+        """Aggregate counters across EVERY pool member (top-level keys
+        match DeviceScheduler.stats(), so existing readers keep working
+        when the pool is active) plus the per-device breakdown under
+        ["devices"]."""
+        with self._lock:
+            members = list(self.schedulers)
+        per = {f"device{s.device_index}": s.stats() for s in members}
+        agg: dict = {"admissions": 0, "waits": 0, "wait_s_total": 0.0,
+                     "yields": 0, "steals": 0, "classes": {}}
+        for s in per.values():
+            for k in ("admissions", "waits", "yields", "steals"):
+                agg[k] += s.get(k, 0)
+            agg["wait_s_total"] += s.get("wait_s_total", 0.0)
+            for c, cs in s.get("classes", {}).items():
+                t = agg["classes"].setdefault(
+                    c, {"admissions": 0, "waits": 0, "wait_s_total": 0.0})
+                t["admissions"] += cs.get("admissions", 0)
+                t["waits"] += cs.get("waits", 0)
+                t["wait_s_total"] = round(
+                    t["wait_s_total"] + cs.get("wait_s_total", 0.0), 6)
+        agg["wait_s_total"] = round(agg["wait_s_total"], 6)
+        agg["devices"] = per
+        return agg
+
+    def reset_stats(self) -> None:
+        with self._lock:
+            members = list(self.schedulers)
+        for s in members:
+            s.reset_stats()
 
 
 POOL = SchedulerPool(1)
@@ -334,26 +559,128 @@ def _visible_devices() -> int:
         return 1
 
 
+def _queues_on(ctx) -> bool:
+    """tidb_tpu_device_queues resolution: on/off are explicit; the
+    default `auto` activates the pool exactly when >1 device is visible
+    (a single-device host keeps PR 5/15 semantics byte-identically)."""
+    queues = str(ctx.vars.get("tidb_tpu_device_queues", "auto")).lower()
+    if queues in ("on", "1", "true"):
+        return True
+    if queues in ("off", "0", "false"):
+        return False
+    return _visible_devices() > 1
+
+
+def pool_devices(ctx) -> int:
+    """Serving peers the statement can be placed across: the visible
+    device count when the pool is active, else 1. device_cache consults
+    this for its replicate-vs-partition placement decisions."""
+    mode = str(ctx.vars.get("tidb_tpu_scheduler", "on")).lower()
+    if mode in ("off", "0", "false") or not _queues_on(ctx):
+        return 1
+    return _visible_devices()
+
+
 def device_slot(ctx):
     """The executor-facing entry: the routed scheduler's slot bound to
     the statement's guard/conn, or a no-op when `tidb_tpu_scheduler=off`.
-    With `tidb_tpu_device_queues=on` the pool grows to one queue per
-    visible device and statements route through the placement hook;
-    otherwise everything shares the device-0 queue (the PR 5 shape)."""
+    With the pool active (device_queues on, or auto with >1 device) the
+    statement's guard carries its placement — stamped here on first
+    acquire if admit_statement didn't already — and every acquire of
+    the statement lands on that one queue."""
     mode = str(ctx.vars.get("tidb_tpu_scheduler", "on")).lower()
     if mode in ("off", "0", "false"):
         return _null_slot()
     guard = getattr(ctx, "guard", None)
     conn_id = getattr(guard, "conn_id", 0) if guard is not None else 0
-    queues = str(ctx.vars.get("tidb_tpu_device_queues", "off")).lower()
-    if queues in ("on", "1", "true"):
+    if _queues_on(ctx):
         POOL.ensure(_visible_devices())
-        sched = POOL.placement(conn_id)
+        idx = POOL.place_statement(guard, conn_id)
+        with POOL._lock:
+            sched = POOL.schedulers[idx]
     else:
         sched = SCHEDULER
     return sched.slot(guard=guard, conn_id=conn_id)
 
 
+def admit_statement(ctx) -> None:
+    """Admission → placement handoff, called by the device executor
+    BEFORE the statement's first open_table (so before any byte picks a
+    device). Places the statement (stamping guard.device_index), and
+    parks BATCH-class statements at their placed queue's turnstile —
+    the one window in a statement's life where an idle sibling may
+    steal it (its working set hasn't landed anywhere yet). Interactive
+    and unclassified statements only get the placement stamp: their
+    point reads go straight to the dispatch slot, exactly the PR 15
+    flow (and the microbatch rendezvous depends on that)."""
+    mode = str(ctx.vars.get("tidb_tpu_scheduler", "on")).lower()
+    if mode in ("off", "0", "false") or not _queues_on(ctx):
+        return
+    guard = getattr(ctx, "guard", None)
+    if guard is None:
+        return
+    POOL.ensure(_visible_devices())
+    conn_id = getattr(guard, "conn_id", 0)
+    home = POOL.place_statement(guard, conn_id)
+    if getattr(guard, "sched_class", None) != "batch" \
+            or getattr(guard, "sched_admitted", False):
+        return
+    guard.sched_admitted = True
+    steal_ok = bool(getattr(guard, "sched_steal_ok", True)) \
+        and POOL.size() > 1
+    from tidb_tpu.util import failpoint
+    idx = home
+    waited_total = 0.0
+    while True:
+        with POOL._lock:
+            sched = POOL.schedulers[min(idx, len(POOL.schedulers) - 1)]
+        try:
+            waited_total += sched.acquire(guard=guard, conn_id=conn_id,
+                                          steal_ok=steal_ok)
+        except _Migrated as m:
+            waited_total += m.waited
+            try:
+                failpoint.inject("steal-migrate")
+            except Exception as err:
+                # injected fault at the handoff: re-queue on the HOME
+                # device with the backoff charged to the guard. The
+                # waiter thread itself performs the migration, so the
+                # statement is never lost (this thread still owns it)
+                # and never runs twice (no other thread ever could).
+                from tidb_tpu.util.backoff import Backoffer
+                Backoffer("steal-migrate", base_ms=1.0, max_ms=20.0,
+                          budget_ms=1000.0,
+                          guard=guard).backoff(err)
+                idx, steal_ok = home, False
+                continue
+            idx, steal_ok = int(m.target), False
+            guard.sched_steals = getattr(guard, "sched_steals", 0) + 1
+            with POOL._lock:
+                tgt = POOL.schedulers[min(idx, len(POOL.schedulers) - 1)]
+            with tgt._cv:
+                tgt.steals += 1
+            from tidb_tpu.util.observability import REGISTRY
+            REGISTRY.inc("tidb_tpu_work_steals_total",
+                         {"device": str(idx)})
+            continue
+        break
+    sched.release()
+    # re-pin to wherever admission finally granted: uploads, dispatch
+    # acquires and compile-cache keys all follow this index from here on
+    guard.device_index = idx
+    ph = getattr(guard, "phases", None)
+    if ph is not None:
+        ph.device_index = idx
+    if waited_total > 0.0:
+        guard.queue_wait_s += waited_total
+        guard.queue_waits += 1
+        if timeline.ENABLED:
+            timeline.record(f"sched-queue:batch"
+                            + (f"@dev{idx}" if idx else ""), "sched",
+                            dur_us=waited_total * 1e6, pid=conn_id)
+
+
 __all__ = ["DeviceScheduler", "SchedulerPool", "SCHEDULER", "POOL",
-           "device_slot", "DEFAULT_FAIRNESS_CAP", "POLL_S", "AGING_S",
+           "device_slot", "admit_statement", "pool_devices",
+           "DEFAULT_FAIRNESS_CAP", "POLL_S", "AGING_S",
            "CHEAP_BATCH_S", "CLASSES"]

@@ -448,8 +448,10 @@ def note_skipped(phases, n: int) -> None:
         return
     if phases is not None:
         phases.note_slabs_skipped(n)
+    dev = getattr(phases, "device_index", 0) if phases is not None else 0
     from tidb_tpu.util.observability import REGISTRY
-    REGISTRY.inc("tidb_tpu_slabs_skipped_total", {"engine": "device"},
+    REGISTRY.inc("tidb_tpu_slabs_skipped_total",
+                 {"engine": "device", "device": str(dev or 0)},
                  by=n)
 
 

@@ -72,9 +72,16 @@ DEFAULT_VARS: Dict[str, object] = {
     # program. 1 = parametrize only (shared programs, no coalescing),
     # 0 = literal-baked programs (the pre-serving-tier behavior)
     "tidb_tpu_microbatch_max": 16,
-    # one admission queue per visible device with round-robin placement
-    # (SchedulerPool); off = every statement shares the device-0 queue
-    "tidb_tpu_device_queues": "off",
+    # one admission queue per visible device with locality-aware
+    # placement and work stealing (SchedulerPool): auto = on when more
+    # than one device is visible (single-device hosts size the pool to
+    # 1, byte-identical to the shared device-0 queue); off = every
+    # statement shares the device-0 queue (the PR 15 serving tier)
+    "tidb_tpu_device_queues": "auto",
+    # tables with at least this many rows partition their slab ranges
+    # across the pool (one contiguous span per owner device) instead of
+    # replicating a full copy per device (executor/device_cache.py)
+    "tidb_tpu_partition_min_rows": 1 << 22,
     # coalesced single-row ingest (session/writebatch.py): N queued
     # same-digest autocommit writes share ONE commit — readers pay one
     # delta extension instead of N; off = every write commits alone
@@ -620,6 +627,10 @@ class Session:
             if prio not in ("off", "0", "false"):
                 guard.sched_class, guard.sched_cost = \
                     _classify_admission(s, one, from_prepared)
+                # tables the digest historically touched: the pool's
+                # locality placement routes warm digests to the device
+                # already holding them (cold digests → least depth)
+                guard.sched_tables = REGISTRY.digest_tables(one)
             self._guard = guard
             self.last_guard = guard
             PROCESS_REGISTRY.stmt_begin(self.conn_id, guard)

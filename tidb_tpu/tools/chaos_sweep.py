@@ -231,6 +231,18 @@ def _scenarios(mesh: Optional[int] = None) -> List[Scenario]:
                  dict(raise_=RuntimeError("chaos: demux"), times=1),
                  run="microbatch",
                  vars={**device_on, "tidb_tpu_microbatch_max": "8"}),
+        # a fault at the work-steal handoff: a batch statement parked at
+        # its admission turnstile is pulled by an idle sibling, the
+        # migration faults once — the waiter must re-queue on its HOME
+        # device (backoff charged), run exactly once, and still answer
+        # the oracle within the deadline; never lost, never doubled
+        Scenario("work-steal handoff fault → re-queued home, never lost",
+                 "steal-migrate",
+                 dict(raise_=RuntimeError("chaos: steal handoff"),
+                      times=1),
+                 run="steal",
+                 vars={**device_on, "tidb_tpu_device_queues": "on"},
+                 extra={"backoff-sleep": dict(value="skip")}),
         # -- HTAP write path (delta slabs) --------------------------------
         # a transient fault at the two-phase delta append's atomic apply
         # point: the commit backoff loop retries and the write lands
@@ -702,6 +714,62 @@ def run_sweep(verbose: bool = False, mesh: Optional[int] = None,
                         failures.append(
                             f"{sc.name}: demux faulted but no fallback "
                             f"was recorded")
+            elif sc.run == "steal":
+                from tidb_tpu.executor.scheduler import POOL
+                q = QUERIES[1]
+                # a second serving peer even on a 1-device host: the
+                # steal protocol is pure host-side queue mechanics, so
+                # the CPU sweep exercises it with device_queues forced
+                # on and the pool grown explicitly
+                POOL.ensure(2)
+                dev0, dev1 = POOL.schedulers[0], POOL.schedulers[1]
+                st_rows: List[Optional[list]] = [None]
+                st_err: List[Optional[BaseException]] = [None]
+
+                def st_run():
+                    try:
+                        st_rows[0] = s.query(q).rows
+                    except BaseException as e:  # noqa: BLE001
+                        st_err[0] = e
+
+                # hold BOTH dispatch slots so the batch statement parks
+                # at its admission turnstile (placement ties to device 0)
+                dev0.acquire(conn_id=-1)
+                dev1.acquire(conn_id=-1)
+                th = threading.Thread(target=st_run, daemon=True)
+                stole = False
+                try:
+                    th.start()
+                    t_park = time.monotonic()
+                    while time.monotonic() - t_park < 5.0:
+                        with dev0._cv:
+                            if dev0._stealable > 0:
+                                break
+                        time.sleep(0.01)
+                    # the idle sibling pulls the parked waiter; the
+                    # armed failpoint faults the handoff
+                    stole = POOL.steal_into(dev1)
+                finally:
+                    dev1.release()
+                    dev0.release()
+                th.join(DEADLINE_S)
+                if th.is_alive():
+                    slow += 1
+                    failures.append(f"{sc.name}: stolen statement HUNG")
+                elif not stole:
+                    failures.append(
+                        f"{sc.name}: no steal-eligible waiter parked "
+                        f"(batch admission never reached the turnstile)")
+                elif st_err[0] is not None:
+                    errors += 1
+                    failures.append(
+                        f"{sc.name}: statement must re-queue home and "
+                        f"heal, not fail: {type(st_err[0]).__name__}: "
+                        f"{st_err[0]}")
+                elif st_rows[0] != oracle[q]:
+                    wrong += 1
+                    failures.append(f"{sc.name}: {q!r} SILENT WRONG "
+                                    f"RESULT after faulted steal")
             elif sc.run == "delta":
                 # warm the device cache, then commit an IN-RANGE row so
                 # the next device read must extend the stale entry —

@@ -163,6 +163,12 @@ register("microbatch-demux", "result de-multiplex of a same-plan "
          "here models a demux fault, which must degrade to warned "
          "per-member individual re-execution, never a shared typed error "
          "(executor/microbatch.py)")
+register("steal-migrate", "work-steal handoff of a queued batch-class "
+         "statement — hit after the waiter is pulled off its home "
+         "device's queue, before it runs on the stealing device; a fault "
+         "here re-queues the waiter on its home device with the backoff "
+         "charged, so the statement is never lost and never run twice "
+         "(executor/scheduler.py admit_statement)")
 
 
 def enable(name: str, *, raise_: Optional[BaseException] = None,

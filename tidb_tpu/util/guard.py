@@ -44,7 +44,9 @@ class ExecutionGuard:
     __slots__ = ("conn_id", "sql", "started", "deadline", "mem_tracker",
                  "checkpoints", "_killed", "escalation", "warnings",
                  "queue_wait_s", "queue_waits", "phases",
-                 "sched_class", "sched_cost")
+                 "sched_class", "sched_cost", "sched_tables",
+                 "device_index", "sched_steal_ok", "sched_admitted",
+                 "sched_steals")
 
     def __init__(self, conn_id: int = 0, sql: str = "",
                  timeout_s: float = 0.0, mem_tracker=None):
@@ -81,6 +83,17 @@ class ExecutionGuard:
         # plus the digest's historical device-seconds cost hint
         self.sched_class: Optional[str] = None
         self.sched_cost: Optional[float] = None
+        # pod-scale placement (executor/scheduler.py SchedulerPool):
+        # tables the digest historically touched (admission handoff),
+        # the device index the statement is pinned to (stamped once by
+        # place_statement/admit_statement), steal eligibility (False
+        # when the working set is pod-partitioned), the admission-
+        # turnstile latch, and how many times this statement migrated
+        self.sched_tables: Optional[list] = None
+        self.device_index: Optional[int] = None
+        self.sched_steal_ok = True
+        self.sched_admitted = False
+        self.sched_steals = 0
         # (level, code, message) rows the statement accumulated — e.g.
         # a degraded-mesh completion — read back by SHOW WARNINGS
         self.warnings: list = []

@@ -183,7 +183,10 @@ class Registry:
                 # last-writer wins: the digest's class is stable by
                 # construction (same digest → same classification)
                 s["sched_class"] = cls
-                key = ("tidb_tpu_queue_wait_seconds", (("class", cls),))
+                dev = getattr(guard, "device_index", None)
+                key = ("tidb_tpu_queue_wait_seconds",
+                       (("class", cls),
+                        ("device", str(dev if dev is not None else 0))))
                 h = self.hists.get(key)
                 if h is None:
                     h = self.hists[key] = _hist_new()
@@ -206,6 +209,12 @@ class Registry:
                 s["h2d_skipped_bytes"] += getattr(
                     ph, "h2d_skipped_bytes", 0)
                 s["delta_rows"] += getattr(ph, "delta_rows", 0)
+                tabs = getattr(ph, "tables", None)
+                if tabs:
+                    # the statement's table footprint (open_table records
+                    # every device-path scan) — locality placement reads
+                    # it back per digest via digest_tables
+                    s.setdefault("tables", set()).update(tabs)
                 for p, v in ph.seconds.items():
                     s["phase_s"][p] = s["phase_s"].get(p, 0.0) + v
             if seconds >= threshold:
@@ -234,6 +243,18 @@ class Registry:
             if s is None or not s["count"] or s["device_s"] <= 0.0:
                 return None
             return s["device_s"] / s["count"]
+
+    def digest_tables(self, sql: str) -> Optional[list]:
+        """Table ids this statement's digest historically opened on the
+        device path — the pool's locality-placement handoff (None until
+        the digest has run with table attribution at least once)."""
+        digest = normalize_sql(sql)
+        with self._lock:
+            s = self.stmt_summary.get(digest)
+            if s is None:
+                return None
+            tabs = s.get("tables")
+            return sorted(tabs) if tabs else None
 
     def slow_rows(self) -> List[tuple]:
         with self._lock:

@@ -70,7 +70,7 @@ class PhaseTimer:
                  "specialization_hits", "conn_id",
                  "h2d_logical_bytes", "scan_logical_bytes",
                  "slabs_skipped", "h2d_skipped_bytes", "delta_rows",
-                 "_delta_seen")
+                 "_delta_seen", "device_index", "tables")
 
     def __init__(self, conn_id: int = 0):
         self.seconds: Dict[str, float] = {p: 0.0 for p in PHASES}
@@ -101,6 +101,13 @@ class PhaseTimer:
         self.delta_rows = 0
         self._delta_seen = set()
         self.conn_id = conn_id    # timeline pid (0 = unattributed)
+        # pod-scale attribution: the device index the statement is
+        # pinned to (scheduler placement stamps it; compile caches,
+        # metric labels and timeline lanes read it) and the table ids
+        # its scans opened — record_stmt folds the set into the digest
+        # profile, closing the loop locality placement routes by
+        self.device_index = 0
+        self.tables = set()
 
     @contextmanager
     def phase(self, name: str, sig: Optional[str] = None):
@@ -116,7 +123,11 @@ class PhaseTimer:
             if name == "encode" and self._in_flight:
                 self.overlapped_s += dt
             if timeline.ENABLED:
-                timeline.record(name, name, dur_us=dt * 1e6,
+                # per-device compute lanes: device 0 keeps the PR 5 lane
+                # name; sibling devices' dispatches render separately
+                lane = f"{name}@dev{self.device_index}" \
+                    if name == "compute" and self.device_index else name
+                timeline.record(lane, name, dur_us=dt * 1e6,
                                 pid=self.conn_id,
                                 args={"sig": sig} if sig else None)
 
