@@ -1015,6 +1015,75 @@ def main():
             "scheduler": sched_on}
         if err_off or err_on:
             pod["errors"] = (err_off + err_on)[:4]
+        # degraded rep (robustness numbers): arm a ONE-SHOT
+        # device-lost-dispatch fault and run the mix again — the first
+        # dispatched statement loses its device, the pool quarantines
+        # it (queued waiters migrate, its cache shard re-homes) and the
+        # mix keeps serving on survivors until the flap-guard readmits.
+        # pod_degraded_qps = qps with the loss AND the recovery inside
+        # the window; pod_recovery_s = quarantine→readmission wall
+        # (a sidecar thread samples the health monitor);
+        # statements_migrated = queue-drain + in-flight handoffs. A
+        # 1-device host grows the pool to two host-side queues first
+        # (the chaos sweep's trick) so the fault domain still
+        # exercises — informational there, like qps_scaling_x.
+        from tidb_tpu.executor.scheduler import POOL
+        from tidb_tpu.util import failpoint as _fp
+        from tidb_tpu.util.observability import REGISTRY as _reg
+
+        def _migrated():
+            return sum(v for (n, _l), v in _reg.counters.items()
+                       if n == "tidb_tpu_statements_migrated_total")
+
+        POOL.ensure(2)
+        mig0 = _migrated()
+        hb = {"fault": None, "heal": None}
+        hb_stop = threading.Event()
+
+        def _health_watch():
+            while not hb_stop.is_set():
+                q = POOL.health.quarantined_indexes()
+                if q and hb["fault"] is None:
+                    hb["fault"] = time.monotonic()
+                elif hb["fault"] is not None and not q:
+                    hb["heal"] = time.monotonic()
+                    return
+                time.sleep(0.005)
+
+        wt = threading.Thread(target=_health_watch, daemon=True)
+        _fp.enable("device-lost-dispatch",
+                   raise_=RuntimeError("bench: device lost"), times=1)
+        try:
+            wt.start()
+            lat_deg, w_deg, _sched_deg, err_deg = run_pod_mix(
+                eng, 64, 100000, level_s, "on")
+            # the mix usually heals in-window (25ms flap delay); give a
+            # quarantine that outlived it a placement-driven grace loop
+            ps = eng.new_session()
+            ps.vars["tidb_tpu_engine"] = "on"
+            ps.vars["tidb_tpu_row_threshold"] = 1
+            ps.vars["tidb_tpu_device_queues"] = "on"
+            t_grace = time.monotonic()
+            while hb["fault"] is not None and hb["heal"] is None and \
+                    time.monotonic() - t_grace < 5.0:
+                ps.query("SELECT v FROM pr WHERE k = 17")
+                time.sleep(0.02)
+        finally:
+            _fp.disable("device-lost-dispatch")
+            hb_stop.set()
+            wt.join(1.0)
+        done_deg = sum(len(v) for v in lat_deg.values())
+        qps_deg = done_deg / w_deg if w_deg > 0 and done_deg else 0.0
+        pod["pod_degraded_qps"] = round(qps_deg, 2)
+        pod["pod_recovery_s"] = \
+            round(hb["heal"] - hb["fault"], 3) \
+            if hb["heal"] is not None and hb["fault"] is not None else None
+        pod["statements_migrated"] = _migrated() - mig0
+        if err_deg:
+            pod.setdefault("errors", []).extend(err_deg[:2])
+        log(f"pod degraded: {qps_deg:.2f} qps during loss, recovery "
+            f"{pod['pod_recovery_s']}s, migrated "
+            f"{pod['statements_migrated']}")
         gate = platform != "cpu" and n_dev > 1
         pod["scaling_gate_armed"] = gate
         extra["pod_serving"] = pod

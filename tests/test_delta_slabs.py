@@ -245,6 +245,55 @@ def test_invalidation_frees_delta_device_arrays():
         f"{len(leaked)} delta-generation arrays survived invalidation"
 
 
+def test_pod_partitioned_delta_eviction_frees_every_owner():
+    """Satellite 2: a pod-partitioned (dev=-1) delta generation spreads
+    its slabs over SEVERAL owner devices — the delta slab and rewritten
+    tombstone slabs included. Invalidation must jax.Array.delete() the
+    buffers on EVERY owner, not just the tail owner that holds the
+    delta slab; a survivor-device array that slips through is an HBM
+    leak that outlives the table."""
+    eng, s = _engine()
+    s.execute("CREATE TABLE pt (a BIGINT, b BIGINT, c VARCHAR(10))")
+    for base in range(0, 8192, 1024):
+        s.execute("INSERT INTO pt VALUES " + ",".join(
+            f"({i % 40}, {(i * 7919) % 5000}, 'k{i % 5}')"
+            for i in range(base, base + 1024)))
+    s.vars["tidb_tpu_max_slab_rows"] = 1024
+    s.vars["tidb_tpu_partition_min_rows"] = 1000
+    qp = "SELECT a, COUNT(*), SUM(b) FROM pt GROUP BY a ORDER BY a"
+    s.query(qp)
+    # tombstones land in non-tail slabs too, so the rewritten keeps sit
+    # on non-tail owners alongside the tail-pinned delta slab
+    s.query("DELETE FROM pt WHERE b % 97 = 3")
+    s.query("INSERT INTO pt VALUES (3, 1234, 'k2')")
+    assert s.query(qp).rows == _oracle(s, qp)
+    ent = _entry(eng, "pt")
+    assert ent.is_delta
+    assert len(set(ent.owners)) > 1, \
+        "pod entry must span several owners for this test to bite"
+    import jax
+    arrays = [a for slabs in ent.dev.values() for t in slabs
+              if t is not None for a in t]
+    assert len({_dev_of(a) for a in arrays}) > 1, \
+        "delta generation's arrays must live on more than one device"
+    tid = eng.catalog.info_schema.table("pt").id
+    dc.invalidate(tid)
+    leaked = [a for a in arrays if not a.is_deleted()]
+    assert not leaked, (
+        f"{len(leaked)} arrays survived invalidation on devices "
+        f"{sorted({str(_dev_of(a)) for a in leaked})} — every owner "
+        f"device must be freed, not just the delta slab's tail owner")
+
+
+def _dev_of(a):
+    ds = getattr(a, "devices", None)
+    if callable(ds):
+        got = list(a.devices())
+        assert len(got) == 1
+        return got[0]
+    return a.device
+
+
 def test_delta_rows_in_phase_accounting():
     eng, s = _engine()
     s.query(Q)

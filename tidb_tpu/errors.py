@@ -114,6 +114,22 @@ class ShardFailure(ExecutionError):
     retryable = True
 
 
+class DeviceLost(ExecutionError):
+    """A serving-pool device failed at a dispatch or upload boundary
+    (launch error, device_put/transfer failure). The scheduler's health
+    monitor quarantines the device, queued waiters migrate to survivors,
+    and the in-flight victim retries ONCE on a survivor — mirroring
+    degraded-mesh semantics. Surfaces typed and retryable only when no
+    survivor exists or the retry itself hits a second lost device."""
+
+    code = 1105
+    retryable = True
+
+    def __init__(self, msg, device=None):
+        super().__init__(msg)
+        self.device = device
+
+
 class LayoutError(ExecutionError):
     """A compressed column's physical-layout descriptor is invalid or
     inconsistent with the data it describes (corrupted kind/width/ref).

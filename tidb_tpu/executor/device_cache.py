@@ -75,7 +75,7 @@ class CachedTable:
                  "compressed", "zmaps", "holes", "base_slabs",
                  "delta_version", "rows_override", "is_delta", "cov",
                  "max_rid", "tomb", "delta_rows", "dictvals_host",
-                 "device", "owners")
+                 "device", "owners", "lost")
 
     def __init__(self, td, max_slab: int, total: int, slab_cap: int,
                  n_slabs: int, parts, n_cols: int, compressed: bool = False):
@@ -113,6 +113,11 @@ class CachedTable:
         # owner device list (contiguous spans — slab s lives on owners[s])
         self.device = 0
         self.owners: Optional[List[int]] = None
+        # slab indexes whose device arrays were LOST to a quarantined
+        # pool member (evict_device nulled them and re-owned the range
+        # onto survivors) — open_table refills EXACTLY these slabs on
+        # next touch instead of re-streaming whole columns
+        self.lost: set = set()
         self.dicts: Dict[int, Optional[np.ndarray]] = {}
         self.dev: Dict[int, List[Tuple]] = {}  # col → [(vals, valid)] slabs
         # col → ColLayout for packed columns; None/absent = raw layout
@@ -436,6 +441,96 @@ def _entry_dev_bytes(key, ent) -> Dict[int, int]:
     return out or {0: 0}
 
 
+def evict_device(dead: int, survivors=None) -> int:
+    """Tear down a quarantined pool member's cache shard (the health
+    monitor calls this when a device is lost). Per-device entries keyed
+    to `dead` are evicted wholesale — small-table replicas lazily
+    re-replicate on survivors on next touch. Pod-partitioned (dev == -1)
+    entries lose ONLY the slabs the dead device owned: those device
+    tuples are nulled (best-effort `jax.Array.delete()` on arrays no
+    surviving slab shares), the holes/lost ledgers grow, and each lost
+    contiguous owner run is re-owned by the least-loaded survivor so the
+    next statement re-encodes and re-uploads JUST those slabs — the
+    untouched owners keep their arrays. Delta generations with lost
+    slabs drop whole (the decline-to-rebuild ladder: their delta slab
+    and tombstone state are pinned to owner geometry). Aligned join
+    structures live on device 0 and drop when device 0 dies.
+
+    → number of cache entries touched."""
+    dead = int(dead)
+    surv = [int(s) for s in (survivors or []) if int(s) != dead]
+    dead_c, dead_a, rehomed = [], [], []
+    with _LOCK:
+        for k in [k for k in _CACHE if k[0] == dead]:
+            ent = _CACHE.pop(k, None)
+            if ent is not None:
+                dead_c.append((k, ent))
+        if dead == 0 and _ALIGNED:
+            dead_a.extend(_ALIGNED.values())
+            _ALIGNED.clear()
+        prot = _all_protected()
+        for k in [k for k in _CACHE if k[0] < 0]:
+            ent = _CACHE[k]
+            owners = getattr(ent, "owners", None)
+            if not owners or dead not in owners:
+                continue
+            if getattr(ent, "is_delta", False) or not surv:
+                _CACHE.pop(k, None)
+                dead_c.append((k, ent))
+                continue
+            lost = [s for s, o in enumerate(owners) if o == dead]
+            doomed = []
+            for i, slabs in ent.dev.items():
+                for s in lost:
+                    if s < len(slabs) and slabs[s] is not None:
+                        doomed.append(slabs[s])
+                        slabs[s] = None
+                    ent.holes[i] = ent.holes.get(i, frozenset()) \
+                        | frozenset([s])
+                    ent.lost.add(s)
+            # re-own each contiguous lost run onto the least-loaded
+            # survivor (ties break low) — keeps owner spans contiguous
+            run = []
+            for s in lost + [None]:
+                if run and (s is None or s != run[-1] + 1):
+                    load = {d: 0 for d in surv}
+                    for o in owners:
+                        if o in load:
+                            load[o] += 1
+                    tgt = min(surv, key=lambda d: (load[d], d))
+                    for r in run:
+                        owners[r] = tgt
+                    run = []
+                if s is not None:
+                    run.append(s)
+            # best-effort delete of arrays no surviving slab shares
+            # (dict-layout dictvals ride every slab a device owns) —
+            # deferred to refcounting when a statement is mid-compute
+            if doomed and k[1:3] not in prot:
+                keep = set()
+                for slabs in ent.dev.values():
+                    for t in slabs:
+                        if t is not None:
+                            keep.update(id(a) for a in t)
+                seen = set()
+                for t in doomed:
+                    for a in t:
+                        if id(a) in keep or id(a) in seen:
+                            continue
+                        seen.add(id(a))
+                        _delete_array(a)
+            rehomed.append(k)
+    for k, ent in dead_c:
+        _safe_delete(ent, k[1:3])
+    for ent in dead_a:
+        _safe_delete(ent)
+    if timeline.ENABLED and (dead_c or rehomed):
+        timeline.instant(f"device-evict dev{dead}", "cache",
+                         args={"dropped": len(dead_c),
+                               "rehomed": len(rehomed)})
+    return len(dead_c) + len(rehomed)
+
+
 def _pow2(n: int, lo: int = 1024) -> int:
     cap = lo
     while cap < n:
@@ -741,7 +836,7 @@ def _note_storage_metrics(ent: CachedTable, key) -> None:
 
 
 def _stream_slabs(ctx, ent: CachedTable, key, used_cols, preps, phases,
-                  skip=frozenset()):
+                  skip=frozenset(), fill=None):
     """Generator behind open_table: per slab, encode the missing columns
     (host), issue their uploads (async device_put), and yield
     (slab_idx, {col: slab tuple}) covering EVERY used column so the
@@ -757,20 +852,46 @@ def _stream_slabs(ctx, ent: CachedTable, key, used_cols, preps, phases,
     they are never encoded, never uploaded, and never yielded — the
     committed column carries None holes there (ent.holes records them,
     so later statements with weaker predicates re-stream the column in
-    full)."""
+    full).
+
+    `fill` (col → slab index set) marks columns already resident whose
+    LOST slabs (nulled by evict_device when their owner was
+    quarantined) are being re-homed: only those slabs encode and upload
+    (to the slab's NEW owner — evict_device already re-owned the
+    range), warm slabs reuse the live tuples, and the commit splices
+    the refilled slabs into the existing column instead of replacing
+    it."""
+    from tidb_tpu.errors import DeviceLost
     from tidb_tpu.executor import zonemap
     from tidb_tpu.ops.jax_env import jax, jnp
+    from tidb_tpu.util import failpoint
     new_slabs = {i: [] for i in preps}
     dev_idx = getattr(ent, "device", 0)
     owners = getattr(ent, "owners", None)
 
     def _put(a, d):
         # commit to the owning pool device when one is pinned; the
-        # single-device fallback keeps the uncommitted jnp.asarray path
+        # single-device fallback keeps the uncommitted jnp.asarray path.
+        # A transfer failure at this boundary is a DEVICE fault, not a
+        # statement fault: classify it typed so the health monitor can
+        # quarantine the member and retry the statement on a survivor
+        # (the abandoned stream is safe — columns only commit after the
+        # last slab, first-commit-wins)
+        try:
+            failpoint.inject("device-lost-upload")
+        except DeviceLost:
+            raise
+        except Exception as e:  # noqa: BLE001 — armed fault, classify
+            raise DeviceLost(f"device upload failed: {e}",
+                             device=d) from e
         h = device_handle(d)
         if h is None:
             return jnp.asarray(a)
-        return jax.device_put(np.asarray(a), h)
+        try:
+            return jax.device_put(np.asarray(a), h)
+        except Exception as e:  # noqa: BLE001 — transfer fault, classify
+            raise DeviceLost(f"device upload failed: {e}",
+                             device=d) from e
 
     # dict-layout columns upload their dictionary values ONCE PER OWNER
     # DEVICE (pod entries span several); the same device array rides
@@ -784,6 +905,16 @@ def _stream_slabs(ctx, ent: CachedTable, key, used_cols, preps, phases,
     def _dict_for(i, d):
         # called under the upload phase (first slab that device owns)
         t = dict_dev.get((i, d))
+        if t is None and fill is not None and i in fill:
+            # partial refill: a survivor that already owns warm slabs of
+            # this column holds the dictionary — reuse it, don't re-ship
+            for s2, tup in enumerate(ent.dev.get(i, ())):
+                if tup is not None and len(tup) >= 3 \
+                        and owners is not None and s2 < len(owners) \
+                        and owners[s2] == d:
+                    t = tup[-1]
+                    dict_dev[(i, d)] = t
+                    break
         if t is None:
             t = _put(preps[i]["dictvals"], d)
             dict_dev[(i, d)] = t
@@ -799,7 +930,8 @@ def _stream_slabs(ctx, ent: CachedTable, key, used_cols, preps, phases,
                 new_slabs[i].append(None)
             zonemap.note_h2d_skipped(
                 phases, sum(_est_slab_phys(p, ent.slab_cap)
-                            for p in preps.values()),
+                            for i, p in preps.items()
+                            if fill is None or i not in fill),
                 table=str(key[2]) if key is not None else "")
             phases.add_scan(0, logical=sum(_slab_logical_est(ent, i, preps)
                                            for i in used_cols))
@@ -809,6 +941,8 @@ def _stream_slabs(ctx, ent: CachedTable, key, used_cols, preps, phases,
         host = {}
         with phases.phase("encode"):
             for i, prep in preps.items():
+                if fill is not None and i in fill and s not in fill[i]:
+                    continue    # warm slab of a partially-lost column
                 host[i] = _slab_host(prep, start, stop, ent.slab_cap)
         slab_dev = owners[s] if owners is not None and s < len(owners) \
             else dev_idx
@@ -818,6 +952,11 @@ def _stream_slabs(ctx, ent: CachedTable, key, used_cols, preps, phases,
                 if i in dict_cols:
                     dev_t = dev_t + (_dict_for(i, slab_dev),)
                 new_slabs[i].append(dev_t)
+        for i in preps:
+            if i not in host:
+                # partial refill, warm slab: carry the live tuple so
+                # the yielded cols dict and commit indexing line up
+                new_slabs[i].append(ent.dev[i][s])
         phases.add_h2d(sum(_tuple_nbytes(ht) for ht in host.values()),
                        logical=sum(_logical_tuple_bytes(ent, i, ht)
                                    for i, ht in host.items()))
@@ -833,6 +972,24 @@ def _stream_slabs(ctx, ent: CachedTable, key, used_cols, preps, phases,
         yield s, cols
     with _LOCK:
         for i, slabs in new_slabs.items():
+            if fill is not None and i in fill:
+                # partial refill: splice ONLY the re-uploaded lost slabs
+                # into the live column — untouched owners keep their
+                # arrays; a raced identical refill loses harmlessly
+                # (refcounting frees the loser's uploads)
+                cur = ent.dev.get(i)
+                if cur is None or len(cur) != len(slabs):
+                    continue
+                for fs in fill[i]:
+                    if cur[fs] is None:
+                        cur[fs] = slabs[fs]
+                rem = frozenset(h for h in ent.holes.get(i, frozenset())
+                                if h < len(cur) and cur[h] is None)
+                if rem:
+                    ent.holes[i] = rem
+                else:
+                    ent.holes.pop(i, None)
+                continue
             # first-commit-wins: two threads cold-loading the same column
             # concurrently both stream byte-identical slabs (the encode is
             # deterministic); the loser's arrays drop on the floor and
@@ -843,6 +1000,11 @@ def _stream_slabs(ctx, ent: CachedTable, key, used_cols, preps, phases,
                     ent.holes[i] = frozenset(skip)
                 else:
                     ent.holes.pop(i, None)
+        if fill is not None and getattr(ent, "lost", None):
+            # a lost slab heals once no resident column still holes it
+            ent.lost = {ls for ls in ent.lost
+                        if any(ls in ent.holes.get(i, frozenset())
+                               for i in ent.dev)}
     phases.clear_in_flight()
     _note_storage_metrics(ent, key)
     if key is not None:
@@ -1159,15 +1321,28 @@ def open_table(ctx, scan, used_cols, max_slab: int, phases=None,
         _safe_delete(ent, key[1:3])
         return open_table(ctx, scan, used_cols, max_slab, phases=phases,
                           prune=prune)
+    fill = {}
     if refill:
-        with _LOCK:
-            for i in refill:
-                # this statement's predicates reach slabs an earlier,
-                # more selective statement pruned away on cold touch:
-                # drop the holey generation and re-stream the column in
-                # full (refcounting frees the old device buffers)
-                ent.dev.pop(i, None)
-                ent.holes.pop(i, None)
+        lost = set(getattr(ent, "lost", None) or ())
+        full = []
+        for i in refill:
+            need = frozenset(ent.holes.get(i, frozenset()) - skip)
+            if lost and need and need <= lost:
+                # every uncovered hole is a quarantine-lost slab whose
+                # range was already re-owned onto survivors: refill JUST
+                # those slabs, keep the untouched owners' arrays
+                fill[i] = need
+            else:
+                full.append(i)
+        if full:
+            with _LOCK:
+                for i in full:
+                    # this statement's predicates reach slabs an earlier,
+                    # more selective statement pruned away on cold touch:
+                    # drop the holey generation and re-stream the column
+                    # in full (refcounting frees the old device buffers)
+                    ent.dev.pop(i, None)
+                    ent.holes.pop(i, None)
     if not missing:
         # fully warm: the program READS every surviving resident slab —
         # charge those HBM bytes to the statement so roofline accounting
@@ -1194,6 +1369,22 @@ def open_table(ctx, scan, used_cols, max_slab: int, phases=None,
     with ph.phase("encode"):
         for i in missing:
             preps[i] = _col_prep(ent, i, ftypes[i])
+            if i in fill:
+                # the re-prep must reproduce the committed layout for
+                # spliced slabs to decode alongside the warm ones — the
+                # host data is unchanged (same td) so it does, unless
+                # workload hints moved choose_layout: then demote to a
+                # full re-stream of the column
+                old = ent.layouts.get(i)
+                new = preps[i]["layout"]
+                same = (old is None and new is None) or (
+                    old is not None and new is not None
+                    and old.sig() == new.sig())
+                if not same:
+                    del fill[i]
+                    with _LOCK:
+                        ent.dev.pop(i, None)
+                        ent.holes.pop(i, None)
             ent.dicts[i] = preps[i]["dict"]
             ent.bounds[i] = preps[i]["bounds"]
             # layout commits eagerly with dicts/bounds: program
@@ -1211,7 +1402,7 @@ def open_table(ctx, scan, used_cols, max_slab: int, phases=None,
         # skip set only ever grows, so warm columns' holes stay covered
         skip = zonemap.prune_slabs(ent, scan)
     return ent, _stream_slabs(ctx, ent, key, list(used_cols), preps, ph,
-                              skip=skip)
+                              skip=skip, fill=fill or None)
 
 
 def get_table(ctx, scan, used_cols, max_slab: int,

@@ -43,7 +43,7 @@ import numpy as np
 log = logging.getLogger("tidb_tpu.fragment")
 
 from tidb_tpu.chunk import Chunk, Column
-from tidb_tpu.errors import (CapacityError, ExecutionError,
+from tidb_tpu.errors import (CapacityError, DeviceLost, ExecutionError,
                              MemoryQuotaExceeded, QueryKilledError,
                              QueryTimeout, ShardFailure)
 from tidb_tpu.expression import EvalContext, Expression, ColumnRef
@@ -1348,6 +1348,11 @@ class TpuFragmentExec:
         qw = (f", queue_wait:{g.queue_wait_s * 1000.0:.1f}ms"
               f"({g.queue_waits})"
               if g is not None and getattr(g, "queue_waits", 0) else "")
+        # degraded-pod marker: how many times this statement was moved
+        # off a lost/quarantined device before it completed
+        mig = (f", migrated:{g.sched_migrated}"
+               if g is not None and getattr(g, "sched_migrated", 0)
+               else "")
         rf = ""
         if ph is not None and ph.scan_bytes and ph.wall_s > 0.0:
             from tidb_tpu.util import roofline
@@ -1362,7 +1367,7 @@ class TpuFragmentExec:
                 if ef > 0.0:
                     rf += f", effective_roofline_fraction:{ef:.3f}"
         if self.used_device:
-            return f"device:yes{esc}{phs}{qw}{rf}"
+            return f"device:yes{esc}{phs}{qw}{mig}{rf}"
         if self.fallback_reason:
             return f"device:fallback({self.fallback_reason}){esc}"
         return ""
@@ -1375,63 +1380,88 @@ class TpuFragmentExec:
             # checkpoint BEFORE device dispatch: a killed/expired query
             # must not pay for compile + upload it will never use
             self.ctx.check_killed("device-dispatch")
-            try:
-                import time as _time
+            retried_lost = False
+            while True:
+                try:
+                    import time as _time
 
-                from tidb_tpu.util.tracing import maybe_span
-                _t0 = _time.perf_counter()
-                with maybe_span(getattr(self.ctx, "tracer", None),
-                                "device.fragment",
-                                root=self.plan.root.name):
-                    # mark every table this fragment reads as in active
-                    # use for the statement's WHOLE device run: sibling
-                    # sessions' evictions (budget, LRU, invalidation)
-                    # must never free buffers mid-compute
-                    with self._protect_tables():
-                        self._result = self._run_device()
-                global LAST_DEVICE_EXEC_S, LAST_PHASES
-                LAST_DEVICE_EXEC_S = _time.perf_counter() - _t0
-                self.used_device = True
-                _ph = getattr(self.ctx, "phases", None)
-                if _ph is not None:
-                    _ph.add_wall(LAST_DEVICE_EXEC_S)
-                    LAST_PHASES = _ph
-                _tr = getattr(self.ctx, "tracer", None)
-                _esc = getattr(self.ctx, "escalation", None)
-                if _tr is not None and _esc is not None and _esc.total:
-                    # TRACE shows what the ladder did to this statement
-                    _tr.event("device.escalation", summary=_esc.summary())
-                if _tr is not None and _ph is not None and _ph.total:
-                    # where the device wall went + how much host encode
-                    # hid behind in-flight transfers/compute
-                    _tr.event("device.phases",
-                              duration_s=LAST_DEVICE_EXEC_S,
-                              **_ph.as_dict())
-            except FragmentFallback as e:
-                # expected ineligibility (shape/feature gate) — quiet path
-                self.fallback_reason = str(e) or "ineligible"
-                if strict:
-                    raise ExecutionError(
-                        f"tidb_tpu_strict: device fragment fell back: "
-                        f"{self.fallback_reason}") from e
-                return self._fallback_next()
-            except (QueryKilledError, QueryTimeout, MemoryQuotaExceeded,
-                    CapacityError, ShardFailure):
-                # lifecycle and typed capacity/shard errors unwind past the
-                # fallback ladder: a killed/expired/over-quota query must
-                # die, not retry the same work on CPU — and a shard fault
-                # that already survived its ladder retry (or an exhausted
-                # capacity ladder) surfaces typed instead of silently
-                # re-running the whole statement on the host
-                raise
-            except Exception as e:  # noqa: BLE001
-                # UNEXPECTED device failure: never silent (VERDICT r1 weak #4)
-                self.fallback_reason = f"{type(e).__name__}: {e}"
-                log.warning("device fragment failed, falling back to CPU: %s",
-                            self.fallback_reason, exc_info=True)
-                if strict:
+                    from tidb_tpu.util.tracing import maybe_span
+                    _t0 = _time.perf_counter()
+                    with maybe_span(getattr(self.ctx, "tracer", None),
+                                    "device.fragment",
+                                    root=self.plan.root.name):
+                        # mark every table this fragment reads as in
+                        # active use for the statement's WHOLE device
+                        # run: sibling sessions' evictions (budget, LRU,
+                        # invalidation) must never free buffers
+                        # mid-compute
+                        with self._protect_tables():
+                            self._result = self._run_device()
+                    global LAST_DEVICE_EXEC_S, LAST_PHASES
+                    LAST_DEVICE_EXEC_S = _time.perf_counter() - _t0
+                    self.used_device = True
+                    _ph = getattr(self.ctx, "phases", None)
+                    if _ph is not None:
+                        _ph.add_wall(LAST_DEVICE_EXEC_S)
+                        LAST_PHASES = _ph
+                    _tr = getattr(self.ctx, "tracer", None)
+                    _esc = getattr(self.ctx, "escalation", None)
+                    if _tr is not None and _esc is not None and _esc.total:
+                        # TRACE shows what the ladder did to this stmt
+                        _tr.event("device.escalation",
+                                  summary=_esc.summary())
+                    if _tr is not None and _ph is not None and _ph.total:
+                        # where the device wall went + how much host
+                        # encode hid behind in-flight transfers/compute
+                        _tr.event("device.phases",
+                                  duration_s=LAST_DEVICE_EXEC_S,
+                                  **_ph.as_dict())
+                except FragmentFallback as e:
+                    # expected ineligibility (shape/feature gate) — quiet
+                    self.fallback_reason = str(e) or "ineligible"
+                    if strict:
+                        raise ExecutionError(
+                            f"tidb_tpu_strict: device fragment fell "
+                            f"back: {self.fallback_reason}") from e
+                    return self._fallback_next()
+                except DeviceLost as e:
+                    # degraded pod: quarantine the lost device (queued
+                    # waiters migrate, its cache shard re-homes) and
+                    # retry ONCE on a healthy survivor — warned with a
+                    # retryable 1105 SHOW WARNINGS row, mirroring
+                    # degraded-mesh semantics. A second loss, a pool
+                    # that cannot degrade (single slot), or no healthy
+                    # survivor surfaces the typed error instead — never
+                    # a silent CPU re-run that would hide a dead device.
+                    from tidb_tpu.executor import scheduler as _sched
+                    tgt = None if retried_lost \
+                        else _sched.device_fault(self.ctx, e)
+                    if tgt is None:
+                        raise
+                    log.warning("device lost, retrying statement on "
+                                "device %d: %s", tgt, e)
+                    retried_lost = True
+                    continue
+                except (QueryKilledError, QueryTimeout,
+                        MemoryQuotaExceeded, CapacityError, ShardFailure):
+                    # lifecycle and typed capacity/shard errors unwind
+                    # past the fallback ladder: a killed/expired/
+                    # over-quota query must die, not retry the same work
+                    # on CPU — and a shard fault that already survived
+                    # its ladder retry (or an exhausted capacity ladder)
+                    # surfaces typed instead of silently re-running the
+                    # whole statement on the host
                     raise
-                return self._fallback_next()
+                except Exception as e:  # noqa: BLE001
+                    # UNEXPECTED device failure: never silent
+                    self.fallback_reason = f"{type(e).__name__}: {e}"
+                    log.warning("device fragment failed, falling back "
+                                "to CPU: %s",
+                                self.fallback_reason, exc_info=True)
+                    if strict:
+                        raise
+                    return self._fallback_next()
+                break
             # checkpoint AFTER host fetch, before results flow upward
             from tidb_tpu.util import failpoint
             failpoint.inject("host-fetch")
@@ -1482,6 +1512,19 @@ class TpuFragmentExec:
         # and may be stolen to an idle sibling — here, before any byte
         # has picked a device
         scheduler.admit_statement(self.ctx)
+        # the dispatch boundary of the device fault domain: a raise here
+        # models the placed device failing its launch, classified into a
+        # typed DeviceLost carrying the device index — next()'s retry
+        # loop quarantines it and re-runs ONCE on a survivor
+        try:
+            failpoint.inject("device-lost-dispatch")
+        except DeviceLost:
+            raise
+        except Exception as e:
+            _g = getattr(self.ctx, "guard", None)
+            raise DeviceLost(
+                f"device launch failed: {e}",
+                device=getattr(_g, "device_index", None)) from e
 
         if getattr(self.plan, "dist", 0) > 1:
             return self._run_device_dist()

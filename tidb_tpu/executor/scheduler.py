@@ -75,6 +75,21 @@ Scope of the slot — dispatch, not residency:
     OUTSIDE the slot. Query B's encode therefore overlaps query A's XLA
     execution exactly as the phase machinery (util/phases.py) names it.
 
+Degraded-pod serving (the device fault domain): a DeviceLost fault at a
+dispatch or upload boundary reports to the pool's DeviceHealthMonitor,
+which quarantines the device (flap-guarded by one shared
+util/backoff.py budget charge per quarantine). A quarantined device
+stops receiving placements and steal pulls, its steal-eligible queued
+waiters migrate to healthy survivors through the same _Migrated handoff
+work stealing uses (KILL/deadline still land on migrated waiters), its
+HBM cache shard is evicted / re-homed (device_cache.evict_device), and
+the in-flight victim retries ONCE on a survivor with a retryable 1105
+SHOW WARNINGS row (device_fault). Once the flap-guard delay passes, a
+health probe through the device-readmit failpoint gate readmits the
+device to placement; it repopulates lazily. report_fault refuses to
+quarantine the LAST healthy device — a pool of one keeps serving and
+the typed error surfaces instead.
+
 Fairness (orthogonal to class): a connection which has taken
 FAIRNESS_CAP consecutive grants while another connection waits yields to
 the best-ranked waiter from a different connection — a tight
@@ -384,10 +399,173 @@ class DeviceScheduler:
             self.class_wait_s = {}
 
 
+class DeviceHealthMonitor:
+    """Device-level fault domain for the serving pool (degraded-pod
+    serving). Per-device records exist ONLY after a first fault — a
+    fault-free pod takes the empty-dict fast path on every placement and
+    steal decision, so its behavior stays byte-identical to a pool with
+    no health tracking at all.
+
+    Lifecycle of one device:
+
+      healthy ──report_fault──▶ QUARANTINED: placements stop, queued
+      steal-eligible waiters migrate to survivors (drain_queue), the
+      HBM cache shard is evicted / re-homed (device_cache.evict_device)
+      ──flap-guard delay (one charge() of the shared util/backoff.py
+      budget per quarantine)──▶ health probe (the device-readmit
+      failpoint gate + a tiny transfer) ──pass──▶ healthy again,
+      repopulating lazily ──fail──▶ next exponential delay; a spent
+      budget quarantines the device permanently (it flapped too often).
+
+    report_fault REFUSES to quarantine the last healthy device: a pool
+    of one keeps serving and the typed DeviceLost surfaces instead."""
+
+    def __init__(self, pool):
+        self._pool = pool
+        self._lock = threading.Lock()
+        self._rec: Dict[int, dict] = {}
+
+    def active(self) -> bool:
+        """Any device ever faulted? False = the fault-free fast path."""
+        return bool(self._rec)
+
+    def healthy(self, idx: int) -> bool:
+        rec = self._rec.get(idx)
+        return rec is None or not rec["quarantined"]
+
+    def healthy_indexes(self) -> List[int]:
+        with self._pool._lock:
+            n = len(self._pool.schedulers)
+        return [i for i in range(n) if self.healthy(i)]
+
+    def quarantined_indexes(self) -> List[int]:
+        with self._lock:
+            return sorted(i for i, r in self._rec.items()
+                          if r["quarantined"])
+
+    def report_fault(self, idx: int, err=None) -> bool:
+        """Quarantine `idx` after a device-level fault. → True when the
+        device was quarantined (survivors exist); False when it is the
+        last healthy device or outside the pool."""
+        from tidb_tpu.util.backoff import BackoffExhausted, Backoffer
+        from tidb_tpu.util.observability import REGISTRY
+        idx = int(idx)
+        with self._pool._lock:
+            n = len(self._pool.schedulers)
+        if idx < 0 or idx >= n:
+            return False
+        with self._lock:
+            survivors = [i for i in range(n)
+                         if i != idx and self.healthy(i)]
+            if not survivors:
+                return False
+            rec = self._rec.get(idx)
+            if rec is None:
+                rec = self._rec[idx] = {
+                    "quarantined": False, "faults": 0, "readmissions": 0,
+                    "bo": Backoffer("device-readmit", base_ms=25.0,
+                                    max_ms=2000.0, budget_ms=10000.0),
+                    "not_before": None, "probing": False}
+            rec["faults"] += 1
+            already = rec["quarantined"]
+            rec["quarantined"] = True
+            # flap guard: every quarantine charges one exponential step
+            # of the shared backoff budget; a spent budget means the
+            # device flapped too often — no more probes, permanent out
+            try:
+                delay_ms = rec["bo"].charge(err)
+                rec["not_before"] = time.monotonic() + delay_ms / 1000.0
+            except BackoffExhausted:
+                rec["not_before"] = None
+        if not already:
+            REGISTRY.inc("tidb_tpu_device_quarantines_total",
+                         {"device": str(idx)})
+            REGISTRY.set_gauge("tidb_tpu_device_healthy", 0.0,
+                               {"device": str(idx)})
+            timeline.instant(f"device-quarantine dev{idx}", "sched")
+        # queued waiters migrate to survivors; the dead shard's HBM is
+        # evicted and pod-partitioned slab ranges re-own onto survivors
+        # (best effort — the pool must keep serving even if cleanup
+        # itself trips on the dead device)
+        self._pool.drain_queue(idx)
+        try:
+            from tidb_tpu.executor import device_cache
+            device_cache.evict_device(idx, survivors)
+        except Exception:  # noqa: BLE001 — eviction is best-effort
+            pass
+        return True
+
+    def maybe_readmit(self) -> None:
+        """Opportunistic readmission sweep, called from placement while
+        quarantined devices exist: every device past its flap-guard
+        delay gets ONE health probe; a clean pass rejoins placement (and
+        repopulates its cache shard lazily on first touch)."""
+        now = time.monotonic()
+        due = []
+        with self._lock:
+            for idx, rec in self._rec.items():
+                if rec["quarantined"] and not rec["probing"] \
+                        and rec["not_before"] is not None \
+                        and now >= rec["not_before"]:
+                    rec["probing"] = True
+                    due.append(idx)
+        for idx in due:
+            self._probe(idx)
+
+    def _probe(self, idx: int) -> None:
+        """One health probe of a quarantined device: the device-readmit
+        failpoint gate, then a tiny best-effort transfer onto the real
+        device handle. Pass → readmitted; fail → next flap-guard step."""
+        from tidb_tpu.util import failpoint
+        from tidb_tpu.util.backoff import BackoffExhausted
+        from tidb_tpu.util.observability import REGISTRY
+        ok, probe_err = True, None
+        try:
+            failpoint.inject("device-readmit")
+            from tidb_tpu.executor import device_cache
+            h = device_cache.device_handle(idx)
+            if h is not None:
+                from tidb_tpu.ops.jax_env import jax
+                import numpy as np
+                jax.device_put(np.zeros((1,), np.int32), h)
+        except Exception as err:  # noqa: BLE001 — probe failed
+            ok, probe_err = False, err
+        with self._lock:
+            rec = self._rec.get(idx)
+            if rec is None:
+                return
+            rec["probing"] = False
+            if ok:
+                rec["quarantined"] = False
+                rec["readmissions"] += 1
+                rec["not_before"] = None
+            else:
+                try:
+                    delay_ms = rec["bo"].charge(probe_err)
+                    rec["not_before"] = \
+                        time.monotonic() + delay_ms / 1000.0
+                except BackoffExhausted:
+                    rec["not_before"] = None
+        if ok:
+            REGISTRY.set_gauge("tidb_tpu_device_healthy", 1.0,
+                               {"device": str(idx)})
+            timeline.instant(f"device-readmit dev{idx}", "sched")
+
+    def snapshot(self) -> Dict[int, dict]:
+        """Per-device health for stats(): faults / readmissions /
+        quarantined, without the live Backoffer."""
+        with self._lock:
+            return {i: {"quarantined": r["quarantined"],
+                        "faults": r["faults"],
+                        "readmissions": r["readmissions"]}
+                    for i, r in self._rec.items()}
+
+
 class SchedulerPool:
     """One DeviceScheduler per visible device slot, with locality-aware
-    placement (place_statement) and the work-steal hook (steal_into) —
-    the pod-scale serving half of the tier."""
+    placement (place_statement), the work-steal hook (steal_into) and a
+    device fault domain (DeviceHealthMonitor) — the pod-scale serving
+    half of the tier."""
 
     def __init__(self, n: int = 1,
                  fairness_cap: int = DEFAULT_FAIRNESS_CAP):
@@ -395,6 +573,7 @@ class SchedulerPool:
         self.schedulers: List[DeviceScheduler] = [
             DeviceScheduler(i, fairness_cap, pool=self)
             for i in range(max(1, n))]
+        self.health = DeviceHealthMonitor(self)
 
     def ensure(self, n: int) -> None:
         """Grow to `n` slots (never shrinks: a statement may still hold
@@ -430,6 +609,16 @@ class SchedulerPool:
         strands nothing — it must simply never bounce."""
         with self._lock:
             n = len(self.schedulers)
+        # degraded pod: probe overdue quarantined devices for
+        # readmission, then keep new placements off the ones still out.
+        # active() is an empty-dict check — a fault-free pod pays one
+        # attribute load here and places byte-identically to PR 18.
+        avoid: set = set()
+        if self.health.active():
+            self.health.maybe_readmit()
+            avoid = {i for i in range(n) if not self.health.healthy(i)}
+            if len(avoid) >= n:
+                avoid = set()      # nothing healthy: serve anyway
         if guard is None:
             return conn_id % n
         idx = getattr(guard, "device_index", None)
@@ -454,14 +643,18 @@ class SchedulerPool:
                         guard.sched_steal_ok = False
                         continue
                     for d in devs:
+                        if d in avoid:
+                            continue
                         votes[d] = votes.get(d, 0) + 1
                 if votes:
                     best = max(votes.values())
                     idx = min(d for d, v in votes.items() if v == best)
                     idx = min(idx, n - 1)
             if idx is None:
-                depths = [s.queue_depth() for s in self.schedulers[:n]]
-                idx = depths.index(min(depths))
+                cand = [i for i in range(n) if i not in avoid] \
+                    or list(range(n))
+                depths = [self.schedulers[i].queue_depth() for i in cand]
+                idx = cand[depths.index(min(depths))]
         guard.device_index = idx
         ph = getattr(guard, "phases", None)
         if ph is not None:
@@ -476,7 +669,24 @@ class SchedulerPool:
         with self._lock:
             members = list(self.schedulers)
         return [s.device_index for s in members
-                if s is not sched and s._holder is None and not s._queue]
+                if s is not sched and s._holder is None and not s._queue
+                and self.health.healthy(s.device_index)]
+
+    @staticmethod
+    def _claim_waiter(sib: DeviceScheduler, e, target_idx: int) -> bool:
+        """Claim ONE queued waiter for migration — caller holds sib._cv.
+        Re-verifies the entry is still queued and unclaimed before
+        stamping _MOVED: the exactly-once guard when a release-into-empty
+        steal races a quarantine drain of the same home queue. Both
+        paths claim through here under the same lock, so the second
+        claimant always observes the first's stamp and backs off — a
+        waiter is migrated once, never lost, never doubled."""
+        if e[_MOVED] is not None or e not in sib._queue:
+            return False
+        e[_MOVED] = int(target_idx)
+        sib._queue.remove(e)
+        sib._stealable -= 1
+        return True
 
     def steal_into(self, target: DeviceScheduler) -> bool:
         """Pull the best-ranked steal-eligible waiter from the deepest
@@ -484,7 +694,10 @@ class SchedulerPool:
         dequeued under its own scheduler's lock with _MOVED set; the
         blocked waiter thread observes the move and re-acquires on the
         target itself — the statement migrates, its thread never
-        changes. → True when a waiter was moved."""
+        changes. → True when a waiter was moved. A quarantined target
+        refuses to pull (it must stop receiving work, not attract it)."""
+        if not self.health.healthy(target.device_index):
+            return False
         with self._lock:
             sibs = [s for s in self.schedulers if s is not target]
         # racy pre-screen (plain int reads): the common all-idle release
@@ -501,12 +714,40 @@ class SchedulerPool:
                 if not elig:
                     continue
                 e = min(elig, key=lambda e: sib._rank(e, now))
-                e[_MOVED] = target.device_index
-                sib._queue.remove(e)
-                sib._stealable -= 1
+                if not self._claim_waiter(sib, e, target.device_index):
+                    continue
                 sib._cv.notify_all()
             return True
         return False
+
+    def drain_queue(self, idx: int) -> int:
+        """Migrate every steal-eligible waiter off a quarantined
+        device's queue onto healthy survivors (round-robin across them).
+        Claims go through _claim_waiter — the same under-lock discipline
+        steal_into uses — so a concurrent release-into-empty steal of
+        this same queue migrates each waiter exactly once. Waiters that
+        cannot migrate (interactive acquires, pod-pinned statements)
+        stay queued: the quarantined scheduler still grants its queue —
+        quarantine stops NEW placements, not drainage — and KILL or a
+        deadline still lands through the acquire poll loop either way.
+        → number of waiters migrated."""
+        with self._lock:
+            if idx < 0 or idx >= len(self.schedulers):
+                return 0
+            sched = self.schedulers[idx]
+        targets = [i for i in self.health.healthy_indexes() if i != idx]
+        if not targets:
+            return 0
+        moved = 0
+        with sched._cv:
+            for e in [e for e in sched._queue
+                      if e[_STEAL] and e[_MOVED] is None]:
+                if self._claim_waiter(sched, e,
+                                      targets[moved % len(targets)]):
+                    moved += 1
+            if moved:
+                sched._cv.notify_all()
+        return moved
 
     def stats(self) -> dict:
         """Aggregate counters across EVERY pool member (top-level keys
@@ -516,6 +757,14 @@ class SchedulerPool:
         with self._lock:
             members = list(self.schedulers)
         per = {f"device{s.device_index}": s.stats() for s in members}
+        health = self.health.snapshot()
+        for s in members:
+            d = per[f"device{s.device_index}"]
+            d["healthy"] = self.health.healthy(s.device_index)
+            h = health.get(s.device_index)
+            if h is not None:
+                d["faults"] = h["faults"]
+                d["readmissions"] = h["readmissions"]
         agg: dict = {"admissions": 0, "waits": 0, "wait_s_total": 0.0,
                      "yields": 0, "steals": 0, "classes": {}}
         for s in per.values():
@@ -654,12 +903,20 @@ def admit_statement(ctx) -> None:
                 idx, steal_ok = home, False
                 continue
             idx, steal_ok = int(m.target), False
+            from tidb_tpu.util.observability import REGISTRY
+            if not POOL.health.healthy(home):
+                # quarantine drain, not a steal: the waiter left a
+                # quarantined home queue for a healthy survivor
+                guard.sched_migrated = \
+                    getattr(guard, "sched_migrated", 0) + 1
+                REGISTRY.inc("tidb_tpu_statements_migrated_total",
+                             {"device": str(idx)})
+                continue
             guard.sched_steals = getattr(guard, "sched_steals", 0) + 1
             with POOL._lock:
                 tgt = POOL.schedulers[min(idx, len(POOL.schedulers) - 1)]
             with tgt._cv:
                 tgt.steals += 1
-            from tidb_tpu.util.observability import REGISTRY
             REGISTRY.inc("tidb_tpu_work_steals_total",
                          {"device": str(idx)})
             continue
@@ -680,7 +937,52 @@ def admit_statement(ctx) -> None:
                             dur_us=waited_total * 1e6, pid=conn_id)
 
 
-__all__ = ["DeviceScheduler", "SchedulerPool", "SCHEDULER", "POOL",
+def device_fault(ctx, err) -> Optional[int]:
+    """Degraded-pod handoff for an in-flight DeviceLost: report the
+    fault to the pool's health monitor (quarantine, queue drain, cache
+    re-homing), pick the least-loaded healthy survivor, and re-pin the
+    statement onto it for its ONE retry — recording a retryable 1105
+    SHOW WARNINGS row, mirroring degraded-mesh semantics. → the
+    survivor's index, or None when the pool cannot degrade (scheduler
+    off, single slot, or no healthy survivor) — the caller lets the
+    typed error surface instead."""
+    mode = str(ctx.vars.get("tidb_tpu_scheduler", "on")).lower()
+    if mode in ("off", "0", "false") or not _queues_on(ctx):
+        return None
+    guard = getattr(ctx, "guard", None)
+    dev = getattr(err, "device", None)
+    if dev is None and guard is not None:
+        dev = getattr(guard, "device_index", None)
+    dev = int(dev) if dev is not None else 0
+    POOL.ensure(_visible_devices())
+    if not POOL.health.report_fault(dev, err):
+        return None
+    survivors = [i for i in POOL.health.healthy_indexes() if i != dev]
+    if not survivors:
+        return None
+    with POOL._lock:
+        scheds = [POOL.schedulers[i] for i in survivors]
+    depths = [s.queue_depth() for s in scheds]
+    idx = survivors[depths.index(min(depths))]
+    if guard is not None:
+        guard.device_index = idx
+        ph = getattr(guard, "phases", None)
+        if ph is not None:
+            ph.device_index = idx
+        guard.sched_migrated = getattr(guard, "sched_migrated", 0) + 1
+        guard.warnings.append(
+            ("Warning", 1105,
+             f"device {dev} lost ({err}); statement retried on device "
+             f"{idx}"))
+    from tidb_tpu.util.observability import REGISTRY
+    REGISTRY.inc("tidb_tpu_statements_migrated_total",
+                 {"device": str(idx)})
+    return idx
+
+
+__all__ = ["DeviceScheduler", "SchedulerPool", "DeviceHealthMonitor",
+           "SCHEDULER", "POOL",
            "device_slot", "admit_statement", "pool_devices",
+           "device_fault",
            "DEFAULT_FAIRNESS_CAP", "POLL_S", "AGING_S",
            "CHEAP_BATCH_S", "CLASSES"]

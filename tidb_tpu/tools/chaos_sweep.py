@@ -243,6 +243,36 @@ def _scenarios(mesh: Optional[int] = None) -> List[Scenario]:
                  run="steal",
                  vars={**device_on, "tidb_tpu_device_queues": "on"},
                  extra={"backoff-sleep": dict(value="skip")}),
+        # -- degraded pod (device fault domain) ---------------------------
+        # a pool device dies at its DISPATCH boundary mid-concurrent-mix:
+        # the in-flight victim classifies into a typed DeviceLost, the
+        # health monitor quarantines the device (queued waiters migrate
+        # to survivors, its HBM shard is evicted/re-homed) and the victim
+        # retries ONCE on a survivor with a retryable 1105 warning —
+        # EVERY statement in the mix must still answer the oracle within
+        # the deadline (zero lost, zero doubled). Once the one-shot fault
+        # is spent, the flap-guard delay elapses, the placement-driven
+        # readmit probe (metered through the armed device-readmit gate)
+        # rejoins the device, and placements land on it again
+        Scenario("device lost at dispatch → quarantine, migrate, readmit",
+                 "device-lost-dispatch",
+                 dict(raise_=RuntimeError("chaos: device lost"), times=1),
+                 run="podfault",
+                 vars={**device_on, "tidb_tpu_device_queues": "on"},
+                 extra={"backoff-sleep": dict(value="skip"),
+                        "device-readmit": dict()}),
+        # the same fault domain at the UPLOAD boundary: the device dies
+        # while its cold cache shard is streaming in (device_put). The
+        # partially-committed shard is evicted with the quarantine and
+        # the statement re-streams onto a survivor — same
+        # exactly-once/readmission contract as the dispatch fault
+        Scenario("device lost at upload → quarantine, re-stream, readmit",
+                 "device-lost-upload",
+                 dict(raise_=RuntimeError("chaos: upload lost"), times=1),
+                 run="podfault",
+                 vars={**device_on, "tidb_tpu_device_queues": "on"},
+                 extra={"backoff-sleep": dict(value="skip"),
+                        "device-readmit": dict()}),
         # -- HTAP write path (delta slabs) --------------------------------
         # a transient fault at the two-phase delta append's atomic apply
         # point: the commit backoff loop retries and the write lands
@@ -396,6 +426,17 @@ def _scenarios(mesh: Optional[int] = None) -> List[Scenario]:
                      run="mesh-isolation", vars=dict(dist_on), mesh=True),
         ]
     return out
+
+
+def list_sites() -> Dict[str, str]:
+    """The sweep's authoritative failpoint catalog: every site
+    registered in util/failpoint.py PLUS module-scope registrations
+    (executor/zonemap.py's zone-map-stale) — imported here so the
+    enumeration matches what the coverage gate sweeps.
+    → {site: description} (tools/check_failpoints.py cross-checks the
+    count, keeping the advertised site number honest)."""
+    from tidb_tpu.executor import zonemap  # noqa: F401 — registers at import
+    return failpoint.catalog()
 
 
 def _run_statement(session, sql: str):
@@ -770,6 +811,145 @@ def run_sweep(verbose: bool = False, mesh: Optional[int] = None,
                     wrong += 1
                     failures.append(f"{sc.name}: {q!r} SILENT WRONG "
                                     f"RESULT after faulted steal")
+            elif sc.run == "podfault":
+                from tidb_tpu.executor import device_cache as _dc
+                from tidb_tpu.executor.scheduler import POOL
+                from tidb_tpu.util.observability import REGISTRY
+
+                def _ctr(name):
+                    return sum(v for (n, _l), v in
+                               REGISTRY.counters.items() if n == name)
+
+                # a pod of two serving peers even on a 1-device host (the
+                # fault domain is host-side pool mechanics), and a COLD
+                # cache so the upload-boundary site actually streams
+                POOL.ensure(2)
+                _dc.clear()
+                q_before = _ctr("tidb_tpu_device_quarantines_total")
+                m_before = _ctr("tidb_tpu_statements_migrated_total")
+                pf_qs = QUERIES * 2
+                pf_sessions = []
+                for _ in pf_qs:
+                    s_i = eng.new_session()
+                    s_i.vars.update(sc.vars)
+                    pf_sessions.append(s_i)
+                pf_rows: List[Optional[list]] = [None] * len(pf_qs)
+                pf_errs: List[Optional[BaseException]] = \
+                    [None] * len(pf_qs)
+
+                def pf_run(i):
+                    try:
+                        pf_rows[i] = pf_sessions[i].query(pf_qs[i]).rows
+                    except BaseException as e:  # noqa: BLE001
+                        pf_errs[i] = e
+
+                ths = [threading.Thread(target=pf_run, args=(i,),
+                                        daemon=True)
+                       for i in range(len(pf_qs))]
+                for th in ths:
+                    th.start()
+                for i, th in enumerate(ths):
+                    th.join(DEADLINE_S)
+                    if th.is_alive():
+                        slow += 1
+                        failures.append(
+                            f"{sc.name}: statement {i} HUNG past the "
+                            f"deadline (lost to the dead device?)")
+                # exactly-once: every statement must come back with the
+                # oracle rows — the one victim heals through its single
+                # survivor retry, so even a typed error is a failure here
+                for i, (rows, err) in enumerate(zip(pf_rows, pf_errs)):
+                    if err is not None:
+                        errors += 1
+                        failures.append(
+                            f"{sc.name}: statement {i} must retry on a "
+                            f"survivor, not fail: "
+                            f"{type(err).__name__}: {err}")
+                    elif rows != oracle[pf_qs[i]]:
+                        wrong += 1
+                        failures.append(
+                            f"{sc.name}: statement {i} SILENT WRONG "
+                            f"ROWS after device loss")
+                if failpoint.hits(sc.site) == 0:
+                    failures.append(
+                        f"{sc.name}: the armed fault never fired — the "
+                        f"mix missed the {sc.site} boundary")
+                else:
+                    if _ctr("tidb_tpu_device_quarantines_total") \
+                            <= q_before:
+                        failures.append(
+                            f"{sc.name}: device fault fired but no "
+                            f"device was quarantined")
+                    if _ctr("tidb_tpu_statements_migrated_total") \
+                            <= m_before:
+                        failures.append(
+                            f"{sc.name}: device fault fired but the "
+                            f"victim statement never migrated")
+                    victims = sorted(
+                        i for i, r in POOL.health.snapshot().items()
+                        if r["faults"] > 0)
+                    if not victims:
+                        failures.append(
+                            f"{sc.name}: fault fired but the health "
+                            f"monitor recorded no victim")
+                    # heal: the one-shot fault is spent; placement drives
+                    # the readmit sweep, so issuing statements past the
+                    # flap-guard delay must readmit every quarantined
+                    # device (the probe passes through the armed
+                    # device-readmit gate, which also meters it)
+                    t_heal = time.monotonic()
+                    healed = False
+                    while time.monotonic() - t_heal < 10.0:
+                        _run_statement(s, QUERIES[0])
+                        if not POOL.health.quarantined_indexes():
+                            healed = True
+                            break
+                        time.sleep(0.05)
+                    if not healed:
+                        failures.append(
+                            f"{sc.name}: device(s) "
+                            f"{POOL.health.quarantined_indexes()} never "
+                            f"readmitted after the fault cleared")
+                    elif failpoint.hits("device-readmit") == 0:
+                        failures.append(
+                            f"{sc.name}: device readmitted without a "
+                            f"health probe")
+                    elif victims:
+                        # placements return: park every OTHER member so
+                        # least-depth placement of an uncached table must
+                        # pick the readmitted device (locality votes
+                        # can't — its shard was evicted, so the probe
+                        # table is cold everywhere after the clear())
+                        try:
+                            s.execute("create table cs_pod (x int)")
+                            s.execute("insert into cs_pod values "
+                                      "(1), (2), (3)")
+                        except TiDBTPUError:
+                            pass        # second podfault scenario
+                        with POOL._lock:
+                            members = list(POOL.schedulers)
+                        parked = [m for m in members
+                                  if m.device_index not in victims]
+                        a0 = sum(m.stats()["admissions"] for m in members
+                                 if m.device_index in victims)
+                        for m in parked:
+                            m.acquire(conn_id=-1)
+                        try:
+                            _, perr, _ = _run_statement(
+                                s, "select count(*) from cs_pod")
+                        finally:
+                            for m in parked:
+                                m.release()
+                        a1 = sum(m.stats()["admissions"] for m in members
+                                 if m.device_index in victims)
+                        if perr is not None:
+                            failures.append(
+                                f"{sc.name}: probe statement on the "
+                                f"readmitted device failed: {perr}")
+                        elif a1 <= a0:
+                            failures.append(
+                                f"{sc.name}: readmitted device(s) "
+                                f"{victims} received no placements")
             elif sc.run == "delta":
                 # warm the device cache, then commit an IN-RANGE row so
                 # the next device read must extend the stale entry —
@@ -967,7 +1147,18 @@ def main(argv=None) -> int:
                          "N-device forced host CPU mesh")
     ap.add_argument("--mesh-only", action="store_true",
                     help="with --mesh: run ONLY the distributed scenarios")
+    ap.add_argument("--list-sites", action="store_true",
+                    help="print the failpoint catalog (site, description,"
+                         " mesh-only tag) and exit without sweeping")
     args = ap.parse_args(argv)
+    if args.list_sites:
+        sites = list_sites()
+        mesh_sites = failpoint.mesh_only_sites()
+        for name in sorted(sites):
+            tag = " [mesh-only]" if name in mesh_sites else ""
+            print(f"{name}{tag}: {sites[name]}")
+        print(f"{len(sites)} sites")
+        return 0
     # drift lints FIRST: a drifting metric name/label or a failpoint
     # site missing from the catalog fails the sweep before any scenario
     # spends wall time (tools/check_metrics.py, tools/check_failpoints.py

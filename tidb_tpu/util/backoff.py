@@ -65,9 +65,13 @@ class Backoffer:
         d = min(self.base_ms * (2.0 ** self.attempts), self.max_ms)
         return d * (1.0 - self.jitter * self._jitter_frac())
 
-    def backoff(self, err: Optional[BaseException] = None) -> None:
-        """Sleep one exponential step; raise BackoffExhausted (chained to
-        `err`) once the budget is spent."""
+    def charge(self, err: Optional[BaseException] = None) -> float:
+        """Account one exponential step WITHOUT sleeping; raise
+        BackoffExhausted (chained to `err`) once the budget is spent.
+        Returns the charged delay in ms — callers that wait elsewhere
+        (e.g. the scheduler's quarantine flap guard, which turns the
+        delay into a not-before readmission time) share the same budget
+        semantics as sleeping retry loops."""
         delay = self.next_delay_ms()
         if self.slept_ms + delay > self.budget_ms:
             raise BackoffExhausted(
@@ -76,6 +80,12 @@ class Backoffer:
                 f"(~{self.slept_ms:.0f}ms slept)") from err
         self.attempts += 1
         self.slept_ms += delay
+        return delay
+
+    def backoff(self, err: Optional[BaseException] = None) -> None:
+        """Sleep one exponential step; raise BackoffExhausted (chained to
+        `err`) once the budget is spent."""
+        delay = self.charge(err)
         if failpoint.inject("backoff-sleep") == "skip":
             if self.guard is not None:
                 self.guard.check("backoff")

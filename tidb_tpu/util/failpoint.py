@@ -169,6 +169,24 @@ register("steal-migrate", "work-steal handoff of a queued batch-class "
          "here re-queues the waiter on its home device with the backoff "
          "charged, so the statement is never lost and never run twice "
          "(executor/scheduler.py admit_statement)")
+register("device-lost-dispatch", "dispatch boundary of the device "
+         "fragment path, right after scheduler admission — a raise here "
+         "models a serving-pool device failing its launch; it is "
+         "classified into a typed DeviceLost, the health monitor "
+         "quarantines the device (queued waiters migrate to survivors), "
+         "and the in-flight victim retries ONCE on a survivor with a "
+         "retryable 1105 SHOW WARNINGS entry "
+         "(executor/fragment.py _run_device)")
+register("device-lost-upload", "HBM column upload onto a serving-pool "
+         "device (device_put) — a raise here models a transfer failure "
+         "on a pool member; classified into a typed DeviceLost at the "
+         "upload boundary, same quarantine + one-retry contract as "
+         "device-lost-dispatch (executor/device_cache.py _stream_slabs)")
+register("device-readmit", "health probe of a quarantined device once "
+         "its flap-guard delay passes — a raise here keeps the device "
+         "quarantined (the backoff budget is charged); a clean pass "
+         "readmits it to placement and it repopulates lazily "
+         "(executor/scheduler.py DeviceHealthMonitor.probe)")
 
 
 def enable(name: str, *, raise_: Optional[BaseException] = None,

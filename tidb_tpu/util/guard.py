@@ -46,7 +46,7 @@ class ExecutionGuard:
                  "queue_wait_s", "queue_waits", "phases",
                  "sched_class", "sched_cost", "sched_tables",
                  "device_index", "sched_steal_ok", "sched_admitted",
-                 "sched_steals")
+                 "sched_steals", "sched_migrated")
 
     def __init__(self, conn_id: int = 0, sql: str = "",
                  timeout_s: float = 0.0, mem_tracker=None):
@@ -94,6 +94,9 @@ class ExecutionGuard:
         self.sched_steal_ok = True
         self.sched_admitted = False
         self.sched_steals = 0
+        # how many times this statement was migrated OFF a lost device
+        # (quarantine retry) — distinct from work-steal migrations
+        self.sched_migrated = 0
         # (level, code, message) rows the statement accumulated — e.g.
         # a degraded-mesh completion — read back by SHOW WARNINGS
         self.warnings: list = []

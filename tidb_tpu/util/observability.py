@@ -81,6 +81,14 @@ class Registry:
         with self._lock:
             self.counters[key] = self.counters.get(key, 0) + by
 
+    def set_gauge(self, name: str, value: float,
+                  labels: Dict[str, str] = None):
+        """Set-point metric (e.g. per-device health flags): stored and
+        rendered alongside the counters, last write wins."""
+        key = (name, tuple(sorted((labels or {}).items())))
+        with self._lock:
+            self.counters[key] = float(value)
+
     def observe(self, name: str, value: float,
                 labels: Dict[str, str] = None):
         key = (name, tuple(sorted((labels or {}).items())))

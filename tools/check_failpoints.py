@@ -124,6 +124,68 @@ def run(root: str = None):
 
     catalog = _catalog(root, register_files)
 
+    # direction 0: the sweep's `--list-sites` enumeration must agree
+    # with the catalog this lint derives from the tree — that printed
+    # "N sites" number is what the docs/README advertise, and a
+    # module-scope registration the sweep forgot to import (or a stale
+    # import that registers a site nothing sweeps) would silently skew
+    # the coverage gate
+    sys.path.insert(0, root)
+    try:
+        from tidb_tpu.tools import chaos_sweep
+        listed = set(chaos_sweep.list_sites())
+        if listed != set(catalog):
+            missing = sorted(set(catalog) - listed)
+            extra = sorted(listed - set(catalog))
+            problems.append(
+                f"catalog: chaos_sweep --list-sites prints {len(listed)} "
+                f"sites but the tree registers {len(catalog)}"
+                + (f"; not listed: {missing}" if missing else "")
+                + (f"; listed but unregistered: {extra}" if extra else ""))
+    except Exception as e:  # noqa: BLE001 — an unimportable sweep can't
+        # enumerate anything; that IS the drift
+        problems.append(
+            f"catalog: cannot import tidb_tpu.tools.chaos_sweep to "
+            f"cross-check --list-sites: {type(e).__name__}: {e}")
+    finally:
+        sys.path.remove(root)
+
+    # direction 0b: the README's failpoint catalog table must list
+    # exactly the registered sites — a new site that skips the table is
+    # undocumented, a removed site that lingers advertises a fault
+    # boundary that no longer exists
+    readme = os.path.join(root, "README.md")
+    if os.path.exists(readme):
+        with open(readme) as f:
+            lines = f.read().splitlines()
+        rows, in_table = set(), False
+        for line in lines:
+            s = line.strip()
+            if s.startswith("| Site |"):
+                in_table = True
+                continue
+            if in_table:
+                if not s.startswith("|"):
+                    break
+                cell = s.split("|")[1].strip()
+                if cell.startswith("`") and cell.rstrip("† ").endswith("`"):
+                    rows.add(cell.strip("`† "))
+        if not in_table:
+            problems.append(
+                "README.md: failpoint catalog table (header '| Site |') "
+                "not found — document the catalog or drop this gate")
+        else:
+            undocumented = sorted(set(catalog) - rows)
+            stale = sorted(rows - set(catalog))
+            if undocumented:
+                problems.append(
+                    f"README.md: failpoint table is missing registered "
+                    f"site(s): {undocumented}")
+            if stale:
+                problems.append(
+                    f"README.md: failpoint table lists unregistered "
+                    f"site(s): {stale}")
+
     # direction 1: every literal inject site is registered
     for name, path, ln in injects:
         if name not in catalog:

@@ -25,10 +25,11 @@ PREFIX = "tidb_tpu_"
 
 
 def _is_registry_call(node: ast.Call):
-    """→ 'inc' | 'observe' when the call is REGISTRY.inc/observe or
-    self.inc/self.observe inside observability.py itself, else None."""
+    """→ 'inc' | 'observe' | 'set_gauge' when the call is
+    REGISTRY.inc/observe/set_gauge, else None."""
     f = node.func
-    if not isinstance(f, ast.Attribute) or f.attr not in ("inc", "observe"):
+    if not isinstance(f, ast.Attribute) \
+            or f.attr not in ("inc", "observe", "set_gauge"):
         return None
     target = f.value
     if isinstance(target, ast.Name) and target.id == "REGISTRY":
@@ -88,7 +89,7 @@ def check_file(path: str):
         if name != name.lower() or not all(
                 c.isalnum() or c == "_" for c in name):
             problems.append(f"{where}: metric {name!r} is not snake_case")
-        if not name.endswith(UNIT_SUFFIXES):
+        if kind != "set_gauge" and not name.endswith(UNIT_SUFFIXES):
             problems.append(
                 f"{where}: metric {name!r} lacks a unit suffix "
                 f"({'/'.join(UNIT_SUFFIXES)})")
@@ -98,6 +99,10 @@ def check_file(path: str):
         if kind == "observe" and name.endswith("_total"):
             problems.append(
                 f"{where}: histogram {name!r} must not end in '_total'")
+        if kind == "set_gauge" and name.endswith("_total"):
+            problems.append(
+                f"{where}: gauge {name!r} must not end in '_total' "
+                f"(gauges are set-points, not counters)")
         keys = _label_keys(node, 1 if kind == "inc" else 2)
         if keys is None:
             problems.append(
