@@ -44,6 +44,13 @@ flag-off FIFO baseline at the top contention level: the PR's acceptance
 claim is interactive p99 (classification on) ≤ interactive p99 (FIFO),
 emitted as priority_serving.interactive_p99_improves.
 
+A "Whole-query compilation coverage" section runs the 22 TPC-H-shaped
+queries of tidb_tpu/tools/coverage.py against a fresh small-SF engine
+and embeds the per-query table in the JSON (`coverage`: fused?,
+fragment count, fallback-taxonomy reason, warm programs-per-slab,
+vs-CPU speedup; `coverage_fused` = the suite-wide fused count that
+tools/check_coverage.py ratchets against COVERAGE.json).
+
 Env: BENCH_SF (default 10) scales row count (SF=1 → 6,001,215 lineitem
 rows); BENCH_REPS / BENCH_CPU_REPS as above; BENCH_TIME_BUDGET_S
 (default 840) is the wall-clock budget for the WHOLE run — when it runs
@@ -104,6 +111,23 @@ Q6 = """SELECT COUNT(*), SUM(l_extendedprice * l_discount)
 
 def log(msg: str):
     print(msg, file=sys.stderr, flush=True)
+
+
+# Every section pins the session row threshold through this one helper.
+# Two regimes: the production default (32768 — only slabs past it route
+# to the device) and force-device (threshold 1 — every eligible fragment
+# takes the device path regardless of cardinality).  Force-device dates
+# to PR 14's Q6 zone-map section: Q6's pruned scan can leave fewer live
+# rows than the default threshold, silently bouncing the section back to
+# the CPU path, so the bench pins threshold=1 wherever it is measuring
+# the device path by name.  Temporary until the threshold is plan-shape
+# aware instead of a single row count.
+PRODUCTION_ROW_THRESHOLD = 32768
+
+
+def set_row_threshold(ss, force_device: bool):
+    ss.vars["tidb_tpu_row_threshold"] = \
+        1 if force_device else PRODUCTION_ROW_THRESHOLD
 
 
 def emit(value: float, vs: float, extra: dict | None = None):
@@ -428,7 +452,7 @@ def run_mix(eng, conc: int, total: int, section_budget_s: float):
     for _ in range(conc):
         ss = eng.new_session()
         ss.vars["tidb_tpu_engine"] = "on"
-        ss.vars["tidb_tpu_row_threshold"] = 32768
+        set_row_threshold(ss, force_device=False)
         sessions.append(ss)
     counter = itertools.count()
     done = [0] * conc
@@ -480,7 +504,7 @@ def run_priority_mix(eng, conc: int, total: int, section_budget_s: float,
     for _ in range(conc):
         ss = eng.new_session()
         ss.vars["tidb_tpu_engine"] = "on"
-        ss.vars["tidb_tpu_row_threshold"] = 1
+        set_row_threshold(ss, force_device=True)
         ss.vars["tidb_tpu_priority_scheduling"] = \
             "on" if prio_on else "off"
         sessions.append(ss)
@@ -548,7 +572,7 @@ def run_pod_mix(eng, conc: int, total: int, section_budget_s: float,
     for _ in range(conc):
         ss = eng.new_session()
         ss.vars["tidb_tpu_engine"] = "on"
-        ss.vars["tidb_tpu_row_threshold"] = 1
+        set_row_threshold(ss, force_device=True)
         ss.vars["tidb_tpu_device_queues"] = device_queues
         sessions.append(ss)
     counter = itertools.count()
@@ -713,7 +737,7 @@ def main():
     # Device path (fused fragment)
     from tidb_tpu.executor import fragment as frag_mod
     s.vars["tidb_tpu_engine"] = "on"
-    s.vars["tidb_tpu_row_threshold"] = 32768
+    set_row_threshold(s, force_device=False)
     log("warming device path (compile + first-touch stream)…")
     q1_cold_t, _, _ = time_query(s, 1)
     # phase split of the COLD run — the one with real encode/upload work;
@@ -814,7 +838,7 @@ def main():
         # path for this section (the per-statement guard's phases, not
         # the module-global LAST_PHASES, meter it: a CPU fallback would
         # leave wall_s at 0 and be visible as q6_device=False)
-        s.vars["tidb_tpu_row_threshold"] = 1
+        set_row_threshold(s, force_device=True)
         time_query(s, 1, Q6, reserve_s=60.0)
         # upload-avoided bytes are a FIRST-touch artifact (warm slabs are
         # already resident or holes) — read them off the warming run
@@ -846,7 +870,7 @@ def main():
         log(f"zone-map skip section skipped: {e}")
         extra["q6_error"] = str(e)[:200]
     finally:
-        s.vars["tidb_tpu_row_threshold"] = 32768
+        set_row_threshold(s, force_device=False)
 
     # ---- concurrent serving: warm mixed Q1/Q3 throughput ------------------
     # concurrency 1 vs 8 through the device scheduler. Runs right after
@@ -904,7 +928,7 @@ def main():
         if left < 75.0:
             raise RuntimeError(f"{left:.0f}s left in wall budget")
         log("priority serving tier: warming point-read path…")
-        s.vars["tidb_tpu_row_threshold"] = 1
+        set_row_threshold(s, force_device=True)
         s.query("SELECT v FROM pr WHERE k = 17")   # parametrized compile
         level_s = max(6.0, min(30.0, remaining_s() * 0.06))
         prio: dict = {}
@@ -968,7 +992,7 @@ def main():
         extra["priority_serving"] = {
             "error": f"{type(e).__name__}: {e}"[:200]}
     finally:
-        s.vars["tidb_tpu_row_threshold"] = 32768
+        set_row_threshold(s, force_device=False)
 
     # ---- pod-scale serving: per-device queues, locality, stealing ---------
     # the PR 15 c64 mix twice in the SAME process: device_queues off
@@ -987,7 +1011,7 @@ def main():
         n_dev = jax.local_device_count()
         platform = jax.devices()[0].platform
         log(f"pod serving: {n_dev} visible {platform} device(s)")
-        s.vars["tidb_tpu_row_threshold"] = 1
+        set_row_threshold(s, force_device=True)
         s.query("SELECT v FROM pr WHERE k = 17")   # warm the point path
         level_s = max(6.0, min(30.0, remaining_s() * 0.08))
         lat_off, w_off, sched_off, err_off = run_pod_mix(
@@ -1061,7 +1085,7 @@ def main():
             # quarantine that outlived it a placement-driven grace loop
             ps = eng.new_session()
             ps.vars["tidb_tpu_engine"] = "on"
-            ps.vars["tidb_tpu_row_threshold"] = 1
+            set_row_threshold(ps, force_device=True)
             ps.vars["tidb_tpu_device_queues"] = "on"
             t_grace = time.monotonic()
             while hb["fault"] is not None and hb["heal"] is None and \
@@ -1103,7 +1127,7 @@ def main():
         log(f"pod serving section skipped: {e}")
         extra["pod_serving"] = {"error": f"{type(e).__name__}: {e}"[:200]}
     finally:
-        s.vars["tidb_tpu_row_threshold"] = 32768
+        set_row_threshold(s, force_device=False)
 
     # secondary metrics: Q3 join and Q5 3-table join (configs #3/#5) —
     # each checks the wall budget first: skip entirely under ~90s left,
@@ -1207,7 +1231,7 @@ def main():
                           ("tidb_tpu_dist_devices",
                            "tidb_tpu_row_threshold")}
             s.vars["tidb_tpu_engine"] = "on"
-            s.vars["tidb_tpu_row_threshold"] = 1
+            set_row_threshold(s, force_device=True)
             s.vars["tidb_tpu_dist_devices"] = mesh_n
             try:
                 clean_rows = s.query(Q3).rows      # compile warmup
@@ -1310,7 +1334,7 @@ def main():
                     s.vars["tidb_tpu_engine"] = "on"
 
             s.vars["tidb_tpu_engine"] = "on"
-            s.vars["tidb_tpu_row_threshold"] = 32768
+            set_row_threshold(s, force_device=False)
             clean_q1 = s.query(Q1).rows         # warm both read shapes
             s.query(Q6)
             base_ctr = {k: ctr(k) for k in (
@@ -1348,7 +1372,7 @@ def main():
             def htap_reader(k: int):
                 rs_ = eng.new_session()
                 rs_.vars["tidb_tpu_engine"] = "on"
-                rs_.vars["tidb_tpu_row_threshold"] = 32768
+                set_row_threshold(rs_, force_device=False)
                 # a low fold threshold so compaction demonstrably fires
                 # inside the ingest window
                 rs_.vars["tidb_tpu_delta_compact_rows"] = 256
@@ -1448,6 +1472,40 @@ def main():
     finally:
         from tidb_tpu.util import failpoint
         failpoint.disable_all()
+
+    # ---- Whole-query compilation coverage: 22 TPC-H-shaped queries --------
+    # The coverage ratchet's sweep surfaced in the bench JSON: a fresh
+    # small-SF engine runs tidb_tpu.tools.coverage's 22 queries and the
+    # table lands in the log plus per-query rows in the JSON — fused?,
+    # fragment count, fallback reason (the tidb_tpu_device_fallbacks_total
+    # taxonomy), programs per slab, speedup vs the CPU path.
+    # tools/check_coverage.py pins the same sweep against COVERAGE.json
+    # as a chaos-sweep preflight; here it also times the CPU side.
+    try:
+        left = remaining_s()
+        if left < 60.0:
+            log(f"coverage sweep skipped: {left:.0f}s left < 60s")
+            extra["coverage_skipped"] = True
+        else:
+            from tidb_tpu.tools import coverage as cov
+            _ceng, cs = cov.fresh_session(6000)
+            cov_rows = cov.run_coverage(cs, time_cpu=True)
+            log(cov.coverage_table(cov_rows))
+            extra["coverage"] = {
+                r["query"]: {
+                    "fused": r["fused"],
+                    "fragments": r["n_fragments"],
+                    "fallback": r["fallback"],
+                    "programs_per_slab": r["programs_per_slab"],
+                    "speedup": r["speedup"],
+                } for r in cov_rows}
+            extra["coverage_fused"] = \
+                sum(1 for r in cov_rows if r["fused"])
+    except Exception as e:  # noqa: BLE001 — must not sink the headline
+        if backend_error(e):
+            raise
+        log(f"coverage sweep failed (headline unaffected): {e}")
+        extra["coverage_error"] = str(e)[:200]
 
     if hasattr(signal, "SIGALRM"):
         signal.alarm(0)

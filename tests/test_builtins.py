@@ -246,6 +246,33 @@ def test_round_scale_exact_half_away_from_zero():
     assert q("SELECT ROUND(12345, -2)") == 12300
 
 
+def test_cast_decimal_downscale_rounds_half_away():
+    """CAST to a SMALLER scale rounds half away from zero (the same
+    types.Round rule as ROUND) — it must never reinterpret the scaled
+    int at the new scale (1.005 → 10.05)."""
+    from decimal import Decimal
+    from tidb_tpu.session import Engine
+    s = Engine().new_session()
+    q = lambda sql: s.query(sql).rows[0][0]    # noqa: E731
+    assert q("SELECT CAST(1.005 AS DECIMAL(10,2))") == Decimal("1.01")
+    assert q("SELECT CAST(-1.005 AS DECIMAL(10,2))") == Decimal("-1.01")
+    assert q("SELECT CAST(1.004 AS DECIMAL(10,2))") == Decimal("1.00")
+    assert q("SELECT CAST(2.5 AS DECIMAL(10,0))") == 3
+    assert q("SELECT CAST(-2.5 AS DECIMAL(10,0))") == -3
+    # up-scale and same-scale stay exact
+    assert q("SELECT CAST(1.005 AS DECIMAL(10,4))") == Decimal("1.0050")
+    assert q("SELECT CAST(3 AS DECIMAL(10,2))") == Decimal("3.00")
+    # column path (not constant-folded), host vs device
+    s.execute("CREATE TABLE bdc (d DECIMAL(6,3))")
+    s.execute("INSERT INTO bdc VALUES (1.005), (-1.005), (2.499), (NULL)")
+    sql = "SELECT CAST(d AS DECIMAL(10,2)) FROM bdc"
+    host = [r[0] for r in s.query(sql).rows]
+    assert host == [Decimal("1.01"), Decimal("-1.01"),
+                    Decimal("2.50"), None]
+    s.vars.update({"tidb_tpu_engine": "on", "tidb_tpu_row_threshold": 1})
+    assert [r[0] for r in s.query(sql).rows] == host
+
+
 def test_breadth_math_misc_builtins():
     from tidb_tpu.session import Engine
     s = Engine().new_session()

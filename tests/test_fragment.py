@@ -239,3 +239,85 @@ def test_strict_mode_and_fallback_reason():
         assert rs.rows == []
     finally:
         s.vars["tidb_tpu_engine"] = "off"
+
+
+# ---- fallback-reason taxonomy (tidb_tpu_device_fallbacks_total) -----------
+
+def test_source_reason_codes_stay_in_taxonomy():
+    """Every reason= literal across the fragment layers is a member of
+    FALLBACK_REASONS — the metric label vocabulary never drifts."""
+    import os
+    import re
+
+    from tidb_tpu.executor.fragment import FALLBACK_REASONS
+    base = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tidb_tpu", "executor")
+    found = 0
+    for mod in ("fragment.py", "dist_fragment.py", "tree_fragment.py",
+                "device_emit.py", "window.py"):
+        with open(os.path.join(base, mod)) as f:
+            src = f.read()
+        for code in re.findall(r'reason="([a-z-]+)"', src):
+            assert code in FALLBACK_REASONS, (mod, code)
+            found += 1
+    assert found >= 10  # the taxonomy is actually in use
+
+
+def test_unknown_reason_normalizes_to_shape():
+    from tidb_tpu.executor.fragment import FragmentFallback
+    assert FragmentFallback("x", reason="no-such-code").reason == "shape"
+    assert FragmentFallback("x").reason == "shape"
+
+
+def test_empty_input_fallback_explain_matches_metric():
+    """EXPLAIN ANALYZE's device:fallback(code) and the reason= label on
+    tidb_tpu_device_fallbacks_total carry the SAME stable code."""
+    from tidb_tpu.util.observability import REGISTRY
+    eng = Engine()
+    s = eng.new_session()
+    s.execute("CREATE TABLE empt (a BIGINT, b DOUBLE)")
+    key = ("tidb_tpu_device_fallbacks_total",
+           (("reason", "empty-input"),))
+    before = REGISTRY.counters.get(key, 0)
+    s.vars["tidb_tpu_engine"] = "on"
+    s.vars["tidb_tpu_row_threshold"] = 1
+    try:
+        rows = s.query("EXPLAIN ANALYZE SELECT a, COUNT(*), SUM(b) "
+                       "FROM empt GROUP BY a").rows
+    finally:
+        s.vars["tidb_tpu_engine"] = "off"
+    txt = "\n".join(str(r) for r in rows)
+    assert "device:fallback(empty-input)" in txt, txt
+    assert REGISTRY.counters.get(key, 0) == before + 1
+
+
+@pytest.mark.parametrize("sql", [
+    # DISTINCT under ROLLUP: pair columns assume nk key cols
+    "SELECT a, COUNT(DISTINCT c) FROM t GROUP BY a WITH ROLLUP",
+    # computed string in an IN-list: no per-dictionary codeset to prepare
+    "SELECT COUNT(*) FROM t WHERE SUBSTRING(c, 1, 2) IN ('an', 'be')",
+])
+def test_ineligible_shape_classes_never_extract_a_fragment(session, sql):
+    """Planning-time gates (taxonomy class `shape`) keep the whole plan
+    on the host — no fragment, no device attempt, stable results."""
+    s = session
+    s.vars["tidb_tpu_engine"] = "on"
+    s.vars["tidb_tpu_row_threshold"] = 1
+    try:
+        plan = s._plan(parse(sql)[0])
+        root = build(plan)
+        chunks = run_to_completion(root, s._exec_ctx())
+        frags = []
+
+        def walk(e):
+            if isinstance(e, TpuFragmentExec):
+                frags.append(e)
+            for c in getattr(e, "children", []):
+                walk(c)
+
+        walk(root)
+        assert not frags, f"shape-gated query extracted a fragment: {sql}"
+        dev = [r for ch in chunks for r in ch.rows()]
+    finally:
+        s.vars["tidb_tpu_engine"] = "off"
+    assert sorted(dev, key=str) == sorted(s.query(sql).rows, key=str)

@@ -134,3 +134,88 @@ def test_dump_cli_over_the_wire(tmp_path):
         assert contents(s2) == contents(s)
     finally:
         srv.stop()
+
+
+# ---- device-coverage ratchet (tools/check_coverage.py) --------------------
+
+def _load_check_coverage():
+    import importlib.util
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "check_coverage", os.path.join(repo, "tools", "check_coverage.py"))
+    cc = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cc)
+    return cc
+
+
+def test_check_coverage_negative_fails_on_regression(tmp_path,
+                                                     monkeypatch):
+    """The ratchet: a query pinned fused in COVERAGE.json that now falls
+    back is a reported problem; so is a drifted or out-of-taxonomy
+    fallback reason. A newly-fused query is NOT a problem."""
+    import json
+    cc = _load_check_coverage()
+    (tmp_path / "COVERAGE.json").write_text(json.dumps({"queries": {
+        "q1": {"fused": True, "fallback": None},
+        "q2": {"fused": False, "fallback": "shape"},
+        "q3": {"fused": False, "fallback": "shape"},
+    }}))
+    monkeypatch.setattr(cc, "_sweep", lambda root: {
+        "q1": {"fused": False, "fallback": "device-error"},  # regressed
+        "q2": {"fused": False, "fallback": "group-cap"},     # drifted
+        "q3": {"fused": True, "fallback": None},             # advanced
+    })
+    problems = cc.run(str(tmp_path))
+    assert any("q1" in p and "REGRESSED" in p for p in problems)
+    assert any("q2" in p and "drifted" in p for p in problems)
+    assert not any("q3" in p for p in problems)
+    # and the clean case really is clean
+    monkeypatch.setattr(cc, "_sweep", lambda root: {
+        "q1": {"fused": True, "fallback": None},
+        "q2": {"fused": False, "fallback": "shape"},
+        "q3": {"fused": False, "fallback": "shape"},
+    })
+    assert cc.run(str(tmp_path)) == []
+
+
+def test_check_coverage_missing_baseline_is_a_problem(tmp_path):
+    cc = _load_check_coverage()
+    problems = cc.run(str(tmp_path))
+    assert problems and "COVERAGE.json" in problems[0]
+
+
+def test_check_coverage_out_of_taxonomy_reason(tmp_path, monkeypatch):
+    import json
+    cc = _load_check_coverage()
+    (tmp_path / "COVERAGE.json").write_text(json.dumps({"queries": {
+        "q1": {"fused": False, "fallback": "shape"}}}))
+    monkeypatch.setattr(cc, "_sweep", lambda root: {
+        "q1": {"fused": False, "fallback": "weird"}})
+    problems = cc.run(str(tmp_path))
+    assert any("taxonomy" in p for p in problems)
+
+
+def test_check_coverage_wired_into_chaos_preflight():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    src = open(os.path.join(repo, "tidb_tpu", "tools",
+                            "chaos_sweep.py")).read()
+    assert '"check_coverage"' in src, \
+        "check_coverage must run as a chaos-sweep preflight"
+
+
+def test_committed_coverage_baseline_shape():
+    """COVERAGE.json exists, covers 22 queries, and every pinned
+    fallback reason is in the fragment taxonomy."""
+    import json
+
+    from tidb_tpu.executor.fragment import FALLBACK_REASONS
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo, "COVERAGE.json")) as f:
+        base = json.load(f)
+    assert base["total"] == len(base["queries"]) == 22
+    assert base["fused"] == sum(
+        1 for v in base["queries"].values() if v["fused"])
+    assert base["fused"] >= 16          # the ISSUE 20 coverage floor
+    for q, v in base["queries"].items():
+        if not v["fused"]:
+            assert v["fallback"] in FALLBACK_REASONS, (q, v)

@@ -553,3 +553,21 @@ def test_range_frame_device_matches_cpu():
     finally:
         s.vars.update(tidb_tpu_engine="off", tidb_tpu_strict="off")
     assert got == want
+
+
+def test_warm_window_launch_count(session):
+    """Warm single-fragment window query stays <= slabs + 1 programs:
+    the segmented scans ride inside the fused program, not extra
+    launches."""
+    s = session
+    sql = DEVICE_WINDOW_QUERIES[0]
+    s.vars["tidb_tpu_engine"] = "on"
+    s.vars["tidb_tpu_row_threshold"] = 1
+    try:
+        s.query(sql)               # compile + first touch
+        s.query(sql)               # warm
+        ph = s.last_guard.phases
+        # 800 rows pad into one slab: one fused program (+ finalize)
+        assert 1 <= ph.programs_launched <= 2, ph.programs_launched
+    finally:
+        s.vars["tidb_tpu_engine"] = "off"

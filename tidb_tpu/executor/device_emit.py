@@ -51,16 +51,19 @@ def emit_topk(keys, descs, live, k: int):
 
 
 def emit_distinct(gids, v, m, live, n: int, keys, pairs_out: bool,
-                  pair_cap: int = 0):
-    """Traced per-batch DISTINCT dedup for one aggregate argument →
+                  pair_cap: int = 0, vcols=None):
+    """Traced per-batch DISTINCT dedup for one aggregate argument tuple →
     (first_mask, pairs). `first_mask` marks the first live occurrence of
     each (group, value) pair — the state-update mask. With `pairs_out`,
-    `pairs` is (cols, n_pairs): the deduped (group-keys, value) tuples
+    `pairs` is (cols, n_pairs): the deduped (group-keys, args...) tuples
     for the cross-slab host merge, truncated to `pair_cap` output slots
-    (0 = no truncation). The factorize itself ALWAYS runs at the full
-    batch capacity so first_mask stays exact; only the pair OUTPUT
-    arrays shrink — n_pairs reports the TRUE count, so the driver can
-    detect a truncated pair set and resize through the capacity
+    (0 = no truncation). `vcols` is the raw per-arg (value, mask) column
+    list shipped in the pair output — for multi-arg DISTINCT, `v` is a
+    batch-local combined code that means nothing across slabs, so the
+    pairs carry real values instead. The factorize itself ALWAYS runs at
+    the full batch capacity so first_mask stays exact; only the pair
+    OUTPUT arrays shrink — n_pairs reports the TRUE count, so the driver
+    can detect a truncated pair set and resize through the capacity
     ladder."""
     from tidb_tpu.ops.jax_env import jnp
     from tidb_tpu.ops import factorize as F
@@ -73,7 +76,8 @@ def emit_distinct(gids, v, m, live, n: int, keys, pairs_out: bool,
     pslot = jnp.arange(pc, dtype=jnp.int32) < n_pairs
     cols = [(jnp.asarray(kv)[rep_p], jnp.asarray(km)[rep_p] & pslot)
             for kv, km in keys]
-    cols.append((v[rep_p], pslot))
+    for av, _am in (vcols if vcols is not None else [(v, m)]):
+        cols.append((jnp.asarray(av)[rep_p], pslot))
     return first, (cols, n_pairs)
 
 
@@ -89,11 +93,23 @@ def emit_root(ctx: EvalContext, live, root, aggs=None, group_cap: int = 0,
       k for TopN); Window: emit_window's {cols, live}; any row root
       (Selection/Projection/Join): padded {cols, live}."""
     from tidb_tpu.ops.jax_env import jnp
-    from tidb_tpu.planner.physical import (PhysHashAgg, PhysSort,
-                                           PhysTopN, PhysWindow)
+    from tidb_tpu.planner.physical import (PhysHashAgg, PhysLimit,
+                                           PhysSort, PhysTopN, PhysWindow)
     if isinstance(root, PhysHashAgg):
         return emit_agg(ctx, live, root, aggs, group_cap, key_bounds,
                         pairs_out=pairs_out, pair_cap=pair_cap)
+    if isinstance(root, PhysLimit):
+        # LIMIT pushdown (no ORDER BY): the first offset+count live rows
+        # in row order — a stable partition of the live mask, the
+        # degenerate keyless emit_topk
+        n = live.shape[0]
+        k = min(root.count + root.offset, slab_cap or n)
+        idx = jnp.argsort(jnp.logical_not(live), stable=True)[:k]
+        n_out = jnp.minimum(live.sum().astype(jnp.int32), jnp.int32(k))
+        out_cols = [ctx.column(i) for i in range(len(root.schema))]
+        gathered = [(jnp.asarray(v)[idx], jnp.asarray(m)[idx])
+                    for v, m in out_cols]
+        return {"cols": gathered, "n_out": n_out}
     if isinstance(root, (PhysTopN, PhysSort)):
         keys = [e.eval(ctx) for e in root.by]
         out_cols = [ctx.column(i) for i in range(len(root.schema))]
@@ -204,7 +220,30 @@ def emit_agg(ctx: EvalContext, live, root, aggs: List[AggFunc],
     from tidb_tpu.ops import factorize as F
     n = live.shape[0]
     cap = group_cap
-    if root.group_exprs and key_bounds is not None:
+    if root.group_exprs and getattr(root, "rollup", False):
+        # WITH ROLLUP: tile the batch (nk+1)× — copy l rolls up the LAST
+        # l group keys (validity masked off, so the rolled-up key is NULL
+        # for free) and a grouping-level column joins the factorize keys
+        # LAST, keeping a genuinely-NULL key group separate from the
+        # super-aggregate over it.  key_out carries the level column as a
+        # trailing internal column: emit_merge / the host merges
+        # re-factorize over ALL key columns generically, and the drivers
+        # decode only the first nk into the result chunk.
+        ctx, live = _rollup_tile(ctx, live, root)
+        n = live.shape[0]
+        nk = len(root.group_exprs)
+        n0 = n // (nk + 1)
+        lev = jnp.repeat(jnp.arange(nk + 1, dtype=jnp.int64), n0)
+        keys = [e.eval(ctx) for e in root.group_exprs]
+        keys = [(jnp.asarray(v), jnp.asarray(m) & (lev < nk - i))
+                for i, (v, m) in enumerate(keys)]
+        fkeys = keys + [(lev, jnp.ones_like(live))]
+        gids, n_groups, rep = F.factorize(fkeys, live, cap)
+        gids = jnp.where(live, gids, jnp.int32(cap))
+        key_out = [(jnp.asarray(v)[rep], jnp.asarray(m)[rep] &
+                    (jnp.arange(cap) < n_groups)) for v, m in fkeys]
+        slot_live = jnp.arange(cap, dtype=jnp.int32) < n_groups
+    elif root.group_exprs and key_bounds is not None:
         keys, gids, n_groups, key_out, slot_live = _perfect_groups(
             ctx, live, root, cap, key_bounds)
     elif root.group_exprs:
@@ -226,12 +265,10 @@ def emit_agg(ctx: EvalContext, live, root, aggs: List[AggFunc],
     for ai, desc in enumerate(root.aggs):
         if not (desc.distinct and desc.args):
             continue
-        v, m = desc.args[0].eval(ctx)
-        v = jnp.asarray(v)
-        m = jnp.asarray(m) & live
+        v, m, vcols = _distinct_arg(ctx, live, desc)
         dvals[ai] = (v, m)
         first, pairs = emit_distinct(gids, v, m, live, n, keys,
-                                     pairs_out, pair_cap)
+                                     pairs_out, pair_cap, vcols=vcols)
         dfirst[ai] = first
         if pairs is not None:
             dpairs[ai] = pairs
@@ -292,6 +329,49 @@ def _perfect_groups(ctx: EvalContext, live, root, cap: int,
     return keys, gids, n_groups, key_out, slot_live
 
 
+def _rollup_tile(ctx: EvalContext, live, root):
+    """Tile the batch columns (nk+1)× along the row axis for WITH ROLLUP
+    level replication.  Wide-decimal limb planes are 2-D (limbs, rows),
+    so values concatenate along the LAST axis; 1-D masks along axis 0 is
+    the same thing."""
+    from tidb_tpu.ops.jax_env import jnp
+    reps = len(root.group_exprs) + 1
+
+    def t(a):
+        a = jnp.asarray(a)
+        return jnp.concatenate([a] * reps, axis=-1)
+
+    cols = [None if c is None else (t(c[0]), t(c[1]))
+            for c in ctx._columns]
+    ctx_t = EvalContext(ctx.xp, cols, dictionaries=ctx.dictionaries,
+                        prepared=ctx.prepared, on_device=ctx.on_device)
+    return ctx_t, t(live)
+
+
+def _distinct_arg(ctx: EvalContext, live, desc):
+    """Evaluate a DISTINCT aggregate's argument tuple → (v, m, vcols).
+    Single-arg: the value itself. Multi-arg (COUNT-only — the eligibility
+    gates reject anything else): `v` is one combined dense code per row
+    via factorize.dense_codes, so equal tuples dedup as one value within
+    the batch, and `m` is the AND of the per-arg masks (MySQL skips rows
+    where ANY DISTINCT argument is NULL). `vcols` keeps the raw per-arg
+    (value, mask) columns for the cross-slab pair output — the combined
+    code is batch-local and cannot be compared across slabs."""
+    from tidb_tpu.ops.jax_env import jnp
+    from tidb_tpu.ops import factorize as F
+    vcols = []
+    m = live
+    for a in desc.args:
+        av, am = a.eval(ctx)
+        av = jnp.asarray(av)
+        am = jnp.asarray(am) & live
+        vcols.append((av, am))
+        m = m & am
+    if len(vcols) == 1:
+        return vcols[0][0], m, vcols
+    return F.dense_codes(vcols, live), m, vcols
+
+
 def agg_states(ctx, live, root, aggs, gids, cap: int, n: int):
     """Per-aggregate partial states over one batch (DISTINCT args dedup
     via factorize.distinct_mask) — shared by single-device and per-shard
@@ -308,6 +388,8 @@ def _agg_states(ctx, live, root, aggs, gids, cap: int, n: int,
         if desc.distinct and desc.args and distinct_vals is not None \
                 and ai in distinct_vals:
             v, m = distinct_vals[ai]     # evaluated once by emit_agg
+        elif desc.distinct and desc.args:
+            v, m, _ = _distinct_arg(ctx, live, desc)
         elif desc.args:
             v, m = desc.args[0].eval(ctx)
             v = jnp.asarray(v)
@@ -337,10 +419,23 @@ def emit_window(ctx: EvalContext, live, root):
     with jnp (the whole-column reformulation of executor/window.go).
     → {cols, live} with the window outputs appended to the child columns."""
     from tidb_tpu.ops.jax_env import jnp
+    n_child = len(root.children[0].schema)
+    in_cols = [ctx.column(i) for i in range(n_child)]
+    out_cols = emit_window_cols(ctx, live, root, in_cols)
+    return {"cols": [(jnp.asarray(v), jnp.asarray(m))
+                     for v, m in out_cols], "live": live}
+
+
+def emit_window_cols(ctx: EvalContext, live, root, in_cols):
+    """The traced window computation proper → the child's column list
+    (None placeholders preserved) with one appended (value, mask) column
+    per window spec. Shared by the window-ROOT emit above and the
+    interior-window case of TreeProgram._emit, where the appended
+    columns feed the operator above in the same trace."""
+    from tidb_tpu.ops.jax_env import jnp
     from tidb_tpu.ops import factorize as F
     n = live.shape[0]
-    n_child = len(root.children[0].schema)
-    out_cols = [ctx.column(i) for i in range(n_child)]
+    out_cols = list(in_cols)
     layouts = {}
     for d in root.wdescs:
         lkey = repr((d.partition, d.order, d.descs))
@@ -378,8 +473,7 @@ def emit_window(ctx: EvalContext, live, root):
         back_v = jnp.zeros(n, dtype=v.dtype).at[perm].set(v)
         back_m = jnp.zeros(n, dtype=bool).at[perm].set(m)
         out_cols.append((back_v, back_m & live))
-    return {"cols": [(jnp.asarray(v), jnp.asarray(m))
-                     for v, m in out_cols], "live": live}
+    return out_cols
 
 
 def _window_value(ctx, live, d, n, perm, pstart, peerstart):

@@ -386,7 +386,7 @@ class StagedDistAgg:
                 return ckpts
             if self.group_cap >= self.cap_limit:
                 self.ladder.fallback("group")
-                raise FragmentFallback("group cap overflow")
+                raise FragmentFallback("group cap overflow", reason="group-cap")
             need = max(ng_true[r] for r in over)
             self.group_cap = self.ladder.resize(
                 "group", self.group_cap, need=need, max_cap=self.cap_limit)
@@ -1024,7 +1024,8 @@ class StagedDistExchange:
             if rounds > 8:
                 self.ladder.fallback("exchange")
                 raise FragmentFallback(
-                    "staged exchange: bucket resize did not converge")
+                    "staged exchange: bucket resize did not converge",
+                    reason="group-cap")
             for r, need in over:
                 failpoint.inject("exchange-overflow")
                 info["bcaps"][r] = self.ladder.resize(
@@ -1206,7 +1207,8 @@ class StagedDistExchange:
             if rounds > 8:
                 self.ladder.fallback("dist")
                 raise FragmentFallback(
-                    "staged exchange: escalation did not converge")
+                    "staged exchange: escalation did not converge",
+                    reason="group-cap")
             # lost join bets / out-cap overflows first: a changed cfg
             # invalidates EVERY rank's checkpoint (unique-mode results
             # under the old bet are not trustworthy) — rerun all
@@ -1221,7 +1223,8 @@ class StagedDistExchange:
                     self.ladder.fallback("join")
                     raise FragmentFallback(
                         f"join fan-out {int(rank_jt[:, ji].max())} "
-                        f"exceeds the per-shard device cap")
+                        f"exceeds the per-shard device cap",
+                        reason="join-cap")
                 if new_cfg is not None:
                     self.join_cfgs[ji] = new_cfg
                     retry_all = True
@@ -1234,7 +1237,7 @@ class StagedDistExchange:
                 return outs
             if self.gcap >= self.cap_limit:
                 self.ladder.fallback("group")
-                raise FragmentFallback("group cap overflow")
+                raise FragmentFallback("group cap overflow", reason="group-cap")
             self.gcap = self.ladder.resize(
                 "group", self.gcap, need=max(ng_true[r] for r in over),
                 max_cap=self.cap_limit)
@@ -1303,14 +1306,16 @@ def unify_string_join_dicts(root: PhysicalPlan, host_cols) -> None:
             if l.ftype.is_ci or r.ftype.is_ci:
                 raise FragmentFallback(
                     "ci-collated join keys need fold-aware dictionary "
-                    "unification (single-chip / CPU only)")
+                    "unification (single-chip / CPU only)",
+                    reason="string-dict")
             lh = _trace_scan_col(node.children[0], l.index) \
                 if isinstance(l, ColumnRef) else None
             rh = _trace_scan_col(node.children[1], r.index) \
                 if isinstance(r, ColumnRef) else None
             if lh is None or rh is None:
                 raise FragmentFallback(
-                    "string join key is not a scan column")
+                    "string join key is not a scan column",
+                    reason="string-dict")
             union((id(lh[0]), lh[1]), (id(rh[0]), rh[1]))
 
     groups: Dict = {}
@@ -1322,7 +1327,8 @@ def unify_string_join_dicts(root: PhysicalPlan, host_cols) -> None:
         dicts = [host_cols[m][2] for m in members
                  if m in host_cols and host_cols[m][2] is not None]
         if len(dicts) < len(members):
-            raise FragmentFallback("string join key without dictionary")
+            raise FragmentFallback("string join key without dictionary",
+                                   reason="string-dict")
         union_d = np.unique(np.concatenate(dicts))
         for m in members:
             codes, _valid, d = host_cols[m]

@@ -49,8 +49,9 @@ from tidb_tpu.errors import (CapacityError, DeviceLost, ExecutionError,
 from tidb_tpu.expression import EvalContext, Expression, ColumnRef
 from tidb_tpu.expression.aggfuncs import AggFunc, build_agg
 from tidb_tpu.planner.physical import (PhysHashAgg, PhysHashJoin,
-                                       PhysProjection, PhysSelection,
-                                       PhysSort, PhysTableScan, PhysTopN,
+                                       PhysLimit, PhysProjection,
+                                       PhysSelection, PhysSort,
+                                       PhysTableScan, PhysTopN,
                                        PhysTpuFragment, PhysWindow,
                                        PhysicalPlan)
 from tidb_tpu.types import FieldType
@@ -71,8 +72,33 @@ def _piggyback_agg(fetch: dict, out, group_cap: int) -> bool:
     return False
 
 
+# The closed fallback-reason taxonomy: every way a fragment can decline
+# the device path maps to ONE of these stable codes. The code is what
+# EXPLAIN ANALYZE prints as `device:fallback(code)` and the `reason`
+# label on tidb_tpu_device_fallbacks_total — free-text detail rides
+# along for logs but never reaches a metric label (bounded cardinality).
+FALLBACK_REASONS = (
+    "shape",          # plan not a device-eligible chain/tree
+    "empty-input",    # zero-row scan: nothing to dispatch
+    "group-cap",      # factorize cap overflow past the ladder ceiling
+    "pair-cap",       # DISTINCT pair-set cap overflow past the ceiling
+    "join-cap",       # join fan-out exceeds the device expansion cap
+    "blocked-expand", # blocked multi-pass join can't serve this shape
+    "mesh-size",      # dist plan wants more devices than are visible
+    "string-dict",    # varlen column with no dictionary encoding
+    "device-error",   # unexpected device/runtime failure
+)
+
+
 class FragmentFallback(Exception):
-    """Raised when the device path cannot run this fragment."""
+    """Raised when the device path cannot run this fragment.
+
+    `reason` must be one of FALLBACK_REASONS (defaults to "shape"); the
+    exception message keeps the free-text detail."""
+
+    def __init__(self, detail: str = "", reason: str = "shape"):
+        super().__init__(detail)
+        self.reason = reason if reason in FALLBACK_REASONS else "shape"
 
 
 def _var_bool(v) -> bool:
@@ -188,6 +214,13 @@ def _exprs_device_ok(exprs: Sequence[Expression]) -> bool:
                         isinstance(sub.args[1], Constant) and
                         sub.args[1].value is not None):
                     return False
+            if isinstance(sub, ScalarFunc) and sub.op == "in" and \
+                    sub.args[0].ftype.kind.is_string and \
+                    not isinstance(sub.args[0], ColumnRef):
+                # string IN-lists prepare a per-dictionary codeset; a
+                # COMPUTED string (SUBSTRING(...) IN (...)) has no
+                # dictionary to prepare against
+                return False
             # wide-decimal COLUMNS arrive as 2-D limb planes no generic
             # kernel understands; computed wide-typed expressions are
             # ordinary 1-D scaled int64 and pass
@@ -214,17 +247,24 @@ def _fragment_ok(plan: PhysicalPlan, threshold: int) -> bool:
         if not _exprs_device_ok(stage):
             return False
         if isinstance(node, PhysHashAgg):
+            if getattr(node, "rollup", False) and \
+                    any(d.distinct for d in node.aggs):
+                return False    # pair columns assume nk key cols; the
+                # rollup level column breaks that layout → host oracle
             for desc in node.aggs:
-                if desc.distinct and len(desc.args) != 1:
-                    return False    # COUNT(DISTINCT a,b): CPU only
+                if desc.distinct and len(desc.args) > 1 and \
+                        desc.name != "count":
+                    return False    # multi-arg DISTINCT is COUNT-only
                 try:
                     if not build_agg(desc).device_capable:
                         return False
                 except Exception:
                     return False
-                if desc.args and desc.args[0].ftype.kind.is_string \
+                if any(a.ftype.kind.is_string for a in desc.args) \
                         and desc.name != "count":
                     return False
+                if not _string_exprs_are_refs(desc.args):
+                    return False    # string agg args read dict codes
                 if any(isinstance(sub, ColumnRef) and
                        sub.ftype.is_wide_decimal
                        for a in desc.args for sub in a.walk()):
@@ -417,7 +457,8 @@ def _chain_signature(chain: List[PhysicalPlan], used_cols: Sequence[int],
         elif isinstance(node, PhysHashAgg):
             parts.append(
                 f"Agg(g={node.group_exprs!r}, "
-                f"a={[(d.name, repr(d.args), str(d.ftype), d.distinct) for d in node.aggs]})")
+                f"a={[(d.name, repr(d.args), str(d.ftype), d.distinct) for d in node.aggs]}, "
+                f"r={getattr(node, 'rollup', False)})")
         elif isinstance(node, (PhysTopN, PhysSort)):
             k = getattr(node, "count", None)
             off = getattr(node, "offset", 0)
@@ -984,6 +1025,22 @@ def _spec_key(guard, kind: str, extra: tuple):
     return (kind, normalize_sql(sql), sql) + extra
 
 
+def _plan_fingerprint(node) -> str:
+    """Cheap per-fragment plan identity for the specialization key: one
+    statement can run SEVERAL fragments under the same guard.sql (a
+    plan-time uncorrelated subquery, a derived table), and geometry
+    alone can't tell them apart — without this, the subquery's entry
+    shadows the outer fragment's and hands it the wrong compiled
+    signature (wrong agg-state layout)."""
+    out = []
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        out.append(n.describe())
+        stack.extend(getattr(n, "children", ()))
+    return "|".join(out)
+
+
 def _spec_lookup(key, lay_sig: Optional[str] = None) -> Optional[dict]:
     """`lay_sig` is the statement's CURRENT layout-set signature. It is
     deliberately NOT part of the key: a table re-encode (compression
@@ -1061,6 +1118,8 @@ def _agg_key_bounds(chain: List[PhysicalPlan], ent) -> Optional[List[Tuple[int, 
     root = chain[0]
     if not isinstance(root, PhysHashAgg) or not root.group_exprs:
         return None
+    if getattr(root, "rollup", False):
+        return None     # level tiling needs the sort factorize
     bounds: List[Tuple[int, int]] = []
     domain = 1
     for e in root.group_exprs:
@@ -1324,6 +1383,7 @@ class TpuFragmentExec:
         self.stats = OperatorStats()
         self.used_device = False
         self.fallback_reason: Optional[str] = None
+        self.fallback_code: Optional[str] = None
         self._result: Optional[Chunk] = None
         self._cpu_root = None
         self._offset = 0
@@ -1335,6 +1395,7 @@ class TpuFragmentExec:
         self._offset = 0
         self.used_device = False
         self.fallback_reason = None
+        self.fallback_code = None
 
     def runtime_info(self) -> str:
         """Surfaced in EXPLAIN ANALYZE (ref: execdetails.go runtime stats)."""
@@ -1369,7 +1430,9 @@ class TpuFragmentExec:
         if self.used_device:
             return f"device:yes{esc}{phs}{qw}{mig}{rf}"
         if self.fallback_reason:
-            return f"device:fallback({self.fallback_reason}){esc}"
+            # the parenthesized value is the STABLE taxonomy code — the
+            # same string labels tidb_tpu_device_fallbacks_total{reason=}
+            return f"device:fallback({self.fallback_code or 'shape'}){esc}"
         return ""
 
     def next(self) -> Optional[Chunk]:
@@ -1418,7 +1481,8 @@ class TpuFragmentExec:
                                   **_ph.as_dict())
                 except FragmentFallback as e:
                     # expected ineligibility (shape/feature gate) — quiet
-                    self.fallback_reason = str(e) or "ineligible"
+                    self._note_fallback(getattr(e, "reason", "shape"),
+                                        str(e))
                     if strict:
                         raise ExecutionError(
                             f"tidb_tpu_strict: device fragment fell "
@@ -1454,7 +1518,8 @@ class TpuFragmentExec:
                     raise
                 except Exception as e:  # noqa: BLE001
                     # UNEXPECTED device failure: never silent
-                    self.fallback_reason = f"{type(e).__name__}: {e}"
+                    self._note_fallback("device-error",
+                                        f"{type(e).__name__}: {e}")
                     log.warning("device fragment failed, falling back "
                                 "to CPU: %s",
                                 self.fallback_reason, exc_info=True)
@@ -1473,6 +1538,18 @@ class TpuFragmentExec:
             self._offset, min(self._offset + size, self._result.num_rows))
         self._offset += out.num_rows
         return out
+
+    def _note_fallback(self, code: str, detail: str) -> None:
+        """Stamp the normalized taxonomy code + free-text detail and move
+        the per-reason counter (the coverage table, EXPLAIN ANALYZE, and
+        metrics all read the SAME code)."""
+        from tidb_tpu.util.observability import REGISTRY
+        self.fallback_code = code if code in FALLBACK_REASONS else "shape"
+        detail = detail or self.fallback_code
+        self.fallback_reason = f"{self.fallback_code}: {detail}" \
+            if detail != self.fallback_code else self.fallback_code
+        REGISTRY.inc("tidb_tpu_device_fallbacks_total",
+                     {"reason": self.fallback_code})
 
     def _fallback_next(self) -> Optional[Chunk]:
         from tidb_tpu.executor import build
@@ -1530,10 +1607,12 @@ class TpuFragmentExec:
             return self._run_device_dist()
         chain = _linearize(self.plan.root)
         if chain is None:
-            from tidb_tpu.executor.tree_fragment import has_join
-            if has_join(self.plan.root):
+            from tidb_tpu.executor.tree_fragment import has_join, has_window
+            if has_join(self.plan.root) or has_window(self.plan.root):
+                # joins, and windowed shapes with no linear-chain lowering
+                # (interior windows), run as tree programs
                 return self._run_device_tree()
-            raise FragmentFallback("not a chain")
+            raise FragmentFallback("not a chain", reason="shape")
         # ORDER BY / TopN directly over the agg: strip the order root and
         # run the rest agg-rooted — the ordering becomes the agg's fused
         # device finalize (or a host re-order when the gate is off)
@@ -1562,7 +1641,7 @@ class TpuFragmentExec:
                                               phases=self.ctx.phases,
                                               prune=True)
         if ent.total == 0:
-            raise FragmentFallback("empty input")
+            raise FragmentFallback("empty input", reason="empty-input")
         dicts = {i: ent.dicts.get(i) for i in used}
         total, slab_cap, n_slabs = ent.total, ent.slab_cap, ent.n_slabs
 
@@ -1706,7 +1785,7 @@ class TpuFragmentExec:
                                          max_slab,
                                          phases=self.ctx.phases)
             if ent.total == 0:
-                raise FragmentFallback("empty input")
+                raise FragmentFallback("empty input", reason="empty-input")
             ents.append((ent, used))
         caps = {id(s): (e.slab_cap, e.n_slabs)
                 for s, (e, _) in zip(scans, ents)}
@@ -1787,12 +1866,11 @@ class TpuFragmentExec:
         # join-probe → partial-agg as ONE program PER PROBE SLAB plus one
         # root merge/finalize, instead of one mega-slab program:
         # intermediates stay in registers/HBM and warm launches drop to
-        # slabs + 1. Single-arg DISTINCT aggs fuse too — the per-slab
-        # programs emit capped (group, value) pair sets the host merges
-        # exactly; only multi-arg DISTINCT keeps the mega-slab path.
-        if is_agg and _var_bool(vars_.get("tidb_tpu_fused_pipeline", "on")) \
-                and not any(d.distinct and len(d.args) != 1
-                            for d in root.aggs):
+        # slabs + 1. DISTINCT aggs fuse too — the per-slab programs emit
+        # capped (group, args...) pair sets the host merges exactly;
+        # multi-arg DISTINCT (COUNT-only) dedups on a combined dense code
+        # in-slab and ships the raw argument columns in the pairs.
+        if is_agg and _var_bool(vars_.get("tidb_tpu_fused_pipeline", "on")):
             anchor = TF.aligned_chain(root.children[0])[0]
             anchor_i = next((i for i, s in enumerate(scans)
                              if s is anchor), None)
@@ -1826,9 +1904,10 @@ class TpuFragmentExec:
             if is_agg:
                 fetch["ng"] = out["n_groups"]
                 _piggyback_agg(fetch, out, gcap)
-            elif isinstance(root, (PhysTopN, PhysSort)):
+            elif isinstance(root, (PhysTopN, PhysSort, PhysLimit)):
                 fetch["no"] = out["n_out"]
-                if isinstance(root, PhysTopN) and out["cols"] and \
+                if isinstance(root, (PhysTopN, PhysLimit)) and \
+                        out["cols"] and \
                         out["cols"][0][0].shape[0] <= SMALL_GROUP_CAP:
                     # the device result is ALREADY truncated to
                     # min(count+offset, rows) (ops/factorize.topn): when
@@ -1875,7 +1954,7 @@ class TpuFragmentExec:
             if is_agg and akb is None and int(flags["ng"]) > gcap:
                 if gcap >= max_cap:
                     ladder.fallback("group")
-                    raise FragmentFallback("group cap overflow")
+                    raise FragmentFallback("group cap overflow", reason="group-cap")
                 # factorize reported the TRUE distinct count: resize to
                 # exact need in one recompile instead of blind doubling
                 gcap = ladder.resize("group", gcap, need=int(flags["ng"]),
@@ -1908,7 +1987,7 @@ class TpuFragmentExec:
                 chunk = _host_order(chunk, order_root, root.schema)
                 chunk = _topn_slice(chunk, order_root)
             return chunk
-        if isinstance(root, (PhysTopN, PhysSort)):
+        if isinstance(root, (PhysTopN, PhysSort, PhysLimit)):
             n_out = int(flags["no"])
             if "cols" in flags:
                 host_cols = [(np.asarray(v)[:n_out], np.asarray(m)[:n_out])
@@ -2011,7 +2090,7 @@ class TpuFragmentExec:
                         e.slab_cap, e.n_slabs) for e, _ in ents),
                  anchor_i, repr(akb), want_pairs, use_fin,
                  _order_sig(order_root) if order_root is not None
-                 else None))
+                 else None, _plan_fingerprint(root)))
         spec = _spec_lookup(skey, lay_sig)
         if skey is not None:
             _spec_note(ph, spec is not None)
@@ -2107,7 +2186,8 @@ class TpuFragmentExec:
                         if pair_cap >= slab_cap:
                             ladder.fallback("pairs")
                             raise FragmentFallback(
-                                "distinct pair overflow")
+                                "distinct pair overflow",
+                                reason="pair-cap")
                         worst = max(int(c) for si, s in enumerate(need)
                                     if s in pover
                                     for c in counts[si].values())
@@ -2142,7 +2222,9 @@ class TpuFragmentExec:
                         # checkpointed partials alive for resumable
                         # retries
                         key_cols = []
-                        for kc in range(len(root.group_exprs)):
+                        # len(partials[0]["keys"]), not nk: rollup
+                        # partials carry a trailing grouping-level column
+                        for kc in range(len(partials[0]["keys"])):
                             key_cols.append(tuple(
                                 jnp.concatenate([p["keys"][kc][f]
                                                  for p in partials])
@@ -2236,7 +2318,7 @@ class TpuFragmentExec:
                 if over or n_final > gcap:
                     if gcap >= max_cap:
                         ladder.fallback("group")
-                        raise FragmentFallback("group cap overflow")
+                        raise FragmentFallback("group cap overflow", reason="group-cap")
                     # clipped slabs understate the merged count, so the
                     # max overflowed per-slab count is the valid lower
                     # bound; merged-only overflow is exact (rerun=0)
@@ -2314,13 +2396,13 @@ class TpuFragmentExec:
         if not isinstance(root, PhysHashAgg):
             raise FragmentFallback(
                 f"join fan-out {est_total} exceeds device cap "
-                f"(non-agg root)")
+                f"(non-agg root)", reason="join-cap")
         if any(d.distinct for d in root.aggs):
-            raise FragmentFallback("blocked expand: DISTINCT aggs")
+            raise FragmentFallback("blocked expand: DISTINCT aggs", reason="blocked-expand")
         if any(d.ftype.is_wide_decimal or
                any(a.ftype.is_wide_decimal for a in d.args)
                for d in root.aggs):
-            raise FragmentFallback("blocked expand: wide-decimal aggs")
+            raise FragmentFallback("blocked expand: wide-decimal aggs", reason="blocked-expand")
         bjoin = walk_joins[bji]
         # the blocked join must be reachable from the root agg via PROBE
         # sides only: each pass joins a slice of the probe rows against
@@ -2342,17 +2424,17 @@ class TpuFragmentExec:
         if not probe_path_ok(root):
             raise FragmentFallback(
                 "blocked expand: overflowing join is inside an ancestor's "
-                "build subtree")
+                "build subtree", reason="blocked-expand")
         bi = 1 if bjoin.build_right else 0
         anchor, crossed = TF.aligned_chain(bjoin.children[1 - bi])
         if anchor is None:
-            raise FragmentFallback("blocked expand: no probe anchor")
+            raise FragmentFallback("blocked expand: no probe anchor", reason="blocked-expand")
         for j in crossed:
             jcfg = join_cfgs[walk_joins.index(j)]
             if not (jcfg.mode == "aligned" or j.kind in ("semi", "anti")):
                 raise FragmentFallback(
                     "blocked expand: probe chain crosses a join that may "
-                    "not preserve the row space")
+                    "not preserve the row space", reason="blocked-expand")
         anchor_ent = next(e for s, (e, _) in zip(scans, ents)
                           if s is anchor)
         total_cap = anchor_ent.slab_cap * anchor_ent.n_slabs
@@ -2399,7 +2481,7 @@ class TpuFragmentExec:
                             restart = True
                 if akb is None and int(got["ng"]) > gcap:
                     if gcap >= max_cap:
-                        raise FragmentFallback("group cap overflow")
+                        raise FragmentFallback("group cap overflow", reason="group-cap")
                     gcap = min(gcap * 4, max_cap)
                     restart = True
                 if overflow or restart:
@@ -2418,7 +2500,7 @@ class TpuFragmentExec:
             inp_dicts = {i: d for i, d in
                          enumerate(flows.get(id(root), []))}
             return self._merge_tree_agg_passes(root, pass_outs, inp_dicts)
-        raise FragmentFallback("blocked expand: skew beyond 128 passes")
+        raise FragmentFallback("blocked expand: skew beyond 128 passes", reason="blocked-expand")
 
     def _merge_tree_agg_passes(self, root: PhysHashAgg, pass_outs,
                                inp_dicts) -> Chunk:
@@ -2428,6 +2510,8 @@ class TpuFragmentExec:
         way)."""
         aggs = [build_agg(d) for d in root.aggs]
         n_keys = len(root.group_exprs)
+        if n_keys and getattr(root, "rollup", False):
+            n_keys += 1     # device partials carry a grouping-level column
         key_parts: List[List] = [[] for _ in range(n_keys)]
         state_parts: List[List] = [[] for _ in aggs]
         for got in pass_outs:
@@ -2688,7 +2772,8 @@ class TpuFragmentExec:
         import jax as _jax
         if len(_jax.devices()) < nd:
             raise FragmentFallback(f"mesh wants {nd} devices, "
-                                   f"{len(_jax.devices())} available")
+                                   f"{len(_jax.devices())} available",
+                                   reason="mesh-size")
         mesh = make_mesh(nd)
         P = jax.sharding.PartitionSpec
         sharding = jax.sharding.NamedSharding(mesh, P("shard"))
@@ -2707,7 +2792,7 @@ class TpuFragmentExec:
                 list(range(len(scan.schema)))
             parts, total = _collect_parts(self.ctx, scan)
             if total == 0:
-                raise FragmentFallback("empty input")
+                raise FragmentFallback("empty input", reason="empty-input")
             shim = pytypes.SimpleNamespace(parts=parts)
             ftypes = scan.schema.field_types
             with ph.phase("encode"):
@@ -2901,7 +2986,7 @@ class TpuFragmentExec:
                     ladder.fallback("join")
                     raise FragmentFallback(
                         f"join fan-out {int(jneed[ji])} exceeds "
-                        f"device cap")
+                        f"device cap", reason="join-cap")
                 if new_cfg is not None:
                     # a lost PK-FK bet re-traces in expand mode; an expand
                     # overflow resizes to the largest shard's true need —
@@ -2923,7 +3008,7 @@ class TpuFragmentExec:
             if gneed > gcap:
                 if gcap >= max_cap * nd:
                     ladder.fallback("group")
-                    raise FragmentFallback("group cap overflow")
+                    raise FragmentFallback("group cap overflow", reason="group-cap")
                 # the pmax'd true per-shard group count came back: exact
                 # need, one recompile
                 gcap = ladder.resize("group", gcap, need=gneed,
@@ -3069,7 +3154,7 @@ class TpuFragmentExec:
                 (id(ent.td), getattr(ent, "delta_version", 0), slab_cap,
                  n_slabs, repr(key_bounds), want_pairs, use_fin,
                  _order_sig(order_root) if order_root is not None
-                 else None))
+                 else None, _plan_fingerprint(chain[0])))
         spec = _spec_lookup(skey, lay_sig)
         if skey is not None:
             _spec_note(ph, spec is not None)
@@ -3161,7 +3246,7 @@ class TpuFragmentExec:
                     if pover:
                         if pair_cap >= slab_cap:
                             ladder.fallback("pairs")
-                            raise FragmentFallback("distinct pair overflow")
+                            raise FragmentFallback("distinct pair overflow", reason="pair-cap")
                         worst = max(int(c) for si, s in enumerate(need)
                                     if s in pover
                                     for c in counts[si].values())
@@ -3201,7 +3286,9 @@ class TpuFragmentExec:
                         # checkpointed partials alive for resumable
                         # retries
                         key_cols = []
-                        for kc in range(len(root.group_exprs)):
+                        # len(partials[0]["keys"]), not nk: rollup
+                        # partials carry a trailing grouping-level column
+                        for kc in range(len(partials[0]["keys"])):
                             v = jnp.concatenate([p["keys"][kc][0]
                                                  for p in partials])
                             m = jnp.concatenate([p["keys"][kc][1]
@@ -3266,7 +3353,7 @@ class TpuFragmentExec:
             if over:
                 if group_cap >= cap_limit:
                     ladder.fallback("group")
-                    raise FragmentFallback("group cap overflow")
+                    raise FragmentFallback("group cap overflow", reason="group-cap")
                 # the MERGED count may be understated when slabs clipped,
                 # so the max overflowed per-slab count is a valid lower
                 # bound — the ladder resizes to it exactly and re-checks
@@ -3287,7 +3374,7 @@ class TpuFragmentExec:
                 # re-merge at the exact-need cap
                 if group_cap >= cap_limit:
                     ladder.fallback("group")
-                    raise FragmentFallback("group cap overflow")
+                    raise FragmentFallback("group cap overflow", reason="group-cap")
                 group_cap = ladder.resize("group", group_cap,
                                           need=n_final,
                                           max_cap=cap_limit)
@@ -3562,16 +3649,20 @@ def _merge_distinct_states(root, host_keys, distinct_pairs, n_final):
     nk = len(root.group_exprs)
     out = {}
     for ai, slabs in distinct_pairs.items():
+        na = max(1, len(root.aggs[ai].args))
         cols = []
-        for c in range(nk + 1):
+        for c in range(nk + na):
             v = np.concatenate([np.asarray(s[c][0]) for s in slabs])
             m = np.concatenate([np.asarray(s[c][1]) for s in slabs])
             cols.append((v, m))
         order, first = _host_run_bounds(cols)
         uniq = np.zeros(len(order), dtype=bool)
         uniq[order] = first
-        vv, vm = cols[-1]
-        keep = uniq & np.asarray(vm)     # NULL values never count
+        vv = cols[nk][0]
+        vm = np.ones(len(order), dtype=bool)
+        for _av, am in cols[nk:]:
+            vm = vm & np.asarray(am)     # any NULL arg → row never counts
+        keep = uniq & vm
         if nk:
             gidx = _host_group_index(
                 host_keys, [(np.asarray(v)[keep], np.asarray(m)[keep])
@@ -3600,7 +3691,7 @@ def _compact_decode(cols_vm, live_mask, ftypes, dicts_root) -> Chunk:
 
 
 def _topn_slice(chunk: Chunk, root) -> Chunk:
-    if isinstance(root, PhysTopN):
+    if isinstance(root, (PhysTopN, PhysLimit)):
         lo = min(root.offset, chunk.num_rows)
         hi = min(root.offset + root.count, chunk.num_rows)
         return chunk.slice(lo, hi)
@@ -3614,7 +3705,7 @@ def _decode_col(ft: FieldType, vals: np.ndarray, mask: np.ndarray,
             if not np.asarray(mask, dtype=bool).any():
                 # unused placeholder column: all-NULL is fine
                 return Column.all_null(ft, len(vals))
-            raise FragmentFallback("string column without dictionary")
+            raise FragmentFallback("string column without dictionary", reason="string-dict")
         neg = vals < 0
         if neg.any():
             mask = mask & ~neg

@@ -243,6 +243,8 @@ class HashAggExec(Executor):
         self.descs = plan.aggs
         self.aggs: List[AggFunc] = [build_agg(d) for d in plan.aggs]
         self.scalar = not plan.group_exprs  # no GROUP BY → always one row
+        self.rollup = getattr(plan, "rollup", False)
+        self._replay: Optional[List[Chunk]] = None
         self._result: Optional[Chunk] = None
         self._offset = 0
 
@@ -320,7 +322,7 @@ class HashAggExec(Executor):
         try:
             if conc == 1:
                 while True:
-                    ch = self.child_next()
+                    ch = self._next_input()
                     if ch is None:
                         break
                     if ch.num_rows == 0:
@@ -354,7 +356,7 @@ class HashAggExec(Executor):
                         tracker.release(reserved)
 
                 while True:
-                    ch = self.child_next()
+                    ch = self._next_input()
                     if ch is None:
                         break
                     if ch.num_rows == 0:
@@ -379,6 +381,59 @@ class HashAggExec(Executor):
             tracker.release(tracked)
             if spill is not None:
                 spill.close()
+
+    def _next_input(self) -> Optional[Chunk]:
+        """Child pull, redirected to the buffered-chunk replay while a
+        rollup level re-runs the pipeline."""
+        if self._replay is not None:
+            return self._replay.pop(0) if self._replay else None
+        return self.child_next()
+
+    def _aggregate_rollup(self) -> Chunk:
+        """GROUP BY ... WITH ROLLUP: one aggregation per prefix of the
+        group list (all k keys down to the grand total), rolled-up key
+        columns emitted as NULL.  The child is drained ONCE; every level
+        replays the buffered chunks through the regular partial/merge
+        pipeline (spill, distinct, process pool all included), so each
+        super-aggregate row is exactly the oracle result for its prefix.
+        A genuinely-NULL key group and the super-aggregate over it stay
+        separate rows, as in MySQL."""
+        chunks: List[Chunk] = []
+        while True:
+            ch = self.child_next()
+            if ch is None:
+                break
+            if ch.num_rows:
+                chunks.append(ch)
+        if not chunks:
+            return _empty_chunk(self.schema)   # no rows at ANY level
+        full_ge, full_scalar = self.group_exprs, self.scalar
+        k = len(full_ge)
+        pieces: List[Chunk] = []
+        try:
+            for keep in range(k, -1, -1):
+                self.group_exprs = full_ge[:keep]
+                self.scalar = keep == 0
+                self._replay = list(chunks)
+                piece = self._aggregate()
+                if piece.num_rows == 0:
+                    continue
+                cols = list(piece.columns[:keep])
+                for kc in range(keep, k):      # rolled-up keys → all-NULL
+                    ft = self.schema[kc]
+                    vals = np.full(piece.num_rows, None, dtype=object) \
+                        if ft.is_varlen else \
+                        np.zeros(piece.num_rows, dtype=ft.np_dtype)
+                    cols.append(Column(ft, vals,
+                                       np.zeros(piece.num_rows, dtype=bool)))
+                cols += list(piece.columns[keep:])
+                pieces.append(Chunk(cols))
+        finally:
+            self.group_exprs, self.scalar = full_ge, full_scalar
+            self._replay = None
+        if not pieces:
+            return _empty_chunk(self.schema)
+        return Chunk.concat(pieces) if len(pieces) > 1 else pieces[0]
 
     def _fold_group_keys(self, key_cols):
         """Every factorize/partition over group keys (partial, merge,
@@ -555,7 +610,8 @@ class HashAggExec(Executor):
     # ---- volcano ----------------------------------------------------------
     def next(self) -> Optional[Chunk]:
         if self._result is None:
-            self._result = self._aggregate()
+            self._result = self._aggregate_rollup() if self.rollup \
+                else self._aggregate()
         if self._offset >= self._result.num_rows:
             return None
         size = self.ctx.chunk_size

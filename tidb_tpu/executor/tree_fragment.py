@@ -44,8 +44,9 @@ import numpy as np
 from tidb_tpu.expression import ColumnRef, EvalContext, Expression
 from tidb_tpu.expression.aggfuncs import build_agg
 from tidb_tpu.planner.physical import (PhysHashAgg, PhysHashJoin,
-                                       PhysProjection, PhysSelection,
-                                       PhysSort, PhysTableScan, PhysTopN,
+                                       PhysLimit, PhysProjection,
+                                       PhysSelection, PhysSort,
+                                       PhysTableScan, PhysTopN,
                                        PhysWindow, PhysicalPlan)
 
 JOIN_KINDS = ("inner", "left", "right", "semi", "anti")
@@ -57,6 +58,12 @@ def has_join(plan: PhysicalPlan) -> bool:
     if isinstance(plan, PhysHashJoin):
         return True
     return any(has_join(c) for c in plan.children)
+
+
+def has_window(plan: PhysicalPlan) -> bool:
+    if isinstance(plan, PhysWindow):
+        return True
+    return any(has_window(c) for c in plan.children)
 
 
 def _string_key_ok(l: Expression, r: Expression) -> bool:
@@ -105,17 +112,23 @@ def tree_ok(plan: PhysicalPlan, threshold: int) -> bool:
             return walk(node.children[0], False) and \
                 walk(node.children[1], False)
         if is_root and isinstance(node, PhysHashAgg):
+            if getattr(node, "rollup", False) and \
+                    any(d.distinct for d in node.aggs):
+                return False    # DISTINCT+ROLLUP stays on the host oracle
             for desc in node.aggs:
-                if desc.distinct and len(desc.args) != 1:
-                    return False    # COUNT(DISTINCT a,b): CPU only
+                if desc.distinct and len(desc.args) > 1 and \
+                        desc.name != "count":
+                    return False    # multi-arg DISTINCT is COUNT-only
                 try:
                     if not build_agg(desc).device_capable:
                         return False
                 except Exception:
                     return False
-                if desc.args and desc.args[0].ftype.kind.is_string \
+                if any(a.ftype.kind.is_string for a in desc.args) \
                         and desc.name != "count":
                     return False
+                if not _string_exprs_are_refs(desc.args):
+                    return False    # string agg args read dict codes
             if not _string_exprs_are_refs(node.group_exprs):
                 return False
             return walk(node.children[0], False)
@@ -137,12 +150,22 @@ def tree_ok(plan: PhysicalPlan, threshold: int) -> bool:
                     return False
                 return walk(child, True)
             return walk(node.children[0], False)
-        if is_root and isinstance(node, PhysWindow):
+        if isinstance(node, PhysWindow):
+            # root OR interior: interior windows compute their columns
+            # in-trace (TreeProgram._emit) and feed the operator above —
+            # the TopN-over-ROW_NUMBER / agg-over-window shapes
             from tidb_tpu.executor.fragment import _window_device_ok
             return _window_device_ok(node) and walk(node.children[0], False)
+        if is_root and isinstance(node, PhysLimit):
+            # LIMIT over a join: the program emits the first offset+count
+            # live rows in probe row order (device_emit.emit_root)
+            return node.count is not None and walk(node.children[0], False)
         return False
 
-    return walk(plan, True) and has_join(plan) and max_scan[0] >= threshold
+    # joinless trees are admitted when a window makes the tree program
+    # worthwhile (mid-chain windows have no linear-chain lowering)
+    return walk(plan, True) and (has_join(plan) or has_window(plan)) \
+        and max_scan[0] >= threshold
 
 
 def dist_ok(plan: PhysicalPlan, threshold: int) -> bool:
@@ -169,6 +192,8 @@ def dist_ok(plan: PhysicalPlan, threshold: int) -> bool:
             # host orders after the merge) — eligibility is the agg's
             return dist_ok(below, threshold)
     if isinstance(plan, PhysHashAgg):
+        if getattr(plan, "rollup", False):
+            return False    # super-aggregate levels don't shard-merge yet
         if any(d.distinct for d in plan.aggs):
             # DISTINCT distributes by re-keying the exchange so every
             # group (or every distinct value, for global aggs) is wholly
@@ -176,26 +201,41 @@ def dist_ok(plan: PhysicalPlan, threshold: int) -> bool:
             # mpp_exec.go); a global agg needs all distinct args equal to
             # pick ONE key
             if not plan.group_exprs:
+                if any(d.distinct and len(d.args) != 1
+                       for d in plan.aggs):
+                    return False    # tuple re-key has no single column
                 dargs = {repr(d.args[0]) for d in plan.aggs
                          if d.distinct and d.args}
                 if len(dargs) != 1:
                     return False
     elif isinstance(plan, PhysWindow):
-        # per-shard windows need every partition wholly on one shard: all
-        # specs must share ONE non-empty bare-ColumnRef partition list so
-        # a single hash exchange co-locates them (insert_exchanges)
-        parts = {repr(d.partition) for d in plan.wdescs}
-        if len(parts) != 1 or not plan.wdescs[0].partition:
-            return False
-        if not all(isinstance(e, ColumnRef)
-                   for e in plan.wdescs[0].partition):
-            return False
+        pass        # the per-window spec check below covers the root too
     elif not isinstance(plan, (PhysTopN, PhysSort, PhysSelection,
                                PhysProjection, PhysHashJoin)):
         return False
-    # interior windows would need their own repartition point mid-tree —
-    # only a window ROOT is distributable
-    if any(isinstance(n, PhysWindow) for n in _walk_nodes(plan)[:-1]):
+    # per-shard windows need every partition wholly on one shard: all
+    # specs must share ONE non-empty bare-ColumnRef partition list so a
+    # single hash exchange directly below the window co-locates them
+    # (insert_exchanges). Above the window only row-wise projections are
+    # distributable (window root, or the select list over it) — a
+    # reducing ancestor (agg/TopN/join) would need its own repartition
+    # point mid-tree
+    def _windows_ok(n, proj_chain):
+        if isinstance(n, PhysWindow):
+            if not proj_chain:
+                return False
+            parts = {repr(d.partition) for d in n.wdescs}
+            if len(parts) != 1 or not n.wdescs[0].partition:
+                return False
+            if not all(isinstance(e, ColumnRef)
+                       for e in n.wdescs[0].partition):
+                return False
+            proj_chain = False       # no second window below the first
+        elif not isinstance(n, PhysProjection):
+            proj_chain = False
+        return all(_windows_ok(c, proj_chain) for c in n.children)
+
+    if not _windows_ok(plan, True):
         return False
     # wide-decimal COLUMNS can't shard (the dist scan encoder is 1-D);
     # wide RESULTS over narrow/computed args are fine — limb states
@@ -204,7 +244,9 @@ def dist_ok(plan: PhysicalPlan, threshold: int) -> bool:
             isinstance(sub, ColumnRef) and sub.ftype.is_wide_decimal
             for d in plan.aggs for a in d.args for sub in a.walk()):
         return False
-    if has_join(plan):
+    if has_join(plan) or has_window(plan):
+        # windowed shapes compile as tree programs (mirrors the
+        # single-device dispatch in fragment.py)
         return tree_ok(plan, threshold)
     return _chain_shape_ok(plan, threshold)
 
@@ -543,6 +585,8 @@ def tree_agg_key_bounds(root: PhysicalPlan, scan_bounds,
     every group key is a bounded column and the packed domain is small."""
     if not isinstance(root, PhysHashAgg) or not root.group_exprs:
         return None
+    if getattr(root, "rollup", False):
+        return None     # level tiling needs the sort factorize
     inp = _bounds_list(root.children[0], scan_bounds)
     out: List[Tuple[int, int]] = []
     domain = 1
@@ -600,7 +644,8 @@ def tree_signature(plan: PhysicalPlan, caps: Dict[int, Tuple[int, int]],
         elif isinstance(node, PhysHashAgg):
             parts.append(
                 f"Agg(g={node.group_exprs!r}, "
-                f"a={[(d.name, repr(d.args), str(d.ftype), d.distinct) for d in node.aggs]})")
+                f"a={[(d.name, repr(d.args), str(d.ftype), d.distinct) for d in node.aggs]}, "
+                f"r={getattr(node, 'rollup', False)})")
         elif isinstance(node, (PhysTopN, PhysSort)):
             parts.append(f"{type(node).__name__}(by={node.by!r}, "
                          f"descs={node.descs}, "
@@ -608,6 +653,8 @@ def tree_signature(plan: PhysicalPlan, caps: Dict[int, Tuple[int, int]],
                          f"off={getattr(node, 'offset', 0)})")
         elif isinstance(node, PhysWindow):
             parts.append(f"Window({node.wdescs!r})")
+        elif isinstance(node, PhysLimit):
+            parts.append(f"Limit(k={node.count}, off={node.offset})")
         elif type(node).__name__ == "PhysExchange":
             parts.append(f"Exch({node.kind}, keys={node.keys!r})")
     return "|".join(parts)
@@ -792,7 +839,18 @@ class TreeProgram:
             return [e.eval(ctx) for e in node.exprs], live
         if isinstance(node, PhysHashJoin):
             return self._emit_join(node, scan_inputs, scan_rows)
-        if isinstance(node, (PhysHashAgg, PhysTopN, PhysSort, PhysWindow)):
+        if isinstance(node, PhysWindow) and node is not self.plan:
+            # interior window: compute the window columns in-trace and
+            # hand them to the operator above (a window ROOT is emitted
+            # by _finish via emit_root instead)
+            from tidb_tpu.executor import device_emit
+            cols, live = self._emit(node.children[0], scan_inputs,
+                                    scan_rows)
+            out = device_emit.emit_window_cols(self._ctx(cols), live,
+                                               node, cols)
+            return out, live
+        if isinstance(node, (PhysHashAgg, PhysTopN, PhysSort, PhysWindow,
+                             PhysLimit)):
             return self._emit(node.children[0], scan_inputs, scan_rows)
         raise AssertionError(f"unexpected node {type(node).__name__}")
 

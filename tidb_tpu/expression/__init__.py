@@ -288,6 +288,11 @@ def collect_preparations(exprs: Sequence[Expression], dictionaries):
 def _rescale(xp, vals, from_scale: int, to_scale: int):
     if to_scale > from_scale:
         return vals * (10 ** (to_scale - from_scale))
+    if to_scale < from_scale:
+        # dropping digits rounds half away from zero (types/mydecimal.go
+        # Round) — CAST(1.005 AS DECIMAL(10,2)) is 1.01, not a
+        # reinterpretation of the scaled int as 10.05
+        return _half_away_div(xp, vals, 10 ** (from_scale - to_scale))
     return vals
 
 

@@ -212,6 +212,7 @@ class SelectStmt(StmtNode):
     for_update: bool = False
     # optimizer hints from /*+ ... */: [(name_lower, [args])]
     hints: List[Tuple[str, List[str]]] = field(default_factory=list)
+    rollup: bool = False                      # GROUP BY ... WITH ROLLUP
 
 
 @dataclass

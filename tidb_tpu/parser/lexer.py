@@ -25,7 +25,7 @@ KEYWORDS = {
     "charset", "collate", "comment", "replace", "ignore", "start",
     "transaction", "over", "partition", "with", "recursive", "alter", "add", "rename", "to", "column",
     "user", "grant", "grants", "revoke", "identified", "privileges",
-    "backup", "restore", "trace", "for", "of", "load", "data",
+    "backup", "restore", "trace", "for", "of", "load", "data", "rollup",
 }
 
 

@@ -411,11 +411,15 @@ class Parser:
             from_ = self.table_refs()
         where = self.expr() if self.try_kw("where") else None
         group_by: List[ast.ExprNode] = []
+        rollup = False
         if self.try_kw("group"):
             self.expect_kw("by")
             group_by.append(self.expr())
             while self.try_op(","):
                 group_by.append(self.expr())
+            if self.try_kw("with"):
+                self.expect_kw("rollup")
+                rollup = True
         having = self.expr() if self.try_kw("having") else None
         order_by = self.order_by_clause() if allow_tail else []
         limit = self.limit_clause() if allow_tail else None
@@ -425,7 +429,8 @@ class Parser:
             for_update = True
         return ast.SelectStmt(items, from_, where, group_by, having,
                                order_by, limit, distinct,
-                               for_update=for_update, hints=hints)
+                               for_update=for_update, hints=hints,
+                               rollup=rollup)
 
     def _parse_hints(self) -> List:
         """/*+ NAME(arg, ...) NAME2() ... */ → [(name_lower, [args])]

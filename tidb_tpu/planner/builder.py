@@ -1213,6 +1213,8 @@ class PlanBuilder:
                 post_rw.rewrite(e)  # registers any new agg slots
 
         plan: LogicalPlan = agg_ctx.build_node(child)
+        if sel.rollup and sel.group_by:
+            plan.rollup = True
         if having is not None:
             plan = LogicalSelection(split_conjunction(having), plan)
         return plan, proj_exprs, names, post_rw

@@ -173,17 +173,20 @@ class LogicalAggregation(LogicalPlan):
     """Output schema: group-by columns first, then aggregate results."""
 
     def __init__(self, group_exprs: List[Expression], aggs: List[AggDesc],
-                 child: LogicalPlan, group_names: Optional[List[str]] = None):
+                 child: LogicalPlan, group_names: Optional[List[str]] = None,
+                 rollup: bool = False):
         names = group_names or [f"group_{i}" for i in range(len(group_exprs))]
         cols = [SchemaColumn(n, e.ftype) for n, e in zip(names, group_exprs)]
         cols += [SchemaColumn(a.name, a.ftype) for a in aggs]
         super().__init__(Schema(cols), [child])
         self.group_exprs = group_exprs
         self.aggs = aggs
+        self.rollup = rollup       # GROUP BY ... WITH ROLLUP super-aggregates
 
     def describe(self):
         return (f"group:{self.group_exprs} "
-                f"aggs:{[(a.name, a.args) for a in self.aggs]}")
+                f"aggs:{[(a.name, a.args) for a in self.aggs]}"
+                + (" rollup" if self.rollup else ""))
 
 
 class LogicalJoin(LogicalPlan):

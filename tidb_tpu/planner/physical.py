@@ -155,14 +155,17 @@ class PhysProjection(PhysicalPlan):
 class PhysHashAgg(PhysicalPlan):
     """Two-phase segment-reduce aggregation (ref: executor/aggregate.go)."""
 
-    def __init__(self, group_exprs, aggs: List[AggDesc], schema, child):
+    def __init__(self, group_exprs, aggs: List[AggDesc], schema, child,
+                 rollup: bool = False):
         super().__init__(schema, [child])
         self.group_exprs = group_exprs
         self.aggs = aggs
+        self.rollup = rollup       # GROUP BY ... WITH ROLLUP super-aggregates
 
     def describe(self):
         return (f"group:{self.group_exprs} "
-                f"funcs:{[(a.name, a.args, a.distinct) for a in self.aggs]}")
+                f"funcs:{[(a.name, a.args, a.distinct) for a in self.aggs]}"
+                + (" rollup" if self.rollup else ""))
 
 
 class PhysHashJoin(PhysicalPlan):
@@ -829,6 +832,8 @@ def _try_stream_agg(agg: LogicalAggregation, child: PhysicalPlan,
     if len(agg.group_exprs) != 1 or not isinstance(agg.group_exprs[0],
                                                    ColumnRef):
         return None
+    if getattr(agg, "rollup", False):
+        return None                 # super-aggregate rows need the hash path
     if any(d.distinct for d in agg.aggs):
         return None
     if not isinstance(child, PhysTableScan):
@@ -1037,7 +1042,8 @@ def _to_physical(plan: LogicalPlan, ctx) -> PhysicalPlan:
     if isinstance(plan, LogicalProjection):
         return PhysProjection(plan.exprs, plan.schema, kids[0])
     if isinstance(plan, LogicalAggregation):
-        ha = PhysHashAgg(plan.group_exprs, plan.aggs, plan.schema, kids[0])
+        ha = PhysHashAgg(plan.group_exprs, plan.aggs, plan.schema, kids[0],
+                         rollup=getattr(plan, "rollup", False))
         sa = _try_stream_agg(plan, kids[0], ctx)
         if sa is None:
             return ha

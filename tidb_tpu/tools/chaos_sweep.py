@@ -1162,11 +1162,15 @@ def main(argv=None) -> int:
     # drift lints FIRST: a drifting metric name/label or a failpoint
     # site missing from the catalog fails the sweep before any scenario
     # spends wall time (tools/check_metrics.py, tools/check_failpoints.py
-    # — the latter is what keeps the coverage gate below trustworthy)
+    # — the latter is what keeps the coverage gate below trustworthy).
+    # check_coverage is the device-coverage ratchet: it replays the 22
+    # TPC-H-shaped coverage queries at small SF against COVERAGE.json,
+    # so a planner/fragment change that silently de-fuses a pinned query
+    # fails here before any chaos scenario runs.
     import importlib.util as _ilu
     _repo = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "..", "..")
-    for _tool in ("check_metrics", "check_failpoints"):
+    for _tool in ("check_metrics", "check_failpoints", "check_coverage"):
         _path = os.path.join(_repo, "tools", f"{_tool}.py")
         if not os.path.exists(_path):
             continue
